@@ -1,0 +1,106 @@
+"""Benchmark: analyzer throughput (msgs/s) on the local accelerator.
+
+Protocol: pre-materialize synthetic record batches on the host (ingest is
+benchmarked separately — the native shim's generator runs at memory
+bandwidth), then stream them through the jitted TPU backend with donated
+state, and report end-to-end messages/second over the timed window.
+
+Prints ONE JSON line:
+  {"metric": "msgs_per_sec", "value": N, "unit": "msgs/s", "vs_baseline": R}
+vs_baseline is the ratio to the reference's only published number,
+590,221 msgs/s (BASELINE.md, demo_output.png).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_MSGS_PER_SEC = 590_221.0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--partitions", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--batches", type=int, default=8,
+                    help="distinct pre-materialized batches")
+    ap.add_argument("--steps", type=int, default=64,
+                    help="timed device steps (cycling the batches)")
+    ap.add_argument("--features", default="counters,hll,quantiles",
+                    help="comma set: counters,alive,hll,quantiles")
+    ap.add_argument("--alive-bits", type=int, default=26)
+    args = ap.parse_args()
+
+    import jax
+
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend, batch_to_arrays
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+    feats = set(args.features.split(","))
+    config = AnalyzerConfig(
+        num_partitions=args.partitions,
+        batch_size=args.batch_size,
+        count_alive_keys="alive" in feats,
+        alive_bitmap_bits=args.alive_bits,
+        enable_hll="hll" in feats,
+        enable_quantiles="quantiles" in feats,
+    )
+    spec = SyntheticSpec(
+        num_partitions=args.partitions,
+        messages_per_partition=(args.batch_size * args.batches) // args.partitions,
+        keys_per_partition=200_000,
+        key_null_permille=50,
+        tombstone_permille=100,
+        value_len_min=100,
+        value_len_max=420,
+        seed=0xBEEF,
+    )
+
+    print(f"bench: device={jax.devices()[0]}", file=sys.stderr)
+    t_gen = time.perf_counter()
+    src = SyntheticSource(spec)
+    host_batches = list(src.batches(args.batch_size))
+    host_batches = [b.pad_to(args.batch_size) for b in host_batches]
+    gen_s = time.perf_counter() - t_gen
+    total_host = sum(b.num_valid for b in host_batches)
+    print(
+        f"bench: generated {total_host} records in {gen_s:.1f}s "
+        f"({total_host / gen_s:,.0f}/s host)",
+        file=sys.stderr,
+    )
+
+    backend = TpuBackend(config, init_now_s=0)
+    # Warmup: compile + first-touch.
+    backend.update(host_batches[0])
+    backend.block_until_ready()
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        backend.update(host_batches[i % len(host_batches)])
+    backend.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    n = args.steps * args.batch_size
+    msgs_per_sec = n / dt
+    metrics = backend.finalize()
+    assert int(metrics.overall_count) == n + args.batch_size  # incl. warmup
+
+    print(
+        f"bench: {n} records in {dt:.3f}s on {jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "msgs_per_sec",
+        "value": round(msgs_per_sec, 1),
+        "unit": "msgs/s",
+        "vs_baseline": round(msgs_per_sec / BASELINE_MSGS_PER_SEC, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

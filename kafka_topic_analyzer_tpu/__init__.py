@@ -1,0 +1,23 @@
+"""TPU-native Kafka topic analyzer.
+
+A brand-new framework with the capabilities of xenji/kafka-topic-analyzer
+(reference layout: ``src/{main,kafka,metric,fnv32}.rs``), redesigned TPU-first:
+
+- the reference's per-message metric accumulators (``src/metric.rs:12-26``)
+  become batched, associatively-mergeable reducer states updated by ``jax.jit``
+  kernels (`kafka_topic_analyzer_tpu.models`, `.ops`),
+- the reference's single ``poll``-loop ingestion (``src/kafka.rs:74-137``)
+  becomes a batching record pipeline with pluggable sources
+  (`kafka_topic_analyzer_tpu.io`) including a native C++ shim,
+- scale-out is data-parallel over a `jax.sharding.Mesh` with XLA collectives
+  (``psum``/``pmax``) merging per-device sketch states over ICI
+  (`kafka_topic_analyzer_tpu.parallel`),
+- the CLI surface and terminal report stay identical to the reference
+  (``src/main.rs:32-67`` and ``src/main.rs:123-179``), plus a
+  ``--backend {cpu,tpu}`` selector (`kafka_topic_analyzer_tpu.cli`).
+"""
+
+__version__ = "0.1.0"
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig  # noqa: F401
+from kafka_topic_analyzer_tpu.records import RecordBatch  # noqa: F401

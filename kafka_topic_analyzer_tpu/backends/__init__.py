@@ -1,0 +1,11 @@
+"""Metric backends.
+
+The reference's single extension seam is ``trait MetricHandler`` with one
+per-message callback (src/kafka.rs:18-20).  The TPU build widens that seam to
+a *batched* `MetricBackend`: sources feed `RecordBatch`es, the backend folds
+them into its accumulator state, and `finalize()` yields a `TopicMetrics`.
+Backends: ``cpu`` (numpy, exact oracle) and ``tpu`` (jax, single-device or
+sharded over a Mesh).
+"""
+
+from kafka_topic_analyzer_tpu.backends.base import MetricBackend, make_backend  # noqa: F401

@@ -1,0 +1,46 @@
+"""Backend protocol + factory."""
+
+from __future__ import annotations
+
+import abc
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.records import RecordBatch
+from kafka_topic_analyzer_tpu.results import TopicMetrics
+
+
+class MetricBackend(abc.ABC):
+    """Batched replacement for the reference's ``MetricHandler`` seam
+    (src/kafka.rs:18-20): updates fold whole record batches, results are read
+    once at the end.
+
+    Contract:
+    - `update` must be called with batches whose per-partition record order
+      matches offset order (records.py ordering contract);
+    - `update` may be asynchronous (device dispatch); `finalize` synchronizes
+      and returns host-side results.
+    """
+
+    def __init__(self, config: AnalyzerConfig):
+        self.config = config
+
+    @abc.abstractmethod
+    def update(self, batch: RecordBatch) -> None:
+        ...
+
+    @abc.abstractmethod
+    def finalize(self) -> TopicMetrics:
+        ...
+
+
+def make_backend(name: str, config: AnalyzerConfig) -> MetricBackend:
+    """Factory for ``--backend {cpu,tpu}`` (default cpu per BASELINE.json)."""
+    if name == "cpu":
+        from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+
+        return CpuExactBackend(config)
+    if name == "tpu":
+        from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+
+        return TpuBackend(config)
+    raise ValueError(f"unknown backend {name!r} (expected 'cpu' or 'tpu')")

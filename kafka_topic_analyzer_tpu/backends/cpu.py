@@ -1,0 +1,184 @@
+"""CPU-exact oracle backend (vectorized numpy).
+
+Reproduces the reference's accumulator semantics exactly (src/metric.rs:
+207-252 per-message update; src/metric.rs:262-305 alive-key bitset including
+fnv32 collision behavior) but over batches.  This backend is the referee for
+every TPU claim: counters must match it bit-for-bit, sketches within their
+error budget (SURVEY.md §4).
+
+It deliberately shares no array code with the TPU backend — an independent
+implementation is what makes parity tests meaningful.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.backends.base import MetricBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.records import RecordBatch
+from kafka_topic_analyzer_tpu.results import (
+    COUNTER_CHANNELS,
+    QuantileSummary,
+    TopicMetrics,
+    U64_MAX,
+)
+from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
+
+_QUANTILE_PROBS = (0.5, 0.9, 0.99)
+
+
+class CpuExactBackend(MetricBackend):
+    def __init__(self, config: AnalyzerConfig, init_now_s: "int | None" = None):
+        super().__init__(config)
+        p = config.num_partitions
+        self.per_partition = np.zeros((p, len(COUNTER_CHANNELS)), dtype=np.int64)
+        # Reference init values: earliest=now, latest=epoch, smallest=u64::MAX,
+        # largest=0 (src/metric.rs:40-43).  We keep "unset" sentinels and
+        # apply the now/epoch clamps at finalize.
+        self.init_now_s = utc_now_seconds() if init_now_s is None else init_now_s
+        self.earliest_s: "int | None" = None
+        self.latest_s: "int | None" = None
+        self.smallest: "int | None" = None
+        self.largest = 0
+        self.overall_size = 0
+        self.overall_count = 0
+        # Alive-key bitmap over fnv32 slots, packed bits (reference: BitSet).
+        self._alive_words: "np.ndarray | None" = None
+        if config.count_alive_keys:
+            nwords = 1 << max(config.alive_bitmap_bits - 5, 0)
+            self._alive_words = np.zeros(nwords, dtype=np.uint32)
+        # Exact distinct-alive/ever-seen key tracking by 64-bit hash identity
+        # (referee for the HLL sketch; collision probability ~2^-64).
+        self._seen_keys: "set[int]" = set()
+        # Exact message sizes histogram referee for quantiles: store sizes
+        # compressed as a dict size->count (sizes are small ints in practice).
+        self._size_counts: Dict[int, int] = {}
+
+    # -- update --------------------------------------------------------------
+
+    def update(self, batch: RecordBatch) -> None:
+        valid = batch.valid
+        if not valid.any():
+            return
+        part = batch.partition
+        kn = valid & ~batch.key_null
+        vn = valid & ~batch.value_null
+        tomb = valid & batch.value_null
+        knull = valid & batch.key_null
+        k_bytes = np.where(kn, batch.key_len, 0).astype(np.int64)
+        v_bytes = np.where(vn, batch.value_len, 0).astype(np.int64)
+
+        p = self.config.num_partitions
+        contrib = np.stack(
+            [
+                valid.astype(np.int64),
+                tomb.astype(np.int64),
+                vn.astype(np.int64),
+                knull.astype(np.int64),
+                kn.astype(np.int64),
+                k_bytes,
+                v_bytes,
+            ],
+            axis=1,
+        )
+        np.add.at(self.per_partition, part[valid], contrib[valid])
+
+        self.overall_count += int(valid.sum())
+        self.overall_size += int(k_bytes.sum() + v_bytes.sum())
+
+        msg_size = k_bytes + v_bytes
+        sized = vn  # min/max excludes tombstones (src/metric.rs:249-251)
+        if sized.any():
+            lo = int(msg_size[sized].min())
+            hi = int(msg_size[sized].max())
+            self.smallest = lo if self.smallest is None else min(self.smallest, lo)
+            self.largest = max(self.largest, hi)
+        ts = batch.ts_s[valid]
+        lo_t, hi_t = int(ts.min()), int(ts.max())
+        self.earliest_s = lo_t if self.earliest_s is None else min(self.earliest_s, lo_t)
+        self.latest_s = hi_t if self.latest_s is None else max(self.latest_s, hi_t)
+
+        keyed = valid & ~batch.key_null
+        if keyed.any():
+            self._seen_keys.update(batch.key_hash64[keyed].tolist())
+            if self._alive_words is not None:
+                self._update_alive_bitmap(
+                    batch.key_hash32[keyed], vn[keyed]
+                )
+        if self.config.enable_quantiles:
+            sizes, counts = np.unique(msg_size[sized], return_counts=True)
+            for s, c in zip(sizes.tolist(), counts.tolist()):
+                self._size_counts[s] = self._size_counts.get(s, 0) + c
+
+    def _update_alive_bitmap(self, h32: np.ndarray, alive: np.ndarray) -> None:
+        """Last-writer-wins per slot within the batch, then packed-bit RMW.
+
+        Semantics identical to replaying ``insert``/``remove`` in record order
+        (src/metric.rs:273-280): for each slot only its last record matters.
+        """
+        bits = self.config.alive_bitmap_bits
+        slot = (h32.astype(np.uint64) & np.uint64((1 << bits) - 1)).astype(np.int64)
+        # Last occurrence per slot: np.unique returns first occurrences, so
+        # scan the reversed array.
+        rev_slot = slot[::-1]
+        rev_alive = alive[::-1]
+        uniq, first_rev = np.unique(rev_slot, return_index=True)
+        final_alive = rev_alive[first_rev]
+        word = (uniq >> 5).astype(np.int64)
+        bit = (np.uint32(1) << (uniq & 31).astype(np.uint32)).astype(np.uint32)
+        set_w = word[final_alive]
+        set_b = bit[final_alive]
+        clr_w = word[~final_alive]
+        clr_b = bit[~final_alive]
+        np.bitwise_and.at(self._alive_words, clr_w, ~clr_b)
+        np.bitwise_or.at(self._alive_words, set_w, set_b)
+
+    # -- finalize ------------------------------------------------------------
+
+    def finalize(self) -> TopicMetrics:
+        earliest = (
+            self.init_now_s
+            if self.earliest_s is None
+            else min(self.init_now_s, self.earliest_s)
+        )
+        latest = 0 if self.latest_s is None else max(0, self.latest_s)
+        smallest = U64_MAX if self.smallest is None else self.smallest
+
+        alive_keys = None
+        if self._alive_words is not None:
+            # bitwise_count avoids unpackbits' 8x temporary (4 GiB at 2^32).
+            alive_keys = int(np.bitwise_count(self._alive_words).sum())
+        quantiles = None
+        if self.config.enable_quantiles and self._size_counts:
+            sizes = np.array(sorted(self._size_counts), dtype=np.int64)
+            counts = np.array(
+                [self._size_counts[int(s)] for s in sizes], dtype=np.int64
+            )
+            cum = np.cumsum(counts)
+            total = int(cum[-1])
+            vals = []
+            for q in _QUANTILE_PROBS:
+                rank = max(0, min(total - 1, int(np.ceil(q * total)) - 1))
+                vals.append(float(sizes[int(np.searchsorted(cum, rank + 1))]))
+            quantiles = QuantileSummary(list(_QUANTILE_PROBS), vals)
+
+        return TopicMetrics(
+            partitions=list(range(self.config.num_partitions)),
+            per_partition=self.per_partition.copy(),
+            earliest_ts_s=earliest,
+            latest_ts_s=latest,
+            smallest_message=smallest,
+            largest_message=self.largest,
+            overall_size=self.overall_size,
+            overall_count=self.overall_count,
+            alive_keys=alive_keys,
+            # Report the exact distinct count only when distinct-key counting
+            # was requested, so cpu/tpu reports stay line-compatible.
+            distinct_keys_exact=(
+                len(self._seen_keys) if self.config.enable_hll else None
+            ),
+            quantiles=quantiles,
+        )

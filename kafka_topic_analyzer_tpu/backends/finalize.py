@@ -1,0 +1,48 @@
+"""Turn a (host-fetched) AnalyzerState into TopicMetrics."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.models.message_metrics import finalize_extremes
+from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_quantiles
+from kafka_topic_analyzer_tpu.ops.hll import hll_estimate
+from kafka_topic_analyzer_tpu.results import QuantileSummary, TopicMetrics
+
+QUANTILE_PROBS = (0.5, 0.9, 0.99)
+
+
+def metrics_from_state(state, config: AnalyzerConfig, init_now_s: int) -> TopicMetrics:
+    """``state`` is an AnalyzerState whose leaves are host numpy arrays
+    (already merged across devices if the run was sharded)."""
+    m = state.metrics
+    earliest, latest, smallest = finalize_extremes(
+        int(m.earliest_s), int(m.latest_s), int(m.smallest), init_now_s
+    )
+    alive_keys = None
+    if state.alive is not None:
+        words = np.asarray(state.alive.words)
+        alive_keys = int(np.bitwise_count(words).sum())
+    hll = None
+    if state.hll is not None:
+        hll = hll_estimate(np.asarray(state.hll.regs))
+    quantiles = None
+    if state.quantiles is not None:
+        vals = ddsketch_quantiles(
+            np.asarray(state.quantiles.counts), QUANTILE_PROBS, config.quantile_gamma
+        )
+        quantiles = QuantileSummary(list(QUANTILE_PROBS), vals)
+    return TopicMetrics(
+        partitions=list(range(config.num_partitions)),
+        per_partition=np.asarray(m.per_partition),
+        earliest_ts_s=earliest,
+        latest_ts_s=latest,
+        smallest_message=smallest,
+        largest_message=int(m.largest),
+        overall_size=int(m.overall_size),
+        overall_count=int(m.overall_count),
+        alive_keys=alive_keys,
+        distinct_keys_hll=hll,
+        quantiles=quantiles,
+    )

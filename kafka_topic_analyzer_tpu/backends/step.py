@@ -1,0 +1,109 @@
+"""The pure analyzer step: fold one record batch into the analyzer state.
+
+This is the device-side computation shared by the single-device TPU backend
+(jitted directly) and the sharded backend (wrapped in ``shard_map`` —
+parallel/sharded.py).  It is a pure function of (state, batch arrays) with
+the config captured statically, so each feature combination compiles once.
+
+It replaces the reference's hot loop body (src/kafka.rs:98-133 fanning out to
+``handle_message`` per message) with a handful of fused batched reductions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState, HLLState
+from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState
+from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState
+from kafka_topic_analyzer_tpu.models.state import AnalyzerState
+from kafka_topic_analyzer_tpu.jax_support import jnp
+from kafka_topic_analyzer_tpu.ops.bitmap import bitmap_update
+from kafka_topic_analyzer_tpu.ops.counters import counters_update, extremes_update
+from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_update
+from kafka_topic_analyzer_tpu.ops.hll import hll_update
+
+
+def analyzer_step(
+    state: AnalyzerState,
+    arrays: Dict[str, "jnp.ndarray"],
+    config: AnalyzerConfig,
+    space_index=0,
+) -> AnalyzerState:
+    valid = arrays["valid"]
+    key_null = arrays["key_null"]
+    value_null = arrays["value_null"]
+    key_len = arrays["key_len"]
+    value_len = arrays["value_len"]
+
+    m = state.metrics
+    per_partition = counters_update(
+        m.per_partition,
+        arrays["partition"],
+        key_len,
+        value_len,
+        key_null,
+        value_null,
+        valid,
+        config.num_partitions,
+    )
+    earliest, latest, smallest, largest = extremes_update(
+        m.earliest_s,
+        m.latest_s,
+        m.smallest,
+        m.largest,
+        key_len,
+        value_len,
+        key_null,
+        value_null,
+        arrays["ts_s"],
+        valid,
+    )
+    kn = valid & ~key_null
+    vn = valid & ~value_null
+    k_bytes = jnp.where(kn, key_len, 0).astype(jnp.int64)
+    v_bytes = jnp.where(vn, value_len, 0).astype(jnp.int64)
+    metrics = MessageMetricsState(
+        per_partition=per_partition,
+        earliest_s=earliest,
+        latest_s=latest,
+        smallest=smallest,
+        largest=largest,
+        overall_size=m.overall_size + jnp.sum(k_bytes + v_bytes),
+        overall_count=m.overall_count + jnp.sum(valid.astype(jnp.int64)),
+    )
+
+    alive_state = state.alive
+    if alive_state is not None:
+        words = bitmap_update(
+            alive_state.words,
+            arrays["key_hash32"],
+            alive=vn,
+            active=kn,
+            bits=config.alive_bitmap_bits,
+            space_index=space_index,
+            space_shards=config.space_shards,
+        )
+        alive_state = AliveBitmapState(words=words)
+
+    hll_state = state.hll
+    if hll_state is not None:
+        regs = hll_update(hll_state.regs, arrays["key_hash64"], kn, config.hll_p)
+        hll_state = HLLState(regs=regs)
+
+    q_state = state.quantiles
+    if q_state is not None:
+        msg_size = k_bytes + v_bytes
+        counts = ddsketch_update(
+            q_state.counts,
+            msg_size,
+            vn,  # quantiles over sized (non-tombstone) messages, like min/max
+            config.quantile_gamma,
+            config.quantile_buckets,
+        )
+        q_state = DDSketchState(counts=counts)
+
+    return AnalyzerState(
+        metrics=metrics, alive=alive_state, hll=hll_state, quantiles=q_state
+    )

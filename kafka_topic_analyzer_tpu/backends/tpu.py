@@ -1,0 +1,82 @@
+"""TPU backend: jitted streaming reduction with donated device state.
+
+Design (SURVEY.md §7 M3):
+- the accumulator state lives on device for the whole scan; each `update`
+  dispatches one jitted step with the state buffers *donated*, so XLA updates
+  them in place and the host never round-trips the state (hard part (e));
+- dispatch is asynchronous — the host thread returns immediately and keeps
+  feeding batches while the device works; `finalize` synchronizes once;
+- batches are padded to the static batch size, so every step hits the same
+  compiled executable.
+
+Multi-device runs go through `kafka_topic_analyzer_tpu.parallel.sharded`
+(same step function under ``shard_map``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from kafka_topic_analyzer_tpu.backends.base import MetricBackend
+from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
+from kafka_topic_analyzer_tpu.backends.step import analyzer_step
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.models.state import AnalyzerState
+from kafka_topic_analyzer_tpu.records import RecordBatch
+from kafka_topic_analyzer_tpu.results import TopicMetrics
+from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
+
+#: RecordBatch fields shipped to the device, in a fixed order.
+DEVICE_FIELDS = (
+    "partition",
+    "key_len",
+    "value_len",
+    "key_null",
+    "value_null",
+    "ts_s",
+    "key_hash32",
+    "key_hash64",
+    "valid",
+)
+
+
+def batch_to_arrays(batch: RecordBatch, batch_size: int):
+    batch = batch.pad_to(batch_size)
+    return {name: getattr(batch, name) for name in DEVICE_FIELDS}
+
+
+class TpuBackend(MetricBackend):
+    def __init__(
+        self,
+        config: AnalyzerConfig,
+        init_now_s: "int | None" = None,
+        device=None,
+    ):
+        super().__init__(config)
+        self.init_now_s = utc_now_seconds() if init_now_s is None else init_now_s
+        self.device = device if device is not None else jax.devices()[0]
+        with jax.default_device(self.device):
+            self.state = AnalyzerState.init(config)
+        self._step = jax.jit(
+            functools.partial(analyzer_step, config=config),
+            donate_argnums=(0,),
+        )
+        self.batches_seen = 0
+
+    def update(self, batch: RecordBatch) -> None:
+        arrays = batch_to_arrays(batch, self.config.batch_size)
+        arrays = {
+            k: jax.device_put(v, self.device) for k, v in arrays.items()
+        }
+        self.state = self._step(self.state, arrays)
+        self.batches_seen += 1
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self.state)
+
+    def finalize(self) -> TopicMetrics:
+        host_state = jax.tree.map(np.asarray, jax.device_get(self.state))
+        return metrics_from_state(host_state, self.config, self.init_now_s)

@@ -1,0 +1,196 @@
+"""CLI — the reference's flag surface plus TPU-build extensions.
+
+Reference-compatible flags (src/main.rs:32-67): ``-t/--topic`` (required),
+``-b/--bootstrap-server`` (comma separated), ``--librdkafka`` (comma-separated
+``k=v`` passthrough into the consumer config), ``-c/--count-alive-keys``.
+Extensions: ``--backend {cpu,tpu}`` (default cpu per BASELINE.json),
+``--source``, sketch/batch/mesh knobs.  Exit code -2 on an empty topic
+(src/main.rs:98-101).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, Optional
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+
+
+def parse_kv_pairs(text: Optional[str]) -> Dict[str, str]:
+    """Parse ``"a=b,c=d"`` exactly like src/main.rs:84-92."""
+    if not text:
+        return {}
+    out: Dict[str, str] = {}
+    for pair in text.split(","):
+        k, _, v = pair.partition("=")
+        out[k] = v
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kafka-topic-analyzer",
+        description="An analyzer for getting metrics about the contents of an "
+        "Apache Kafka topic (TPU-native rebuild)",
+    )
+    # --- reference-compatible surface (src/main.rs:32-67) -------------------
+    p.add_argument("-t", "--topic", required=True, metavar="TOPIC",
+                   help="The topic to analyze")
+    p.add_argument("-b", "--bootstrap-server", metavar="BOOTSTRAP_SERVER",
+                   help="Bootstrap server(s) to work with, comma separated")
+    p.add_argument("--librdkafka", metavar="LIBRDKAFKA",
+                   help="Options to pass into the underlying consumer, comma "
+                        "separated key=value pairs")
+    p.add_argument("-c", "--count-alive-keys", action="store_true",
+                   help="Counts the effective number of alive keys in a log "
+                        "compacted topic. A key is 'alive' when it is present "
+                        "and has a non-null value in its latest-offset version")
+    # --- TPU-build extensions ----------------------------------------------
+    p.add_argument("--backend", choices=["cpu", "tpu"], default="cpu",
+                   help="Metric backend: numpy exact oracle (cpu) or JAX "
+                        "streaming reducers (tpu). Default: cpu")
+    p.add_argument("--source", choices=["kafka", "synthetic", "segfile"],
+                   default="kafka",
+                   help="Record source. 'kafka' reads the real topic via the "
+                        "wire protocol; 'synthetic'/'segfile' are cluster-free")
+    p.add_argument("--synthetic", metavar="SPEC",
+                   help="Synthetic workload spec, comma separated k=v: "
+                        "partitions,messages,keys,key_null,tombstones,vmin,"
+                        "vmax,seed")
+    p.add_argument("--segment-dir", metavar="DIR",
+                   help="Directory of .ktaseg segment dumps (--source segfile)")
+    p.add_argument("--batch-size", type=int, default=1 << 18,
+                   help="Records per device step")
+    p.add_argument("--alive-bitmap-bits", type=int, default=32,
+                   help="log2 of alive-key bitmap slots (32 = reference-exact)")
+    p.add_argument("--distinct-keys", action="store_true",
+                   help="Also estimate distinct keys with a HyperLogLog sketch")
+    p.add_argument("--quantiles", action="store_true",
+                   help="Also compute message-size quantiles (DDSketch)")
+    p.add_argument("--mesh", metavar="DATA[,SPACE]", default="1",
+                   help="Device mesh shape: data shards[, space shards]")
+    p.add_argument("--native", choices=["auto", "on", "off"], default="auto",
+                   help="Use the native C++ ingest shim when available")
+    p.add_argument("--profile-dir", metavar="DIR",
+                   help="Write a JAX profiler trace of the scan")
+    p.add_argument("--quiet", action="store_true", help="No progress spinner")
+    return p
+
+
+def parse_mesh(text: str) -> "tuple[int, int]":
+    parts = [int(x) for x in text.split(",") if x]
+    if len(parts) == 1:
+        return (parts[0], 1)
+    if len(parts) == 2:
+        return (parts[0], parts[1])
+    raise ValueError(f"bad --mesh {text!r}")
+
+
+def make_source(args) -> "object":
+    if args.source == "synthetic":
+        from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+        kv = parse_kv_pairs(args.synthetic)
+        seed_raw = kv.get("seed")
+        spec = SyntheticSpec(
+            num_partitions=int(kv.get("partitions", 1)),
+            messages_per_partition=int(kv.get("messages", 1_000_000)),
+            keys_per_partition=int(kv.get("keys", 10_000)),
+            key_null_permille=int(kv.get("key_null", 50)),
+            tombstone_permille=int(kv.get("tombstones", 100)),
+            value_len_min=int(kv.get("vmin", 100)),
+            value_len_max=int(kv.get("vmax", 400)),
+            seed=int(seed_raw, 0) if seed_raw is not None else 0x5EED,
+        )
+        use_native = args.native in ("auto", "on")
+        if use_native:
+            try:
+                from kafka_topic_analyzer_tpu.io.native import NativeSyntheticSource
+
+                return NativeSyntheticSource(spec)
+            except Exception:
+                if args.native == "on":
+                    raise
+        return SyntheticSource(spec)
+    if args.source == "segfile":
+        if not args.segment_dir:
+            raise SystemExit("--source segfile requires --segment-dir")
+        from kafka_topic_analyzer_tpu.io.segfile import SegmentFileSource
+
+        return SegmentFileSource(args.segment_dir, topic=args.topic)
+    # kafka
+    if not args.bootstrap_server:
+        raise SystemExit("--source kafka requires -b/--bootstrap-server")
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+    return KafkaWireSource(
+        bootstrap_servers=args.bootstrap_server,
+        topic=args.topic,
+        overrides=parse_kv_pairs(args.librdkafka),
+    )
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = build_parser().parse_args(argv)
+    source = make_source(args)
+
+    # Empty-topic guard: exit(-2) like src/main.rs:98-101.
+    if source.is_empty():
+        print(
+            "Given topic has no content, no analysis possible. Exiting.",
+            file=sys.stderr,
+        )
+        sys.exit(-2)
+
+    mesh_shape = parse_mesh(args.mesh)
+    config = AnalyzerConfig(
+        num_partitions=len(source.partitions()),
+        batch_size=args.batch_size,
+        count_alive_keys=args.count_alive_keys,
+        alive_bitmap_bits=args.alive_bitmap_bits,
+        enable_hll=args.distinct_keys,
+        enable_quantiles=args.quantiles,
+        mesh_shape=mesh_shape,
+    )
+
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.report import render_report
+    from kafka_topic_analyzer_tpu.utils.profiling import maybe_jax_trace
+    from kafka_topic_analyzer_tpu.utils.progress import Spinner
+
+    if args.backend == "tpu" and mesh_shape != (1, 1):
+        from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+        backend = ShardedTpuBackend(config)
+    else:
+        from kafka_topic_analyzer_tpu.backends.base import make_backend
+
+        backend = make_backend(args.backend, config)
+
+    print(f"Subscribing to {args.topic}")
+    print("Starting message consumption...")
+    with maybe_jax_trace(args.profile_dir):
+        result = run_scan(
+            args.topic,
+            source,
+            backend,
+            batch_size=args.batch_size,
+            spinner=Spinner(enabled=not args.quiet),
+        )
+
+    sys.stdout.write(
+        render_report(
+            args.topic,
+            result.metrics,
+            result.start_offsets,
+            result.end_offsets,
+            result.duration_secs,
+            show_alive_keys=args.count_alive_keys,
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Analyzer configuration.
+
+The reference has no config system beyond four CLI flags and a ``--librdkafka``
+key=value escape hatch (``src/main.rs:32-67``, SURVEY.md §5.6).  The TPU build
+needs a few more knobs (batch shape, sketch precisions, mesh layout); they all
+live here as one frozen dataclass so every layer — CLI, backends, parallel —
+shares a single source of truth and jitted functions can treat it as static.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalyzerConfig:
+    """Static configuration for one analysis run.
+
+    Anything that changes the compiled XLA program (shapes, enabled sketches,
+    precisions) belongs here; runtime data (offsets, records) does not.
+    """
+
+    # --- topology -----------------------------------------------------------
+    #: Number of Kafka partitions in the topic (P).  Static: it fixes the
+    #: shape of the per-partition counter matrix (reference keeps HashMaps
+    #: keyed by partition id instead, src/metric.rs:12-19).
+    num_partitions: int = 1
+    #: Records per device step (B).  The last batch is padded with
+    #: ``valid=False`` records (XLA static shapes; SURVEY.md §7 hard part (b)).
+    batch_size: int = 1 << 16
+
+    # --- feature toggles (each adds state + kernels to the jitted update) ---
+    #: Reference-compatible alive-key bitmap (``-c`` flag; src/metric.rs:262-305).
+    count_alive_keys: bool = False
+    #: log2 of the bitmap slot space.  The reference's fnv32 hash gives 2^32
+    #: slots (512 MiB of packed bits); smaller values trade memory for extra
+    #: collisions.  Hashes are masked to this width.
+    alive_bitmap_bits: int = 32
+    #: HyperLogLog distinct-key sketch (new capability; replaces the bitmap's
+    #: O(2^bits) memory with O(2^hll_p) at ~1.04/sqrt(2^hll_p) rel. error).
+    enable_hll: bool = False
+    #: HLL precision p (m = 2^p registers). p=14 → 0.81% standard error.
+    hll_p: int = 14
+    #: DDSketch message-size quantiles (new capability).
+    enable_quantiles: bool = False
+    #: DDSketch relative accuracy alpha (gamma = (1+a)/(1-a)).
+    quantile_alpha: float = 0.005
+    #: Number of log-gamma buckets (covers sizes up to gamma^nbuckets).
+    quantile_buckets: int = 2560
+
+    # --- parallelism --------------------------------------------------------
+    #: Device mesh shape (data, space).  'data' shards record batches by
+    #: partition; 'space' shards the alive-bitmap slot space.  (1, 1) runs
+    #: single-device.  See kafka_topic_analyzer_tpu/parallel/.
+    mesh_shape: Tuple[int, int] = (1, 1)
+
+    def __post_init__(self) -> None:
+        if self.num_partitions < 1:
+            raise ValueError("num_partitions must be >= 1")
+        if self.batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        if not (0 < self.alive_bitmap_bits <= 32):
+            raise ValueError("alive_bitmap_bits must be in (0, 32]")
+        if not (4 <= self.hll_p <= 18):
+            raise ValueError("hll_p must be in [4, 18]")
+        if self.quantile_buckets < 8:
+            raise ValueError("quantile_buckets must be >= 8")
+
+    @property
+    def hll_m(self) -> int:
+        return 1 << self.hll_p
+
+    @property
+    def quantile_gamma(self) -> float:
+        a = self.quantile_alpha
+        return (1.0 + a) / (1.0 - a)
+
+    @property
+    def data_shards(self) -> int:
+        return self.mesh_shape[0]
+
+    @property
+    def space_shards(self) -> int:
+        return self.mesh_shape[1]

@@ -1,0 +1,134 @@
+"""Scan orchestration — the analog of ``main``'s wiring + read loop.
+
+Replaces src/main.rs:69-121 (build analyzer → snapshot offsets → empty guard
+→ register handlers → scan → report) with: build source → snapshot
+watermarks → empty guard → build backend → batched scan → finalize → report.
+
+Partition ids need not be dense (the reference keeps HashMaps keyed by id);
+the engine remaps true ids to dense row indices before batches reach the
+backend and maps them back in the result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.backends.base import MetricBackend
+from kafka_topic_analyzer_tpu.io.source import RecordSource
+from kafka_topic_analyzer_tpu.records import RecordBatch
+from kafka_topic_analyzer_tpu.results import TopicMetrics
+from kafka_topic_analyzer_tpu.utils.profiling import ScanProfile
+from kafka_topic_analyzer_tpu.utils.progress import Spinner
+from kafka_topic_analyzer_tpu.utils.timefmt import format_utc_seconds
+
+
+class PartitionIndex:
+    """Bidirectional map between true partition ids and dense row indices."""
+
+    def __init__(self, partition_ids: "list[int]"):
+        self.ids = sorted(partition_ids)
+        self._sorted = np.array(self.ids, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def to_dense(self, partition: np.ndarray) -> np.ndarray:
+        return np.searchsorted(self._sorted, partition).astype(np.int32)
+
+    def remap_batch(self, batch: RecordBatch) -> RecordBatch:
+        if self.ids == list(range(len(self.ids))):
+            return batch  # already dense
+        batch.partition = self.to_dense(batch.partition)
+        return batch
+
+
+@dataclasses.dataclass
+class ScanResult:
+    metrics: TopicMetrics
+    duration_secs: int
+    profile: ScanProfile
+    start_offsets: "dict[int, int]"
+    end_offsets: "dict[int, int]"
+
+
+def run_scan(
+    topic: str,
+    source: RecordSource,
+    backend: MetricBackend,
+    batch_size: int,
+    spinner: Optional[Spinner] = None,
+) -> ScanResult:
+    """Full earliest→latest scan of the topic through the backend."""
+    pindex = PartitionIndex(source.partitions())
+    start_offsets, end_offsets = source.watermarks()
+    profile = ScanProfile()
+    spinner = spinner or Spinner(enabled=False)
+    t0 = time.monotonic()
+    seq = 0
+
+    if hasattr(backend, "update_shards"):
+        # Sharded scan: one batch stream per data shard, each restricted to
+        # its own partitions (records.py ordering contract), zipped so every
+        # device step carries one full batch per shard.
+        from kafka_topic_analyzer_tpu.parallel.mesh import assign_partitions
+
+        d = backend.config.data_shards
+        shard_parts = assign_partitions(pindex.ids, d)
+        iters = [
+            source.batches(batch_size, partitions=parts) if parts else iter(())
+            for parts in shard_parts
+        ]
+        alive = [True] * d
+        while any(alive):
+            shard_batches: "list[RecordBatch | None]" = []
+            step_valid = 0
+            with profile.stage("ingest"):
+                for i, it in enumerate(iters):
+                    b = next(it, None) if alive[i] else None
+                    if b is None:
+                        alive[i] = False
+                    else:
+                        step_valid += b.num_valid
+                        b = pindex.remap_batch(b)
+                    shard_batches.append(b)
+            if step_valid == 0 and not any(alive):
+                break
+            with profile.stage("dispatch", items=step_valid):
+                backend.update_shards(shard_batches)
+            seq += step_valid
+            spinner.set_message(f"[Sq: {seq} | T: {topic} | shards: {d}]")
+    else:
+        batches = source.batches(batch_size)
+        while True:
+            with profile.stage("ingest"):
+                batch = next(batches, None)
+            if batch is None:
+                break
+            nvalid = batch.num_valid
+            last = len(batch) - 1
+            last_partition = int(batch.partition[last])  # true id, pre-remap
+            batch = pindex.remap_batch(batch)
+            with profile.stage("dispatch", items=nvalid, nbytes=batch.nbytes):
+                backend.update(batch)
+            seq += nvalid
+            spinner.set_message(
+                f"[Sq: {seq} | T: {topic} | P: {last_partition} | "
+                f"O: ~ | Ts: {format_utc_seconds(int(batch.ts_s[last]))}]"
+            )
+
+    with profile.stage("finalize"):
+        metrics = backend.finalize()
+    metrics.partitions = pindex.ids
+    spinner.finish_with_message("done")
+    duration_secs = int(time.monotonic() - t0)
+    return ScanResult(
+        metrics=metrics,
+        duration_secs=duration_secs,
+        profile=profile,
+        start_offsets=start_offsets,
+        end_offsets=end_offsets,
+    )

@@ -1,0 +1,14 @@
+"""Record sources (ingestion layer).
+
+The reference's ingestion is a single librdkafka consumer polled one message
+at a time (src/kafka.rs:74-137).  Here ingestion is a `RecordSource` that
+yields pre-extracted `RecordBatch`es:
+
+- `SyntheticSource` — deterministic counter-based workload generator
+  (numpy, mirrored bit-for-bit by the native C++ shim);
+- `SegmentFileSource` — reads the on-disk segment dump format;
+- `KafkaWireSource` — speaks the Kafka wire protocol directly.
+"""
+
+from kafka_topic_analyzer_tpu.io.source import RecordSource  # noqa: F401
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec  # noqa: F401

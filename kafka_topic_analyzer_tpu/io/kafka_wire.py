@@ -1,0 +1,18 @@
+"""Kafka wire-protocol source.
+
+Implementation lands with the ingestion milestone (SURVEY.md §7 M2): a
+from-scratch client for ApiVersions/Metadata/ListOffsets/Fetch with
+RecordBatch v2 decoding, replacing the reference's librdkafka dependency
+(src/kafka.rs:23-54).  Until then, constructing it reports the gap cleanly
+instead of a ModuleNotFoundError.
+"""
+
+from __future__ import annotations
+
+
+class KafkaWireSource:  # pragma: no cover - placeholder until M2 lands
+    def __init__(self, bootstrap_servers: str, topic: str, overrides=None):
+        raise SystemExit(
+            "the kafka wire-protocol source is not available yet in this "
+            "build — use --source synthetic or --source segfile"
+        )

@@ -1,0 +1,17 @@
+"""Segment-dump file source (.ktaseg).
+
+Implementation lands with the ingestion milestone (SURVEY.md §7 M2): a
+binary on-disk record-metadata format written once and scanned at memory
+bandwidth by the native C++ shim.  Until then, constructing it reports the
+gap cleanly instead of a ModuleNotFoundError.
+"""
+
+from __future__ import annotations
+
+
+class SegmentFileSource:  # pragma: no cover - placeholder until M2 lands
+    def __init__(self, segment_dir: str, topic: str = ""):
+        raise SystemExit(
+            "the segment-file source is not available yet in this build — "
+            "use --source synthetic"
+        )

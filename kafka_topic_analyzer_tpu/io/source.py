@@ -1,0 +1,48 @@
+"""RecordSource protocol — the ingestion seam.
+
+Mirrors the reference's topology handshake (``get_topic_offsets``,
+src/kafka.rs:60-72: metadata + per-partition watermarks fixed at scan start)
+followed by a full earliest→latest read, but batched: a source yields
+`RecordBatch`es instead of single messages, and can be asked to restrict
+itself to a subset of partitions (one data shard's slice — records.py
+ordering contract).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+
+class RecordSource(abc.ABC):
+    @abc.abstractmethod
+    def partitions(self) -> List[int]:
+        """Sorted partition ids (src/main.rs:103-106 sorts them too)."""
+
+    @abc.abstractmethod
+    def watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        """(start_offsets, end_offsets) snapshot — the termination contract:
+        the scan covers exactly [start, end) per partition as of now
+        (src/kafka.rs:60-72, :119-121)."""
+
+    @abc.abstractmethod
+    def batches(
+        self,
+        batch_size: int,
+        partitions: Optional[List[int]] = None,
+    ) -> Iterator[RecordBatch]:
+        """Yield batches covering [start, end) for the given partitions (all
+        by default), per-partition offset order, batches not padded (the
+        backend pads)."""
+
+    def total_records(self) -> int:
+        start, end = self.watermarks()
+        return sum(end[p] - start[p] for p in end)
+
+    def is_empty(self) -> bool:
+        """True when every end offset is 0 — the reference exits ``-2``
+        (src/main.rs:98-101)."""
+        _, end = self.watermarks()
+        return all(v == 0 for v in end.values())

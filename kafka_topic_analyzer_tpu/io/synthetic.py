@@ -1,0 +1,163 @@
+"""Deterministic synthetic topic generator (counter-based RNG).
+
+Benchmark and test workloads (BASELINE.json configs) need reproducible topics
+without a live cluster.  Every field of record ``(partition p, offset o)`` is
+derived from ``x = splitmix64(seed ^ (p << 40) ^ o)`` with pure integer
+bit-fiddling — no stateful RNG — so the generator is:
+
+- order-independent (any shard can generate any slice),
+- trivially vectorizable in numpy,
+- mirrored bit-for-bit by the native C++ shim (native/ingest.cpp), which the
+  parity tests assert.
+
+Key scheme: keys are fixed-width decimal strings ``k%0*d`` of a *per-partition
+disjoint* key id (``key_id = p + P * local``), matching Kafka's invariant that
+a key lives in exactly one partition — which is what makes per-shard
+last-writer-wins alive tracking exact (records.py ordering contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.io.source import RecordSource
+from kafka_topic_analyzer_tpu.ops.fnv import (
+    fnv1a32_ref_batch,
+    fnv1a64_batch,
+    splitmix64_np,
+)
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticSpec:
+    num_partitions: int = 1
+    messages_per_partition: int = 1_000_000
+    #: Distinct keys *per partition* (key ids are partition-disjoint).
+    keys_per_partition: int = 10_000
+    #: Per-mille of records with a null key.
+    key_null_permille: int = 50
+    #: Per-mille of records with a null value (tombstones).
+    tombstone_permille: int = 100
+    value_len_min: int = 100
+    value_len_max: int = 400
+    #: Fixed decimal width of the key id inside the key string "k%0*d".
+    key_digits: int = 11
+    ts_start_ms: int = 1_600_000_000_000
+    ts_step_ms: int = 1
+    seed: int = 0x5EED
+
+    @property
+    def key_len(self) -> int:
+        return 1 + self.key_digits
+
+    def describe(self) -> str:
+        return (
+            f"synthetic(P={self.num_partitions}, N/p={self.messages_per_partition}, "
+            f"K/p={self.keys_per_partition}, seed={self.seed:#x})"
+        )
+
+
+def synth_fields(
+    spec: SyntheticSpec, partition: np.ndarray, offset: np.ndarray
+) -> Dict[str, np.ndarray]:
+    """Vectorized field derivation for records (partition[i], offset[i]).
+
+    The exact bit-field layout below is the generator's wire contract; the
+    C++ mirror in native/ingest.cpp implements the same expressions.
+    """
+    p64 = partition.astype(np.uint64)
+    o64 = offset.astype(np.uint64)
+    x = splitmix64_np(np.uint64(spec.seed) ^ (p64 << np.uint64(40)) ^ o64)
+
+    key_null = (x % np.uint64(1000)).astype(np.int64) < spec.key_null_permille
+    value_null = (
+        ((x >> np.uint64(10)) % np.uint64(1000)).astype(np.int64)
+        < spec.tombstone_permille
+    )
+    local = ((x >> np.uint64(20)) % np.uint64(spec.keys_per_partition)).astype(
+        np.uint64
+    )
+    key_id = p64 + np.uint64(spec.num_partitions) * local
+    vspread = np.uint64(spec.value_len_max - spec.value_len_min + 1)
+    value_len = (
+        spec.value_len_min + ((x >> np.uint64(40)) % vspread).astype(np.int64)
+    ).astype(np.int32)
+    value_len = np.where(value_null, 0, value_len).astype(np.int32)
+
+    ts_ms = np.int64(spec.ts_start_ms) + offset.astype(np.int64) * np.int64(
+        spec.ts_step_ms
+    )
+    ts_s = ts_ms // 1000  # second granularity, like src/metric.rs:209-211
+
+    # Key bytes: b"k" + fixed-width decimal of key_id.
+    n = partition.shape[0]
+    padded = np.zeros((n, spec.key_len), dtype=np.uint8)
+    padded[:, 0] = ord("k")
+    rem = key_id.copy()
+    for d in range(spec.key_digits - 1, -1, -1):
+        padded[:, 1 + d] = (rem % np.uint64(10)).astype(np.uint8) + ord("0")
+        rem //= np.uint64(10)
+    lengths = np.full(n, spec.key_len, dtype=np.int64)
+    h32 = fnv1a32_ref_batch(padded, lengths)
+    h64 = fnv1a64_batch(padded, lengths)
+
+    key_len = np.where(key_null, 0, spec.key_len).astype(np.int32)
+    h32 = np.where(key_null, np.uint32(0), h32)
+    h64 = np.where(key_null, np.uint64(0), h64)
+
+    return {
+        "partition": partition.astype(np.int32),
+        "key_len": key_len,
+        "value_len": value_len,
+        "key_null": key_null,
+        "value_null": value_null,
+        "ts_s": ts_s,
+        "key_hash32": h32,
+        "key_hash64": h64,
+        "valid": np.ones(n, dtype=np.bool_),
+    }
+
+
+def synth_key_bytes(spec: SyntheticSpec, key_id: int) -> bytes:
+    """Scalar reference for tests: the key byte string for a key id."""
+    return b"k" + str(key_id).zfill(spec.key_digits).encode()
+
+
+class SyntheticSource(RecordSource):
+    """Round-robin multiplex of the partitions, like a balanced consumer:
+    global index ``g`` maps to partition ``S[g % |S|]`` at offset
+    ``g // |S|`` — per-partition offset order by construction."""
+
+    def __init__(self, spec: SyntheticSpec):
+        self.spec = spec
+
+    def partitions(self) -> List[int]:
+        return list(range(self.spec.num_partitions))
+
+    def watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        start = {p: 0 for p in self.partitions()}
+        end = {p: self.spec.messages_per_partition for p in self.partitions()}
+        return start, end
+
+    def batches(
+        self,
+        batch_size: int,
+        partitions: Optional[List[int]] = None,
+    ) -> Iterator[RecordBatch]:
+        parts = np.array(
+            sorted(partitions) if partitions is not None else self.partitions(),
+            dtype=np.int64,
+        )
+        s = len(parts)
+        if s == 0:
+            return
+        total = self.spec.messages_per_partition * s
+        for lo in range(0, total, batch_size):
+            g = np.arange(lo, min(lo + batch_size, total), dtype=np.int64)
+            partition = parts[g % s]
+            offset = g // s
+            yield RecordBatch(**synth_fields(self.spec, partition, offset))

@@ -1,0 +1,17 @@
+"""Central jax import + configuration.
+
+Every device-side module imports jax through here so that 64-bit integer
+support is enabled exactly once, before any tracing happens.  The analyzer's
+accumulators are genuinely 64-bit (byte sums over billions of records exceed
+2^32; the reference uses ``u64`` throughout, src/metric.rs:12-26), so we
+enable ``jax_enable_x64`` globally.  Per-record *contributions* stay int32
+where possible to keep the TPU hot path cheap; only the accumulator state is
+64-bit.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402,F401
+from jax import lax  # noqa: E402,F401

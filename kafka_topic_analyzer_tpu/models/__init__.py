@@ -1,0 +1,14 @@
+"""Metric state models.
+
+Each model owns a fixed-shape, associatively-mergeable accumulator state (a
+jax pytree) plus init/finalize logic.  The pure batch-update kernels live in
+`kafka_topic_analyzer_tpu.ops`; backends wire models and ops together.  This
+mirrors the reference's split between metric state (``src/metric.rs:12-26``)
+and its per-message update (``src/metric.rs:206-253``) — with the update
+re-shaped from per-message virtual dispatch into batched reductions.
+"""
+
+from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState  # noqa: F401
+from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState, HLLState  # noqa: F401
+from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState  # noqa: F401
+from kafka_topic_analyzer_tpu.models.state import AnalyzerState  # noqa: F401

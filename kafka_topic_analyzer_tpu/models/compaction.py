@@ -1,0 +1,49 @@
+"""Alive-key tracking states for log-compacted topics (``-c`` flag).
+
+Two models, selected by config:
+
+- `AliveBitmapState` — reference-compatible: packed bits over the fnv32 slot
+  space, identical collision semantics to ``LogCompactionInMemoryMetrics``'s
+  ``BitSet`` (src/metric.rs:262-305) when ``alive_bitmap_bits=32``.  2^32
+  slots = 512 MiB of HBM; optionally sharded over the mesh's 'space' axis.
+- `HLLState` — sketch of *distinct keys ever seen* (insertions only; an HLL
+  cannot observe deletions, so it reports key cardinality, not aliveness —
+  the right tool for BASELINE.json config 3's 50M-key distinct count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.jax_support import jnp
+from kafka_topic_analyzer_tpu.ops.bitmap import bitmap_num_words
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AliveBitmapState:
+    words: jax.Array  # uint32[W] packed bits (this shard's slot range)
+
+    @classmethod
+    def init(cls, config: AnalyzerConfig) -> "AliveBitmapState":
+        w = bitmap_num_words(config.alive_bitmap_bits, config.space_shards)
+        return cls(words=jnp.zeros((w,), dtype=jnp.uint32))
+
+    def merge(self, other: "AliveBitmapState") -> "AliveBitmapState":
+        return AliveBitmapState(words=self.words | other.words)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class HLLState:
+    regs: jax.Array  # int32[2^p]
+
+    @classmethod
+    def init(cls, config: AnalyzerConfig) -> "HLLState":
+        return cls(regs=jnp.zeros((config.hll_m,), dtype=jnp.int32))
+
+    def merge(self, other: "HLLState") -> "HLLState":
+        return HLLState(regs=jnp.maximum(self.regs, other.regs))

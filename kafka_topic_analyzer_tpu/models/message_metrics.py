@@ -1,0 +1,81 @@
+"""Exact counter state — the TPU analog of ``MessageMetrics``.
+
+State layout (vs. the reference's seven ``HashMap<i32, u64>`` buckets plus
+six globals, src/metric.rs:12-26): one dense ``int64[P, 7]`` matrix (channel
+order ``results.COUNTER_CHANNELS``) plus six int64 scalars.  Everything is
+exact integer arithmetic — no sketching — and every field merges
+associatively (sums add; extremes min/max), which is what makes the state
+shardable across devices with ``psum``/``pmin``/``pmax``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.jax_support import jnp
+from kafka_topic_analyzer_tpu.ops.counters import I64_MAX, I64_MIN
+from kafka_topic_analyzer_tpu.results import U64_MAX
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MessageMetricsState:
+    per_partition: jax.Array  # int64[P, 7]
+    earliest_s: jax.Array     # int64 scalar, I64_MAX until first record
+    latest_s: jax.Array       # int64 scalar, I64_MIN until first record
+    smallest: jax.Array       # int64 scalar, I64_MAX until first sized record
+    largest: jax.Array        # int64 scalar
+    overall_size: jax.Array   # int64 scalar
+    overall_count: jax.Array  # int64 scalar
+
+    @classmethod
+    def init(cls, config: AnalyzerConfig) -> "MessageMetricsState":
+        # Note: every leaf must be a distinct buffer — the TPU backend donates
+        # the whole state, and XLA rejects donating one buffer twice.
+        return cls(
+            per_partition=jnp.zeros((config.num_partitions, 7), dtype=jnp.int64),
+            earliest_s=jnp.int64(I64_MAX),
+            latest_s=jnp.int64(I64_MIN),
+            smallest=jnp.int64(I64_MAX),
+            largest=jnp.int64(0),
+            overall_size=jnp.int64(0),
+            overall_count=jnp.int64(0),
+        )
+
+    def merge(self, other: "MessageMetricsState") -> "MessageMetricsState":
+        return MessageMetricsState(
+            per_partition=self.per_partition + other.per_partition,
+            earliest_s=jnp.minimum(self.earliest_s, other.earliest_s),
+            latest_s=jnp.maximum(self.latest_s, other.latest_s),
+            smallest=jnp.minimum(self.smallest, other.smallest),
+            largest=jnp.maximum(self.largest, other.largest),
+            overall_size=self.overall_size + other.overall_size,
+            overall_count=self.overall_count + other.overall_count,
+        )
+
+
+def finalize_extremes(
+    earliest_s: int, latest_s: int, smallest: int, init_now_s: int
+) -> "tuple[int, int, int]":
+    """Map sentinel-initialized extremes to the reference's reporting values.
+
+    The reference initializes ``earliest_message`` to *scan start time* and
+    ``latest_message`` to epoch 0 (src/metric.rs:40-41), so the reported
+    earliest is ``min(now, min_ts)`` and latest is ``max(0, max_ts)``;
+    ``smallest_message`` reports u64::MAX → 0 handled via `results`.
+    """
+    earliest = min(init_now_s, earliest_s) if earliest_s != I64_MAX else init_now_s
+    latest = max(0, latest_s) if latest_s != I64_MIN else 0
+    smallest_u64 = U64_MAX if smallest == int(I64_MAX) else smallest
+    return earliest, latest, smallest_u64
+
+
+def state_to_numpy(state: MessageMetricsState) -> "dict[str, np.ndarray]":
+    return {
+        f.name: np.asarray(getattr(state, f.name))
+        for f in dataclasses.fields(MessageMetricsState)
+    }

@@ -1,0 +1,25 @@
+"""DDSketch quantile state (device) — see ops/ddsketch.py for the kernels."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.jax_support import jnp
+from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_num_buckets
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DDSketchState:
+    counts: jax.Array  # int64[nbuckets + 2]
+
+    @classmethod
+    def init(cls, config: AnalyzerConfig) -> "DDSketchState":
+        n = ddsketch_num_buckets(config.quantile_buckets)
+        return cls(counts=jnp.zeros((n,), dtype=jnp.int64))
+
+    def merge(self, other: "DDSketchState") -> "DDSketchState":
+        return DDSketchState(counts=self.counts + other.counts)

@@ -1,0 +1,48 @@
+"""Combined analyzer state — the single pytree carried across device steps.
+
+Optional sub-states are ``None`` when their feature is disabled (None leaves
+are empty subtrees in jax pytrees, so one code path covers every feature
+combination; each combination is its own jit specialization).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState, HLLState
+from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState
+from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AnalyzerState:
+    metrics: MessageMetricsState
+    alive: Optional[AliveBitmapState]
+    hll: Optional[HLLState]
+    quantiles: Optional[DDSketchState]
+
+    @classmethod
+    def init(cls, config: AnalyzerConfig) -> "AnalyzerState":
+        return cls(
+            metrics=MessageMetricsState.init(config),
+            alive=AliveBitmapState.init(config) if config.count_alive_keys else None,
+            hll=HLLState.init(config) if config.enable_hll else None,
+            quantiles=DDSketchState.init(config) if config.enable_quantiles else None,
+        )
+
+    def merge(self, other: "AnalyzerState") -> "AnalyzerState":
+        return AnalyzerState(
+            metrics=self.metrics.merge(other.metrics),
+            alive=self.alive.merge(other.alive) if self.alive is not None else None,
+            hll=self.hll.merge(other.hll) if self.hll is not None else None,
+            quantiles=(
+                self.quantiles.merge(other.quantiles)
+                if self.quantiles is not None
+                else None
+            ),
+        )

@@ -1,0 +1,94 @@
+"""Exact per-partition counter reduction (the 7 buckets + globals), in JAX.
+
+This is the device-side replacement for ``MessageMetrics::handle_message``
+(src/metric.rs:207-252): instead of 7 HashMap increments per message, one
+batched masked scatter-add produces the whole ``[P, 7]`` counter delta, and
+masked min/max reductions update the global timestamp/size extremes.
+
+Semantics preserved exactly (SURVEY.md §3.4):
+- "alive" = record with non-null value, counted per record;
+- key bytes count only when the key is non-null; value bytes only when the
+  value is non-null;
+- min/max message size is key_len+value_len and *excludes tombstones*
+  (src/metric.rs:249-251);
+- timestamps participate at second granularity, missing timestamps as 0.
+"""
+
+from __future__ import annotations
+
+from kafka_topic_analyzer_tpu.jax_support import jnp
+
+#: Sentinel for "never seen" minima (mapped to the reference's u64::MAX
+#: reporting rule at finalize, src/metric.rs:177-183).
+I64_MAX = jnp.iinfo(jnp.int64).max
+I64_MIN = jnp.iinfo(jnp.int64).min
+
+
+def counters_update(
+    per_partition,  # int64[P, 7]
+    partition,      # int32[B]
+    key_len,        # int32[B]
+    value_len,      # int32[B]
+    key_null,       # bool[B]
+    value_null,     # bool[B]
+    valid,          # bool[B]
+    num_partitions: int,
+):
+    """Add one batch's contribution to the ``[P, 7]`` counter matrix.
+
+    Channel order follows ``results.COUNTER_CHANNELS``:
+    total, tombstones, alive, key_null, key_non_null, key_size_sum,
+    value_size_sum.
+    """
+    kn = valid & ~key_null
+    vn = valid & ~value_null
+    tomb = valid & value_null
+    knull = valid & key_null
+    k_bytes = jnp.where(kn, key_len, 0)
+    v_bytes = jnp.where(vn, value_len, 0)
+    contrib = jnp.stack(
+        [
+            valid.astype(jnp.int32),
+            tomb.astype(jnp.int32),
+            vn.astype(jnp.int32),
+            knull.astype(jnp.int32),
+            kn.astype(jnp.int32),
+            k_bytes,
+            v_bytes,
+        ],
+        axis=1,
+    ).astype(jnp.int64)
+    # Route padded records to a scratch row that is sliced off: keeps the
+    # scatter free of a second mask and the shapes static.
+    idx = jnp.where(valid, partition, num_partitions)
+    scratch = jnp.zeros((num_partitions + 1, 7), dtype=jnp.int64)
+    delta = scratch.at[idx].add(contrib)[:num_partitions]
+    return per_partition + delta
+
+
+def extremes_update(
+    earliest_s,     # int64 scalar
+    latest_s,       # int64 scalar
+    smallest,       # int64 scalar (I64_MAX sentinel when unset)
+    largest,        # int64 scalar
+    key_len,
+    value_len,
+    key_null,
+    value_null,
+    ts_s,           # int64[B]
+    valid,
+):
+    """Update global min/max timestamp and message size."""
+    kn = valid & ~key_null
+    vn = valid & ~value_null
+    msg_size = (
+        jnp.where(kn, key_len, 0).astype(jnp.int64)
+        + jnp.where(vn, value_len, 0).astype(jnp.int64)
+    )
+    # Size extremes exclude tombstones (src/metric.rs:249-251).
+    sized = vn
+    smallest = jnp.minimum(smallest, jnp.min(jnp.where(sized, msg_size, I64_MAX)))
+    largest = jnp.maximum(largest, jnp.max(jnp.where(sized, msg_size, 0)))
+    earliest_s = jnp.minimum(earliest_s, jnp.min(jnp.where(valid, ts_s, I64_MAX)))
+    latest_s = jnp.maximum(latest_s, jnp.max(jnp.where(valid, ts_s, I64_MIN)))
+    return earliest_s, latest_s, smallest, largest

@@ -1,0 +1,68 @@
+"""DDSketch message-size quantiles (device update, host estimate).
+
+New capability (BASELINE.json config 4: p50/p99 payload-size percentiles over
+1B mixed-size messages).  The classic t-digest keeps a variable-length list
+of centroids — hostile to XLA's static shapes — so the TPU-native choice is
+DDSketch (Masson et al., VLDB'19): fixed log-γ buckets, a guaranteed relative
+error α, updates that are a single bincount scatter-add, and a merge that is
+plain vector addition (``psum`` over ICI).  The independent referee is the
+CPU oracle's exact size histogram (backends/cpu.py), which parity tests
+compare against within 2α.
+
+Bucket layout for non-negative integer sizes:
+- bucket 0: size == 0 (possible: alive record with empty value and null key);
+- bucket i in [1, nbuckets]: ceil(log_gamma(size)) == i-? (see code) — sizes
+  up to gamma^nbuckets;
+- bucket nbuckets+1: overflow.
+
+Quantile answers carry relative error ≤ α (= ``quantile_alpha``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.jax_support import jnp
+
+
+def ddsketch_num_buckets(nbuckets: int) -> int:
+    return nbuckets + 2  # zero bucket + log buckets + overflow
+
+
+def ddsketch_update(counts, sizes, active, gamma: float, nbuckets: int):
+    """Scatter-add one batch of sizes into ``int64[nbuckets+2]`` counts."""
+    x = sizes.astype(jnp.float32)
+    log_gamma = np.float32(np.log(gamma))
+    idx = jnp.ceil(jnp.log(jnp.maximum(x, 1.0)) / log_gamma).astype(jnp.int32) + 1
+    idx = jnp.clip(idx, 1, nbuckets + 1)
+    idx = jnp.where(sizes == 0, 0, idx)
+    idx = jnp.where(active, idx, nbuckets + 2)  # scratch bucket for masked
+    scratch = jnp.zeros((nbuckets + 3,), dtype=jnp.int64)
+    delta = scratch.at[idx].add(jnp.int64(1))[: nbuckets + 2]
+    return counts + delta
+
+
+def ddsketch_merge(a, b):
+    return a + b
+
+
+def ddsketch_quantiles(counts: np.ndarray, probs, gamma: float) -> "list[float]":
+    """Host-side quantile extraction from final bucket counts."""
+    counts = np.asarray(counts, dtype=np.int64)
+    total = int(counts.sum())
+    out: "list[float]" = []
+    if total == 0:
+        return [float("nan") for _ in probs]
+    cum = np.cumsum(counts)
+    nbuckets = counts.shape[0] - 2
+    for q in probs:
+        rank = max(0, min(total - 1, int(np.ceil(q * total)) - 1))
+        b = int(np.searchsorted(cum, rank + 1))
+        if b == 0:
+            out.append(0.0)
+        elif b > nbuckets:
+            out.append(float("inf"))
+        else:
+            # midpoint of (gamma^(b-2), gamma^(b-1)]: 2*gamma^(b-1)/(gamma+1)
+            out.append(float(2.0 * gamma ** (b - 1) / (gamma + 1.0)))
+    return out

@@ -1,0 +1,100 @@
+"""FNV-1a hashing, scalar and batch-vectorized.
+
+The reference's only hashing utility is a 32-bit FNV-1a *variant* at
+``src/fnv32.rs:68-102``: it starts from the offset basis ``0x811c9dc5`` and —
+deviating from standard FNV-1a — multiplies by the offset basis again instead
+of the FNV prime ``0x01000193`` (``src/fnv32.rs:92-101``).  The alive-key
+bitset (``src/metric.rs:256-260``) indexes by that hash, so its collision
+behavior is part of the reference's observable output.  We reproduce the
+variant bit-for-bit (`fnv1a32_ref`) for the bug-compatible alive-key bitmap,
+and additionally provide a standard 64-bit FNV-1a (`fnv1a64`) whose output
+feeds the HLL / distinct-key sketches (the reference has no 64-bit hash; this
+is new capability).
+
+Batch forms operate on a padded ``uint8[B, L]`` matrix plus a length vector —
+the host-side ingest pre-extracts these so that no variable-length bytes ever
+need to reach the TPU (SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FNV32_OFFSET = np.uint32(0x811C9DC5)
+# The reference multiplies by the offset basis, NOT the FNV prime 0x01000193.
+FNV32_MULT = np.uint32(0x811C9DC5)
+
+FNV64_OFFSET = np.uint64(0xCBF29CE484222325)
+FNV64_PRIME = np.uint64(0x100000001B3)
+
+_U32_MASK = 0xFFFFFFFF
+_U64_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a32_ref(data: bytes) -> int:
+    """Scalar bug-compatible FNV-1a-32 (multiplies by offset basis)."""
+    h = 0x811C9DC5
+    for b in data:
+        h ^= b
+        h = (h * 0x811C9DC5) & _U32_MASK
+    return h
+
+
+def fnv1a64(data: bytes) -> int:
+    """Scalar standard FNV-1a-64."""
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & _U64_MASK
+    return h
+
+
+def splitmix64(x: int) -> int:
+    """SplitMix64 finalizer — used to turn counters into well-mixed 64-bit
+    values (synthetic workload generation and sketch hashing)."""
+    x = (x + 0x9E3779B97F4A7C15) & _U64_MASK
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _U64_MASK
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _U64_MASK
+    return x ^ (x >> 31)
+
+
+def splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized SplitMix64 over a uint64 array."""
+    x = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        x += np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def fnv1a32_ref_batch(padded: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Vectorized bug-compatible FNV-1a-32 over ``uint8[B, L]`` rows.
+
+    Row ``i`` hashes ``padded[i, :lengths[i]]``.  Columns are processed in a
+    short Python loop of length ``L`` (max key length), each step fully
+    vectorized over the batch — the per-byte recurrence is inherently
+    sequential, the batch axis is not.
+    """
+    padded = np.ascontiguousarray(padded, dtype=np.uint8)
+    lengths = np.asarray(lengths)
+    h = np.full(padded.shape[0], FNV32_OFFSET, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        for col in range(padded.shape[1]):
+            active = lengths > col
+            nh = (h ^ padded[:, col]) * FNV32_MULT
+            h = np.where(active, nh, h)
+    return h
+
+
+def fnv1a64_batch(padded: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Vectorized standard FNV-1a-64 over ``uint8[B, L]`` rows."""
+    padded = np.ascontiguousarray(padded, dtype=np.uint8)
+    lengths = np.asarray(lengths)
+    h = np.full(padded.shape[0], FNV64_OFFSET, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        for col in range(padded.shape[1]):
+            active = lengths > col
+            nh = (h ^ padded[:, col].astype(np.uint64)) * FNV64_PRIME
+            h = np.where(active, nh, h)
+    return h

@@ -1,0 +1,23 @@
+"""Data/space parallel scale-out over a jax.sharding.Mesh.
+
+The reference is single-threaded end to end (SURVEY.md §2.4) — its only
+concurrency lives inside librdkafka's broker threads.  Scale-out here is the
+genuinely new design:
+
+- **'data' axis** — Kafka partitions are the natural data-parallel axis.
+  Each data shard owns a disjoint set of partitions and folds its own batches
+  into a device-local `AnalyzerState` with *no per-step collectives*; states
+  merge once at finalize with XLA collectives over ICI (``psum`` for sums,
+  ``pmin``/``pmax`` for extremes, all-gather+OR for the alive bitmap).
+  This works because every accumulator is associative and commutative, and
+  because a Kafka key lives in exactly one partition (records.py contract).
+- **'space' axis** — the alive-key bitmap's slot space (up to 512 MiB packed
+  bits) is model-parallel sharded: each space shard masks updates to its slot
+  range, again collective-free per step.
+
+Multi-host runs extend the same mesh over DCN via ``jax.distributed`` — the
+mesh shape is the only thing that changes (SURVEY.md §5.8).
+"""
+
+from kafka_topic_analyzer_tpu.parallel.mesh import make_mesh  # noqa: F401
+from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend  # noqa: F401

@@ -1,0 +1,261 @@
+"""Sharded TPU backend: shard_map update steps + collective finalize.
+
+Per-step work is collective-free (each (data, space) device folds its own
+slice); all cross-device communication happens once, in the finalize step:
+
+- counters / byte sums / counts : ``psum``   over 'data'
+- timestamp & size extremes     : ``pmin`` / ``pmax`` over 'data'
+- HLL registers                 : ``pmax``  over 'data'
+- DDSketch bucket counts        : ``psum``  over 'data'
+- alive bitmap                  : ``all_gather`` over 'data' + OR-reduce
+                                  (bit-OR has no wired-in collective; the
+                                  gather is one-shot), popcount, then
+                                  ``psum`` over 'space'
+
+State layout: every `AnalyzerState` leaf gains a leading 'data' axis of size
+D; the bitmap's word axis is additionally sharded over 'space'.  The update
+step is jitted with the state donated, exactly like the single-device path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kafka_topic_analyzer_tpu.backends.base import MetricBackend
+from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
+from kafka_topic_analyzer_tpu.backends.step import analyzer_step
+from kafka_topic_analyzer_tpu.backends.tpu import DEVICE_FIELDS, batch_to_arrays
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.jax_support import jnp, lax
+from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState
+from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState
+from kafka_topic_analyzer_tpu.models.state import AnalyzerState
+from kafka_topic_analyzer_tpu.ops.bitmap import bitmap_num_words
+from kafka_topic_analyzer_tpu.parallel.mesh import DATA_AXIS, SPACE_AXIS, make_mesh
+from kafka_topic_analyzer_tpu.records import RecordBatch
+from kafka_topic_analyzer_tpu.results import TopicMetrics
+from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
+
+
+def _state_specs(config: AnalyzerConfig) -> AnalyzerState:
+    """PartitionSpec pytree matching the stacked AnalyzerState."""
+    metrics = MessageMetricsState(
+        per_partition=P(DATA_AXIS),
+        earliest_s=P(DATA_AXIS),
+        latest_s=P(DATA_AXIS),
+        smallest=P(DATA_AXIS),
+        largest=P(DATA_AXIS),
+        overall_size=P(DATA_AXIS),
+        overall_count=P(DATA_AXIS),
+    )
+    alive = (
+        AliveBitmapState(words=P(DATA_AXIS, SPACE_AXIS))
+        if config.count_alive_keys
+        else None
+    )
+    from kafka_topic_analyzer_tpu.models.compaction import HLLState
+    from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState
+
+    hll = HLLState(regs=P(DATA_AXIS)) if config.enable_hll else None
+    quantiles = DDSketchState(counts=P(DATA_AXIS)) if config.enable_quantiles else None
+    return AnalyzerState(metrics=metrics, alive=alive, hll=hll, quantiles=quantiles)
+
+
+def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
+    """Host-built stacked state (leading 'data' axis), placed with shardings."""
+    d = config.data_shards
+    p = config.num_partitions
+    i64max = np.iinfo(np.int64).max
+    i64min = np.iinfo(np.int64).min
+    metrics = MessageMetricsState(
+        per_partition=np.zeros((d, p, 7), np.int64),
+        earliest_s=np.full((d,), i64max, np.int64),
+        latest_s=np.full((d,), i64min, np.int64),
+        smallest=np.full((d,), i64max, np.int64),
+        largest=np.zeros((d,), np.int64),
+        overall_size=np.zeros((d,), np.int64),
+        overall_count=np.zeros((d,), np.int64),
+    )
+    alive = None
+    if config.count_alive_keys:
+        w_local = bitmap_num_words(config.alive_bitmap_bits, config.space_shards)
+        alive = AliveBitmapState(
+            words=np.zeros((d, w_local * config.space_shards), np.uint32)
+        )
+    hll = None
+    if config.enable_hll:
+        from kafka_topic_analyzer_tpu.models.compaction import HLLState
+
+        hll = HLLState(regs=np.zeros((d, config.hll_m), np.int32))
+    quantiles = None
+    if config.enable_quantiles:
+        from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState
+        from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_num_buckets
+
+        quantiles = DDSketchState(
+            counts=np.zeros((d, ddsketch_num_buckets(config.quantile_buckets)), np.int64)
+        )
+    state = AnalyzerState(metrics=metrics, alive=alive, hll=hll, quantiles=quantiles)
+    specs = _state_specs(config)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
+    )
+
+
+class ShardedTpuBackend(MetricBackend):
+    """Multi-device backend over a (data, space) mesh.
+
+    Feed it via `update_shards` with one batch per data shard (the engine
+    routes each partition to a fixed shard — records.py ordering contract).
+    `update` also works for convenience and splits a mixed batch by the
+    partition→shard assignment.
+    """
+
+    def __init__(
+        self,
+        config: AnalyzerConfig,
+        mesh=None,
+        init_now_s: "int | None" = None,
+    ):
+        super().__init__(config)
+        self.init_now_s = utc_now_seconds() if init_now_s is None else init_now_s
+        self.mesh = mesh if mesh is not None else make_mesh(*config.mesh_shape)
+        if dict(zip(self.mesh.axis_names, self.mesh.devices.shape)) != {
+            DATA_AXIS: config.data_shards,
+            SPACE_AXIS: config.space_shards,
+        }:
+            raise ValueError("mesh shape does not match config.mesh_shape")
+        self.state = _stacked_init(config, self.mesh)
+        self._specs = _state_specs(config)
+        self._arrays_spec = {name: P(DATA_AXIS) for name in DEVICE_FIELDS}
+        self._batch_sharding = {
+            name: NamedSharding(self.mesh, P(DATA_AXIS)) for name in DEVICE_FIELDS
+        }
+
+        config_ = config
+
+        def _step_body(state, arrays):
+            local = jax.tree.map(lambda x: x[0], state)
+            a = {k: v[0] for k, v in arrays.items()}
+            space_idx = lax.axis_index(SPACE_AXIS)
+            new = analyzer_step(local, a, config_, space_index=space_idx)
+            return jax.tree.map(lambda x: x[None], new)
+
+        step = jax.shard_map(
+            _step_body,
+            mesh=self.mesh,
+            in_specs=(self._specs, self._arrays_spec),
+            out_specs=self._specs,
+        )
+        self._step = jax.jit(step, donate_argnums=(0,))
+        self._merge = jax.jit(self._build_merge())
+
+    # -- merge ---------------------------------------------------------------
+
+    def _build_merge(self):
+        config = self.config
+        specs = self._specs
+
+        def merge_body(state):
+            local = jax.tree.map(lambda x: x[0], state)
+            m = local.metrics
+            merged = MessageMetricsState(
+                per_partition=lax.psum(m.per_partition, DATA_AXIS),
+                earliest_s=lax.pmin(m.earliest_s, DATA_AXIS),
+                latest_s=lax.pmax(m.latest_s, DATA_AXIS),
+                smallest=lax.pmin(m.smallest, DATA_AXIS),
+                largest=lax.pmax(m.largest, DATA_AXIS),
+                overall_size=lax.psum(m.overall_size, DATA_AXIS),
+                overall_count=lax.psum(m.overall_count, DATA_AXIS),
+            )
+            alive_count = jnp.int64(-1)
+            if local.alive is not None:
+                gathered = lax.all_gather(local.alive.words, DATA_AXIS)  # [D, W]
+                words = lax.reduce(
+                    gathered, np.uint32(0), lambda a, b: a | b, (0,)
+                )
+                pops = jnp.sum(lax.population_count(words).astype(jnp.int64))
+                # The OR-reduced words are equal on every data shard but vma
+                # still marks them varying over 'data'; a scalar pmax makes
+                # the replication explicit (and is a no-op numerically).
+                alive_count = lax.pmax(lax.psum(pops, SPACE_AXIS), DATA_AXIS)
+            hll_regs = (
+                lax.pmax(local.hll.regs, DATA_AXIS) if local.hll is not None else None
+            )
+            dd_counts = (
+                lax.psum(local.quantiles.counts, DATA_AXIS)
+                if local.quantiles is not None
+                else None
+            )
+            return merged, alive_count, hll_regs, dd_counts
+
+        out_specs = (
+            jax.tree.map(lambda _: P(), _state_specs(self.config).metrics),
+            P(),
+            P() if config.enable_hll else None,
+            P() if config.enable_quantiles else None,
+        )
+        return jax.shard_map(
+            merge_body,
+            mesh=self.mesh,
+            in_specs=(specs,),
+            out_specs=out_specs,
+        )
+
+    # -- update --------------------------------------------------------------
+
+    def update_shards(self, batches: List[Optional[RecordBatch]]) -> None:
+        d = self.config.data_shards
+        if len(batches) != d:
+            raise ValueError(f"expected {d} shard batches, got {len(batches)}")
+        bs = self.config.batch_size
+        stacked = {}
+        per_shard = [
+            batch_to_arrays(b if b is not None else RecordBatch.empty(0), bs)
+            for b in batches
+        ]
+        for name in DEVICE_FIELDS:
+            host = np.stack([sa[name] for sa in per_shard])
+            stacked[name] = jax.device_put(host, self._batch_sharding[name])
+        self.state = self._step(self.state, stacked)
+
+    def update(self, batch: RecordBatch) -> None:
+        """Split a mixed batch by partition→shard (partition % D)."""
+        d = self.config.data_shards
+        shard_of = np.asarray(batch.partition) % d
+        self.update_shards(
+            [batch.take(np.nonzero(shard_of == s)[0]) for s in range(d)]
+        )
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self.state)
+
+    # -- finalize ------------------------------------------------------------
+
+    def finalize(self) -> TopicMetrics:
+        merged, alive_count, hll_regs, dd_counts = self._merge(self.state)
+        merged = jax.tree.map(np.asarray, jax.device_get(merged))
+        alive_count = int(alive_count)
+
+        from kafka_topic_analyzer_tpu.models.compaction import HLLState
+        from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState
+
+        host_state = AnalyzerState(
+            metrics=merged,
+            alive=None,
+            hll=HLLState(regs=np.asarray(hll_regs)) if hll_regs is not None else None,
+            quantiles=(
+                DDSketchState(counts=np.asarray(dd_counts))
+                if dd_counts is not None
+                else None
+            ),
+        )
+        metrics = metrics_from_state(host_state, self.config, self.init_now_s)
+        if self.config.count_alive_keys:
+            metrics.alive_keys = alive_count
+        return metrics

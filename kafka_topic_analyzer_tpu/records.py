@@ -1,0 +1,123 @@
+"""Structure-of-arrays record batches — the host↔device data contract.
+
+The reference hands each message to its reducers as a borrowed rdkafka message
+(``src/kafka.rs:107-109``) and every reducer re-extracts partition / key /
+payload / timestamp per message (``src/metric.rs:207-252``).  On TPU that
+per-message shape is hostile: XLA wants static shapes and the reducers never
+actually need payload *bytes* — only lengths, null-ness, timestamps, and key
+hashes (SURVEY.md §3.4, §7).  So the host ingest layer pre-extracts exactly
+those into fixed-width vectors; one `RecordBatch` is the unit that crosses the
+host→device boundary.
+
+Ordering contract: within a partition, records appear in offset order, and all
+records of a given partition are routed to the same data shard (keys live in a
+single partition, so shard-local last-writer-wins alive tracking composes into
+an exact global OR-merge — see models/compaction.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RecordBatch:
+    """One batch of pre-extracted record metadata (host-side numpy).
+
+    All arrays share length ``B``.  Padded records have ``valid=False`` and
+    must be ignored by every reducer.
+    """
+
+    #: Partition id of each record (int32).
+    partition: np.ndarray
+    #: Key length in bytes; 0 when the key is null (int32).
+    key_len: np.ndarray
+    #: Value length in bytes; 0 when the value is null / tombstone (int32).
+    value_len: np.ndarray
+    #: True where the record has no key (bool).
+    key_null: np.ndarray
+    #: True where the record has no value — a tombstone (bool).
+    value_null: np.ndarray
+    #: Message timestamp in *seconds* (int64).  The reference parses
+    #: timestamps at second granularity: ``to_millis().unwrap_or(0) / 1000``
+    #: (src/metric.rs:209-211); missing timestamps become 0 (epoch).
+    ts_s: np.ndarray
+    #: Bug-compatible fnv32 hash of the key bytes (uint32); 0 for null keys.
+    #: Indexes the alive-key bitmap exactly like src/metric.rs:256-260.
+    key_hash32: np.ndarray
+    #: Standard 64-bit key hash (uint64); feeds HLL / exact distinct counting.
+    key_hash64: np.ndarray
+    #: False for padding records appended to reach the static batch size.
+    valid: np.ndarray
+
+    FIELDS = (
+        ("partition", np.int32),
+        ("key_len", np.int32),
+        ("value_len", np.int32),
+        ("key_null", np.bool_),
+        ("value_null", np.bool_),
+        ("ts_s", np.int64),
+        ("key_hash32", np.uint32),
+        ("key_hash64", np.uint64),
+        ("valid", np.bool_),
+    )
+
+    def __post_init__(self) -> None:
+        n = len(self.partition)
+        for name, dtype in self.FIELDS:
+            arr = np.asarray(getattr(self, name))
+            if arr.dtype != dtype:
+                arr = arr.astype(dtype)
+            if arr.shape != (n,):
+                raise ValueError(f"{name}: expected shape ({n},), got {arr.shape}")
+            setattr(self, name, arr)
+
+    def __len__(self) -> int:
+        return len(self.partition)
+
+    @property
+    def num_valid(self) -> int:
+        return int(np.count_nonzero(self.valid))
+
+    @classmethod
+    def empty(cls, n: int = 0) -> "RecordBatch":
+        return cls(**{name: np.zeros(n, dtype=dt) for name, dt in cls.FIELDS})
+
+    def pad_to(self, size: int) -> "RecordBatch":
+        """Pad with invalid records up to ``size`` (no-op if already there)."""
+        n = len(self)
+        if n == size:
+            return self
+        if n > size:
+            raise ValueError(f"batch of {n} records cannot pad to {size}")
+        out = {}
+        for name, dt in self.FIELDS:
+            arr = np.zeros(size, dtype=dt)
+            arr[:n] = getattr(self, name)
+            out[name] = arr
+        return RecordBatch(**out)
+
+    @classmethod
+    def concat(cls, batches: "list[RecordBatch]") -> "RecordBatch":
+        if not batches:
+            return cls.empty()
+        return cls(
+            **{
+                name: np.concatenate([getattr(b, name) for b in batches])
+                for name, _ in cls.FIELDS
+            }
+        )
+
+    def take(self, idx: np.ndarray) -> "RecordBatch":
+        return RecordBatch(
+            **{name: getattr(self, name)[idx] for name, _ in self.FIELDS}
+        )
+
+    def as_dict(self) -> "dict[str, np.ndarray]":
+        return {name: getattr(self, name) for name, _ in self.FIELDS}
+
+    @property
+    def nbytes(self) -> int:
+        return sum(getattr(self, name).nbytes for name, _ in self.FIELDS)

@@ -1,0 +1,104 @@
+"""Terminal report renderer — byte-compatible with the reference.
+
+Reproduces the report block of ``src/main.rs:123-179``: the global stats
+lines, the optional alive-keys block, the legend, and the 15-column
+per-partition prettytable.  New-capability lines (HLL distinct keys, size
+quantiles) are appended *after* the reference-compatible block so the
+reference surface stays byte-identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kafka_topic_analyzer_tpu.results import TopicMetrics
+from kafka_topic_analyzer_tpu.utils.table import render_table
+from kafka_topic_analyzer_tpu.utils.timefmt import format_utc_seconds
+
+#: Header row of the per-partition table (src/main.rs:150).
+TABLE_HEADER = [
+    "P", "< OS", "> OS", "Total", "Alive", "Tmb", "DR", "K Null", "K !Null",
+    "P-Bytes", "K-Bytes", "V-Bytes", "A K-Sz", "A V-Sz", "A M-Sz",
+]
+
+LEGEND = (
+    "| K = Key, V = Value, P = Partition, Tmb = Tombstone(s), Sz = Size\n"
+    "| DR = Dirty Ratio, A = Average, Lst = last, < OS = start offset, > OS = end offset\n"
+)
+
+
+def render_report(
+    topic: str,
+    metrics: TopicMetrics,
+    start_offsets: Dict[int, int],
+    end_offsets: Dict[int, int],
+    duration_secs: int,
+    show_alive_keys: bool = False,
+    show_extensions: bool = True,
+) -> str:
+    """Render the full post-scan report (src/main.rs:123-179)."""
+    eq = "=" * 120
+    dash = "-" * 120
+    out: List[str] = []
+    out.append("")
+    out.append(eq)
+    out.append("Calculating statistics...")
+    out.append(f"Topic {topic}")
+    out.append(f"Scanning took: {duration_secs} seconds")
+    # Integer division, denominator clamped to >= 1 (src/main.rs:130).
+    out.append(f"Estimated Msg/s: {metrics.overall_count // max(duration_secs, 1)}")
+    out.append(dash)
+    out.append(f"Earliest Message: {format_utc_seconds(metrics.earliest_ts_s)}")
+    out.append(f"Latest Message: {format_utc_seconds(metrics.latest_ts_s)}")
+    out.append(dash)
+    out.append(f"Largest Message: {metrics.largest_message} bytes")
+    out.append(f"Smallest Message: {metrics.smallest_message_reported()} bytes")
+    out.append(f"Topic Size: {metrics.overall_size} bytes")
+    if show_alive_keys and metrics.alive_keys is not None:
+        out.append(dash)
+        out.append(f"Alive keys: {metrics.alive_keys}")
+        out.append(dash)
+    out.append(eq)
+
+    rows: List[List[str]] = [TABLE_HEADER]
+    for p in metrics.partitions:
+        rows.append([
+            f"{p}",
+            f"{start_offsets[p]}",
+            f"{end_offsets[p]}",
+            f"{metrics.total(p)}",
+            f"{metrics.alive(p)}",
+            f"{metrics.tombstones(p)}",
+            f"{metrics.dirty_ratio(p):.4f}",
+            f"{metrics.key_null(p)}",
+            f"{metrics.key_non_null(p)}",
+            f"{metrics.key_size_sum(p) + metrics.value_size_sum(p)}",
+            f"{metrics.key_size_sum(p)}",
+            f"{metrics.value_size_sum(p)}",
+            f"{metrics.key_size_avg(p)}",
+            f"{metrics.value_size_avg(p)}",
+            f"{metrics.message_size_avg(p)}",
+        ])
+
+    body = "\n".join(out) + "\n"
+    # Legend is printed *before* the table in the reference (src/main.rs:174-176).
+    body += LEGEND
+    body += render_table(rows)
+    body += "\n" + eq + "\n"
+    body += _render_extensions(metrics) if show_extensions else ""
+    return body
+
+
+def _render_extensions(metrics: TopicMetrics) -> str:
+    """New-capability lines, outside the reference-compatible block."""
+    lines: List[str] = []
+    if metrics.distinct_keys_hll is not None:
+        lines.append(f"Distinct keys (HLL est.): {round(metrics.distinct_keys_hll)}")
+    if metrics.distinct_keys_exact is not None:
+        lines.append(f"Distinct keys (exact): {metrics.distinct_keys_exact}")
+    if metrics.quantiles is not None:
+        qs = " ".join(
+            f"p{int(p * 100)}={v:.0f}B" for p, v in zip(metrics.quantiles.probs, metrics.quantiles.values)
+        )
+        lines.append(f"Message size quantiles: {qs}")
+    return ("\n".join(lines) + "\n") if lines else ""

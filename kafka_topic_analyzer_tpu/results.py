@@ -1,0 +1,123 @@
+"""Finalized analysis results + the reference's derived-metric semantics.
+
+`TopicMetrics` is the backend-agnostic result every backend (cpu, tpu,
+sharded-tpu) finalizes into; the report renderer consumes only this.  The
+derived metrics reproduce ``src/metric.rs`` exactly, including its quirks
+(documented per method) — bug-compatibility decisions per SURVEY.md §3.4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+#: Column order of the per-partition counter matrix ``per_partition[P, 7]``.
+COUNTER_CHANNELS = (
+    "total",
+    "tombstones",
+    "alive",
+    "key_null",
+    "key_non_null",
+    "key_size_sum",
+    "value_size_sum",
+)
+CH = {name: i for i, name in enumerate(COUNTER_CHANNELS)}
+
+#: Sentinel matching Rust's ``u64::MAX`` initialisation of smallest_message
+#: (src/metric.rs:42) — reported as 0 when never set (src/metric.rs:177-183).
+U64_MAX = (1 << 64) - 1
+
+
+@dataclasses.dataclass
+class QuantileSummary:
+    """Message-size quantiles (new capability; not in the reference)."""
+
+    probs: "list[float]"
+    values: "list[float]"
+
+    def as_dict(self) -> Dict[float, float]:
+        return dict(zip(self.probs, self.values))
+
+
+@dataclasses.dataclass
+class TopicMetrics:
+    """Finalized topic metrics.
+
+    ``per_partition`` rows follow the partition-id order of ``partitions``;
+    channels follow `COUNTER_CHANNELS`.  Scalars mirror the globals of
+    ``MessageMetrics`` (src/metric.rs:20-26).
+    """
+
+    partitions: "list[int]"
+    per_partition: np.ndarray  # int64[P, 7]
+    earliest_ts_s: int
+    latest_ts_s: int
+    smallest_message: int  # U64_MAX when no sized message was seen
+    largest_message: int
+    overall_size: int
+    overall_count: int
+    #: Alive-key count from the reference-compatible fnv32 bitmap (``-c``).
+    alive_keys: Optional[int] = None
+    #: HLL estimate of distinct keys ever seen (new capability).
+    distinct_keys_hll: Optional[float] = None
+    #: Exact distinct keys (CPU oracle only; referee for the HLL claim).
+    distinct_keys_exact: Optional[int] = None
+    #: Message-size quantiles (new capability).
+    quantiles: Optional[QuantileSummary] = None
+
+    # -- per-partition getters (reference getter semantics) ------------------
+
+    def _row(self, partition: int) -> np.ndarray:
+        return self.per_partition[self.partitions.index(partition)]
+
+    def total(self, p: int) -> int:
+        return int(self._row(p)[CH["total"]])
+
+    def tombstones(self, p: int) -> int:
+        return int(self._row(p)[CH["tombstones"]])
+
+    def alive(self, p: int) -> int:
+        return int(self._row(p)[CH["alive"]])
+
+    def key_null(self, p: int) -> int:
+        return int(self._row(p)[CH["key_null"]])
+
+    def key_non_null(self, p: int) -> int:
+        return int(self._row(p)[CH["key_non_null"]])
+
+    def key_size_sum(self, p: int) -> int:
+        return int(self._row(p)[CH["key_size_sum"]])
+
+    def value_size_sum(self, p: int) -> int:
+        return int(self._row(p)[CH["value_size_sum"]])
+
+    def key_size_avg(self, p: int) -> int:
+        """Floor(sum/alive) — the reference divides by *alive*, not total or
+        key_non_null (src/metric.rs:132-139), and guards on ``sum > 0``."""
+        s = self.key_size_sum(p)
+        return s // self.alive(p) if s > 0 else 0
+
+    def value_size_avg(self, p: int) -> int:
+        s = self.value_size_sum(p)
+        return s // self.alive(p) if s > 0 else 0
+
+    def message_size_avg(self, p: int) -> int:
+        s = self.key_size_sum(p) + self.value_size_sum(p)
+        return s // self.alive(p) if s > 0 else 0
+
+    def dirty_ratio(self, p: int) -> float:
+        """Percentage of tombstones, computed in float32 exactly like
+        ``tombstones as f32 / (total as f32 / 100.0)`` (src/metric.rs:159-167)."""
+        total = self.total(p)
+        tomb = self.tombstones(p)
+        if total > 0 and tomb > 0:
+            return float(np.float32(tomb) / (np.float32(total) / np.float32(100.0)))
+        return 0.0
+
+    # -- global getters ------------------------------------------------------
+
+    def smallest_message_reported(self) -> int:
+        """0 when never set (src/metric.rs:177-183)."""
+        return 0 if self.smallest_message == U64_MAX else self.smallest_message

@@ -1,0 +1,2 @@
+"""Small shared utilities: report table rendering, chrono-compatible time
+formatting, progress display, logging setup, profiling counters."""

@@ -1,0 +1,67 @@
+"""Per-stage throughput counters + optional JAX profiler traces.
+
+The reference's only observability is wall-clock + a derived msgs/s
+(src/main.rs:129-130, SURVEY.md §5.1).  Since msgs/s *is* the north-star
+metric here, the engine keeps per-stage (ingest / dispatch / finalize)
+wall-time and record counters, and can wrap the scan in a JAX profiler trace
+(``--profile-dir``) for XLA-level analysis on TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Dict, Iterator
+
+
+@dataclasses.dataclass
+class StageStats:
+    seconds: float = 0.0
+    items: int = 0
+    bytes: int = 0
+
+    @property
+    def items_per_sec(self) -> float:
+        return self.items / self.seconds if self.seconds > 0 else 0.0
+
+
+class ScanProfile:
+    def __init__(self) -> None:
+        self.stages: Dict[str, StageStats] = {}
+        self.wall_start = time.monotonic()
+
+    @contextlib.contextmanager
+    def stage(self, name: str, items: int = 0, nbytes: int = 0) -> Iterator[None]:
+        st = self.stages.setdefault(name, StageStats())
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            st.seconds += time.perf_counter() - t0
+            st.items += items
+            st.bytes += nbytes
+
+    @property
+    def wall_seconds(self) -> float:
+        return time.monotonic() - self.wall_start
+
+    def summary(self) -> str:
+        lines = []
+        for name, st in self.stages.items():
+            lines.append(
+                f"  {name}: {st.seconds:.3f}s, {st.items} records"
+                + (f" ({st.items_per_sec:,.0f}/s)" if st.items else "")
+            )
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def maybe_jax_trace(profile_dir: "str | None") -> Iterator[None]:
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(profile_dir):
+        yield

@@ -1,0 +1,51 @@
+"""indicatif-style progress spinner.
+
+The reference updates an indicatif spinner with template
+``"{spinner:.green} [{elapsed_precise}] {msg}"`` once *per message*
+(src/kafka.rs:85-86, :111-113) — a measured hot-loop cost (SURVEY.md §3.3).
+Here the spinner updates once per batch, rate-limited, and writes to stderr
+so report output stays clean.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+_FRAMES = "⠁⠂⠄⡀⢀⠠⠐⠈"
+
+
+class Spinner:
+    def __init__(self, enabled: "bool | None" = None, min_interval_s: float = 0.1):
+        if enabled is None:
+            enabled = sys.stderr.isatty()
+        self.enabled = enabled
+        self.min_interval_s = min_interval_s
+        self.start = time.monotonic()
+        self._last = 0.0
+        self._frame = 0
+        self._dirty = False
+
+    def _elapsed_precise(self) -> str:
+        e = int(time.monotonic() - self.start)
+        return f"{e // 3600:02d}:{(e % 3600) // 60:02d}:{e % 60:02d}"
+
+    def set_message(self, msg: str) -> None:
+        if not self.enabled:
+            return
+        now = time.monotonic()
+        if now - self._last < self.min_interval_s:
+            return
+        self._last = now
+        frame = _FRAMES[self._frame % len(_FRAMES)]
+        self._frame += 1
+        sys.stderr.write(f"\r{frame} [{self._elapsed_precise()}] {msg}\x1b[K")
+        sys.stderr.flush()
+        self._dirty = True
+
+    def finish_with_message(self, msg: str) -> None:
+        if not self.enabled:
+            return
+        sys.stderr.write(f"\r  [{self._elapsed_precise()}] {msg}\x1b[K\n")
+        sys.stderr.flush()
+        self._dirty = False

@@ -1,0 +1,22 @@
+"""Test env: force JAX onto a virtual 8-device CPU platform *before* jax is
+imported anywhere (SURVEY.md §4 — multi-core without a cluster), and make the
+repo root importable without installation."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Some environments (axon) import jax from sitecustomize before conftest runs,
+# freezing jax_platforms from the ambient env; override via the config API,
+# which works as long as no backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

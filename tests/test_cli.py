@@ -1,0 +1,66 @@
+"""End-to-end CLI runs (synthetic source, cpu + tpu backends)."""
+
+import pytest
+
+from kafka_topic_analyzer_tpu.cli import main, parse_kv_pairs, parse_mesh
+
+
+def test_parse_kv_pairs():
+    assert parse_kv_pairs("a=b,c=d") == {"a": "b", "c": "d"}
+    assert parse_kv_pairs(None) == {}
+
+
+def test_parse_mesh():
+    assert parse_mesh("4") == (4, 1)
+    assert parse_mesh("4,2") == (4, 2)
+
+
+def _run(capsys, extra):
+    argv = [
+        "-t", "unit.topic",
+        "--source", "synthetic",
+        "--synthetic", "partitions=2,messages=500,keys=40,tombstones=200",
+        "--batch-size", "256",
+        "--quiet",
+        "--native", "off",
+    ] + extra
+    assert main(argv) == 0
+    return capsys.readouterr().out
+
+
+def test_cli_cpu_end_to_end(capsys):
+    out = _run(capsys, ["--backend", "cpu", "-c"])
+    assert "Topic unit.topic" in out
+    assert "Alive keys: " in out
+    assert "| P | < OS | > OS | Total |" in out
+    # 2 partitions * 500 messages
+    assert "Topic Size: " in out
+    assert out.count("| 0 |") == 1 and out.count("| 1 |") == 1
+
+
+def test_cli_tpu_matches_cpu_report(capsys):
+    out_cpu = _run(capsys, ["--backend", "cpu", "-c", "--alive-bitmap-bits", "24"])
+    out_tpu = _run(capsys, ["--backend", "tpu", "-c", "--alive-bitmap-bits", "24"])
+
+    def stable(s: str) -> str:
+        # Drop timing-dependent lines.
+        return "\n".join(
+            l for l in s.splitlines()
+            if not l.startswith(("Scanning took:", "Estimated Msg/s:", "Earliest Message:"))
+        )
+
+    # Earliest Message depends on scan start time only when the topic has no
+    # older message; the synthetic ts range is in the past, so it is stable —
+    # but scan start differs between runs by <1s; keep it excluded anyway.
+    assert stable(out_cpu) == stable(out_tpu)
+
+
+def test_cli_empty_topic_exits_minus_2(capsys):
+    with pytest.raises(SystemExit) as e:
+        main([
+            "-t", "empty.topic",
+            "--source", "synthetic",
+            "--synthetic", "partitions=2,messages=0",
+            "--quiet", "--native", "off",
+        ])
+    assert e.value.code == -2

@@ -1,0 +1,56 @@
+"""Hash kernels: reference-variant fnv32, standard fnv64, batch == scalar."""
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.ops.fnv import (
+    fnv1a32_ref,
+    fnv1a32_ref_batch,
+    fnv1a64,
+    fnv1a64_batch,
+    splitmix64,
+    splitmix64_np,
+)
+
+
+def test_fnv32_ref_empty_is_offset_basis():
+    assert fnv1a32_ref(b"") == 0x811C9DC5
+
+
+def test_fnv32_ref_variant_multiplies_by_offset_basis():
+    # One hand-evaluated step of the reference's (buggy) recurrence
+    # (src/fnv32.rs:92-101): h = (basis ^ byte) * basis mod 2^32.
+    expected = ((0x811C9DC5 ^ 0x61) * 0x811C9DC5) & 0xFFFFFFFF
+    assert fnv1a32_ref(b"a") == expected
+    # And differs from standard FNV-1a-32 of "a" (0xe40c292c).
+    assert fnv1a32_ref(b"a") != 0xE40C292C
+
+
+def test_fnv64_known_vectors():
+    # Standard FNV-1a 64-bit test vectors (isthe.com/chongo/tech/comp/fnv).
+    assert fnv1a64(b"") == 0xCBF29CE484222325
+    assert fnv1a64(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv1a64(b"foobar") == 0x85944171F73967E8
+
+
+def test_batch_matches_scalar():
+    rng = np.random.default_rng(0)
+    keys = [rng.integers(0, 256, size=int(n), dtype=np.uint8).tobytes()
+            for n in rng.integers(0, 20, size=64)]
+    maxlen = max(len(k) for k in keys)
+    padded = np.zeros((len(keys), maxlen), dtype=np.uint8)
+    lengths = np.zeros(len(keys), dtype=np.int64)
+    for i, k in enumerate(keys):
+        padded[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
+        lengths[i] = len(k)
+    h32 = fnv1a32_ref_batch(padded, lengths)
+    h64 = fnv1a64_batch(padded, lengths)
+    for i, k in enumerate(keys):
+        assert int(h32[i]) == fnv1a32_ref(k)
+        assert int(h64[i]) == fnv1a64(k)
+
+
+def test_splitmix_batch_matches_scalar():
+    xs = np.array([0, 1, 2, 0xDEADBEEF, 2**63, 2**64 - 1], dtype=np.uint64)
+    out = splitmix64_np(xs)
+    for i, x in enumerate(xs.tolist()):
+        assert int(out[i]) == splitmix64(int(x))

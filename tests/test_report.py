@@ -1,0 +1,89 @@
+"""Report renderer: golden output locked against the reference's format
+(src/main.rs:123-179, prettytable-rs default style)."""
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.report import render_report
+from kafka_topic_analyzer_tpu.results import TopicMetrics, U64_MAX
+from kafka_topic_analyzer_tpu.utils.table import render_table
+
+
+def test_render_table_prettytable_style():
+    out = render_table([["P", "Tot"], ["0", "12"]])
+    assert out == (
+        "+---+-----+\n"
+        "| P | Tot |\n"
+        "+---+-----+\n"
+        "| 0 | 12  |\n"
+        "+---+-----+\n"
+    )
+
+
+def _metrics() -> TopicMetrics:
+    # partition 0: 10 total, 2 tombstones, 8 alive, 1 key_null, 9 key_non_null,
+    # key bytes 90, value bytes 800.
+    per = np.array([[10, 2, 8, 1, 9, 90, 800]], dtype=np.int64)
+    return TopicMetrics(
+        partitions=[0],
+        per_partition=per,
+        earliest_ts_s=0,
+        latest_ts_s=1_600_000_000,
+        smallest_message=100,
+        largest_message=121,
+        overall_size=890,
+        overall_count=10,
+        alive_keys=7,
+    )
+
+
+def test_report_golden():
+    out = render_report(
+        topic="demo",
+        metrics=_metrics(),
+        start_offsets={0: 0},
+        end_offsets={0: 10},
+        duration_secs=2,
+        show_alive_keys=True,
+    )
+    expected = (
+        "\n"
+        + "=" * 120 + "\n"
+        + "Calculating statistics...\n"
+        + "Topic demo\n"
+        + "Scanning took: 2 seconds\n"
+        + "Estimated Msg/s: 5\n"
+        + "-" * 120 + "\n"
+        + "Earliest Message: 1970-01-01 00:00:00 UTC\n"
+        + "Latest Message: 2020-09-13 12:26:40 UTC\n"
+        + "-" * 120 + "\n"
+        + "Largest Message: 121 bytes\n"
+        + "Smallest Message: 100 bytes\n"
+        + "Topic Size: 890 bytes\n"
+        + "-" * 120 + "\n"
+        + "Alive keys: 7\n"
+        + "-" * 120 + "\n"
+        + "=" * 120 + "\n"
+        + "| K = Key, V = Value, P = Partition, Tmb = Tombstone(s), Sz = Size\n"
+        + "| DR = Dirty Ratio, A = Average, Lst = last, < OS = start offset, > OS = end offset\n"
+        + "+---+------+------+-------+-------+-----+---------+--------+---------+---------+---------+---------+--------+--------+--------+\n"
+        + "| P | < OS | > OS | Total | Alive | Tmb | DR      | K Null | K !Null | P-Bytes | K-Bytes | V-Bytes | A K-Sz | A V-Sz | A M-Sz |\n"
+        + "+---+------+------+-------+-------+-----+---------+--------+---------+---------+---------+---------+--------+--------+--------+\n"
+        + "| 0 | 0    | 10   | 10    | 8     | 2   | 20.0000 | 1      | 9       | 890     | 90      | 800     | 11     | 100    | 111    |\n"
+        + "+---+------+------+-------+-------+-----+---------+--------+---------+---------+---------+---------+--------+--------+--------+\n"
+        + "\n"
+        + "=" * 120 + "\n"
+    )
+    assert out == expected
+
+
+def test_derived_metric_semantics():
+    m = _metrics()
+    # Averages divide by alive (8), floor division (src/metric.rs:132-157).
+    assert m.key_size_avg(0) == 90 // 8
+    assert m.value_size_avg(0) == 100
+    assert m.message_size_avg(0) == 890 // 8
+    # Dirty ratio in f32: 2 / (10/100) = 20.0 (src/metric.rs:159-167).
+    assert abs(m.dirty_ratio(0) - 20.0) < 1e-6
+    # u64::MAX smallest reports as 0 (src/metric.rs:177-183).
+    m.smallest_message = U64_MAX
+    assert m.smallest_message_reported() == 0

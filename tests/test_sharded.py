@@ -1,0 +1,78 @@
+"""Sharded (mesh) backend parity vs the CPU oracle on the virtual 8-device
+CPU platform — SURVEY.md §4 'multi-core without a cluster'."""
+
+import jax
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+SPEC = SyntheticSpec(
+    num_partitions=7,  # deliberately not divisible by the shard count
+    messages_per_partition=3_000,
+    keys_per_partition=300,
+    key_null_permille=60,
+    tombstone_permille=180,
+    value_len_min=20,
+    value_len_max=220,
+    seed=99,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def run_cpu(config):
+    be = CpuExactBackend(config, init_now_s=10**10)
+    src = SyntheticSource(SPEC)
+    return run_scan("t", src, be, config.batch_size).metrics
+
+
+def run_sharded(config):
+    be = ShardedTpuBackend(config, init_now_s=10**10)
+    src = SyntheticSource(SPEC)
+    return run_scan("t", src, be, config.batch_size).metrics
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_parity(mesh_shape):
+    cfg = AnalyzerConfig(
+        num_partitions=7,
+        batch_size=1024,
+        count_alive_keys=True,
+        alive_bitmap_bits=20,
+        enable_hll=True,
+        enable_quantiles=True,
+        mesh_shape=mesh_shape,
+    )
+    m_cpu = run_cpu(cfg)
+    m_tpu = run_sharded(cfg)
+    assert np.array_equal(m_cpu.per_partition, m_tpu.per_partition)
+    assert m_cpu.earliest_ts_s == m_tpu.earliest_ts_s
+    assert m_cpu.latest_ts_s == m_tpu.latest_ts_s
+    assert m_cpu.smallest_message == m_tpu.smallest_message
+    assert m_cpu.largest_message == m_tpu.largest_message
+    assert m_cpu.overall_size == m_tpu.overall_size
+    assert m_cpu.overall_count == m_tpu.overall_count
+    assert m_cpu.alive_keys == m_tpu.alive_keys
+    # Sketches merged across shards stay inside their error budget.
+    assert m_tpu.distinct_keys_hll == pytest.approx(
+        m_cpu.distinct_keys_exact, rel=0.05
+    )
+    for q_exact, q_sketch in zip(m_cpu.quantiles.values, m_tpu.quantiles.values):
+        assert q_sketch == pytest.approx(q_exact, rel=0.011)
+
+
+def test_mixed_batch_update_splits_by_partition():
+    cfg = AnalyzerConfig(num_partitions=7, batch_size=512, mesh_shape=(4, 1))
+    be = ShardedTpuBackend(cfg, init_now_s=10**10)
+    src = SyntheticSource(SPEC)
+    for batch in src.batches(512):
+        be.update(batch)  # mixed-partition path
+    m = be.finalize()
+    assert int(m.overall_count) == 7 * 3_000

@@ -1,0 +1,95 @@
+"""TPU backend parity vs the CPU-exact oracle (runs on the virtual CPU
+platform in tests; same code path runs on real TPU).
+
+Counters must match bit-for-bit; sketches within their error budgets
+(SURVEY.md §4 backend-contract tests).
+"""
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+SPEC = SyntheticSpec(
+    num_partitions=3,
+    messages_per_partition=5_000,
+    keys_per_partition=400,
+    key_null_permille=80,
+    tombstone_permille=150,
+    value_len_min=50,
+    value_len_max=350,
+    seed=7,
+)
+
+
+def run_both(config: AnalyzerConfig, spec: SyntheticSpec = SPEC):
+    cpu = CpuExactBackend(config, init_now_s=10**10)
+    tpu = TpuBackend(config, init_now_s=10**10)
+    src = SyntheticSource(spec)
+    for batch in src.batches(config.batch_size):
+        cpu.update(batch)
+        tpu.update(batch)
+    return cpu.finalize(), tpu.finalize()
+
+
+def test_exact_counters_parity():
+    cfg = AnalyzerConfig(num_partitions=3, batch_size=2048)
+    m_cpu, m_tpu = run_both(cfg)
+    assert np.array_equal(m_cpu.per_partition, m_tpu.per_partition)
+    assert m_cpu.earliest_ts_s == m_tpu.earliest_ts_s
+    assert m_cpu.latest_ts_s == m_tpu.latest_ts_s
+    assert m_cpu.smallest_message == m_tpu.smallest_message
+    assert m_cpu.largest_message == m_tpu.largest_message
+    assert m_cpu.overall_size == m_tpu.overall_size
+    assert m_cpu.overall_count == m_tpu.overall_count
+
+
+def test_alive_bitmap_parity():
+    cfg = AnalyzerConfig(
+        num_partitions=3,
+        batch_size=1024,
+        count_alive_keys=True,
+        alive_bitmap_bits=22,
+    )
+    m_cpu, m_tpu = run_both(cfg)
+    assert m_cpu.alive_keys == m_tpu.alive_keys
+    # With a roomy bitmap and few keys, the bitmap count equals the true
+    # number of alive keys from a sequential dict replay.
+    replay = {}
+    for batch in SyntheticSource(SPEC).batches(4096):
+        keyed = ~batch.key_null
+        for h, dead in zip(
+            batch.key_hash64[keyed].tolist(), batch.value_null[keyed].tolist()
+        ):
+            replay[h] = not dead
+        # (offset order within partitions is preserved by the source)
+    assert m_cpu.alive_keys == sum(replay.values())
+
+
+def test_hll_within_error_budget():
+    cfg = AnalyzerConfig(num_partitions=3, batch_size=2048, enable_hll=True, hll_p=14)
+    m_cpu, m_tpu = run_both(cfg)
+    exact = m_cpu.distinct_keys_exact
+    assert exact == 3 * 400
+    est = m_tpu.distinct_keys_hll
+    assert est == pytest.approx(exact, rel=0.05)  # p=14 → ~0.8% σ; 5% is 6σ
+
+
+def test_ddsketch_within_alpha():
+    cfg = AnalyzerConfig(
+        num_partitions=3, batch_size=2048, enable_quantiles=True, quantile_alpha=0.005
+    )
+    m_cpu, m_tpu = run_both(cfg)
+    assert m_cpu.quantiles is not None and m_tpu.quantiles is not None
+    for q_exact, q_sketch in zip(m_cpu.quantiles.values, m_tpu.quantiles.values):
+        assert q_sketch == pytest.approx(q_exact, rel=0.011)  # 2*alpha + rank slack
+
+
+def test_batch_padding_is_inert():
+    cfg = AnalyzerConfig(num_partitions=3, batch_size=4096)
+    # 15000 records into 4096-sized padded steps exercises padding heavily.
+    m_cpu, m_tpu = run_both(cfg)
+    assert m_tpu.overall_count == 15_000
