@@ -128,6 +128,7 @@ def make_source(args) -> "object":
         bootstrap_servers=args.bootstrap_server,
         topic=args.topic,
         overrides=parse_kv_pairs(args.librdkafka),
+        use_native_hashing=args.native != "off",
     )
 
 

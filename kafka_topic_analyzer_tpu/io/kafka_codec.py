@@ -1,0 +1,573 @@
+"""Kafka wire-protocol codec: primitives + the three RPCs the analyzer needs.
+
+The reference delegates the entire Kafka protocol to librdkafka (C)
+(src/kafka.rs:6-11, Cargo.toml:19).  This build speaks the protocol directly:
+the analyzer only ever *reads* — Metadata (api 3), ListOffsets (api 2), Fetch
+(api 1), plus ApiVersions (api 18) for the handshake — so a compact codec
+covers the whole surface.  Both the client (`kafka_wire.py`) and the test
+fake broker use these encoders/decoders, mirroring SURVEY.md §4's
+backend-contract strategy.
+
+Implemented versions (classic encoding, no flexible/tagged fields):
+- Metadata v1, ListOffsets v1, Fetch v4, ApiVersions v0
+- RecordBatch v2 ("magic 2", Kafka >= 0.11) with zigzag-varint records;
+  compression: none and gzip (zlib).  v0/v1 MessageSets are rejected with a
+  clear error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+API_METADATA = 3
+API_VERSIONS = 18
+
+EARLIEST_TIMESTAMP = -2
+LATEST_TIMESTAMP = -1
+
+#: Kafka error codes the client interprets.
+ERR_NONE = 0
+ERR_OFFSET_OUT_OF_RANGE = 1
+ERR_UNKNOWN_TOPIC_OR_PARTITION = 3
+ERR_NOT_LEADER_FOR_PARTITION = 6
+
+
+class KafkaProtocolError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# primitives
+
+
+class ByteWriter:
+    def __init__(self) -> None:
+        self._parts: List[bytes] = []
+
+    def raw(self, b: bytes) -> "ByteWriter":
+        self._parts.append(b)
+        return self
+
+    def i8(self, v: int) -> "ByteWriter":
+        return self.raw(struct.pack(">b", v))
+
+    def i16(self, v: int) -> "ByteWriter":
+        return self.raw(struct.pack(">h", v))
+
+    def i32(self, v: int) -> "ByteWriter":
+        return self.raw(struct.pack(">i", v))
+
+    def i64(self, v: int) -> "ByteWriter":
+        return self.raw(struct.pack(">q", v))
+
+    def u32(self, v: int) -> "ByteWriter":
+        return self.raw(struct.pack(">I", v))
+
+    def string(self, s: Optional[str]) -> "ByteWriter":
+        if s is None:
+            return self.i16(-1)
+        b = s.encode()
+        return self.i16(len(b)).raw(b)
+
+    def bytes_(self, b: Optional[bytes]) -> "ByteWriter":
+        if b is None:
+            return self.i32(-1)
+        return self.i32(len(b)).raw(b)
+
+    def varint(self, v: int) -> "ByteWriter":
+        """Zigzag varint (signed)."""
+        z = (v << 1) ^ (v >> 63) if v < 0 else (v << 1)
+        z &= (1 << 64) - 1
+        out = bytearray()
+        while True:
+            b = z & 0x7F
+            z >>= 7
+            if z:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        return self.raw(bytes(out))
+
+    def varbytes(self, b: Optional[bytes]) -> "ByteWriter":
+        if b is None:
+            return self.varint(-1)
+        return self.varint(len(b)).raw(b)
+
+    def done(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class ByteReader:
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise KafkaProtocolError(
+                f"truncated message: need {n} bytes at {self.pos}, have {len(self.buf)}"
+            )
+        b = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return b
+
+    def i8(self) -> int:
+        return struct.unpack(">b", self._take(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def u32(self) -> int:
+        return struct.unpack(">I", self._take(4))[0]
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        if n < 0:
+            return None
+        return self._take(n).decode()
+
+    def bytes_(self) -> Optional[bytes]:
+        n = self.i32()
+        if n < 0:
+            return None
+        return self._take(n)
+
+    def varint(self) -> int:
+        shift = 0
+        z = 0
+        while True:
+            b = self._take(1)[0]
+            z |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 63:
+                raise KafkaProtocolError("varint too long")
+        return (z >> 1) ^ -(z & 1)  # un-zigzag
+
+    def varbytes(self) -> Optional[bytes]:
+        n = self.varint()
+        if n < 0:
+            return None
+        return self._take(n)
+
+    def remaining(self) -> int:
+        return len(self.buf) - self.pos
+
+
+# ---------------------------------------------------------------------------
+# request framing
+
+
+def encode_request(
+    api_key: int, api_version: int, correlation_id: int, client_id: str, body: bytes
+) -> bytes:
+    """Length-prefixed request with header v1 (src client.id analog:
+    the reference sets client.id=topic-analyzer, src/kafka.rs:36)."""
+    w = ByteWriter()
+    w.i16(api_key).i16(api_version).i32(correlation_id).string(client_id)
+    payload = w.done() + body
+    return struct.pack(">i", len(payload)) + payload
+
+
+def decode_request_header(buf: bytes) -> Tuple[int, int, int, Optional[str], ByteReader]:
+    r = ByteReader(buf)
+    api_key = r.i16()
+    api_version = r.i16()
+    corr = r.i32()
+    client_id = r.string()
+    return api_key, api_version, corr, client_id, r
+
+
+# ---------------------------------------------------------------------------
+# Metadata v1
+
+
+def encode_metadata_request(topics: Optional[List[str]]) -> bytes:
+    w = ByteWriter()
+    if topics is None:
+        w.i32(-1)
+    else:
+        w.i32(len(topics))
+        for t in topics:
+            w.string(t)
+    return w.done()
+
+
+@dataclasses.dataclass
+class PartitionMetadata:
+    error: int
+    partition: int
+    leader: int
+
+
+@dataclasses.dataclass
+class TopicMetadata:
+    error: int
+    name: str
+    partitions: List[PartitionMetadata]
+
+
+@dataclasses.dataclass
+class MetadataResponse:
+    brokers: "dict[int, tuple[str, int]]"  # node_id -> (host, port)
+    controller_id: int
+    topics: List[TopicMetadata]
+
+
+def encode_metadata_response(resp: MetadataResponse) -> bytes:
+    w = ByteWriter()
+    w.i32(len(resp.brokers))
+    for node_id, (host, port) in resp.brokers.items():
+        w.i32(node_id).string(host).i32(port).string(None)  # rack
+    w.i32(resp.controller_id)
+    w.i32(len(resp.topics))
+    for t in resp.topics:
+        w.i16(t.error).string(t.name).i8(0)  # is_internal
+        w.i32(len(t.partitions))
+        for p in t.partitions:
+            w.i16(p.error).i32(p.partition).i32(p.leader)
+            w.i32(1).i32(p.leader)  # replicas
+            w.i32(1).i32(p.leader)  # isr
+    return w.done()
+
+
+def decode_metadata_response(r: ByteReader) -> MetadataResponse:
+    brokers = {}
+    for _ in range(r.i32()):
+        node_id = r.i32()
+        host = r.string() or ""
+        port = r.i32()
+        r.string()  # rack
+        brokers[node_id] = (host, port)
+    controller = r.i32()
+    topics = []
+    for _ in range(r.i32()):
+        err = r.i16()
+        name = r.string() or ""
+        r.i8()  # is_internal
+        parts = []
+        for _ in range(r.i32()):
+            perr = r.i16()
+            pid = r.i32()
+            leader = r.i32()
+            for _ in range(r.i32()):
+                r.i32()  # replicas
+            for _ in range(r.i32()):
+                r.i32()  # isr
+            parts.append(PartitionMetadata(perr, pid, leader))
+        topics.append(TopicMetadata(err, name, parts))
+    return MetadataResponse(brokers, controller, topics)
+
+
+# ---------------------------------------------------------------------------
+# ListOffsets v1
+
+
+def encode_list_offsets_request(
+    topic: str, partition_timestamps: List[Tuple[int, int]]
+) -> bytes:
+    w = ByteWriter()
+    w.i32(-1)  # replica_id
+    w.i32(1).string(topic)
+    w.i32(len(partition_timestamps))
+    for pid, ts in partition_timestamps:
+        w.i32(pid).i64(ts)
+    return w.done()
+
+
+def decode_list_offsets_request(r: ByteReader) -> Tuple[str, List[Tuple[int, int]]]:
+    r.i32()  # replica_id
+    ntopics = r.i32()
+    assert ntopics == 1
+    topic = r.string() or ""
+    out = []
+    for _ in range(r.i32()):
+        out.append((r.i32(), r.i64()))
+    return topic, out
+
+
+def encode_list_offsets_response(
+    topic: str, results: List[Tuple[int, int, int, int]]
+) -> bytes:
+    """results: (partition, error, timestamp, offset)."""
+    w = ByteWriter()
+    w.i32(1).string(topic)
+    w.i32(len(results))
+    for pid, err, ts, off in results:
+        w.i32(pid).i16(err).i64(ts).i64(off)
+    return w.done()
+
+
+def decode_list_offsets_response(r: ByteReader) -> "dict[int, tuple[int, int]]":
+    out = {}
+    for _ in range(r.i32()):
+        r.string()  # topic
+        for _ in range(r.i32()):
+            pid = r.i32()
+            err = r.i16()
+            r.i64()  # timestamp
+            off = r.i64()
+            out[pid] = (err, off)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fetch v4
+
+
+def encode_fetch_request(
+    topic: str,
+    partition_offsets: List[Tuple[int, int]],
+    max_wait_ms: int,
+    min_bytes: int,
+    max_bytes: int,
+    partition_max_bytes: int,
+) -> bytes:
+    w = ByteWriter()
+    w.i32(-1)  # replica_id
+    w.i32(max_wait_ms).i32(min_bytes).i32(max_bytes).i8(0)  # isolation: read_uncommitted
+    w.i32(1).string(topic)
+    w.i32(len(partition_offsets))
+    for pid, off in partition_offsets:
+        w.i32(pid).i64(off).i32(partition_max_bytes)
+    return w.done()
+
+
+def decode_fetch_request(r: ByteReader):
+    r.i32()  # replica
+    max_wait = r.i32()
+    min_bytes = r.i32()
+    max_bytes = r.i32()
+    r.i8()  # isolation
+    ntopics = r.i32()
+    assert ntopics == 1
+    topic = r.string() or ""
+    parts = []
+    for _ in range(r.i32()):
+        pid = r.i32()
+        off = r.i64()
+        pmax = r.i32()
+        parts.append((pid, off, pmax))
+    return topic, parts, max_wait, min_bytes, max_bytes
+
+
+def encode_fetch_response(
+    topic: str, partitions: List[Tuple[int, int, int, bytes]]
+) -> bytes:
+    """partitions: (partition, error, high_watermark, record_set_bytes)."""
+    w = ByteWriter()
+    w.i32(0)  # throttle_time_ms
+    w.i32(1).string(topic)
+    w.i32(len(partitions))
+    for pid, err, hw, records in partitions:
+        w.i32(pid).i16(err).i64(hw)
+        w.i64(hw)  # last_stable_offset
+        w.i32(0)   # aborted_transactions: empty
+        w.bytes_(records)
+    return w.done()
+
+
+@dataclasses.dataclass
+class FetchedPartition:
+    partition: int
+    error: int
+    high_watermark: int
+    records: bytes
+
+
+def decode_fetch_response(r: ByteReader) -> List[FetchedPartition]:
+    r.i32()  # throttle
+    out = []
+    for _ in range(r.i32()):
+        r.string()  # topic
+        for _ in range(r.i32()):
+            pid = r.i32()
+            err = r.i16()
+            hw = r.i64()
+            r.i64()  # last_stable_offset
+            for _ in range(r.i32()):  # aborted txns
+                r.i64()
+                r.i64()
+            records = r.bytes_() or b""
+            out.append(FetchedPartition(pid, err, hw, records))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ApiVersions v0
+
+
+def encode_api_versions_response(apis: List[Tuple[int, int, int]]) -> bytes:
+    w = ByteWriter()
+    w.i16(0)  # error
+    w.i32(len(apis))
+    for key, vmin, vmax in apis:
+        w.i16(key).i16(vmin).i16(vmax)
+    return w.done()
+
+
+def decode_api_versions_response(r: ByteReader) -> "dict[int, tuple[int, int]]":
+    err = r.i16()
+    if err:
+        raise KafkaProtocolError(f"ApiVersions error {err}")
+    out = {}
+    for _ in range(r.i32()):
+        out[r.i16()] = (r.i16(), r.i16())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RecordBatch v2
+
+COMPRESSION_NONE = 0
+COMPRESSION_GZIP = 1
+
+#: (timestamp_ms, key bytes|None, value bytes|None)
+RecordTuple = Tuple[int, Optional[bytes], Optional[bytes]]
+
+#: (absolute_offset, timestamp_ms, key, value) — offsets may have gaps, as
+#: log compaction leaves holes in retained batches.
+OffsetRecord = Tuple[int, int, Optional[bytes], Optional[bytes]]
+
+
+def encode_record_batch(
+    records: List[OffsetRecord],
+    compression: int = COMPRESSION_NONE,
+) -> bytes:
+    if not records:
+        return b""
+    base_offset = records[0][0]
+    first_ts = records[0][1]
+    max_ts = max(ts for _, ts, _, _ in records)
+    body = ByteWriter()
+    for off, ts, key, value in records:
+        rec = ByteWriter()
+        rec.i8(0)  # attributes
+        rec.varint(ts - first_ts)
+        rec.varint(off - base_offset)
+        rec.varbytes(key)
+        rec.varbytes(value)
+        rec.varint(0)  # headers
+        rb = rec.done()
+        body.varint(len(rb)).raw(rb)
+    payload = body.done()
+    if compression == COMPRESSION_GZIP:
+        # Kafka's gzip codec is RFC-1952 gzip framing (Java GZIPOutputStream),
+        # not a bare zlib stream.
+        co = zlib.compressobj(wbits=31)
+        payload = co.compress(payload) + co.flush()
+
+    # Fields covered by the CRC (everything from attributes onward).
+    crcw = ByteWriter()
+    crcw.i16(compression)  # attributes (low bits = codec)
+    crcw.i32(records[-1][0] - base_offset)  # last_offset_delta
+    crcw.i64(first_ts).i64(max_ts)
+    crcw.i64(-1).i16(-1).i32(-1)  # producer id/epoch, base sequence
+    crcw.i32(len(records))
+    crc_part = crcw.done() + payload
+    crc = _crc32c(crc_part)  # Kafka checksums batches with CRC32-C
+
+    head = ByteWriter()
+    head.i64(base_offset)
+    head.i32(4 + 1 + 4 + len(crc_part))  # batch_length: from leader_epoch on
+    head.i32(-1)  # partition_leader_epoch
+    head.i8(2)  # magic
+    head.u32(crc)
+    return head.done() + crc_part
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC32-C (Castagnoli), table-driven — Kafka's record-batch checksum."""
+    table = _CRC32C_TABLE
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _make_crc32c_table():
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_CRC32C_TABLE = _make_crc32c_table()
+
+
+def decode_record_batches(
+    buf: bytes, verify_crc: bool = False
+) -> Iterator[Tuple[int, RecordTuple]]:
+    """Yield (absolute_offset, (timestamp_ms, key, value)) for every record.
+
+    Tolerates a trailing partial batch (brokers may truncate at max_bytes).
+    """
+    pos = 0
+    n = len(buf)
+    while pos + 17 <= n:  # base_offset + batch_length + leader_epoch + magic
+        base_offset = struct.unpack_from(">q", buf, pos)[0]
+        batch_length = struct.unpack_from(">i", buf, pos + 8)[0]
+        end = pos + 12 + batch_length
+        if batch_length <= 0 or end > n:
+            return  # partial trailing batch
+        magic = buf[pos + 16]
+        if magic != 2:
+            raise KafkaProtocolError(
+                f"unsupported record format magic={magic} (need magic 2 / Kafka >= 0.11)"
+            )
+        r = ByteReader(buf, pos + 17)
+        crc = r.u32()
+        crc_start = r.pos
+        attributes = r.i16()
+        r.i32()  # last_offset_delta
+        first_ts = r.i64()
+        r.i64()  # max_ts
+        r.i64()  # producer id
+        r.i16()  # producer epoch
+        r.i32()  # base sequence
+        num_records = r.i32()
+        payload = buf[r.pos : end]
+        if verify_crc and _crc32c(buf[crc_start:end]) != crc:
+            raise KafkaProtocolError(f"record batch CRC mismatch at offset {base_offset}")
+        codec = attributes & 0x07
+        if codec == COMPRESSION_GZIP:
+            # wbits=47: auto-detect gzip (RFC 1952) or zlib (RFC 1950) framing.
+            payload = zlib.decompress(payload, wbits=47)
+        elif codec != COMPRESSION_NONE:
+            raise KafkaProtocolError(
+                f"unsupported compression codec {codec} (supported: none, gzip)"
+            )
+        rr = ByteReader(payload)
+        for _ in range(num_records):
+            length = rr.varint()
+            rec_end = rr.pos + length
+            rr.i8()  # attributes
+            ts_delta = rr.varint()
+            off_delta = rr.varint()
+            key = rr.varbytes()
+            value = rr.varbytes()
+            nheaders = rr.varint()
+            for _ in range(nheaders):
+                hk = rr.varbytes()
+                rr.varbytes()
+                del hk
+            rr.pos = rec_end  # tolerate unknown trailing record fields
+            yield base_offset + off_delta, (first_ts + ts_delta, key, value)
+        pos = end

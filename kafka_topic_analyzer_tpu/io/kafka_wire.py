@@ -1,18 +1,438 @@
-"""Kafka wire-protocol source.
+"""Kafka wire-protocol record source (librdkafka replacement).
 
-Implementation lands with the ingestion milestone (SURVEY.md §7 M2): a
-from-scratch client for ApiVersions/Metadata/ListOffsets/Fetch with
-RecordBatch v2 decoding, replacing the reference's librdkafka dependency
-(src/kafka.rs:23-54).  Until then, constructing it reports the gap cleanly
-instead of a ModuleNotFoundError.
+Speaks the Kafka protocol directly over TCP (codec in kafka_codec.py) and
+reproduces the reference consumer's observable behavior (src/kafka.rs):
+
+- topology handshake: Metadata + per-partition earliest/latest watermarks
+  fixed at scan start (src/kafka.rs:60-72); missing topic raises, like the
+  reference's ``panic!("Topic not found!")``;
+- full earliest→latest read per partition; termination when every partition
+  reaches its snapshot-time high watermark (src/kafka.rs:119-121);
+- no consumer group protocol at all: the reference already runs with
+  ``enable.auto.commit=false`` + a fresh UUID group id per run
+  (src/kafka.rs:28-34), i.e. group membership never has an observable
+  effect — so this client fetches directly from partition leaders;
+- ``--librdkafka`` overrides map onto the fetch knobs this client has
+  (fetch.wait.max.ms, fetch.min.bytes, fetch.max.bytes,
+  max.partition.fetch.bytes); unknown keys are ignored with a warning, like
+  librdkafka logs unknown properties.
+
+Record metadata is extracted batch-at-a-time: key/value lengths, null flags,
+second-granularity timestamps (truncated toward zero like Rust's ``/ 1000``,
+src/metric.rs:209-211), and key hashes via the native C++ shim when
+available (numpy fallback otherwise).  Payload bytes never leave this module
+(SURVEY.md §7 hard part (b)).
 """
 
 from __future__ import annotations
 
+import logging
+import socket
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
 
-class KafkaWireSource:  # pragma: no cover - placeholder until M2 lands
-    def __init__(self, bootstrap_servers: str, topic: str, overrides=None):
-        raise SystemExit(
-            "the kafka wire-protocol source is not available yet in this "
-            "build — use --source synthetic or --source segfile"
+import numpy as np
+
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.source import RecordSource
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+log = logging.getLogger(__name__)
+
+CLIENT_ID = "topic-analyzer"  # src/kafka.rs:36
+
+
+def _hash_keys(
+    keys: List[Optional[bytes]], use_native: bool = True
+) -> Tuple[np.ndarray, np.ndarray]:
+    """fnv32-variant + fnv64 hashes for a list of key byte strings."""
+    n = len(keys)
+    data = b"".join(k or b"" for k in keys)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(k) if k else 0 for k in keys], out=offsets[1:])
+    if use_native:
+        from kafka_topic_analyzer_tpu.io.native import hash_batch_native, native_available
+
+        # native_available caches build failures, so a broken toolchain costs
+        # one probe, not one `make` per batch.
+        if native_available():
+            return hash_batch_native(data, offsets)
+    from kafka_topic_analyzer_tpu.ops.fnv import fnv1a32_ref_batch, fnv1a64_batch
+
+    maxlen = int((offsets[1:] - offsets[:-1]).max(initial=0))
+    padded = np.zeros((n, max(maxlen, 1)), dtype=np.uint8)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    lengths = offsets[1:] - offsets[:-1]
+    for i in range(n):
+        if lengths[i]:
+            padded[i, : lengths[i]] = buf[offsets[i] : offsets[i + 1]]
+    return fnv1a32_ref_batch(padded, lengths), fnv1a64_batch(padded, lengths)
+
+
+class BrokerConnection:
+    """One blocking TCP connection to a broker."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.host = host
+        self.port = port
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._corr = 0
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < n:
+            chunk = self.sock.recv(n - got)
+            if not chunk:
+                raise kc.KafkaProtocolError(
+                    f"broker {self.host}:{self.port} closed the connection"
+                )
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def request(self, api_key: int, api_version: int, body: bytes) -> kc.ByteReader:
+        self._corr += 1
+        corr = self._corr
+        self.sock.sendall(
+            kc.encode_request(api_key, api_version, corr, CLIENT_ID, body)
         )
+        (length,) = struct.unpack(">i", self._recv_exact(4))
+        payload = self._recv_exact(length)
+        r = kc.ByteReader(payload)
+        got_corr = r.i32()
+        if got_corr != corr:
+            raise kc.KafkaProtocolError(
+                f"correlation id mismatch: sent {corr}, got {got_corr}"
+            )
+        return r
+
+
+def parse_bootstrap(bootstrap_servers: str) -> List[Tuple[str, int]]:
+    """Comma-separated host[:port] list (src/main.rs:45-51)."""
+    out = []
+    for hp in bootstrap_servers.split(","):
+        hp = hp.strip()
+        if not hp:
+            continue
+        host, _, port = hp.rpartition(":") if ":" in hp else (hp, "", "")
+        out.append((host or hp, int(port) if port else 9092))
+    return out
+
+
+class KafkaWireSource(RecordSource):
+    def __init__(
+        self,
+        bootstrap_servers: str,
+        topic: str,
+        overrides: Optional[Dict[str, str]] = None,
+        timeout_s: float = 10.0,
+        use_native_hashing: bool = True,
+    ):
+        self.topic = topic
+        self.timeout_s = timeout_s
+        self.use_native_hashing = use_native_hashing
+        overrides = dict(overrides or {})
+        # librdkafka-name knobs this client honors (others warned+ignored).
+        self.max_wait_ms = int(overrides.pop("fetch.wait.max.ms", 100))
+        self.min_bytes = int(overrides.pop("fetch.min.bytes", 1))
+        self.max_bytes = int(overrides.pop("fetch.max.bytes", 64 << 20))
+        self.partition_max_bytes = int(
+            overrides.pop("max.partition.fetch.bytes", 8 << 20)
+        )
+        self.verify_crc = overrides.pop("check.crcs", "false").lower() == "true"
+        for k in overrides:
+            log.warning("ignoring unsupported consumer property %r", k)
+
+        self._bootstrap = parse_bootstrap(bootstrap_servers)
+        self._conns: Dict[Tuple[str, int], BrokerConnection] = {}
+        self._brokers: Dict[int, Tuple[str, int]] = {}
+        self._leaders: Dict[int, int] = {}
+        self._watermarks: Optional[Tuple[Dict[int, int], Dict[int, int]]] = None
+        self._load_metadata()
+
+    # -- connections ---------------------------------------------------------
+
+    def _connect(self, host: str, port: int) -> BrokerConnection:
+        key = (host, port)
+        conn = self._conns.get(key)
+        if conn is None:
+            conn = BrokerConnection(host, port, self.timeout_s)
+            self._conns[key] = conn
+        return conn
+
+    def _any_conn(self) -> BrokerConnection:
+        errors = []
+        for host, port in self._bootstrap:
+            try:
+                return self._connect(host, port)
+            except OSError as e:
+                errors.append(f"{host}:{port}: {e}")
+        raise kc.KafkaProtocolError(
+            "could not reach any bootstrap server: " + "; ".join(errors)
+        )
+
+    def _leader_conn(self, partition: int) -> BrokerConnection:
+        node = self._leaders[partition]
+        host, port = self._brokers[node]
+        return self._connect(host, port)
+
+    def close(self) -> None:
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()
+
+    # -- topology (src/kafka.rs:60-72) --------------------------------------
+
+    def _load_metadata(self, retries: int = 5) -> None:
+        import time
+
+        last_issue = ""
+        for attempt in range(retries):
+            conn = self._any_conn()
+            r = conn.request(
+                kc.API_METADATA, 1, kc.encode_metadata_request([self.topic])
+            )
+            md = kc.decode_metadata_response(r)
+            self._brokers = md.brokers
+            topic_md = next((t for t in md.topics if t.name == self.topic), None)
+            if topic_md is None or topic_md.error == kc.ERR_UNKNOWN_TOPIC_OR_PARTITION:
+                raise SystemExit("Topic not found!")  # src/kafka.rs:62
+            if topic_md.error:
+                raise kc.KafkaProtocolError(
+                    f"metadata error {topic_md.error} for topic {self.topic!r}"
+                )
+            # Leaderless partitions (error set or leader == -1) happen during
+            # elections; retry briefly instead of failing later with KeyError.
+            bad = [
+                p for p in topic_md.partitions
+                if p.error or p.leader < 0 or p.leader not in md.brokers
+            ]
+            if not bad:
+                self._leaders = {p.partition: p.leader for p in topic_md.partitions}
+                return
+            last_issue = ", ".join(
+                f"partition {p.partition} (error={p.error}, leader={p.leader})"
+                for p in bad
+            )
+            log.warning("metadata not ready (%s), retry %d", last_issue, attempt + 1)
+            time.sleep(min(0.2 * (attempt + 1), 1.0))
+        raise kc.KafkaProtocolError(
+            f"no usable leader for topic {self.topic!r}: {last_issue}"
+        )
+
+    def partitions(self) -> List[int]:
+        return sorted(self._leaders)
+
+    def watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        if self._watermarks is not None:
+            return self._watermarks
+        start: Dict[int, int] = {}
+        end: Dict[int, int] = {}
+        by_leader: Dict[int, List[int]] = {}
+        for p, leader in self._leaders.items():
+            by_leader.setdefault(leader, []).append(p)
+        for leader, parts in by_leader.items():
+            host, port = self._brokers[leader]
+            conn = self._connect(host, port)
+            for ts, dest in (
+                (kc.EARLIEST_TIMESTAMP, start),
+                (kc.LATEST_TIMESTAMP, end),
+            ):
+                r = conn.request(
+                    kc.API_LIST_OFFSETS,
+                    1,
+                    kc.encode_list_offsets_request(
+                        self.topic, [(p, ts) for p in parts]
+                    ),
+                )
+                for pid, (err, off) in kc.decode_list_offsets_response(r).items():
+                    if err:
+                        raise kc.KafkaProtocolError(
+                            f"ListOffsets error {err} for partition {pid}"
+                        )
+                    dest[pid] = off
+        self._watermarks = (start, end)
+        return self._watermarks
+
+    def _earliest_offset(self, partition: int) -> int:
+        conn = self._leader_conn(partition)
+        r = conn.request(
+            kc.API_LIST_OFFSETS,
+            1,
+            kc.encode_list_offsets_request(
+                self.topic, [(partition, kc.EARLIEST_TIMESTAMP)]
+            ),
+        )
+        err, off = kc.decode_list_offsets_response(r)[partition]
+        if err:
+            raise kc.KafkaProtocolError(
+                f"ListOffsets error {err} for partition {partition}"
+            )
+        return off
+
+    # -- the read loop (src/kafka.rs:74-137, batched) ------------------------
+
+    def batches(
+        self,
+        batch_size: int,
+        partitions: Optional[List[int]] = None,
+    ) -> Iterator[RecordBatch]:
+        start, end = self.watermarks()
+        parts = sorted(partitions) if partitions is not None else self.partitions()
+        next_offset = {p: start[p] for p in parts}
+        remaining = {p for p in parts if next_offset[p] < end[p]}
+
+        pend: List[Tuple[int, int, Optional[bytes], Optional[bytes]]] = []
+        # (partition, ts_ms, key, value) accumulator flushed as RecordBatches.
+
+        def flush(force: bool) -> Iterator[RecordBatch]:
+            while len(pend) >= batch_size or (force and pend):
+                chunk = pend[:batch_size]
+                del pend[:batch_size]
+                yield self._records_to_batch(chunk)
+
+        import time
+
+        error_streak: Dict[int, int] = {p: 0 for p in parts}
+        max_error_streak = 100
+
+        while remaining:
+            by_leader: Dict[int, List[int]] = {}
+            for p in remaining:
+                by_leader.setdefault(self._leaders[p], []).append(p)
+            progressed = False
+            for leader, lparts in by_leader.items():
+                conn = self._leader_conn(lparts[0])
+                r = conn.request(
+                    kc.API_FETCH,
+                    4,
+                    kc.encode_fetch_request(
+                        self.topic,
+                        [(p, next_offset[p]) for p in sorted(lparts)],
+                        self.max_wait_ms,
+                        self.min_bytes,
+                        self.max_bytes,
+                        self.partition_max_bytes,
+                    ),
+                )
+                for fp in kc.decode_fetch_response(r):
+                    p = fp.partition
+                    if p not in remaining:
+                        continue
+                    if fp.error:
+                        # Warn and re-poll, like the reference's poll loop
+                        # (src/kafka.rs:95-97) — but with recovery for the
+                        # known-persistent errors and a bounded retry budget.
+                        log.warning("fetch error %d on partition %d", fp.error, p)
+                        error_streak[p] += 1
+                        if fp.error == kc.ERR_NOT_LEADER_FOR_PARTITION:
+                            self._load_metadata()
+                        elif fp.error == kc.ERR_OFFSET_OUT_OF_RANGE:
+                            # Retention advanced past our offset: resume at
+                            # the new earliest (scan window stays [.., end)).
+                            new_start = self._earliest_offset(p)
+                            if new_start > next_offset[p]:
+                                next_offset[p] = new_start
+                                progressed = True
+                        if error_streak[p] >= max_error_streak:
+                            raise kc.KafkaProtocolError(
+                                f"partition {p}: {error_streak[p]} consecutive "
+                                f"fetch errors (last: {fp.error})"
+                            )
+                        continue
+                    error_streak[p] = 0
+                    consumed = 0
+                    decoded = 0
+                    for off, (ts_ms, key, value) in kc.decode_record_batches(
+                        fp.records, verify_crc=self.verify_crc
+                    ):
+                        decoded += 1
+                        if off < next_offset[p]:
+                            continue  # compressed batches can start earlier
+                        if off >= end[p]:
+                            break
+                        pend.append((p, ts_ms, key, value))
+                        next_offset[p] = off + 1
+                        consumed += 1
+                        progressed = True
+                    if consumed == 0 and next_offset[p] < end[p]:
+                        if fp.records and decoded == 0:
+                            # A batch larger than partition_max_bytes came
+                            # back truncated: grow the limit and refetch.
+                            self.partition_max_bytes *= 2
+                            log.warning(
+                                "partition %d: batch exceeds fetch size, "
+                                "growing max.partition.fetch.bytes to %d",
+                                p,
+                                self.partition_max_bytes,
+                            )
+                            progressed = True
+                        else:
+                            # Nothing left for us below the snapshot-time
+                            # watermark (empty fetch, or every decoded offset
+                            # already >= end): compaction removed the rest.
+                            next_offset[p] = end[p]
+                            progressed = True
+                    if next_offset[p] >= end[p]:
+                        remaining.discard(p)
+                yield from flush(force=False)
+            if not progressed and remaining:
+                # Nothing moved this round (e.g. leader churn): brief pause
+                # so error responses don't busy-spin the broker.
+                time.sleep(self.max_wait_ms / 1000.0)
+        yield from flush(force=True)
+
+    def _records_to_batch(
+        self, rows: List[Tuple[int, int, Optional[bytes], Optional[bytes]]]
+    ) -> RecordBatch:
+        return records_to_batch(rows, use_native=self.use_native_hashing)
+
+
+def records_to_batch(
+    rows: List[Tuple[int, int, Optional[bytes], Optional[bytes]]],
+    use_native: bool = True,
+) -> RecordBatch:
+    """(partition, ts_ms, key, value) rows → RecordBatch with hashes."""
+    n = len(rows)
+    partition = np.fromiter((r[0] for r in rows), dtype=np.int32, count=n)
+    ts_ms = np.fromiter(
+        # Missing timestamps (-1) report as 0 ms, like
+        # ``to_millis().unwrap_or(0)`` (src/metric.rs:209).
+        ((r[1] if r[1] >= 0 else 0) for r in rows),
+        dtype=np.int64,
+        count=n,
+    )
+    keys = [r[2] for r in rows]
+    values = [r[3] for r in rows]
+    key_null = np.fromiter((k is None for k in keys), dtype=np.bool_, count=n)
+    value_null = np.fromiter((v is None for v in values), dtype=np.bool_, count=n)
+    key_len = np.fromiter(
+        (len(k) if k is not None else 0 for k in keys), dtype=np.int32, count=n
+    )
+    value_len = np.fromiter(
+        (len(v) if v is not None else 0 for v in values), dtype=np.int32, count=n
+    )
+    h32, h64 = _hash_keys(keys, use_native=use_native)
+    h32 = np.where(key_null, np.uint32(0), h32)
+    h64 = np.where(key_null, np.uint64(0), h64)
+    # Truncate toward zero like Rust integer division (src/metric.rs:210).
+    ts_s = (np.abs(ts_ms) // 1000) * np.sign(ts_ms)
+    return RecordBatch(
+        partition=partition,
+        key_len=key_len,
+        value_len=value_len,
+        key_null=key_null,
+        value_null=value_null,
+        ts_s=ts_s,
+        key_hash32=h32,
+        key_hash64=h64,
+        valid=np.ones(n, dtype=np.bool_),
+    )

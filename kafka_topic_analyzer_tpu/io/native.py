@@ -1,0 +1,203 @@
+"""ctypes bindings to the native C++ ingest shim (native/ingest.cpp).
+
+Auto-builds ``libkta_ingest.so`` with the repo's Makefile on first use (g++
+is part of the environment; no Python build deps needed).  The native layer
+fills caller-allocated numpy buffers directly — zero copies on the Python
+side — and is asserted bit-identical to the numpy generator by
+tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libkta_ingest.so")
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None" = None
+_load_error: "Exception | None" = None
+
+
+class _KtaSynthSpec(ctypes.Structure):
+    # Mirrors struct KtaSynthSpec in native/ingest.cpp (wire contract).
+    _fields_ = [
+        ("seed", ctypes.c_uint64),
+        ("num_partitions", ctypes.c_int32),
+        ("messages_per_partition", ctypes.c_int64),
+        ("keys_per_partition", ctypes.c_uint64),
+        ("key_null_permille", ctypes.c_int32),
+        ("tombstone_permille", ctypes.c_int32),
+        ("value_len_min", ctypes.c_int32),
+        ("value_len_max", ctypes.c_int32),
+        ("key_digits", ctypes.c_int32),
+        ("ts_start_ms", ctypes.c_int64),
+        ("ts_step_ms", ctypes.c_int64),
+    ]
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR, "-s"],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+
+
+def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
+    """Load (building if needed) the native shim; raises on failure.
+
+    A failed build/load is cached: hot paths (per-batch key hashing) probe
+    via `native_available` without re-running `make` every time.
+    """
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            raise _load_error
+        try:
+            if not os.path.exists(_SO_PATH):
+                if not build_if_missing:
+                    raise FileNotFoundError(_SO_PATH)
+                _build()
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.kta_version.restype = ctypes.c_int32
+            if lib.kta_version() != 1:
+                raise RuntimeError("libkta_ingest ABI version mismatch")
+            lib.kta_synth_batch.restype = ctypes.c_int32
+            lib.kta_hash_batch.restype = ctypes.c_int32
+        except Exception as e:  # remember the failure
+            _load_error = e
+            raise
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+def _as_ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def synth_batch_native(
+    spec: SyntheticSpec,
+    parts: np.ndarray,
+    lo: int,
+    hi: int,
+    threads: int = 0,
+) -> RecordBatch:
+    """Generate records for global indices [lo, hi) via the C++ shim."""
+    lib = load_library()
+    n = hi - lo
+    if threads <= 0:
+        threads = min(os.cpu_count() or 1, 16)
+    parts = np.ascontiguousarray(parts, dtype=np.int32)
+    out = {name: np.empty(n, dtype=dt) for name, dt in RecordBatch.FIELDS}
+    cspec = _KtaSynthSpec(
+        seed=spec.seed,
+        num_partitions=spec.num_partitions,
+        messages_per_partition=spec.messages_per_partition,
+        keys_per_partition=spec.keys_per_partition,
+        key_null_permille=spec.key_null_permille,
+        tombstone_permille=spec.tombstone_permille,
+        value_len_min=spec.value_len_min,
+        value_len_max=spec.value_len_max,
+        key_digits=spec.key_digits,
+        ts_start_ms=spec.ts_start_ms,
+        ts_step_ms=spec.ts_step_ms,
+    )
+    rc = lib.kta_synth_batch(
+        ctypes.byref(cspec),
+        _as_ptr(parts, ctypes.c_int32),
+        ctypes.c_int32(len(parts)),
+        ctypes.c_int64(lo),
+        ctypes.c_int64(hi),
+        ctypes.c_int32(threads),
+        _as_ptr(out["partition"], ctypes.c_int32),
+        _as_ptr(out["key_len"], ctypes.c_int32),
+        _as_ptr(out["value_len"], ctypes.c_int32),
+        _as_ptr(out["key_null"], ctypes.c_uint8),
+        _as_ptr(out["value_null"], ctypes.c_uint8),
+        _as_ptr(out["ts_s"], ctypes.c_int64),
+        _as_ptr(out["key_hash32"], ctypes.c_uint32),
+        _as_ptr(out["key_hash64"], ctypes.c_uint64),
+        _as_ptr(out["valid"], ctypes.c_uint8),
+    )
+    if rc != 0:
+        raise RuntimeError(f"kta_synth_batch failed with rc={rc}")
+    return RecordBatch(**out)
+
+
+def hash_batch_native(
+    data: bytes | np.ndarray, offsets: np.ndarray, threads: int = 0
+) -> "tuple[np.ndarray, np.ndarray]":
+    """Hash n packed byte slices: returns (fnv32-variant, fnv64) arrays."""
+    lib = load_library()
+    if threads <= 0:
+        threads = min(os.cpu_count() or 1, 16)
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, bytes) else data
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets) - 1
+    h32 = np.empty(n, dtype=np.uint32)
+    h64 = np.empty(n, dtype=np.uint64)
+    rc = lib.kta_hash_batch(
+        _as_ptr(buf, ctypes.c_uint8),
+        _as_ptr(offsets, ctypes.c_int64),
+        ctypes.c_int64(n),
+        ctypes.c_int32(threads),
+        _as_ptr(h32, ctypes.c_uint32),
+        _as_ptr(h64, ctypes.c_uint64),
+    )
+    if rc != 0:
+        raise RuntimeError(f"kta_hash_batch failed with rc={rc}")
+    return h32, h64
+
+
+class NativeSyntheticSource(SyntheticSource):
+    """SyntheticSource with generation delegated to the C++ shim.
+
+    Identical stream to the numpy implementation (asserted by parity tests);
+    an order of magnitude faster, which matters when the host generator must
+    keep a TPU fed (SURVEY.md §7 hard part (a)).
+    """
+
+    def __init__(self, spec: SyntheticSpec, threads: int = 0):
+        super().__init__(spec)
+        self.threads = threads
+        load_library()  # fail fast if the shim cannot be built
+
+    def batches(
+        self,
+        batch_size: int,
+        partitions: Optional[List[int]] = None,
+    ) -> Iterator[RecordBatch]:
+        parts = np.array(
+            sorted(partitions) if partitions is not None else self.partitions(),
+            dtype=np.int32,
+        )
+        if len(parts) == 0:
+            return
+        total = self.spec.messages_per_partition * len(parts)
+        for lo in range(0, total, batch_size):
+            yield synth_batch_native(
+                self.spec, parts, lo, min(lo + batch_size, total), self.threads
+            )

@@ -1,17 +1,195 @@
-"""Segment-dump file source (.ktaseg).
+"""Segment-dump files (.ktaseg): columnar on-disk record metadata.
 
-Implementation lands with the ingestion milestone (SURVEY.md §7 M2): a
-binary on-disk record-metadata format written once and scanned at memory
-bandwidth by the native C++ shim.  Until then, constructing it reports the
-gap cleanly instead of a ModuleNotFoundError.
+A cluster-free ingestion path: scan a topic once (any source — the Kafka
+wire client can persist while fetching), keep only the fixed-width metadata
+columns the reducers need (SURVEY.md §3.4 — never payload bytes), and re-run
+analyses at memory bandwidth.  One file per partition, little-endian,
+columnar so batches map straight into `RecordBatch` arrays:
+
+    magic      8s   b"KTASEG01"
+    partition  i32
+    reserved   i32  (zero)
+    start_off  i64  (first offset in the file)
+    count      i64
+    key_len    i32[count]
+    value_len  i32[count]
+    key_null   u8 [count]
+    value_null u8 [count]
+    ts_ms      i64[count]
+    key_hash32 u32[count]   (fnv32 reference variant)
+    key_hash64 u64[count]
+
+Files are named ``{topic}-{partition}.ktaseg``.
 """
 
 from __future__ import annotations
 
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
 
-class SegmentFileSource:  # pragma: no cover - placeholder until M2 lands
-    def __init__(self, segment_dir: str, topic: str = ""):
-        raise SystemExit(
-            "the segment-file source is not available yet in this build — "
-            "use --source synthetic"
+import numpy as np
+
+from kafka_topic_analyzer_tpu.io.source import RecordSource
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+MAGIC = b"KTASEG01"
+_HEADER = struct.Struct("<8sii qq")  # magic, partition, reserved, start, count
+HEADER_SIZE = _HEADER.size
+
+#: (column name, dtype) in file order; names match RecordBatch fields except
+#: ts_ms (stored at millisecond precision; RecordBatch carries seconds).
+COLUMNS = (
+    ("key_len", np.int32),
+    ("value_len", np.int32),
+    ("key_null", np.uint8),
+    ("value_null", np.uint8),
+    ("ts_ms", np.int64),
+    ("key_hash32", np.uint32),
+    ("key_hash64", np.uint64),
+)
+
+
+def segment_path(directory: str, topic: str, partition: int) -> str:
+    return os.path.join(directory, f"{topic}-{partition}.ktaseg")
+
+
+def write_segment(
+    path: str,
+    partition: int,
+    start_offset: int,
+    columns: Dict[str, np.ndarray],
+) -> None:
+    """Write one partition's columns to a .ktaseg file."""
+    count = len(columns["key_len"])
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(MAGIC, partition, 0, start_offset, count))
+        for name, dtype in COLUMNS:
+            arr = np.ascontiguousarray(columns[name], dtype=dtype)
+            if arr.shape != (count,):
+                raise ValueError(f"{name}: bad shape {arr.shape}")
+            f.write(arr.tobytes())
+
+
+def write_segment_from_batches(
+    directory: str, topic: str, partition: int, batches: "list[RecordBatch]",
+    start_offset: int = 0,
+) -> str:
+    """Convenience writer from RecordBatches of a single partition."""
+    full = RecordBatch.concat(batches)
+    if not np.all(full.partition == partition):
+        raise ValueError("batches contain records of other partitions")
+    path = segment_path(directory, topic, partition)
+    write_segment(
+        path,
+        partition,
+        start_offset,
+        {
+            "key_len": full.key_len,
+            "value_len": full.value_len,
+            "key_null": full.key_null.astype(np.uint8),
+            "value_null": full.value_null.astype(np.uint8),
+            "ts_ms": full.ts_s * 1000,
+            "key_hash32": full.key_hash32,
+            "key_hash64": full.key_hash64,
+        },
+    )
+    return path
+
+
+class SegmentFile:
+    """Memory-mapped reader of one .ktaseg file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            header = f.read(HEADER_SIZE)
+        if len(header) != HEADER_SIZE:
+            raise ValueError(f"{path}: truncated header")
+        magic, partition, _, start_offset, count = _HEADER.unpack(header)
+        if magic != MAGIC:
+            raise ValueError(f"{path}: bad magic {magic!r}")
+        self.partition = partition
+        self.start_offset = start_offset
+        self.count = count
+        self._col_offsets: Dict[str, Tuple[int, np.dtype]] = {}
+        off = HEADER_SIZE
+        for name, dtype in COLUMNS:
+            self._col_offsets[name] = (off, np.dtype(dtype))
+            off += count * np.dtype(dtype).itemsize
+        expected = off
+        actual = os.path.getsize(path)
+        if actual != expected:
+            raise ValueError(f"{path}: size {actual} != expected {expected}")
+        self._mm = np.memmap(path, dtype=np.uint8, mode="r")
+
+    def column(self, name: str, lo: int = 0, hi: Optional[int] = None) -> np.ndarray:
+        off, dtype = self._col_offsets[name]
+        hi = self.count if hi is None else hi
+        start = off + lo * dtype.itemsize
+        stop = off + hi * dtype.itemsize
+        return self._mm[start:stop].view(dtype)
+
+    def read_batch(self, lo: int, hi: int) -> RecordBatch:
+        n = hi - lo
+        return RecordBatch(
+            partition=np.full(n, self.partition, dtype=np.int32),
+            key_len=self.column("key_len", lo, hi).copy(),
+            value_len=self.column("value_len", lo, hi).copy(),
+            key_null=self.column("key_null", lo, hi).astype(np.bool_),
+            value_null=self.column("value_null", lo, hi).astype(np.bool_),
+            ts_s=self.column("ts_ms", lo, hi) // 1000,
+            key_hash32=self.column("key_hash32", lo, hi).copy(),
+            key_hash64=self.column("key_hash64", lo, hi).copy(),
+            valid=np.ones(n, dtype=np.bool_),
         )
+
+
+class SegmentFileSource(RecordSource):
+    """RecordSource over a directory of {topic}-{partition}.ktaseg files."""
+
+    def __init__(self, segment_dir: str, topic: str):
+        self.segment_dir = segment_dir
+        self.topic = topic
+        # Exact match on "{topic}-{int}.ktaseg": a prefix match would also
+        # swallow segments of topics like "{topic}-extra".
+        import re
+
+        pattern = re.compile(rf"^{re.escape(topic)}-(\d+)\.ktaseg$")
+        self.segments: Dict[int, SegmentFile] = {}
+        for fname in sorted(os.listdir(segment_dir)):
+            m = pattern.match(fname)
+            if not m:
+                continue
+            seg = SegmentFile(os.path.join(segment_dir, fname))
+            if seg.partition != int(m.group(1)):
+                raise ValueError(
+                    f"{fname}: header partition {seg.partition} does not "
+                    f"match filename"
+                )
+            self.segments[seg.partition] = seg
+        if not self.segments:
+            raise SystemExit(
+                f"no {topic}-*.ktaseg files in {segment_dir!r}"
+            )
+
+    def partitions(self) -> List[int]:
+        return sorted(self.segments)
+
+    def watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        start = {p: s.start_offset for p, s in self.segments.items()}
+        end = {p: s.start_offset + s.count for p, s in self.segments.items()}
+        return start, end
+
+    def batches(
+        self,
+        batch_size: int,
+        partitions: Optional[List[int]] = None,
+    ) -> Iterator[RecordBatch]:
+        parts = sorted(partitions) if partitions is not None else self.partitions()
+        # Sequential per-partition chunks: fastest IO pattern, and the order
+        # contract only requires per-partition offset order.
+        for p in parts:
+            seg = self.segments[p]
+            for lo in range(0, seg.count, batch_size):
+                yield seg.read_batch(lo, min(lo + batch_size, seg.count))

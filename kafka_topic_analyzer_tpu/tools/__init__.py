@@ -1,0 +1,1 @@
+"""Operational tools (segment dump creation, snapshot inspection)."""

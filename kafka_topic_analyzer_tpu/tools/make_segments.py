@@ -1,0 +1,67 @@
+"""Create .ktaseg segment dumps from a synthetic workload spec.
+
+Usage:
+    python -m kafka_topic_analyzer_tpu.tools.make_segments \
+        --out /tmp/segs --topic demo \
+        --synthetic "partitions=4,messages=100000,keys=5000"
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from kafka_topic_analyzer_tpu.cli import parse_kv_pairs
+from kafka_topic_analyzer_tpu.io.segfile import write_segment_from_batches
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+
+def spec_from_kv(text: "str | None") -> SyntheticSpec:
+    kv = parse_kv_pairs(text)
+    seed_raw = kv.get("seed")
+    return SyntheticSpec(
+        num_partitions=int(kv.get("partitions", 1)),
+        messages_per_partition=int(kv.get("messages", 1_000_000)),
+        keys_per_partition=int(kv.get("keys", 10_000)),
+        key_null_permille=int(kv.get("key_null", 50)),
+        tombstone_permille=int(kv.get("tombstones", 100)),
+        value_len_min=int(kv.get("vmin", 100)),
+        value_len_max=int(kv.get("vmax", 400)),
+        seed=int(seed_raw, 0) if seed_raw is not None else 0x5EED,
+    )
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True, help="output directory")
+    ap.add_argument("--topic", required=True)
+    ap.add_argument("--synthetic", help="same spec format as the analyzer CLI")
+    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--native", choices=["auto", "on", "off"], default="auto")
+    args = ap.parse_args(argv)
+
+    spec = spec_from_kv(args.synthetic)
+    src: SyntheticSource
+    if args.native in ("auto", "on"):
+        try:
+            from kafka_topic_analyzer_tpu.io.native import NativeSyntheticSource
+
+            src = NativeSyntheticSource(spec)
+        except Exception:
+            if args.native == "on":
+                raise
+            src = SyntheticSource(spec)
+    else:
+        src = SyntheticSource(spec)
+
+    os.makedirs(args.out, exist_ok=True)
+    for p in src.partitions():
+        batches = list(src.batches(args.batch_size, partitions=[p]))
+        path = write_segment_from_batches(args.out, args.topic, p, batches)
+        print(f"wrote {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

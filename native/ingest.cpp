@@ -1,0 +1,178 @@
+// Native ingest shim — the TPU build's counterpart of the reference's only
+// native component, librdkafka (Cargo.toml:19; SURVEY.md §2.2).  The
+// reference leans on librdkafka's C threads for all wire-level work and then
+// processes messages one at a time in Rust; here the native layer's job is
+// the *batch extraction* hot path (SURVEY.md §7 hard parts (a)/(b)): produce
+// fixed-width record-metadata columns (lengths, null flags, timestamps, key
+// hashes) at memory bandwidth so only numeric tensors ever cross into JAX.
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in this image):
+//   - kta_synth_batch:   deterministic synthetic workload generation,
+//                        bit-identical to io/synthetic.py::synth_fields
+//   - kta_hash_batch:    fnv32(reference variant, src/fnv32.rs:92-101) +
+//                        standard fnv64 over packed variable-length keys
+//   - kta_version:       ABI version stamp
+//
+// Build: `make -C native` → libkta_ingest.so (g++ -O3, pthreads).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kFnv32Offset = 0x811c9dc5u;
+// The reference multiplies by the offset basis, NOT the FNV prime —
+// reproduced on purpose (src/fnv32.rs:92-101).
+constexpr uint32_t kFnv32Mult = 0x811c9dc5u;
+constexpr uint64_t kFnv64Offset = 0xcbf29ce484222325ull;
+constexpr uint64_t kFnv64Prime = 0x100000001b3ull;
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+inline uint32_t fnv1a32_ref(const uint8_t* p, int64_t n) {
+  uint32_t h = kFnv32Offset;
+  for (int64_t i = 0; i < n; ++i) h = (h ^ p[i]) * kFnv32Mult;
+  return h;
+}
+
+inline uint64_t fnv1a64(const uint8_t* p, int64_t n) {
+  uint64_t h = kFnv64Offset;
+  for (int64_t i = 0; i < n; ++i) h = (h ^ p[i]) * kFnv64Prime;
+  return h;
+}
+
+// Parallel-for over [0, n) in contiguous chunks.
+template <typename F>
+void parallel_for(int64_t n, int threads, F&& body) {
+  if (threads <= 1 || n < (1 << 14)) {
+    body(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back([&body, lo, hi] { body(lo, hi); });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Mirrors io/synthetic.py::SyntheticSpec (wire contract — keep in sync).
+struct KtaSynthSpec {
+  uint64_t seed;
+  int32_t num_partitions;
+  int64_t messages_per_partition;
+  uint64_t keys_per_partition;
+  int32_t key_null_permille;
+  int32_t tombstone_permille;
+  int32_t value_len_min;
+  int32_t value_len_max;
+  int32_t key_digits;
+  int64_t ts_start_ms;
+  int64_t ts_step_ms;
+};
+
+int32_t kta_version() { return 1; }
+
+// Generate records for global indices [lo, hi) over the partition list
+// `parts` (round-robin: g -> parts[g % nparts] at offset g / nparts),
+// exactly like SyntheticSource.batches.  All output arrays have hi-lo
+// elements.  Returns 0 on success.
+int32_t kta_synth_batch(const KtaSynthSpec* spec,
+                        const int32_t* parts, int32_t nparts,
+                        int64_t lo, int64_t hi, int32_t threads,
+                        int32_t* partition_out, int32_t* key_len_out,
+                        int32_t* value_len_out, uint8_t* key_null_out,
+                        uint8_t* value_null_out, int64_t* ts_s_out,
+                        uint32_t* h32_out, uint64_t* h64_out,
+                        uint8_t* valid_out) {
+  if (!spec || !parts || nparts <= 0 || hi < lo) return -1;
+  const int64_t n = hi - lo;
+  const KtaSynthSpec s = *spec;
+  const int key_len_total = 1 + s.key_digits;
+
+  parallel_for(n, threads, [&](int64_t a, int64_t b) {
+    uint8_t keybuf[64];
+    keybuf[0] = 'k';
+    for (int64_t i = a; i < b; ++i) {
+      const int64_t g = lo + i;
+      const int32_t p = parts[g % nparts];
+      const int64_t o = g / nparts;
+      const uint64_t x =
+          splitmix64(s.seed ^ (static_cast<uint64_t>(p) << 40) ^
+                     static_cast<uint64_t>(o));
+
+      const bool key_null =
+          static_cast<int64_t>(x % 1000ull) < s.key_null_permille;
+      const bool value_null =
+          static_cast<int64_t>((x >> 10) % 1000ull) < s.tombstone_permille;
+      const uint64_t local = (x >> 20) % s.keys_per_partition;
+      const uint64_t key_id =
+          static_cast<uint64_t>(p) +
+          static_cast<uint64_t>(s.num_partitions) * local;
+      const uint64_t vspread =
+          static_cast<uint64_t>(s.value_len_max - s.value_len_min + 1);
+      const int32_t vlen =
+          value_null ? 0
+                     : s.value_len_min +
+                           static_cast<int32_t>((x >> 40) % vspread);
+
+      partition_out[i] = p;
+      value_len_out[i] = vlen;
+      key_null_out[i] = key_null ? 1 : 0;
+      value_null_out[i] = value_null ? 1 : 0;
+      // floor division like numpy (`//`): values are non-negative here.
+      ts_s_out[i] = (s.ts_start_ms + o * s.ts_step_ms) / 1000;
+      valid_out[i] = 1;
+
+      if (key_null) {
+        key_len_out[i] = 0;
+        h32_out[i] = 0;
+        h64_out[i] = 0;
+      } else {
+        key_len_out[i] = key_len_total;
+        uint64_t rem = key_id;
+        for (int d = s.key_digits - 1; d >= 0; --d) {
+          keybuf[1 + d] = static_cast<uint8_t>('0' + (rem % 10));
+          rem /= 10;
+        }
+        h32_out[i] = fnv1a32_ref(keybuf, key_len_total);
+        h64_out[i] = fnv1a64(keybuf, key_len_total);
+      }
+    }
+  });
+  return 0;
+}
+
+// Hash n variable-length byte slices packed in `data` at `offsets`
+// (offsets[n] marks the end).  Used by the Kafka wire source to hash real
+// key bytes off the fetch path.
+int32_t kta_hash_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
+                       int32_t threads, uint32_t* h32_out, uint64_t* h64_out) {
+  if (!data || !offsets || n < 0) return -1;
+  parallel_for(n, threads, [&](int64_t a, int64_t b) {
+    for (int64_t i = a; i < b; ++i) {
+      const int64_t off = offsets[i];
+      const int64_t len = offsets[i + 1] - off;
+      h32_out[i] = fnv1a32_ref(data + off, len);
+      h64_out[i] = fnv1a64(data + off, len);
+    }
+  });
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,179 @@
+"""In-process fake Kafka broker speaking the same wire protocol as the
+client (Metadata v1 / ListOffsets v1 / Fetch v4 / ApiVersions v0), serving
+configurable per-partition records — the cluster-free integration seam
+(SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+
+#: (offset, ts_ms, key, value)
+Record = Tuple[int, int, Optional[bytes], Optional[bytes]]
+
+
+class FakeBroker:
+    def __init__(
+        self,
+        topic: str,
+        partition_records: Dict[int, List[Record]],
+        compression: int = kc.COMPRESSION_NONE,
+        max_records_per_fetch: int = 500,
+        start_offsets: Optional[Dict[int, int]] = None,
+        end_offsets: Optional[Dict[int, int]] = None,
+    ):
+        self.topic = topic
+        self.records = {
+            p: sorted(rs, key=lambda r: r[0]) for p, rs in partition_records.items()
+        }
+        self.compression = compression
+        self.max_records_per_fetch = max_records_per_fetch
+        self.start_offsets = start_offsets or {
+            p: (rs[0][0] if rs else 0) for p, rs in self.records.items()
+        }
+        # High watermark: one past the last retained offset (overridable to
+        # simulate a watermark snapshot older than the retained log).
+        self.end_offsets = end_offsets or {
+            p: (rs[-1][0] + 1 if rs else self.start_offsets[p])
+            for p, rs in self.records.items()
+        }
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("127.0.0.1", 0))
+        self._server.listen(8)
+        self.port = self._server.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.fetch_count = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FakeBroker":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "FakeBroker":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- serving -------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytes]:
+        chunks = []
+        got = 0
+        while got < n:
+            try:
+                chunk = conn.recv(n - got)
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                head = self._recv_exact(conn, 4)
+                if head is None:
+                    return
+                (length,) = struct.unpack(">i", head)
+                payload = self._recv_exact(conn, length)
+                if payload is None:
+                    return
+                api_key, api_version, corr, _client, r = kc.decode_request_header(
+                    payload
+                )
+                body = self._dispatch(api_key, api_version, r)
+                resp = struct.pack(">i", 4 + len(body)) + struct.pack(">i", corr) + body
+                conn.sendall(resp)
+
+    def _dispatch(self, api_key: int, api_version: int, r: kc.ByteReader) -> bytes:
+        if api_key == kc.API_VERSIONS:
+            return kc.encode_api_versions_response(
+                [(kc.API_FETCH, 0, 4), (kc.API_LIST_OFFSETS, 0, 1), (kc.API_METADATA, 0, 1)]
+            )
+        if api_key == kc.API_METADATA:
+            requested = []
+            n = r.i32()
+            for _ in range(max(n, 0)):
+                requested.append(r.string())
+            topics: List[kc.TopicMetadata] = []
+            for name in requested if requested else [self.topic]:
+                if name == self.topic:
+                    topics.append(
+                        kc.TopicMetadata(
+                            0,
+                            name,
+                            [
+                                kc.PartitionMetadata(0, p, 0)
+                                for p in sorted(self.records)
+                            ],
+                        )
+                    )
+                else:
+                    topics.append(
+                        kc.TopicMetadata(
+                            kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, name or "", []
+                        )
+                    )
+            return kc.encode_metadata_response(
+                kc.MetadataResponse({0: ("127.0.0.1", self.port)}, 0, topics)
+            )
+        if api_key == kc.API_LIST_OFFSETS:
+            _topic, parts = kc.decode_list_offsets_request(r)
+            results = []
+            for pid, ts in parts:
+                if pid not in self.records:
+                    results.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, -1))
+                elif ts == kc.EARLIEST_TIMESTAMP:
+                    results.append((pid, 0, -1, self.start_offsets[pid]))
+                else:
+                    results.append((pid, 0, -1, self.end_offsets[pid]))
+            return kc.encode_list_offsets_response(self.topic, results)
+        if api_key == kc.API_FETCH:
+            self.fetch_count += 1
+            _topic, parts, _mw, _mb, _xb = kc.decode_fetch_request(r)
+            out = []
+            for pid, fetch_offset, _pmax in parts:
+                rs = self.records.get(pid)
+                if rs is None:
+                    out.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, b""))
+                    continue
+                hw = self.end_offsets[pid]
+                selected = [rec for rec in rs if rec[0] >= fetch_offset]
+                selected = selected[: self.max_records_per_fetch]
+                record_set = (
+                    kc.encode_record_batch(selected, self.compression)
+                    if selected
+                    else b""
+                )
+                out.append((pid, 0, hw, record_set))
+            return kc.encode_fetch_response(self.topic, out)
+        raise AssertionError(f"fake broker: unsupported api {api_key}")

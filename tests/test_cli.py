@@ -55,6 +55,30 @@ def test_cli_tpu_matches_cpu_report(capsys):
     assert stable(out_cpu) == stable(out_tpu)
 
 
+def test_cli_kafka_source_end_to_end(capsys):
+    """The reference-identical invocation: -t topic -b broker."""
+    from fake_broker import FakeBroker
+
+    records = {
+        0: [(i, 1_600_000_000_000 + i, f"k{i%9}".encode(),
+             None if i % 5 == 3 else b"x" * 20) for i in range(100)],
+        1: [(i, 1_600_000_000_000 + i, None, b"y" * 30) for i in range(60)],
+    }
+    with FakeBroker("real.topic", records) as broker:
+        assert main([
+            "-t", "real.topic",
+            "-b", f"127.0.0.1:{broker.port}",
+            "--librdkafka", "fetch.wait.max.ms=10,check.crcs=true",
+            "-c", "--alive-bitmap-bits", "20",
+            "--quiet", "--native", "off",
+        ]) == 0
+    out = capsys.readouterr().out
+    assert "Topic real.topic" in out
+    assert "Alive keys: " in out
+    # 100 + 60 records scanned
+    assert "| 0    | 100  | 100   |" in out
+
+
 def test_cli_empty_topic_exits_minus_2(capsys):
     with pytest.raises(SystemExit) as e:
         main([

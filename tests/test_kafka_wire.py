@@ -1,0 +1,181 @@
+"""Kafka wire-protocol client against the in-process fake broker.
+
+Covers: codec roundtrips (varints, record batches, CRC32-C, gzip), the
+topology handshake, the full fetch loop with multi-fetch pagination,
+compaction gaps, null keys/values, missing timestamps, and end-to-end
+metric parity with a direct scan of the same records.
+"""
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource, parse_bootstrap
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+from fake_broker import FakeBroker
+
+
+# ---------------------------------------------------------------------------
+# codec units
+
+
+def test_varint_roundtrip():
+    w = kc.ByteWriter()
+    values = [0, 1, -1, 2, -2, 127, 128, -300, 10**12, -(10**12)]
+    for v in values:
+        w.varint(v)
+    r = kc.ByteReader(w.done())
+    assert [r.varint() for _ in values] == values
+
+
+@pytest.mark.parametrize("compression", [kc.COMPRESSION_NONE, kc.COMPRESSION_GZIP])
+def test_record_batch_roundtrip(compression):
+    records = [
+        (100, 1_600_000_000_000, b"k1", b"v1"),
+        (101, 1_600_000_000_123, None, b"v2"),       # null key
+        (105, 1_600_000_001_000, b"k3", None),       # tombstone, offset gap
+        (106, -1, b"", b""),                          # empty (not null) k/v
+    ]
+    buf = kc.encode_record_batch(records, compression)
+    got = [(off, ts, k, v) for off, (ts, k, v) in kc.decode_record_batches(buf, verify_crc=True)]
+    assert got == records
+
+
+def test_record_batch_crc_detects_corruption():
+    buf = bytearray(kc.encode_record_batch([(0, 0, b"k", b"v")]))
+    buf[-1] ^= 0xFF
+    with pytest.raises(kc.KafkaProtocolError, match="CRC"):
+        list(kc.decode_record_batches(bytes(buf), verify_crc=True))
+
+
+def test_partial_trailing_batch_tolerated():
+    full = kc.encode_record_batch([(0, 0, b"k", b"v"), (1, 0, b"k2", b"v2")])
+    truncated = full + full[: len(full) // 2]
+    assert len(list(kc.decode_record_batches(truncated))) == 2
+
+
+def test_parse_bootstrap():
+    assert parse_bootstrap("a:9092,b") == [("a", 9092), ("b", 9092)]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end against the fake broker
+
+
+def _mk_records(partition, n, start=0, key_every=1, tombstone_every=7, ts0=1_600_000_000_000):
+    out = []
+    for i in range(n):
+        off = start + i
+        key = f"p{partition}-key-{i % 50}".encode() if i % key_every == 0 else None
+        value = None if (key is not None and i % tombstone_every == 3) else bytes(10 + i % 40)
+        out.append((off, ts0 + i * 1000, key, value))
+    return out
+
+
+def _scan_via_wire(broker, topic="wire.topic", batch_size=333, overrides=None):
+    src = KafkaWireSource(f"127.0.0.1:{broker.port}", topic, overrides=overrides)
+    cfg = AnalyzerConfig(
+        num_partitions=len(src.partitions()), batch_size=batch_size,
+        count_alive_keys=True, alive_bitmap_bits=20,
+    )
+    be = CpuExactBackend(cfg, init_now_s=10**10)
+    result = run_scan(topic, src, be, batch_size)
+    src.close()
+    return result
+
+
+def _scan_direct(partition_records, partitions):
+    cfg = AnalyzerConfig(
+        num_partitions=len(partitions), batch_size=1024,
+        count_alive_keys=True, alive_bitmap_bits=20,
+    )
+    be = CpuExactBackend(cfg, init_now_s=10**10)
+    from kafka_topic_analyzer_tpu.io.kafka_wire import records_to_batch
+
+    for pidx, p in enumerate(sorted(partitions)):
+        rows = [(pidx, ts, k, v) for (_, ts, k, v) in partition_records[p]]
+        if rows:
+            be.update(records_to_batch(rows))
+    return be.finalize()
+
+
+def test_wire_scan_matches_direct_scan():
+    records = {0: _mk_records(0, 400), 1: _mk_records(1, 250), 2: []}
+    with FakeBroker("wire.topic", records, max_records_per_fetch=97) as broker:
+        result = _scan_via_wire(broker)
+    direct = _scan_direct(records, [0, 1, 2])
+    m = result.metrics
+    assert np.array_equal(m.per_partition, direct.per_partition)
+    assert m.alive_keys == direct.alive_keys
+    assert m.overall_count == 650
+    assert m.earliest_ts_s == direct.earliest_ts_s
+    assert m.latest_ts_s == direct.latest_ts_s
+    assert m.smallest_message == direct.smallest_message
+    assert m.largest_message == direct.largest_message
+    # Pagination actually happened (400 records / 97 per fetch).
+    assert broker.fetch_count > 4
+
+
+def test_wire_scan_gzip():
+    records = {0: _mk_records(0, 120)}
+    with FakeBroker("wire.topic", records, compression=kc.COMPRESSION_GZIP) as broker:
+        result = _scan_via_wire(broker, overrides={"check.crcs": "true"})
+    assert result.metrics.overall_count == 120
+
+
+def test_wire_scan_compaction_gaps():
+    # Only every third offset retained; start offset nonzero.
+    rows = [r for r in _mk_records(0, 300, start=50) if r[0] % 3 == 0]
+    with FakeBroker("wire.topic", {0: rows}) as broker:
+        result = _scan_via_wire(broker)
+    assert result.metrics.overall_count == len(rows)
+    # Watermarks reflect the retained range, like fetch_watermarks.
+    assert result.start_offsets == {0: 51}
+    assert result.end_offsets == {0: 349}  # last retained offset 348 + 1
+
+
+def test_wire_missing_timestamps_map_to_epoch():
+    rows = [(0, -1, b"k", b"v"), (1, -1, b"k2", b"v2")]
+    with FakeBroker("wire.topic", {0: rows}) as broker:
+        result = _scan_via_wire(broker)
+    assert result.metrics.earliest_ts_s == 0  # unwrap_or(0) semantics
+
+
+def test_wire_all_records_beyond_watermark_terminates():
+    # Snapshot end=15, but compaction removed 10..14 and retained records
+    # continue at 20: the fetch at offset 10 returns a non-empty batch whose
+    # offsets are all >= end.  The scan must skip to the watermark and
+    # terminate with only the 10 in-window records.
+    rows = _mk_records(0, 10) + [
+        (20 + i, 1_600_000_100_000 + i, b"late", b"v") for i in range(10)
+    ]
+    with FakeBroker(
+        "wire.topic", {0: rows}, end_offsets={0: 15}
+    ) as broker:
+        result = _scan_via_wire(broker)
+    assert result.metrics.overall_count == 10
+
+
+def test_gzip_uses_real_gzip_framing():
+    # Kafka's gzip codec is RFC-1952; the encoded payload must carry the
+    # gzip magic so real brokers/clients interoperate.
+    buf = kc.encode_record_batch([(0, 0, b"k", b"v")], kc.COMPRESSION_GZIP)
+    # header: offset(8) + len(4) + epoch(4) + magic(1) + crc(4) + attrs..count(45 total to payload)
+    assert b"\x1f\x8b" in buf  # gzip magic somewhere in the batch payload
+
+
+def test_topic_not_found_exits():
+    with FakeBroker("other.topic", {0: []}) as broker:
+        with pytest.raises(SystemExit, match="Topic not found!"):
+            KafkaWireSource(f"127.0.0.1:{broker.port}", "missing.topic")
+
+
+def test_empty_topic_is_empty():
+    with FakeBroker("wire.topic", {0: [], 1: []}) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "wire.topic")
+        assert src.is_empty()
+        src.close()
