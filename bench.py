@@ -36,9 +36,10 @@ def main() -> int:
 
     import jax
 
-    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend, batch_to_arrays
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
     from kafka_topic_analyzer_tpu.config import AnalyzerConfig
     from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+    from kafka_topic_analyzer_tpu.packing import packed_nbytes
 
     feats = set(args.features.split(","))
     config = AnalyzerConfig(
@@ -62,14 +63,20 @@ def main() -> int:
 
     print(f"bench: device={jax.devices()[0]}", file=sys.stderr)
     t_gen = time.perf_counter()
-    src = SyntheticSource(spec)
+    try:
+        from kafka_topic_analyzer_tpu.io.native import NativeSyntheticSource
+
+        src = NativeSyntheticSource(spec)
+    except Exception:
+        src = SyntheticSource(spec)
     host_batches = list(src.batches(args.batch_size))
     host_batches = [b.pad_to(args.batch_size) for b in host_batches]
     gen_s = time.perf_counter() - t_gen
     total_host = sum(b.num_valid for b in host_batches)
     print(
         f"bench: generated {total_host} records in {gen_s:.1f}s "
-        f"({total_host / gen_s:,.0f}/s host)",
+        f"({total_host / gen_s:,.0f}/s host, {type(src).__name__}); "
+        f"{packed_nbytes(config, args.batch_size) / args.batch_size:.1f} B/record on the wire",
         file=sys.stderr,
     )
 

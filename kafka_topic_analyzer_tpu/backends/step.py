@@ -19,10 +19,10 @@ from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState
 from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState
 from kafka_topic_analyzer_tpu.models.state import AnalyzerState
 from kafka_topic_analyzer_tpu.jax_support import jnp
-from kafka_topic_analyzer_tpu.ops.bitmap import bitmap_update
+from kafka_topic_analyzer_tpu.ops.bitmap import bitmap_apply_pairs
 from kafka_topic_analyzer_tpu.ops.counters import counters_update, extremes_update
 from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_update
-from kafka_topic_analyzer_tpu.ops.hll import hll_update
+from kafka_topic_analyzer_tpu.ops.hll import hll_apply
 
 
 def analyzer_step(
@@ -76,11 +76,11 @@ def analyzer_step(
 
     alive_state = state.alive
     if alive_state is not None:
-        words = bitmap_update(
+        words = bitmap_apply_pairs(
             alive_state.words,
-            arrays["key_hash32"],
-            alive=vn,
-            active=kn,
+            arrays["alive_slot"],
+            arrays["alive_flag"],
+            arrays["n_pairs"],
             bits=config.alive_bitmap_bits,
             space_index=space_index,
             space_shards=config.space_shards,
@@ -89,7 +89,7 @@ def analyzer_step(
 
     hll_state = state.hll
     if hll_state is not None:
-        regs = hll_update(hll_state.regs, arrays["key_hash64"], kn, config.hll_p)
+        regs = hll_apply(hll_state.regs, arrays["hll_idx"], arrays["hll_rho"])
         hll_state = HLLState(regs=regs)
 
     q_state = state.quantiles

@@ -1,21 +1,24 @@
 """TPU backend: jitted streaming reduction with donated device state.
 
-Design (SURVEY.md §7 M3):
+Design (SURVEY.md §7 M3 + the transfer work in packing.py):
 - the accumulator state lives on device for the whole scan; each `update`
   dispatches one jitted step with the state buffers *donated*, so XLA updates
   them in place and the host never round-trips the state (hard part (e));
+- each batch crosses the host→device boundary as ONE packed uint8 buffer in
+  wire format v1 (packing.py) — minimal bytes per record, host-side
+  pre-reduction for the bitmap/HLL updates;
 - dispatch is asynchronous — the host thread returns immediately and keeps
-  feeding batches while the device works; `finalize` synchronizes once;
-- batches are padded to the static batch size, so every step hits the same
-  compiled executable.
+  packing the next batch while the device works; `finalize` synchronizes;
+- a one-time pack→unpack self-check at init guards the bitcast layout
+  against byte-order surprises on new platforms.
 
 Multi-device runs go through `kafka_topic_analyzer_tpu.parallel.sharded`
-(same step function under ``shard_map``).
+(same packed step under ``shard_map``).
 """
 
 from __future__ import annotations
 
-import functools
+import os
 
 import jax
 import numpy as np
@@ -25,27 +28,53 @@ from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
 from kafka_topic_analyzer_tpu.backends.step import analyzer_step
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 from kafka_topic_analyzer_tpu.models.state import AnalyzerState
+from kafka_topic_analyzer_tpu.packing import pack_batch, unpack_device, unpack_numpy
 from kafka_topic_analyzer_tpu.records import RecordBatch
 from kafka_topic_analyzer_tpu.results import TopicMetrics
 from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
 
-#: RecordBatch fields shipped to the device, in a fixed order.
-DEVICE_FIELDS = (
-    "partition",
-    "key_len",
-    "value_len",
-    "key_null",
-    "value_null",
-    "ts_s",
-    "key_hash32",
-    "key_hash64",
-    "valid",
-)
+
+def make_packed_step(config: AnalyzerConfig):
+    """The jittable forward step: (state, packed uint8 buffer) → state."""
+
+    def step(state: AnalyzerState, buf) -> AnalyzerState:
+        return analyzer_step(state, unpack_device(buf, config), config)
+
+    return step
 
 
-def batch_to_arrays(batch: RecordBatch, batch_size: int):
-    batch = batch.pad_to(batch_size)
-    return {name: getattr(batch, name) for name in DEVICE_FIELDS}
+def self_check_unpack(device=None) -> None:
+    """One-time guard: pack a known batch on the host, unpack it on the
+    device, and compare — catches any bitcast/byte-order mismatch before it
+    could corrupt results."""
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+    config = AnalyzerConfig(
+        num_partitions=3,
+        batch_size=128,
+        count_alive_keys=True,
+        alive_bitmap_bits=16,
+        enable_hll=True,
+        hll_p=8,
+    )
+    spec = SyntheticSpec(
+        num_partitions=3, messages_per_partition=40, keys_per_partition=16, seed=11
+    )
+    batch = next(SyntheticSource(spec).batches(100))
+    buf = pack_batch(batch, config, use_native=False)
+    expected = unpack_numpy(buf, config)
+    unpack = jax.jit(lambda b: unpack_device(b, config))
+    got = unpack(jax.device_put(buf, device))
+    for name, exp in expected.items():
+        g = np.asarray(got[name])
+        if not np.array_equal(g, np.asarray(exp)):
+            raise RuntimeError(
+                f"packed-transfer self-check failed on field {name!r}: "
+                f"device unpack disagrees with host layout (byte order?)"
+            )
+
+
+_checked_devices: "set[str]" = set()
 
 
 class TpuBackend(MetricBackend):
@@ -54,28 +83,38 @@ class TpuBackend(MetricBackend):
         config: AnalyzerConfig,
         init_now_s: "int | None" = None,
         device=None,
+        use_native: bool = True,
     ):
         super().__init__(config)
         self.init_now_s = utc_now_seconds() if init_now_s is None else init_now_s
         self.device = device if device is not None else jax.devices()[0]
+        self.use_native = use_native
+        key = str(self.device)
+        if key not in _checked_devices and not os.environ.get("KTA_SKIP_SELFCHECK"):
+            self_check_unpack(self.device)
+            _checked_devices.add(key)
         with jax.default_device(self.device):
             self.state = AnalyzerState.init(config)
-        self._step = jax.jit(
-            functools.partial(analyzer_step, config=config),
-            donate_argnums=(0,),
-        )
+        self._step = jax.jit(make_packed_step(config), donate_argnums=(0,))
         self.batches_seen = 0
 
     def update(self, batch: RecordBatch) -> None:
-        arrays = batch_to_arrays(batch, self.config.batch_size)
-        arrays = {
-            k: jax.device_put(v, self.device) for k, v in arrays.items()
-        }
-        self.state = self._step(self.state, arrays)
+        buf = pack_batch(batch, self.config, use_native=self.use_native)
+        self.state = self._step(self.state, jax.device_put(buf, self.device))
         self.batches_seen += 1
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.state)
+
+    # -- snapshot/resume (checkpoint.py) -------------------------------------
+
+    def get_state(self) -> AnalyzerState:
+        return self.state
+
+    def set_state(self, host_state: AnalyzerState) -> None:
+        self.state = jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), self.device), host_state
+        )
 
     def finalize(self) -> TopicMetrics:
         host_state = jax.tree.map(np.asarray, jax.device_get(self.state))

@@ -1,0 +1,129 @@
+"""Scan snapshots: resumable long scans (SURVEY.md §5.4).
+
+The reference deliberately has no resume: offsets are stored but never
+committed, and every run rescans from earliest (src/kafka.rs:28-34,
+src/main.rs:63-65's stale help text notwithstanding).  For 1B-message scans
+that is wasteful, so the TPU build adds periodic snapshots: the analyzer
+state is a small, associatively-merged pytree, so a snapshot is just
+
+    (config fingerprint, per-partition next offsets, state arrays)
+
+written atomically.  Resuming replays nothing: the saved state already
+folds every record below the saved offsets, and the source continues from
+them.  Works because updates are deterministic folds and batches respect
+per-partition offset order (records.py contract).
+
+Format: one ``.npz`` per snapshot (atomic rename), holding the state leaves
+flattened by pytree path plus offset/config metadata as JSON strings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.models.state import AnalyzerState
+
+SNAPSHOT_NAME = "scan_snapshot.npz"
+
+
+def config_fingerprint(config: AnalyzerConfig, topic: str) -> str:
+    """Snapshot compatibility key: anything that changes state shapes or
+    fold semantics participates."""
+    payload = json.dumps(
+        {"topic": topic, **dataclasses.asdict(config)}, sort_keys=True
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _flatten(state: AnalyzerState) -> Dict[str, np.ndarray]:
+    flat = {}
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in leaves:
+        key = "state" + "".join(str(p) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save_snapshot(
+    directory: str,
+    topic: str,
+    config: AnalyzerConfig,
+    state: AnalyzerState,
+    next_offsets: Dict[int, int],
+    records_seen: int,
+    init_now_s: int,
+) -> str:
+    """Atomically write the snapshot; returns its path."""
+    os.makedirs(directory, exist_ok=True)
+    host_state = jax.tree.map(np.asarray, jax.device_get(state))
+    flat = _flatten(host_state)
+    meta = {
+        "fingerprint": config_fingerprint(config, topic),
+        "topic": topic,
+        "next_offsets": {str(k): int(v) for k, v in next_offsets.items()},
+        "records_seen": int(records_seen),
+        "init_now_s": int(init_now_s),
+    }
+    path = os.path.join(directory, SNAPSHOT_NAME)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, __meta__=json.dumps(meta), **flat)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_snapshot(
+    directory: str,
+    topic: str,
+    config: AnalyzerConfig,
+    template: Optional[AnalyzerState] = None,
+) -> Optional[Tuple[AnalyzerState, Dict[int, int], int, int]]:
+    """Load (state, next_offsets, records_seen, init_now_s), or None if no
+    compatible snapshot exists.  An incompatible snapshot (different config/
+    topic) raises — silently restarting would double-count.
+
+    ``template`` supplies the expected state shapes; it defaults to the
+    single-device layout.  Sharded backends pass their freshly-initialized
+    (data-stacked) state — the engine uses ``backend.get_state()`` — since
+    their leaves carry a leading data-shard axis.
+    """
+    path = os.path.join(directory, SNAPSHOT_NAME)
+    if not os.path.exists(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        if meta["fingerprint"] != config_fingerprint(config, topic):
+            raise ValueError(
+                f"snapshot at {path} was taken with a different topic/config "
+                "(fingerprint mismatch) — delete it or match the original flags"
+            )
+        if template is None:
+            template = AnalyzerState.init(config)
+        template = jax.tree.map(np.asarray, jax.device_get(template))
+        flat = _flatten(template)
+        loaded = {k: z[k] for k in flat}
+    leaves_p, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path_key, leaf in leaves_p:
+        key = "state" + "".join(str(p) for p in path_key)
+        arr = loaded[key]
+        if arr.shape != leaf.shape or arr.dtype != np.asarray(leaf).dtype:
+            raise ValueError(f"snapshot leaf {key} has shape {arr.shape}")
+        new_leaves.append(arr)
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    offsets = {int(k): int(v) for k, v in meta["next_offsets"].items()}
+    return state, offsets, int(meta["records_seen"]), int(meta["init_now_s"])

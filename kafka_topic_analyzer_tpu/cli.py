@@ -74,6 +74,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Use the native C++ ingest shim when available")
     p.add_argument("--profile-dir", metavar="DIR",
                    help="Write a JAX profiler trace of the scan")
+    p.add_argument("--snapshot-dir", metavar="DIR",
+                   help="Periodically save resumable scan snapshots here")
+    p.add_argument("--snapshot-every", type=float, default=60.0,
+                   metavar="SECONDS", help="Snapshot interval (default 60s)")
+    p.add_argument("--resume", action="store_true",
+                   help="Resume from a snapshot in --snapshot-dir if present")
+    p.add_argument("--stats", action="store_true",
+                   help="Print per-stage throughput stats to stderr")
     p.add_argument("--quiet", action="store_true", help="No progress spinner")
     return p
 
@@ -178,7 +186,13 @@ def main(argv: "list[str] | None" = None) -> int:
             backend,
             batch_size=args.batch_size,
             spinner=Spinner(enabled=not args.quiet),
+            snapshot_dir=args.snapshot_dir,
+            snapshot_every_s=args.snapshot_every,
+            resume=args.resume,
         )
+    if args.stats:
+        print("scan stages:", file=sys.stderr)
+        print(result.profile.summary(), file=sys.stderr)
 
     sys.stdout.write(
         render_report(

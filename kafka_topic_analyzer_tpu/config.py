@@ -41,6 +41,7 @@ class AnalyzerConfig:
     #: O(2^bits) memory with O(2^hll_p) at ~1.04/sqrt(2^hll_p) rel. error).
     enable_hll: bool = False
     #: HLL precision p (m = 2^p registers). p=14 → 0.81% standard error.
+    #: Capped at 15 so bucket indices fit the packed transfer's u16 section.
     hll_p: int = 14
     #: DDSketch message-size quantiles (new capability).
     enable_quantiles: bool = False
@@ -49,6 +50,12 @@ class AnalyzerConfig:
     #: Number of log-gamma buckets (covers sizes up to gamma^nbuckets).
     quantile_buckets: int = 2560
 
+    # --- host→device transfer ----------------------------------------------
+    #: Pre-reduce bitmap updates on the host: last-writer-wins dedupe of
+    #: (slot, alive) pairs per batch (C++ shim or numpy), so the device does
+    #: two scatter-adds instead of a 1M-element sort.  The device-sort path
+    #: remains available for reference (packing always dedupes on host; the
+    #: sort kernel is exercised by its own unit tests).
     # --- parallelism --------------------------------------------------------
     #: Device mesh shape (data, space).  'data' shards record batches by
     #: partition; 'space' shards the alive-bitmap slot space.  (1, 1) runs
@@ -62,8 +69,8 @@ class AnalyzerConfig:
             raise ValueError("batch_size must be >= 1")
         if not (0 < self.alive_bitmap_bits <= 32):
             raise ValueError("alive_bitmap_bits must be in (0, 32]")
-        if not (4 <= self.hll_p <= 18):
-            raise ValueError("hll_p must be in [4, 18]")
+        if not (4 <= self.hll_p <= 15):
+            raise ValueError("hll_p must be in [4, 15]")
         if self.quantile_buckets < 8:
             raise ValueError("quantile_buckets must be >= 8")
 

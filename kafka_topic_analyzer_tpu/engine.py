@@ -55,20 +55,101 @@ class ScanResult:
     end_offsets: "dict[int, int]"
 
 
+class _ProgressTracker:
+    """Per-partition next-offset tracking for snapshot resume.
+
+    Gapless sources are tracked by counting records; sources that attach
+    per-record offsets (compacted Kafka topics) are tracked exactly.
+    """
+
+    def __init__(self, start_offsets: "dict[int, int]"):
+        self.next_offsets = dict(start_offsets)
+
+    def observe(self, batch: RecordBatch, true_partition: np.ndarray) -> None:
+        valid = batch.valid
+        if batch.offsets is not None:
+            parts = true_partition[valid]
+            offs = batch.offsets[valid]
+            for p in np.unique(parts):
+                self.next_offsets[int(p)] = max(
+                    self.next_offsets.get(int(p), 0),
+                    int(offs[parts == p].max()) + 1,
+                )
+        else:
+            parts, counts = np.unique(true_partition[valid], return_counts=True)
+            for p, c in zip(parts.tolist(), counts.tolist()):
+                self.next_offsets[p] = self.next_offsets.get(p, 0) + int(c)
+
+
 def run_scan(
     topic: str,
     source: RecordSource,
     backend: MetricBackend,
     batch_size: int,
     spinner: Optional[Spinner] = None,
+    snapshot_dir: Optional[str] = None,
+    snapshot_every_s: float = 60.0,
+    resume: bool = False,
 ) -> ScanResult:
-    """Full earliest→latest scan of the topic through the backend."""
+    """Full earliest→latest scan of the topic through the backend.
+
+    With ``snapshot_dir`` set, the analyzer state + per-partition progress
+    are saved atomically every ``snapshot_every_s`` seconds; with ``resume``
+    a compatible snapshot restarts the scan where it left off
+    (checkpoint.py; requires a backend with get_state/set_state, i.e. the
+    TPU backends)."""
     pindex = PartitionIndex(source.partitions())
     start_offsets, end_offsets = source.watermarks()
     profile = ScanProfile()
     spinner = spinner or Spinner(enabled=False)
     t0 = time.monotonic()
     seq = 0
+
+    start_at = None
+    tracker = _ProgressTracker(start_offsets)
+    can_snapshot = snapshot_dir is not None and hasattr(backend, "get_state")
+    if snapshot_dir is not None and not hasattr(backend, "get_state"):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "backend %s does not support snapshots; continuing without",
+            type(backend).__name__,
+        )
+    if resume and can_snapshot:
+        from kafka_topic_analyzer_tpu.checkpoint import load_snapshot
+
+        snap = load_snapshot(
+            snapshot_dir, topic, backend.config, template=backend.get_state()
+        )
+        if snap is not None:
+            state, offsets, records_seen, init_now_s = snap
+            backend.set_state(state)
+            backend.init_now_s = init_now_s
+            tracker.next_offsets.update(offsets)
+            start_at = offsets
+            seq = records_seen
+    last_snap = time.monotonic()
+
+    def maybe_snapshot(force: bool = False) -> None:
+        nonlocal last_snap
+        if not can_snapshot:
+            return
+        now = time.monotonic()
+        if not force and now - last_snap < snapshot_every_s:
+            return
+        from kafka_topic_analyzer_tpu.checkpoint import save_snapshot
+
+        with profile.stage("snapshot"):
+            save_snapshot(
+                snapshot_dir,
+                topic,
+                backend.config,
+                backend.get_state(),
+                tracker.next_offsets,
+                seq,
+                backend.init_now_s,
+            )
+        last_snap = time.monotonic()
 
     if hasattr(backend, "update_shards"):
         # Sharded scan: one batch stream per data shard, each restricted to
@@ -79,7 +160,9 @@ def run_scan(
         d = backend.config.data_shards
         shard_parts = assign_partitions(pindex.ids, d)
         iters = [
-            source.batches(batch_size, partitions=parts) if parts else iter(())
+            source.batches(batch_size, partitions=parts, start_at=start_at)
+            if parts
+            else iter(())
             for parts in shard_parts
         ]
         alive = [True] * d
@@ -93,6 +176,7 @@ def run_scan(
                         alive[i] = False
                     else:
                         step_valid += b.num_valid
+                        tracker.observe(b, b.partition)
                         b = pindex.remap_batch(b)
                     shard_batches.append(b)
             if step_valid == 0 and not any(alive):
@@ -100,9 +184,10 @@ def run_scan(
             with profile.stage("dispatch", items=step_valid):
                 backend.update_shards(shard_batches)
             seq += step_valid
+            maybe_snapshot()
             spinner.set_message(f"[Sq: {seq} | T: {topic} | shards: {d}]")
     else:
-        batches = source.batches(batch_size)
+        batches = source.batches(batch_size, start_at=start_at)
         while True:
             with profile.stage("ingest"):
                 batch = next(batches, None)
@@ -111,10 +196,12 @@ def run_scan(
             nvalid = batch.num_valid
             last = len(batch) - 1
             last_partition = int(batch.partition[last])  # true id, pre-remap
+            tracker.observe(batch, batch.partition)
             batch = pindex.remap_batch(batch)
             with profile.stage("dispatch", items=nvalid, nbytes=batch.nbytes):
                 backend.update(batch)
             seq += nvalid
+            maybe_snapshot()
             spinner.set_message(
                 f"[Sq: {seq} | T: {topic} | P: {last_partition} | "
                 f"O: ~ | Ts: {format_utc_seconds(int(batch.ts_s[last]))}]"

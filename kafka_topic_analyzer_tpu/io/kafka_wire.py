@@ -284,20 +284,35 @@ class KafkaWireSource(RecordSource):
         self,
         batch_size: int,
         partitions: Optional[List[int]] = None,
+        start_at: Optional[Dict[int, int]] = None,
     ) -> Iterator[RecordBatch]:
         start, end = self.watermarks()
         parts = sorted(partitions) if partitions is not None else self.partitions()
         next_offset = {p: start[p] for p in parts}
+        if start_at:
+            for p in parts:
+                if p in start_at:
+                    next_offset[p] = max(next_offset[p], start_at[p])
         remaining = {p for p in parts if next_offset[p] < end[p]}
 
-        pend: List[Tuple[int, int, Optional[bytes], Optional[bytes]]] = []
-        # (partition, ts_ms, key, value) accumulator flushed as RecordBatches.
+        pend: List[Tuple[int, int, int, Optional[bytes], Optional[bytes]]] = []
+        # (partition, offset, ts_ms, key, value) accumulator flushed as
+        # RecordBatches (offsets ride along for snapshot resume).
 
         def flush(force: bool) -> Iterator[RecordBatch]:
             while len(pend) >= batch_size or (force and pend):
                 chunk = pend[:batch_size]
                 del pend[:batch_size]
-                yield self._records_to_batch(chunk)
+                batch = records_to_batch(
+                    [(p, ts, k, v) for p, _off, ts, k, v in chunk],
+                    use_native=self.use_native_hashing,
+                )
+                batch.offsets = np.fromiter(
+                    (off for _p, off, _ts, _k, _v in chunk),
+                    dtype=np.int64,
+                    count=len(chunk),
+                )
+                yield batch
 
         import time
 
@@ -359,7 +374,7 @@ class KafkaWireSource(RecordSource):
                             continue  # compressed batches can start earlier
                         if off >= end[p]:
                             break
-                        pend.append((p, ts_ms, key, value))
+                        pend.append((p, off, ts_ms, key, value))
                         next_offset[p] = off + 1
                         consumed += 1
                         progressed = True

@@ -22,7 +22,10 @@ from kafka_topic_analyzer_tpu.records import RecordBatch
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libkta_ingest.so")
+#: ABI version baked into the filename (see native/Makefile): a rebuild can
+#: never be shadowed by a stale still-mapped library at the same path.
+_ABI = 2
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", f"libkta_ingest.v{_ABI}.so")
 
 _lock = threading.Lock()
 _lib: "ctypes.CDLL | None" = None
@@ -74,10 +77,14 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
                 _build()
             lib = ctypes.CDLL(_SO_PATH)
             lib.kta_version.restype = ctypes.c_int32
-            if lib.kta_version() != 1:
-                raise RuntimeError("libkta_ingest ABI version mismatch")
+            if lib.kta_version() != _ABI:
+                raise RuntimeError(
+                    f"libkta_ingest ABI mismatch: {_SO_PATH} reports "
+                    f"{lib.kta_version()}, expected {_ABI}"
+                )
             lib.kta_synth_batch.restype = ctypes.c_int32
             lib.kta_hash_batch.restype = ctypes.c_int32
+            lib.kta_dedupe_slots.restype = ctypes.c_int64
         except Exception as e:  # remember the failure
             _load_error = e
             raise
@@ -172,6 +179,35 @@ def hash_batch_native(
     return h32, h64
 
 
+def dedupe_slots_native(
+    h32: np.ndarray, active: np.ndarray, alive: np.ndarray, bits: int
+) -> "tuple[np.ndarray, np.ndarray]":
+    """Last-writer-wins (slot, aliveness) dedupe via the C++ shim.
+
+    NOTE: pair order differs from the numpy implementation (first-touch vs
+    sorted) — callers must not rely on ordering, only on the set semantics.
+    """
+    lib = load_library()
+    n = len(h32)
+    h32 = np.ascontiguousarray(h32, dtype=np.uint32)
+    active = np.ascontiguousarray(active, dtype=np.uint8)
+    alive = np.ascontiguousarray(alive, dtype=np.uint8)
+    slot_out = np.empty(n, dtype=np.uint32)
+    alive_out = np.empty(n, dtype=np.uint8)
+    count = lib.kta_dedupe_slots(
+        _as_ptr(h32, ctypes.c_uint32),
+        _as_ptr(active, ctypes.c_uint8),
+        _as_ptr(alive, ctypes.c_uint8),
+        ctypes.c_int64(n),
+        ctypes.c_int32(bits),
+        _as_ptr(slot_out, ctypes.c_uint32),
+        _as_ptr(alive_out, ctypes.c_uint8),
+    )
+    if count < 0:
+        raise RuntimeError(f"kta_dedupe_slots failed with rc={count}")
+    return slot_out[:count], alive_out[:count]
+
+
 class NativeSyntheticSource(SyntheticSource):
     """SyntheticSource with generation delegated to the C++ shim.
 
@@ -189,6 +225,7 @@ class NativeSyntheticSource(SyntheticSource):
         self,
         batch_size: int,
         partitions: Optional[List[int]] = None,
+        start_at: "Optional[dict[int, int]] | None" = None,
     ) -> Iterator[RecordBatch]:
         parts = np.array(
             sorted(partitions) if partitions is not None else self.partitions(),
@@ -196,7 +233,18 @@ class NativeSyntheticSource(SyntheticSource):
         )
         if len(parts) == 0:
             return
-        total = self.spec.messages_per_partition * len(parts)
+        n = self.spec.messages_per_partition
+        if start_at:
+            # Partition-sequential resume: with a single partition, the
+            # global index equals the offset.
+            for p in parts.tolist():
+                one = np.array([p], dtype=np.int32)
+                for lo in range(min(start_at.get(p, 0), n), n, batch_size):
+                    yield synth_batch_native(
+                        self.spec, one, lo, min(lo + batch_size, n), self.threads
+                    )
+            return
+        total = n * len(parts)
         for lo in range(0, total, batch_size):
             yield synth_batch_native(
                 self.spec, parts, lo, min(lo + batch_size, total), self.threads

@@ -185,11 +185,15 @@ class SegmentFileSource(RecordSource):
         self,
         batch_size: int,
         partitions: Optional[List[int]] = None,
+        start_at: Optional[Dict[int, int]] = None,
     ) -> Iterator[RecordBatch]:
         parts = sorted(partitions) if partitions is not None else self.partitions()
         # Sequential per-partition chunks: fastest IO pattern, and the order
         # contract only requires per-partition offset order.
         for p in parts:
             seg = self.segments[p]
-            for lo in range(0, seg.count, batch_size):
+            first = 0
+            if start_at and p in start_at:
+                first = min(max(start_at[p] - seg.start_offset, 0), seg.count)
+            for lo in range(first, seg.count, batch_size):
                 yield seg.read_batch(lo, min(lo + batch_size, seg.count))

@@ -11,7 +11,7 @@ ordering contract).
 from __future__ import annotations
 
 import abc
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple  # noqa: F401
 
 from kafka_topic_analyzer_tpu.records import RecordBatch
 
@@ -32,10 +32,13 @@ class RecordSource(abc.ABC):
         self,
         batch_size: int,
         partitions: Optional[List[int]] = None,
+        start_at: Optional[Dict[int, int]] = None,
     ) -> Iterator[RecordBatch]:
         """Yield batches covering [start, end) for the given partitions (all
         by default), per-partition offset order, batches not padded (the
-        backend pads)."""
+        backend pads).  ``start_at`` overrides the per-partition start
+        offsets (snapshot resume, checkpoint.py); missing partitions start
+        at their earliest offset."""
 
     def total_records(self) -> int:
         start, end = self.watermarks()

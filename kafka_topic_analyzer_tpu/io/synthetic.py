@@ -147,6 +147,7 @@ class SyntheticSource(RecordSource):
         self,
         batch_size: int,
         partitions: Optional[List[int]] = None,
+        start_at: Optional[Dict[int, int]] = None,
     ) -> Iterator[RecordBatch]:
         parts = np.array(
             sorted(partitions) if partitions is not None else self.partitions(),
@@ -155,7 +156,17 @@ class SyntheticSource(RecordSource):
         s = len(parts)
         if s == 0:
             return
-        total = self.spec.messages_per_partition * s
+        n = self.spec.messages_per_partition
+        if start_at:
+            # Resumed scans run partition-sequential (the order contract is
+            # per-partition only).
+            for p in parts.tolist():
+                for lo in range(min(start_at.get(p, 0), n), n, batch_size):
+                    offset = np.arange(lo, min(lo + batch_size, n), dtype=np.int64)
+                    partition = np.full(len(offset), p, dtype=np.int64)
+                    yield RecordBatch(**synth_fields(self.spec, partition, offset))
+            return
+        total = n * s
         for lo in range(0, total, batch_size):
             g = np.arange(lo, min(lo + batch_size, total), dtype=np.int64)
             partition = parts[g % s]

@@ -9,9 +9,37 @@ where possible to keep the TPU hot path cheap; only the accumulator state is
 64-bit.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+def force_platform(platforms: str) -> None:
+    """Force the jax platform list even when a sitecustomize pinned
+    JAX_PLATFORMS before we ran (e.g. axon's TPU tunnel).
+
+    When the override excludes such a tunnel plugin, its factory is dropped
+    outright — its client init runs even for non-selected platforms and
+    blocks indefinitely if the tunnel is unreachable.  Must run before any
+    backend is initialized.  Best-effort: relies on a private jax attribute,
+    so failures are swallowed (the config update alone usually suffices).
+    """
+    try:
+        jax.config.update("jax_platforms", platforms)
+        if "axon" not in platforms:
+            from jax._src import xla_bridge as _xb
+
+            _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+# Escape hatch for CLI users (e.g. run the tpu backend on the host CPU when
+# the accelerator tunnel is down): KTA_JAX_PLATFORMS=cpu.
+_override = os.environ.get("KTA_JAX_PLATFORMS")
+if _override:
+    force_platform(_override)
 
 import jax.numpy as jnp  # noqa: E402,F401
 from jax import lax  # noqa: E402,F401

@@ -49,6 +49,13 @@ def hll_update(regs, key_hash64, active, p: int):
     return jnp.maximum(regs, delta)
 
 
+def hll_apply(regs, idx, rho):
+    """Apply host pre-split HLL updates (packing.py::hll_idx_rho_numpy):
+    one scatter-max of rho into the register file.  Masked records carry
+    rho=0, which is a no-op under max."""
+    return regs.at[idx.astype(jnp.int32)].max(rho.astype(jnp.int32))
+
+
 def hll_merge(regs_a, regs_b):
     return jnp.maximum(regs_a, regs_b)
 

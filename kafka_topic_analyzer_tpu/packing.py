@@ -1,0 +1,276 @@
+"""Packed host→device batch transfer (wire format v1).
+
+The profiled bottleneck of the streaming path is host→device bandwidth
+(SURVEY.md §7 hard part (a) — on this environment's tunneled TPU it measures
+~0.25 GB/s, far below PCIe).  Three levers, all here:
+
+1. **One buffer, one transfer** — all per-record columns packed into a single
+   contiguous ``uint8[N]`` section layout instead of nine separate arrays.
+2. **Minimal bytes per record** — 17 B for the exact counters (vs 37 B naive):
+   partition i16, key_len u16, value_len u32, flags u8, ts_s i64; padding is
+   expressed as a single ``n_valid`` prefix length in the header instead of a
+   bool per record.
+3. **Host pre-reduction** — the alive bitmap's last-writer-wins dedupe
+   happens on the host (C++ shim / numpy): the device receives at most one
+   (slot, aliveness) pair per touched slot (+5 B) and applies two scatter-ORs
+   instead of sorting a million int64 keys; HLL updates ship as pre-split
+   (bucket index u16, rho u8) (+3 B) instead of a full 64-bit hash.
+
+Layout (sections in order; B = static batch size):
+
+    header   u8[16]   n_valid i32 | n_pairs i32 | reserved
+    partition i16[B]
+    key_len   u16[B]  (keys > 64 KiB are rejected at pack time)
+    value_len u32[B]
+    flags     u8[B]   bit0 = key_null, bit1 = value_null
+    ts_s      i64[B]
+    [alive]  slot u32[B] + alive u8[B]          iff count_alive_keys
+    [hll]    idx u16[B] + rho u8[B]             iff enable_hll
+
+Device-side unpacking is pure ``lax.bitcast_convert_type`` on reshaped slices
+(both host and TPU are little-endian; the TPU backend runs a one-time
+pack→unpack self-check at init to guarantee it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+HEADER_BYTES = 16
+MAX_KEY_LEN = 0xFFFF
+#: Dense partition indices ride an i16 section.
+MAX_PARTITIONS = 0x7FFF
+
+
+def _sections(config: AnalyzerConfig, batch_size: int):
+    """(name, dtype, count) section list, in buffer order."""
+    b = batch_size
+    sec = [
+        ("partition", np.int16, b),
+        ("key_len", np.uint16, b),
+        ("value_len", np.uint32, b),
+        ("flags", np.uint8, b),
+        ("ts_s", np.int64, b),
+    ]
+    if config.count_alive_keys:
+        sec.append(("alive_slot", np.uint32, b))
+        sec.append(("alive_flag", np.uint8, b))
+    if config.enable_hll:
+        sec.append(("hll_idx", np.uint16, b))
+        sec.append(("hll_rho", np.uint8, b))
+    return sec
+
+
+def packed_nbytes(config: AnalyzerConfig, batch_size: int) -> int:
+    return HEADER_BYTES + sum(
+        np.dtype(dt).itemsize * n for _, dt, n in _sections(config, batch_size)
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side pre-reductions
+
+
+def dedupe_slots_numpy(
+    h32: np.ndarray, active: np.ndarray, alive: np.ndarray, bits: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Last-writer-wins (slot, aliveness) pairs for one batch (numpy).
+
+    Equivalent to replaying insert/remove in record order
+    (src/metric.rs:273-280): only each slot's last record survives.
+    """
+    slot = (h32.astype(np.uint64) & np.uint64((1 << bits) - 1)).astype(np.uint32)
+    slot = slot[active]
+    alive = alive[active]
+    if len(slot) == 0:
+        return slot, alive.astype(np.uint8)
+    uniq, first_rev = np.unique(slot[::-1], return_index=True)
+    return uniq.astype(np.uint32), alive[::-1][first_rev].astype(np.uint8)
+
+
+def hll_idx_rho_numpy(
+    h64: np.ndarray, active: np.ndarray, p: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pre-split HLL updates: (bucket index, rho).  Inactive records get the
+    scratch bucket 2^p with rho 0."""
+    from kafka_topic_analyzer_tpu.ops.fnv import splitmix64_np
+
+    h = splitmix64_np(h64.astype(np.uint64))
+    idx = (h >> np.uint64(64 - p)).astype(np.uint16)
+    rest = (h << np.uint64(p)) & np.uint64((1 << 64) - 1)
+    # rho = clz(rest) + 1, capped at 64 - p + 1 when rest == 0.
+    # numpy >= 2.0: bit_count unavailable for clz; use float trick on the
+    # top bits via log2 of rest (exact for leading-zero counting).
+    rho = np.full(h.shape, 64 - p + 1, dtype=np.uint8)
+    nz = rest != 0
+    # floor(log2(x)) is exact for uint64 -> float64 only up to 2^53 of
+    # mantissa; compute clz via hi/lo split to stay exact.
+    hi = (rest >> np.uint64(32)).astype(np.uint32)
+    lo = (rest & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    clz_hi = 31 - np.floor(np.log2(np.maximum(hi, 1).astype(np.float64))).astype(np.int32)
+    clz_lo = 63 - np.floor(np.log2(np.maximum(lo, 1).astype(np.float64))).astype(np.int32)
+    clz = np.where(hi != 0, clz_hi, np.where(lo != 0, clz_lo, 64)).astype(np.int32)
+    rho[nz] = (clz[nz] + 1).astype(np.uint8)
+    # Inactive records: rho 0 is a no-op under scatter-max (registers are
+    # never negative), so no index sentinel is needed.
+    idx = np.where(active, idx, np.uint16(0))
+    rho = np.where(active, rho, np.uint8(0))
+    return idx.astype(np.uint16), rho
+
+
+def _dedupe_slots(h32, active, alive, bits, use_native=True):
+    if use_native:
+        try:
+            from kafka_topic_analyzer_tpu.io.native import dedupe_slots_native, native_available
+
+            if native_available():
+                return dedupe_slots_native(h32, active, alive, bits)
+        except ImportError:
+            pass
+    return dedupe_slots_numpy(h32, active, alive, bits)
+
+
+# ---------------------------------------------------------------------------
+# pack (host)
+
+
+def pack_batch(
+    batch: RecordBatch,
+    config: AnalyzerConfig,
+    use_native: bool = True,
+) -> np.ndarray:
+    """RecordBatch → one contiguous uint8 buffer (wire format v1).
+
+    The batch's valid records must be a prefix (all sources produce
+    prefix-valid batches; padding lives at the tail).
+    """
+    b = config.batch_size
+    n = len(batch)
+    if n > b:
+        raise ValueError(f"batch of {n} exceeds batch_size {b}")
+    n_valid = batch.num_valid
+    if n_valid and not bool(batch.valid[:n_valid].all()):
+        raise ValueError("packed transfer requires prefix-valid batches")
+    if batch.key_len.max(initial=0) > MAX_KEY_LEN:
+        raise ValueError(
+            f"key length {int(batch.key_len.max())} exceeds the packed "
+            f"transfer limit of {MAX_KEY_LEN} bytes"
+        )
+    if n and (
+        batch.partition.max(initial=0) > MAX_PARTITIONS or batch.partition.min() < 0
+    ):
+        raise ValueError(
+            f"partition index out of packed-transfer range [0, {MAX_PARTITIONS}]"
+        )
+
+    out = np.zeros(packed_nbytes(config, b), dtype=np.uint8)
+    header = np.zeros(4, dtype=np.int32)
+    header[0] = n_valid
+
+    pos = HEADER_BYTES
+    fields: Dict[str, np.ndarray] = {
+        "partition": batch.partition.astype(np.int16),
+        "key_len": batch.key_len.astype(np.uint16),
+        "value_len": batch.value_len.astype(np.uint32),
+        "flags": (
+            batch.key_null.astype(np.uint8) | (batch.value_null.astype(np.uint8) << 1)
+        ),
+        "ts_s": batch.ts_s,
+    }
+    if config.count_alive_keys:
+        active = batch.valid & ~batch.key_null
+        alive = batch.valid & ~batch.value_null
+        slots, flags = _dedupe_slots(
+            batch.key_hash32, active, alive, config.alive_bitmap_bits, use_native
+        )
+        n_pairs = len(slots)
+        if n_pairs > b:
+            raise AssertionError("dedupe produced more pairs than records")
+        header[1] = n_pairs
+        slot_arr = np.zeros(b, dtype=np.uint32)
+        flag_arr = np.zeros(b, dtype=np.uint8)
+        slot_arr[:n_pairs] = slots
+        flag_arr[:n_pairs] = flags
+        fields["alive_slot"] = slot_arr
+        fields["alive_flag"] = flag_arr
+    if config.enable_hll:
+        active = batch.valid & ~batch.key_null
+        idx, rho = hll_idx_rho_numpy(batch.key_hash64, active, config.hll_p)
+        fields["hll_idx"] = idx
+        fields["hll_rho"] = rho
+
+    out[:HEADER_BYTES] = header.view(np.uint8)
+    for name, dtype, count in _sections(config, b):
+        nbytes = np.dtype(dtype).itemsize * count
+        src = fields[name]
+        sec = np.zeros(count, dtype=dtype)
+        sec[: len(src)] = src.astype(dtype, copy=False)
+        out[pos : pos + nbytes] = sec.view(np.uint8)
+        pos += nbytes
+    return out
+
+
+def unpack_numpy(buf: np.ndarray, config: AnalyzerConfig) -> Dict[str, np.ndarray]:
+    """Host-side reference unpack (tests + the device self-check oracle)."""
+    b = config.batch_size
+    header = buf[:HEADER_BYTES].view(np.int32)
+    out: Dict[str, np.ndarray] = {
+        "n_valid": header[0],
+        "n_pairs": header[1],
+    }
+    pos = HEADER_BYTES
+    for name, dtype, count in _sections(config, b):
+        nbytes = np.dtype(dtype).itemsize * count
+        out[name] = buf[pos : pos + nbytes].view(dtype)
+        pos += nbytes
+    flags = out.pop("flags")
+    out["key_null"] = (flags & 1).astype(bool)
+    out["value_null"] = (flags & 2).astype(bool)
+    out["valid"] = np.arange(b, dtype=np.int32) < out["n_valid"]
+    out["partition"] = out["partition"].astype(np.int32)
+    out["key_len"] = out["key_len"].astype(np.int32)
+    out["value_len"] = out["value_len"].astype(np.int32)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unpack (device, inside jit)
+
+
+def unpack_device(buf, config: AnalyzerConfig):
+    """uint8[N] → dict of typed device arrays (runs under jit)."""
+    from kafka_topic_analyzer_tpu.jax_support import jnp, lax
+
+    b = config.batch_size
+
+    def cast(section, dtype):
+        itemsize = np.dtype(dtype).itemsize
+        if itemsize == 1:
+            return section.astype(dtype) if dtype != jnp.uint8 else section
+        return lax.bitcast_convert_type(
+            section.reshape(-1, itemsize), jnp.dtype(dtype)
+        )
+
+    header = lax.bitcast_convert_type(buf[:HEADER_BYTES].reshape(4, 4), jnp.int32)
+    out = {"n_valid": header[0], "n_pairs": header[1]}
+    pos = HEADER_BYTES
+    for name, dtype, count in _sections(config, b):
+        nbytes = np.dtype(dtype).itemsize * count
+        out[name] = cast(buf[pos : pos + nbytes], dtype)
+        pos += nbytes
+
+    iota = jnp.arange(b, dtype=jnp.int32)
+    valid = iota < out["n_valid"]
+    flags = out.pop("flags")
+    out["key_null"] = (flags & 1).astype(bool)
+    out["value_null"] = (flags & 2).astype(bool)
+    out["valid"] = valid
+    out["partition"] = out["partition"].astype(jnp.int32)
+    out["key_len"] = out["key_len"].astype(jnp.int32)
+    out["value_len"] = out["value_len"].astype(jnp.int32)
+    return out

@@ -29,8 +29,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from kafka_topic_analyzer_tpu.backends.base import MetricBackend
 from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
 from kafka_topic_analyzer_tpu.backends.step import analyzer_step
-from kafka_topic_analyzer_tpu.backends.tpu import DEVICE_FIELDS, batch_to_arrays
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.packing import pack_batch, unpack_device
 from kafka_topic_analyzer_tpu.jax_support import jnp, lax
 from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState
 from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState
@@ -121,6 +121,7 @@ class ShardedTpuBackend(MetricBackend):
         config: AnalyzerConfig,
         mesh=None,
         init_now_s: "int | None" = None,
+        use_native: bool = True,
     ):
         super().__init__(config)
         self.init_now_s = utc_now_seconds() if init_now_s is None else init_now_s
@@ -132,24 +133,22 @@ class ShardedTpuBackend(MetricBackend):
             raise ValueError("mesh shape does not match config.mesh_shape")
         self.state = _stacked_init(config, self.mesh)
         self._specs = _state_specs(config)
-        self._arrays_spec = {name: P(DATA_AXIS) for name in DEVICE_FIELDS}
-        self._batch_sharding = {
-            name: NamedSharding(self.mesh, P(DATA_AXIS)) for name in DEVICE_FIELDS
-        }
+        self._buf_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.use_native = use_native
 
         config_ = config
 
-        def _step_body(state, arrays):
+        def _step_body(state, bufs):
             local = jax.tree.map(lambda x: x[0], state)
-            a = {k: v[0] for k, v in arrays.items()}
+            arrays = unpack_device(bufs[0], config_)
             space_idx = lax.axis_index(SPACE_AXIS)
-            new = analyzer_step(local, a, config_, space_index=space_idx)
+            new = analyzer_step(local, arrays, config_, space_index=space_idx)
             return jax.tree.map(lambda x: x[None], new)
 
         step = jax.shard_map(
             _step_body,
             mesh=self.mesh,
-            in_specs=(self._specs, self._arrays_spec),
+            in_specs=(self._specs, P(DATA_AXIS)),
             out_specs=self._specs,
         )
         self._step = jax.jit(step, donate_argnums=(0,))
@@ -213,16 +212,18 @@ class ShardedTpuBackend(MetricBackend):
         d = self.config.data_shards
         if len(batches) != d:
             raise ValueError(f"expected {d} shard batches, got {len(batches)}")
-        bs = self.config.batch_size
-        stacked = {}
-        per_shard = [
-            batch_to_arrays(b if b is not None else RecordBatch.empty(0), bs)
-            for b in batches
-        ]
-        for name in DEVICE_FIELDS:
-            host = np.stack([sa[name] for sa in per_shard])
-            stacked[name] = jax.device_put(host, self._batch_sharding[name])
-        self.state = self._step(self.state, stacked)
+        per_shard = np.stack(
+            [
+                pack_batch(
+                    b if b is not None else RecordBatch.empty(0),
+                    self.config,
+                    use_native=self.use_native,
+                )
+                for b in batches
+            ]
+        )
+        bufs = jax.device_put(per_shard, self._buf_sharding)
+        self.state = self._step(self.state, bufs)
 
     def update(self, batch: RecordBatch) -> None:
         """Split a mixed batch by partition→shard (partition % D)."""
@@ -234,6 +235,20 @@ class ShardedTpuBackend(MetricBackend):
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.state)
+
+    # -- snapshot/resume (checkpoint.py) -------------------------------------
+
+    def get_state(self) -> AnalyzerState:
+        return self.state
+
+    def set_state(self, host_state: AnalyzerState) -> None:
+        self.state = jax.tree.map(
+            lambda x, s: jax.device_put(
+                np.asarray(x), NamedSharding(self.mesh, s)
+            ),
+            host_state,
+            self._specs,
+        )
 
     # -- finalize ------------------------------------------------------------
 

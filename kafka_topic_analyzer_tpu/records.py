@@ -51,6 +51,11 @@ class RecordBatch:
     key_hash64: np.ndarray
     #: False for padding records appended to reach the static batch size.
     valid: np.ndarray
+    #: OPTIONAL host-only per-record Kafka offsets (int64), never transferred
+    #: to the device.  Sources whose offset space has gaps (log compaction)
+    #: attach them so snapshots can record exact resume positions; gapless
+    #: sources leave None and progress is tracked by counting.
+    offsets: "np.ndarray | None" = None
 
     FIELDS = (
         ("partition", np.int32),
@@ -73,6 +78,10 @@ class RecordBatch:
             if arr.shape != (n,):
                 raise ValueError(f"{name}: expected shape ({n},), got {arr.shape}")
             setattr(self, name, arr)
+        if self.offsets is not None:
+            self.offsets = np.asarray(self.offsets, dtype=np.int64)
+            if self.offsets.shape != (n,):
+                raise ValueError("offsets: wrong shape")
 
     def __len__(self) -> int:
         return len(self.partition)
@@ -97,23 +106,34 @@ class RecordBatch:
             arr = np.zeros(size, dtype=dt)
             arr[:n] = getattr(self, name)
             out[name] = arr
-        return RecordBatch(**out)
+        padded = RecordBatch(**out)
+        if self.offsets is not None:
+            offs = np.full(size, -1, dtype=np.int64)
+            offs[:n] = self.offsets
+            padded.offsets = offs
+        return padded
 
     @classmethod
     def concat(cls, batches: "list[RecordBatch]") -> "RecordBatch":
         if not batches:
             return cls.empty()
-        return cls(
+        out = cls(
             **{
                 name: np.concatenate([getattr(b, name) for b in batches])
                 for name, _ in cls.FIELDS
             }
         )
+        if all(b.offsets is not None for b in batches):
+            out.offsets = np.concatenate([b.offsets for b in batches])
+        return out
 
     def take(self, idx: np.ndarray) -> "RecordBatch":
-        return RecordBatch(
+        out = RecordBatch(
             **{name: getattr(self, name)[idx] for name, _ in self.FIELDS}
         )
+        if self.offsets is not None:
+            out.offsets = self.offsets[idx]
+        return out
 
     def as_dict(self) -> "dict[str, np.ndarray]":
         return {name: getattr(self, name) for name, _ in self.FIELDS}

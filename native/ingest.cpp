@@ -86,7 +86,52 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 1; }
+int32_t kta_version() { return 2; }
+
+// Last-writer-wins dedupe of alive-bitmap updates for one batch
+// (the host half of the packed transfer's pre-reduction; see
+// kafka_topic_analyzer_tpu/packing.py).  For each slot = h32 & (2^bits - 1)
+// of an active record, only the LAST record's aliveness survives —
+// equivalent to replaying insert/remove in record order.  Open-addressing
+// hash table over the batch (capacity = next pow2 >= 2n), single pass.
+// Outputs at most n (slot, alive) pairs; returns the pair count, or -1 on
+// bad arguments.
+int64_t kta_dedupe_slots(const uint32_t* h32, const uint8_t* active,
+                         const uint8_t* alive, int64_t n, int32_t bits,
+                         uint32_t* slot_out, uint8_t* alive_out) {
+  if (!h32 || !active || !alive || !slot_out || !alive_out || n < 0 ||
+      bits < 1 || bits > 32)
+    return -1;
+  const uint32_t mask =
+      bits == 32 ? 0xffffffffu : ((1u << bits) - 1u);
+  size_t cap = 16;
+  while (cap < static_cast<size_t>(n) * 2) cap <<= 1;
+  const size_t cap_mask = cap - 1;
+  // table: index into out arrays + 1; 0 = empty.
+  std::vector<int64_t> table(cap, 0);
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (!active[i]) continue;
+    const uint32_t slot = h32[i] & mask;
+    size_t pos = (splitmix64(slot) & cap_mask);
+    for (;;) {
+      int64_t entry = table[pos];
+      if (entry == 0) {
+        table[pos] = count + 1;
+        slot_out[count] = slot;
+        alive_out[count] = alive[i];
+        ++count;
+        break;
+      }
+      if (slot_out[entry - 1] == slot) {
+        alive_out[entry - 1] = alive[i];  // later record wins
+        break;
+      }
+      pos = (pos + 1) & cap_mask;
+    }
+  }
+  return count;
+}
 
 // Generate records for global indices [lo, hi) over the partition list
 // `parts` (round-robin: g -> parts[g % nparts] at offset g / nparts),

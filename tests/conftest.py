@@ -12,11 +12,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# Some environments (axon) import jax from sitecustomize before conftest runs,
-# freezing jax_platforms from the ambient env; override via the config API,
-# which works as long as no backend has been initialized yet.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Some environments (axon) import jax from sitecustomize before conftest runs,
+# freezing jax_platforms from the ambient env; force_platform overrides it
+# via the config API and drops the tunnel plugin factory, whose client init
+# would otherwise block when the tunnel/chip lease is wedged — tests must
+# never depend on the chip being reachable.
+from kafka_topic_analyzer_tpu.jax_support import force_platform  # noqa: E402
+
+force_platform("cpu")

@@ -1,0 +1,205 @@
+"""Snapshot/resume: an interrupted scan resumed from a snapshot must produce
+exactly the same report as an uninterrupted scan (SURVEY.md §5.4)."""
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.checkpoint import load_snapshot, save_snapshot
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+SPEC = SyntheticSpec(
+    num_partitions=3,
+    messages_per_partition=4_000,
+    keys_per_partition=200,
+    tombstone_permille=150,
+    seed=31,
+)
+CFG = AnalyzerConfig(
+    num_partitions=3,
+    batch_size=512,
+    count_alive_keys=True,
+    alive_bitmap_bits=20,
+    enable_hll=True,
+    hll_p=10,
+    enable_quantiles=True,
+)
+
+
+def _metrics_equal(a, b):
+    assert np.array_equal(a.per_partition, b.per_partition)
+    assert a.alive_keys == b.alive_keys
+    assert a.earliest_ts_s == b.earliest_ts_s
+    assert a.latest_ts_s == b.latest_ts_s
+    assert a.smallest_message == b.smallest_message
+    assert a.largest_message == b.largest_message
+    assert a.overall_count == b.overall_count
+    assert a.distinct_keys_hll == b.distinct_keys_hll
+    assert a.quantiles.values == b.quantiles.values
+
+
+class _Interrupt(Exception):
+    pass
+
+
+class _InterruptingSource(SyntheticSource):
+    """Raises after yielding `limit` batches — simulates a crash mid-scan."""
+
+    def __init__(self, spec, limit):
+        super().__init__(spec)
+        self.limit = limit
+
+    def batches(self, batch_size, partitions=None, start_at=None):
+        it = super().batches(batch_size, partitions, start_at)
+        for i, b in enumerate(it):
+            if start_at is None and i >= self.limit:
+                raise _Interrupt()
+            yield b
+
+
+def test_resume_matches_uninterrupted(tmp_path):
+    # Uninterrupted run.
+    full = run_scan(
+        "t", SyntheticSource(SPEC), TpuBackend(CFG, init_now_s=10**10), 512
+    ).metrics
+
+    # Interrupted run: snapshot every batch, crash after 7 batches.
+    be1 = TpuBackend(CFG, init_now_s=10**10)
+    src = _InterruptingSource(SPEC, limit=7)
+    with pytest.raises(_Interrupt):
+        run_scan(
+            "t", src, be1, 512,
+            snapshot_dir=str(tmp_path), snapshot_every_s=0.0,
+        )
+
+    # Resume with a fresh backend (fresh process semantics).
+    be2 = TpuBackend(CFG, init_now_s=0)  # init time restored from snapshot
+    result = run_scan(
+        "t", SyntheticSource(SPEC), be2, 512,
+        snapshot_dir=str(tmp_path), snapshot_every_s=3600.0, resume=True,
+    )
+    _metrics_equal(full, result.metrics)
+    assert be2.init_now_s == 10**10  # restored, not re-stamped
+
+
+def test_kafka_resume_with_compaction_gaps(tmp_path):
+    """Offset-exact resume on a gappy (compacted) offset space."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from fake_broker import FakeBroker
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+    rows = [
+        (off, 1_600_000_000_000 + off, f"k{off % 37}".encode(),
+         None if off % 11 == 7 else bytes(20 + off % 64))
+        for off in range(0, 900, 3)  # offsets 0,3,6,... (gaps)
+    ]
+    cfg = AnalyzerConfig(
+        num_partitions=1, batch_size=128, count_alive_keys=True,
+        alive_bitmap_bits=16,
+    )
+    with FakeBroker("snap.topic", {0: rows}) as broker:
+        bootstrap = f"127.0.0.1:{broker.port}"
+        full = run_scan(
+            "snap.topic", KafkaWireSource(bootstrap, "snap.topic"),
+            TpuBackend(cfg, init_now_s=10**10), 128,
+        ).metrics
+
+        # First half: consume 2 batches then stop (limit via islice wrapper).
+        src1 = KafkaWireSource(bootstrap, "snap.topic")
+        be1 = TpuBackend(cfg, init_now_s=10**10)
+
+        class Half:
+            def __getattr__(self, name):
+                return getattr(src1, name)
+
+            def batches(self, batch_size, partitions=None, start_at=None):
+                it = src1.batches(batch_size, partitions, start_at)
+                for i, b in enumerate(it):
+                    if i >= 2:
+                        raise _Interrupt()
+                    yield b
+
+        with pytest.raises(_Interrupt):
+            run_scan(
+                "snap.topic", Half(), be1, 128,
+                snapshot_dir=str(tmp_path), snapshot_every_s=0.0,
+            )
+
+        snap = load_snapshot(str(tmp_path), "snap.topic", cfg)
+        assert snap is not None
+        _, offsets, records_seen, _ = snap
+        assert records_seen == 256
+        # Offsets have gaps: next offset reflects true positions, not counts.
+        assert offsets[0] == rows[255][0] + 1
+
+        be2 = TpuBackend(cfg, init_now_s=0)
+        result = run_scan(
+            "snap.topic", KafkaWireSource(bootstrap, "snap.topic"), be2, 128,
+            snapshot_dir=str(tmp_path), resume=True,
+        )
+    assert np.array_equal(full.per_partition, result.metrics.per_partition)
+    assert full.alive_keys == result.metrics.alive_keys
+
+
+def test_sharded_resume_matches_uninterrupted(tmp_path):
+    """Snapshot/resume through the mesh backend (stacked state leaves)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    cfg = AnalyzerConfig(
+        num_partitions=3,
+        batch_size=512,
+        count_alive_keys=True,
+        alive_bitmap_bits=18,
+        enable_hll=True,
+        hll_p=10,
+        mesh_shape=(2, 2),
+    )
+    full = run_scan(
+        "t", SyntheticSource(SPEC), ShardedTpuBackend(cfg, init_now_s=10**10), 512
+    ).metrics
+
+    be1 = ShardedTpuBackend(cfg, init_now_s=10**10)
+    with pytest.raises(_Interrupt):
+        run_scan(
+            "t", _InterruptingSource(SPEC, limit=5), be1, 512,
+            snapshot_dir=str(tmp_path), snapshot_every_s=0.0,
+        )
+    be2 = ShardedTpuBackend(cfg, init_now_s=0)
+    result = run_scan(
+        "t", SyntheticSource(SPEC), be2, 512,
+        snapshot_dir=str(tmp_path), resume=True,
+    )
+    assert np.array_equal(full.per_partition, result.metrics.per_partition)
+    assert full.alive_keys == result.metrics.alive_keys
+    assert full.distinct_keys_hll == result.metrics.distinct_keys_hll
+    assert full.overall_count == result.metrics.overall_count
+
+
+def test_pack_rejects_out_of_range_partition():
+    from kafka_topic_analyzer_tpu.packing import pack_batch
+    from kafka_topic_analyzer_tpu.records import RecordBatch
+
+    cfg = AnalyzerConfig(num_partitions=1, batch_size=8)
+    b = RecordBatch.empty(4)
+    b.valid[:] = True
+    b.partition[0] = 40_000
+    with pytest.raises(ValueError, match="partition index"):
+        pack_batch(b, cfg, use_native=False)
+
+
+def test_incompatible_snapshot_rejected(tmp_path):
+    be = TpuBackend(CFG, init_now_s=5)
+    save_snapshot(str(tmp_path), "t", CFG, be.get_state(), {0: 1}, 1, 5)
+    other = AnalyzerConfig(num_partitions=4, batch_size=512)
+    with pytest.raises(ValueError, match="fingerprint"):
+        load_snapshot(str(tmp_path), "t", other)
+    with pytest.raises(ValueError, match="fingerprint"):
+        load_snapshot(str(tmp_path), "other-topic", CFG)

@@ -1,0 +1,121 @@
+"""Packed transfer (wire format v1): layout roundtrip, host pre-reductions."""
+
+import jax
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+from kafka_topic_analyzer_tpu.packing import (
+    dedupe_slots_numpy,
+    hll_idx_rho_numpy,
+    pack_batch,
+    packed_nbytes,
+    unpack_device,
+    unpack_numpy,
+)
+
+SPEC = SyntheticSpec(
+    num_partitions=5,
+    messages_per_partition=300,
+    keys_per_partition=40,
+    key_null_permille=100,
+    tombstone_permille=200,
+    seed=21,
+)
+
+CFG = AnalyzerConfig(
+    num_partitions=5,
+    batch_size=512,
+    count_alive_keys=True,
+    alive_bitmap_bits=18,
+    enable_hll=True,
+    hll_p=10,
+)
+
+
+def _batch():
+    return next(SyntheticSource(SPEC).batches(400)).pad_to(512)
+
+
+def test_pack_unpack_numpy_roundtrip():
+    batch = _batch()
+    buf = pack_batch(batch, CFG, use_native=False)
+    assert buf.nbytes == packed_nbytes(CFG, 512)
+    got = unpack_numpy(buf, CFG)
+    assert int(got["n_valid"]) == 400
+    assert np.array_equal(got["partition"][:400], batch.partition[:400])
+    assert np.array_equal(got["key_len"][:400], batch.key_len[:400])
+    assert np.array_equal(got["value_len"][:400], batch.value_len[:400])
+    assert np.array_equal(got["ts_s"][:400], batch.ts_s[:400])
+    assert np.array_equal(got["key_null"][:400], batch.key_null[:400])
+    assert np.array_equal(got["value_null"][:400], batch.value_null[:400])
+    assert np.array_equal(got["valid"], batch.valid)
+
+
+def test_device_unpack_matches_numpy_unpack():
+    batch = _batch()
+    buf = pack_batch(batch, CFG, use_native=False)
+    expected = unpack_numpy(buf, CFG)
+    got = jax.jit(lambda b: unpack_device(b, CFG))(buf)
+    for name, exp in expected.items():
+        assert np.array_equal(np.asarray(got[name]), np.asarray(exp)), name
+
+
+def test_dedupe_numpy_last_writer_wins():
+    h32 = np.array([5, 5, 6, 6, 7, 9], dtype=np.uint32)
+    active = np.array([1, 1, 1, 1, 1, 0], dtype=bool)
+    alive = np.array([1, 0, 0, 1, 1, 1], dtype=bool)
+    slots, flags = dedupe_slots_numpy(h32, active, alive, bits=16)
+    result = dict(zip(slots.tolist(), flags.tolist()))
+    assert result == {5: 0, 6: 1, 7: 1}  # inactive slot 9 ignored
+
+
+def test_dedupe_native_matches_numpy():
+    native = pytest.importorskip("kafka_topic_analyzer_tpu.io.native")
+    if not native.native_available():
+        pytest.skip("native shim unavailable")
+    rng = np.random.default_rng(3)
+    n = 5000
+    h32 = rng.integers(0, 2**32, size=n, dtype=np.uint64).astype(np.uint32)
+    active = rng.random(n) > 0.1
+    alive = rng.random(n) > 0.3
+    for bits in (8, 16, 32):
+        s_np, f_np = dedupe_slots_numpy(h32, active, alive, bits)
+        s_nat, f_nat = native.dedupe_slots_native(h32, active, alive, bits)
+        assert dict(zip(s_np.tolist(), f_np.tolist())) == dict(
+            zip(s_nat.tolist(), f_nat.tolist())
+        ), bits
+
+
+def test_hll_idx_rho_matches_reference():
+    from kafka_topic_analyzer_tpu.ops.fnv import splitmix64
+
+    rng = np.random.default_rng(4)
+    h64 = rng.integers(0, 2**63, size=1000, dtype=np.uint64)
+    # make some values produce long rho runs
+    h64[:4] = [0, 1, 1 << 50, (1 << 64) - 1]
+    active = np.ones(1000, dtype=bool)
+    p = 10
+    idx, rho = hll_idx_rho_numpy(h64, active, p)
+    for i in range(64):
+        h = splitmix64(int(h64[i]))
+        exp_idx = h >> (64 - p)
+        rest = (h << p) & ((1 << 64) - 1)
+        exp_rho = (64 - p + 1) if rest == 0 else (64 - rest.bit_length() + 1)
+        assert int(idx[i]) == exp_idx, i
+        assert int(rho[i]) == exp_rho, i
+
+
+def test_pack_rejects_oversize_keys():
+    batch = _batch()
+    batch.key_len[0] = 1 << 17
+    with pytest.raises(ValueError, match="key length"):
+        pack_batch(batch, CFG, use_native=False)
+
+
+def test_pack_rejects_non_prefix_valid():
+    batch = _batch()
+    batch.valid[10] = False
+    with pytest.raises(ValueError, match="prefix-valid"):
+        pack_batch(batch, CFG, use_native=False)
