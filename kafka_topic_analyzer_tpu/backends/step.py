@@ -38,7 +38,13 @@ def analyzer_step(
     value_len = arrays["value_len"]
 
     m = state.metrics
-    per_partition = counters_update(
+    if config.use_pallas_counters:
+        from kafka_topic_analyzer_tpu.ops.pallas_counters import (
+            pallas_counters_update as counters_fn,
+        )
+    else:
+        counters_fn = counters_update
+    per_partition = counters_fn(
         m.per_partition,
         arrays["partition"],
         key_len,

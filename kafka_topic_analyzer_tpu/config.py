@@ -50,6 +50,13 @@ class AnalyzerConfig:
     #: Number of log-gamma buckets (covers sizes up to gamma^nbuckets).
     quantile_buckets: int = 2560
 
+    #: Use the Pallas MXU one-hot-matmul kernel for the per-partition counter
+    #: reduction (ops/pallas_counters.py) instead of the XLA scatter-add.
+    #: Requires num_partitions <= 128, batch_size a multiple of 1024, and
+    #: value lengths < 16 MiB (validated in __post_init__ / pack time).
+    #: Off by default until benchmarked faster on the target hardware.
+    use_pallas_counters: bool = False
+
     # --- host→device transfer ----------------------------------------------
     #: Pre-reduce bitmap updates on the host: last-writer-wins dedupe of
     #: (slot, alive) pairs per batch (C++ shim or numpy), so the device does
@@ -73,6 +80,15 @@ class AnalyzerConfig:
             raise ValueError("hll_p must be in [4, 15]")
         if self.quantile_buckets < 8:
             raise ValueError("quantile_buckets must be >= 8")
+        if self.use_pallas_counters:
+            if self.num_partitions > 128:
+                raise ValueError(
+                    "use_pallas_counters supports at most 128 partitions"
+                )
+            if self.batch_size % 1024:
+                raise ValueError(
+                    "use_pallas_counters requires batch_size % 1024 == 0"
+                )
 
     @property
     def hll_m(self) -> int:

@@ -1,0 +1,179 @@
+"""Pallas TPU kernel: fused per-partition counter reduction on the MXU.
+
+The counter update (ops/counters.py) is a segment-sum of 7 channels by
+partition id — XLA lowers it as a scatter-add.  This kernel instead maps it
+onto the MXU as a one-hot matmul, the TPU-native formulation of a segment
+sum (guide: /opt/skills/guides/pallas_guide.md):
+
+    contrib[16, N] · one_hot[N, P] → [16, P]    (per 1024-record block)
+
+**Exactness.**  The MXU accumulates in f32, which is exact only below 2^24.
+Counts are 0/1 so they are safe, but byte lengths are not — so the two byte
+channels are decomposed into 12-bit digits (lo = v & 0xFFF, hi = v >> 12):
+every matmul partial is ≤ 4095·1024 < 2^24, the per-block result converts
+losslessly to i32, blocks accumulate in an i32 VMEM scratch (safe for
+≤ 2^18 records per call), and the digits recombine in i64 outside.  Value
+lengths are capped at 2^24-1 (16 MiB, enforced by packing.py) so two digits
+suffice.
+
+Channel plane layout (rows of the [16, P] accumulator):
+    0..6  COUNTER_CHANNELS lo digits (counts have no hi digit)
+    7     key_size_sum   hi digit
+    8     value_size_sum hi digit
+    9..15 zero padding (MXU-friendly row count)
+
+Enabled by ``AnalyzerConfig.use_pallas_counters``; the lax scatter path
+remains the default until the kernel is benchmarked faster on real hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kafka_topic_analyzer_tpu.jax_support import jnp
+
+#: Records per grid step: an (8, 128) int32 tile.
+BLOCK = 1024
+#: Max records per pallas_call: keeps i32 scratch sums < 2^31
+#: (2^18 · 4095 ≈ 1.07e9).
+MAX_CALL = 1 << 18
+PLANES = 16
+#: One 128-lane tile of partitions per call.
+MAX_KERNEL_PARTITIONS = 128
+
+
+def _kernel(part_ref, klen_ref, vlen_ref, kn_ref, vn_ref, valid_ref, out_ref, acc_ref, *, p_pad: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    part = part_ref[:].reshape(-1)          # [BLOCK] i32
+    klen = klen_ref[:].reshape(-1)
+    vlen = vlen_ref[:].reshape(-1)
+    kn = kn_ref[:].reshape(-1)              # i32 0/1: valid & key non-null
+    vn = vn_ref[:].reshape(-1)              # i32 0/1: valid & value non-null
+    valid = valid_ref[:].reshape(-1)        # i32 0/1
+
+    tomb = valid - vn                       # valid & value_null
+    knull = valid - kn
+    k_bytes = klen * kn
+    v_bytes = vlen * vn
+
+    planes = [
+        valid,                               # total
+        tomb,                                # tombstones
+        vn,                                  # alive
+        knull,                               # key_null
+        kn,                                  # key_non_null
+        k_bytes & 0xFFF,                     # key_size_sum lo
+        v_bytes & 0xFFF,                     # value_size_sum lo
+        k_bytes >> 12,                       # key_size_sum hi
+        v_bytes >> 12,                       # value_size_sum hi
+    ]
+    zeros = jnp.zeros_like(valid)
+    planes += [zeros] * (PLANES - len(planes))
+    contrib = jnp.stack(planes).astype(jnp.float32)        # [16, BLOCK]
+
+    # One-hot over partitions; invalid records carry partition 0 but all
+    # their contribution planes are 0, so they add nothing.
+    iota = jax.lax.broadcasted_iota(jnp.int32, (BLOCK, p_pad), 1)
+    one_hot = (part[:, None] == iota).astype(jnp.float32)  # [BLOCK, P_pad]
+
+    # precision=HIGHEST: without it the MXU may run f32 operands through
+    # bf16 passes, whose 8-bit mantissa cannot represent the 12-bit digit
+    # planes — preferred_element_type alone only fixes the accumulator.
+    block_out = jax.lax.dot_general(
+        contrib,
+        one_hot,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                       # [16, P_pad]
+    acc_ref[:] += block_out.astype(jnp.int32)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def _call(part, klen, vlen, kn, vn, valid, num_partitions: int, interpret: bool):
+    n = part.shape[0]
+    assert n % BLOCK == 0 and n <= MAX_CALL
+    rows = n // 128
+    if num_partitions > MAX_KERNEL_PARTITIONS:
+        raise ValueError(
+            f"pallas counter kernel supports up to {MAX_KERNEL_PARTITIONS} "
+            f"partitions (got {num_partitions}); use the lax path for wider topics"
+        )
+    p_pad = MAX_KERNEL_PARTITIONS
+
+    def shape2d(x):
+        return x.reshape(rows, 128)
+
+    block_rows = BLOCK // 128
+    in_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_kernel, p_pad=p_pad),
+        grid=(rows // block_rows,),
+        in_specs=[in_spec] * 6,
+        out_specs=pl.BlockSpec((PLANES, p_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((PLANES, p_pad), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((PLANES, p_pad), jnp.int32)],
+        interpret=interpret,
+    )(
+        shape2d(part), shape2d(klen), shape2d(vlen),
+        shape2d(kn), shape2d(vn), shape2d(valid),
+    )
+    return out
+
+
+def pallas_counters_update(
+    per_partition,  # int64[P, 7]
+    partition,      # int32[B]
+    key_len,
+    value_len,
+    key_null,
+    value_null,
+    valid,
+    num_partitions: int,
+    interpret: bool = False,
+):
+    """Drop-in replacement for ops.counters.counters_update via the MXU
+    kernel.  Requires B % 1024 == 0 (config batch sizes are powers of two)."""
+    b = partition.shape[0]
+    if b % BLOCK != 0:
+        raise ValueError(f"batch size {b} must be a multiple of {BLOCK}")
+    # The compiled kernel targets TPU; on the CPU platform (tests, virtual
+    # meshes) fall back to the interpreter automatically.
+    interpret = interpret or jax.default_backend() == "cpu"
+    kn = (valid & ~key_null).astype(jnp.int32)
+    vn = (valid & ~value_null).astype(jnp.int32)
+    v32 = valid.astype(jnp.int32)
+    part = partition.astype(jnp.int32)
+    klen = key_len.astype(jnp.int32)
+    vlen = value_len.astype(jnp.int32)
+
+    total = jnp.zeros((PLANES, 128), dtype=jnp.int64)
+    for lo in range(0, b, MAX_CALL):
+        hi = min(lo + MAX_CALL, b)
+        sl = slice(lo, hi)
+        total = total + _call(
+            part[sl], klen[sl], vlen[sl], kn[sl], vn[sl], v32[sl],
+            num_partitions, interpret,
+        ).astype(jnp.int64)
+
+    p = num_partitions
+    counts = total[:5, :p]                                # [5, P]
+    k_sum = total[5, :p] + (total[7, :p] << 12)
+    v_sum = total[6, :p] + (total[8, :p] << 12)
+    delta = jnp.concatenate(
+        [counts, k_sum[None, :], v_sum[None, :]], axis=0
+    ).T                                                    # [P, 7]
+    return per_partition + delta

@@ -45,6 +45,10 @@ HEADER_BYTES = 16
 MAX_KEY_LEN = 0xFFFF
 #: Dense partition indices ride an i16 section.
 MAX_PARTITIONS = 0x7FFF
+#: 16 MiB - 1: lets byte sums decompose into two 12-bit MXU-exact digits
+#: (ops/pallas_counters.py); comfortably above Kafka's practical max
+#: message size.
+MAX_VALUE_LEN = (1 << 24) - 1
 
 
 def _sections(config: AnalyzerConfig, batch_size: int):
@@ -166,6 +170,17 @@ def pack_batch(
     ):
         raise ValueError(
             f"partition index out of packed-transfer range [0, {MAX_PARTITIONS}]"
+        )
+    if (
+        config.use_pallas_counters
+        and batch.value_len.max(initial=0) > MAX_VALUE_LEN
+    ):
+        # Only the MXU kernel's 12-bit digit decomposition needs this cap;
+        # the default scatter path handles full u32 lengths exactly.
+        raise ValueError(
+            f"value length {int(batch.value_len.max())} exceeds the Pallas "
+            f"counter kernel's limit of {MAX_VALUE_LEN} bytes — disable "
+            f"use_pallas_counters for such topics"
         )
 
     out = np.zeros(packed_nbytes(config, b), dtype=np.uint8)

@@ -114,6 +114,20 @@ def test_pack_rejects_oversize_keys():
         pack_batch(batch, CFG, use_native=False)
 
 
+def test_pack_rejects_oversize_values_only_for_pallas():
+    # The 16 MiB cap exists for the MXU kernel's digit decomposition; the
+    # default scatter path accepts full u32 lengths.  (Exercised directly:
+    # the synthetic generator can only draw 24-bit value lengths.)
+    batch = _batch()
+    batch.value_len[3] = 1 << 25
+    pack_batch(batch, CFG, use_native=False)  # default path: fine
+    pallas_cfg = AnalyzerConfig(
+        num_partitions=5, batch_size=1024, use_pallas_counters=True
+    )
+    with pytest.raises(ValueError, match="value length"):
+        pack_batch(batch.pad_to(1024), pallas_cfg, use_native=False)
+
+
 def test_pack_rejects_non_prefix_valid():
     batch = _batch()
     batch.valid[10] = False
