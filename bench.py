@@ -21,8 +21,27 @@ import time
 BASELINE_MSGS_PER_SEC = 590_221.0
 
 
+#: BASELINE.json benchmark configs (scaled-down record counts; the shape of
+#: each workload — partitions, features, key cardinality — is preserved).
+CONFIGS = {
+    1: dict(partitions=1, features="counters", keys=10_000,
+            help="1-partition default metrics scan"),
+    2: dict(partitions=16, features="counters,quantiles", keys=200_000,
+            help="16-partition size histograms + ts range"),
+    3: dict(partitions=16, features="counters,alive,hll", keys=1_000_000,
+            help="log-compacted alive/distinct keys"),
+    4: dict(partitions=16, features="counters,quantiles", keys=200_000,
+            vmin=10, vmax=65_000, help="mixed-size payload percentiles"),
+    5: dict(partitions=64, features="counters,alive,hll,quantiles",
+            keys=500_000, help="8-topic fan-in shape (64 total rows)"),
+}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, choices=sorted(CONFIGS),
+                    help="BASELINE.json workload preset (overrides "
+                         "--partitions/--features)")
     ap.add_argument("--partitions", type=int, default=16)
     ap.add_argument("--batch-size", type=int, default=1 << 20)
     ap.add_argument("--batches", type=int, default=8,
@@ -32,7 +51,20 @@ def main() -> int:
     ap.add_argument("--features", default="counters,hll,quantiles",
                     help="comma set: counters,alive,hll,quantiles")
     ap.add_argument("--alive-bits", type=int, default=26)
+    ap.add_argument("--keys", type=int, default=200_000)
+    ap.add_argument("--vmin", type=int, default=100)
+    ap.add_argument("--vmax", type=int, default=420)
+    ap.add_argument("--pallas", action="store_true",
+                    help="use the Pallas MXU counter kernel")
     args = ap.parse_args()
+    if args.config:
+        preset = CONFIGS[args.config]
+        args.partitions = preset["partitions"]
+        args.features = preset["features"]
+        args.keys = preset.get("keys", args.keys)
+        args.vmin = preset.get("vmin", args.vmin)
+        args.vmax = preset.get("vmax", args.vmax)
+        print(f"bench: config {args.config} — {preset['help']}", file=sys.stderr)
 
     import jax
 
@@ -49,15 +81,16 @@ def main() -> int:
         alive_bitmap_bits=args.alive_bits,
         enable_hll="hll" in feats,
         enable_quantiles="quantiles" in feats,
+        use_pallas_counters=args.pallas,
     )
     spec = SyntheticSpec(
         num_partitions=args.partitions,
         messages_per_partition=(args.batch_size * args.batches) // args.partitions,
-        keys_per_partition=200_000,
+        keys_per_partition=args.keys,
         key_null_permille=50,
         tombstone_permille=100,
-        value_len_min=100,
-        value_len_max=420,
+        value_len_min=args.vmin,
+        value_len_max=args.vmax,
         seed=0xBEEF,
     )
 

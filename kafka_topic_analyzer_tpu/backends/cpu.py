@@ -23,7 +23,7 @@ from kafka_topic_analyzer_tpu.results import (
     COUNTER_CHANNELS,
     QuantileSummary,
     TopicMetrics,
-    U64_MAX,
+    finalize_extremes,
 )
 from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
 
@@ -36,13 +36,15 @@ class CpuExactBackend(MetricBackend):
         p = config.num_partitions
         self.per_partition = np.zeros((p, len(COUNTER_CHANNELS)), dtype=np.int64)
         # Reference init values: earliest=now, latest=epoch, smallest=u64::MAX,
-        # largest=0 (src/metric.rs:40-43).  We keep "unset" sentinels and
-        # apply the now/epoch clamps at finalize.
+        # largest=0 (src/metric.rs:40-43).  We keep "unset" sentinels (per
+        # partition, matching the TPU state layout) and apply the now/epoch
+        # clamps at finalize.
         self.init_now_s = utc_now_seconds() if init_now_s is None else init_now_s
-        self.earliest_s: "int | None" = None
-        self.latest_s: "int | None" = None
-        self.smallest: "int | None" = None
-        self.largest = 0
+        i64 = np.iinfo(np.int64)
+        self.earliest_s = np.full(p, i64.max, dtype=np.int64)
+        self.latest_s = np.full(p, i64.min, dtype=np.int64)
+        self.smallest = np.full(p, i64.max, dtype=np.int64)
+        self.largest = np.zeros(p, dtype=np.int64)
         self.overall_size = 0
         self.overall_count = 0
         # Alive-key bitmap over fnv32 slots, packed bits (reference: BitSet).
@@ -92,14 +94,10 @@ class CpuExactBackend(MetricBackend):
         msg_size = k_bytes + v_bytes
         sized = vn  # min/max excludes tombstones (src/metric.rs:249-251)
         if sized.any():
-            lo = int(msg_size[sized].min())
-            hi = int(msg_size[sized].max())
-            self.smallest = lo if self.smallest is None else min(self.smallest, lo)
-            self.largest = max(self.largest, hi)
-        ts = batch.ts_s[valid]
-        lo_t, hi_t = int(ts.min()), int(ts.max())
-        self.earliest_s = lo_t if self.earliest_s is None else min(self.earliest_s, lo_t)
-        self.latest_s = hi_t if self.latest_s is None else max(self.latest_s, hi_t)
+            np.minimum.at(self.smallest, part[sized], msg_size[sized])
+            np.maximum.at(self.largest, part[sized], msg_size[sized])
+        np.minimum.at(self.earliest_s, part[valid], batch.ts_s[valid])
+        np.maximum.at(self.latest_s, part[valid], batch.ts_s[valid])
 
         keyed = valid & ~batch.key_null
         if keyed.any():
@@ -139,13 +137,12 @@ class CpuExactBackend(MetricBackend):
     # -- finalize ------------------------------------------------------------
 
     def finalize(self) -> TopicMetrics:
-        earliest = (
-            self.init_now_s
-            if self.earliest_s is None
-            else min(self.init_now_s, self.earliest_s)
+        earliest, latest, smallest = finalize_extremes(
+            int(self.earliest_s.min()),
+            int(self.latest_s.max()),
+            int(self.smallest.min()),
+            self.init_now_s,
         )
-        latest = 0 if self.latest_s is None else max(0, self.latest_s)
-        smallest = U64_MAX if self.smallest is None else self.smallest
 
         alive_keys = None
         if self._alive_words is not None:
@@ -171,7 +168,7 @@ class CpuExactBackend(MetricBackend):
             earliest_ts_s=earliest,
             latest_ts_s=latest,
             smallest_message=smallest,
-            largest_message=self.largest,
+            largest_message=int(self.largest.max()),
             overall_size=self.overall_size,
             overall_count=self.overall_count,
             alive_keys=alive_keys,
@@ -181,4 +178,9 @@ class CpuExactBackend(MetricBackend):
                 len(self._seen_keys) if self.config.enable_hll else None
             ),
             quantiles=quantiles,
+            per_partition_extremes=np.stack(
+                [self.earliest_s, self.latest_s, self.smallest, self.largest],
+                axis=1,
+            ),
+            init_now_s=self.init_now_s,
         )

@@ -5,10 +5,13 @@ from __future__ import annotations
 import numpy as np
 
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
-from kafka_topic_analyzer_tpu.models.message_metrics import finalize_extremes
 from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_quantiles
 from kafka_topic_analyzer_tpu.ops.hll import hll_estimate
-from kafka_topic_analyzer_tpu.results import QuantileSummary, TopicMetrics
+from kafka_topic_analyzer_tpu.results import (
+    QuantileSummary,
+    TopicMetrics,
+    finalize_extremes,
+)
 
 QUANTILE_PROBS = (0.5, 0.9, 0.99)
 
@@ -17,8 +20,21 @@ def metrics_from_state(state, config: AnalyzerConfig, init_now_s: int) -> TopicM
     """``state`` is an AnalyzerState whose leaves are host numpy arrays
     (already merged across devices if the run was sharded)."""
     m = state.metrics
+    # Per-partition extremes reduce to the reference's global lines.
     earliest, latest, smallest = finalize_extremes(
-        int(m.earliest_s), int(m.latest_s), int(m.smallest), init_now_s
+        int(np.min(m.earliest_s)),
+        int(np.max(m.latest_s)),
+        int(np.min(m.smallest)),
+        init_now_s,
+    )
+    extremes = np.stack(
+        [
+            np.asarray(m.earliest_s),
+            np.asarray(m.latest_s),
+            np.asarray(m.smallest),
+            np.asarray(m.largest),
+        ],
+        axis=1,
     )
     alive_keys = None
     if state.alive is not None:
@@ -39,10 +55,12 @@ def metrics_from_state(state, config: AnalyzerConfig, init_now_s: int) -> TopicM
         earliest_ts_s=earliest,
         latest_ts_s=latest,
         smallest_message=smallest,
-        largest_message=int(m.largest),
+        largest_message=int(np.max(m.largest)),
         overall_size=int(m.overall_size),
         overall_count=int(m.overall_count),
         alive_keys=alive_keys,
         distinct_keys_hll=hll,
         quantiles=quantiles,
+        per_partition_extremes=extremes,
+        init_now_s=init_now_s,
     )

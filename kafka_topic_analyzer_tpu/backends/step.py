@@ -59,12 +59,14 @@ def analyzer_step(
         m.latest_s,
         m.smallest,
         m.largest,
+        arrays["partition"],
         key_len,
         value_len,
         key_null,
         value_null,
         arrays["ts_s"],
         valid,
+        config.num_partitions,
     )
     kn = valid & ~key_null
     vn = valid & ~value_null

@@ -39,7 +39,10 @@ def config_fingerprint(config: AnalyzerConfig, topic: str) -> str:
     """Snapshot compatibility key: anything that changes state shapes or
     fold semantics participates."""
     payload = json.dumps(
-        {"topic": topic, **dataclasses.asdict(config)}, sort_keys=True
+        # state_version: bump whenever the AnalyzerState layout changes so
+        # stale snapshots are rejected instead of shape-erroring.
+        {"topic": topic, "state_version": 2, **dataclasses.asdict(config)},
+        sort_keys=True,
     )
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 

@@ -95,7 +95,8 @@ def parse_mesh(text: str) -> "tuple[int, int]":
     raise ValueError(f"bad --mesh {text!r}")
 
 
-def make_source(args) -> "object":
+def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object":
+    topic = topic if topic is not None else args.topic
     if args.source == "synthetic":
         from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
 
@@ -109,7 +110,7 @@ def make_source(args) -> "object":
             tombstone_permille=int(kv.get("tombstones", 100)),
             value_len_min=int(kv.get("vmin", 100)),
             value_len_max=int(kv.get("vmax", 400)),
-            seed=int(seed_raw, 0) if seed_raw is not None else 0x5EED,
+            seed=(int(seed_raw, 0) if seed_raw is not None else 0x5EED) + seed_salt,
         )
         use_native = args.native in ("auto", "on")
         if use_native:
@@ -126,7 +127,7 @@ def make_source(args) -> "object":
             raise SystemExit("--source segfile requires --segment-dir")
         from kafka_topic_analyzer_tpu.io.segfile import SegmentFileSource
 
-        return SegmentFileSource(args.segment_dir, topic=args.topic)
+        return SegmentFileSource(args.segment_dir, topic=topic)
     # kafka
     if not args.bootstrap_server:
         raise SystemExit("--source kafka requires -b/--bootstrap-server")
@@ -134,14 +135,117 @@ def make_source(args) -> "object":
 
     return KafkaWireSource(
         bootstrap_servers=args.bootstrap_server,
-        topic=args.topic,
+        topic=topic,
         overrides=parse_kv_pairs(args.librdkafka),
         use_native_hashing=args.native != "off",
     )
 
 
+def run_multi_topic(args, topics: "list[str]") -> int:
+    """Fan-in scan of several topics through one backend: per-topic reports
+    from row slices, plus a cross-topic union block whose sketch lines come
+    from the associatively merged state (io/multi.py)."""
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.multi import MultiTopicSource
+    from kafka_topic_analyzer_tpu.report import render_report
+    from kafka_topic_analyzer_tpu.results import slice_rows
+    from kafka_topic_analyzer_tpu.utils.profiling import maybe_jax_trace
+    from kafka_topic_analyzer_tpu.utils.progress import Spinner
+    from kafka_topic_analyzer_tpu.utils.timefmt import format_utc_seconds
+
+    multi = MultiTopicSource(
+        [(t, make_source(args, topic=t, seed_salt=i)) for i, t in enumerate(topics)]
+    )
+    if multi.is_empty():
+        print(
+            "Given topic has no content, no analysis possible. Exiting.",
+            file=sys.stderr,
+        )
+        sys.exit(-2)
+
+    mesh_shape = parse_mesh(args.mesh)
+    config = AnalyzerConfig(
+        num_partitions=len(multi.partitions()),
+        batch_size=args.batch_size,
+        count_alive_keys=args.count_alive_keys,
+        alive_bitmap_bits=args.alive_bitmap_bits,
+        enable_hll=args.distinct_keys,
+        enable_quantiles=args.quantiles,
+        mesh_shape=mesh_shape,
+    )
+    if args.backend == "tpu" and mesh_shape != (1, 1):
+        from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+        backend = ShardedTpuBackend(config)
+    else:
+        from kafka_topic_analyzer_tpu.backends.base import make_backend
+
+        backend = make_backend(args.backend, config)
+
+    print(f"Subscribing to {', '.join(topics)} ({len(topics)}-topic fan-in)")
+    print("Starting message consumption...")
+    with maybe_jax_trace(args.profile_dir):
+        result = run_scan(
+            args.topic,
+            multi,
+            backend,
+            batch_size=args.batch_size,
+            spinner=Spinner(enabled=not args.quiet),
+            snapshot_dir=args.snapshot_dir,
+            snapshot_every_s=args.snapshot_every,
+            resume=args.resume,
+        )
+    if args.stats:
+        print("scan stages:", file=sys.stderr)
+        print(result.profile.summary(), file=sys.stderr)
+
+    union = result.metrics
+    # Per-topic reports: exact row slices with true partition ids.
+    for topic in topics:
+        rows = multi.rows_for(topic)
+        ids = [multi.true_partition(r) for r in rows]
+        sliced = slice_rows(union, rows, ids)
+        start = {multi.true_partition(r): result.start_offsets[r] for r in rows}
+        end = {multi.true_partition(r): result.end_offsets[r] for r in rows}
+        sys.stdout.write(
+            render_report(
+                topic, sliced, start, end, result.duration_secs,
+                show_alive_keys=False, show_extensions=False,
+            )
+        )
+
+    # Union block: totals + merged sketches (not sliceable per topic).
+    eq = "=" * 120
+    print(eq)
+    print(f"FAN-IN UNION of {len(topics)} topics: {', '.join(topics)}")
+    print(f"Messages: {union.overall_count}")
+    print(f"Bytes: {union.overall_size}")
+    print(f"Earliest Message: {format_utc_seconds(union.earliest_ts_s)}")
+    print(f"Latest Message: {format_utc_seconds(union.latest_ts_s)}")
+    if args.count_alive_keys and union.alive_keys is not None:
+        # Sum of per-topic alive keys (slots are salted per topic so the
+        # count is mesh- and interleaving-independent; io/multi.py).
+        print(f"Alive keys (sum over topics): {union.alive_keys}")
+    if union.distinct_keys_hll is not None:
+        print(f"Distinct keys (HLL est., union): {round(union.distinct_keys_hll)}")
+    if union.distinct_keys_exact is not None:
+        print(f"Distinct keys (exact, union): {union.distinct_keys_exact}")
+    if union.quantiles is not None:
+        qs = " ".join(
+            f"p{int(p * 100)}={v:.0f}B"
+            for p, v in zip(union.quantiles.probs, union.quantiles.values)
+        )
+        print(f"Message size quantiles (union): {qs}")
+    print(eq)
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = build_parser().parse_args(argv)
+    # Kafka topic names cannot contain commas, so "-t a,b,c" unambiguously
+    # selects multi-topic fan-in (new capability; BASELINE.json config 5).
+    if "," in args.topic:
+        return run_multi_topic(args, [t for t in args.topic.split(",") if t])
     source = make_source(args)
 
     # Empty-topic guard: exit(-2) like src/main.rs:98-101.

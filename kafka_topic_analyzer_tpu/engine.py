@@ -90,6 +90,7 @@ def run_scan(
     snapshot_dir: Optional[str] = None,
     snapshot_every_s: float = 60.0,
     resume: bool = False,
+    prefetch_depth: int = 2,
 ) -> ScanResult:
     """Full earliest→latest scan of the topic through the backend.
 
@@ -151,61 +152,87 @@ def run_scan(
             )
         last_snap = time.monotonic()
 
-    if hasattr(backend, "update_shards"):
-        # Sharded scan: one batch stream per data shard, each restricted to
-        # its own partitions (records.py ordering contract), zipped so every
-        # device step carries one full batch per shard.
-        from kafka_topic_analyzer_tpu.parallel.mesh import assign_partitions
+    # Prefetch iterators run worker threads; close them on ANY exit so an
+    # error mid-scan doesn't leak threads or the source's connections.
+    open_iters: "list" = []
 
-        d = backend.config.data_shards
-        shard_parts = assign_partitions(pindex.ids, d)
-        iters = [
-            source.batches(batch_size, partitions=parts, start_at=start_at)
-            if parts
-            else iter(())
-            for parts in shard_parts
-        ]
-        alive = [True] * d
-        while any(alive):
-            shard_batches: "list[RecordBatch | None]" = []
-            step_valid = 0
-            with profile.stage("ingest"):
-                for i, it in enumerate(iters):
-                    b = next(it, None) if alive[i] else None
-                    if b is None:
-                        alive[i] = False
-                    else:
-                        step_valid += b.num_valid
-                        tracker.observe(b, b.partition)
-                        b = pindex.remap_batch(b)
-                    shard_batches.append(b)
-            if step_valid == 0 and not any(alive):
-                break
-            with profile.stage("dispatch", items=step_valid):
-                backend.update_shards(shard_batches)
-            seq += step_valid
-            maybe_snapshot()
-            spinner.set_message(f"[Sq: {seq} | T: {topic} | shards: {d}]")
-    else:
-        batches = source.batches(batch_size, start_at=start_at)
-        while True:
-            with profile.stage("ingest"):
-                batch = next(batches, None)
-            if batch is None:
-                break
-            nvalid = batch.num_valid
-            last = len(batch) - 1
-            last_partition = int(batch.partition[last])  # true id, pre-remap
-            tracker.observe(batch, batch.partition)
-            batch = pindex.remap_batch(batch)
-            with profile.stage("dispatch", items=nvalid, nbytes=batch.nbytes):
-                backend.update(batch)
-            seq += nvalid
-            maybe_snapshot()
-            spinner.set_message(
-                f"[Sq: {seq} | T: {topic} | P: {last_partition} | "
-                f"O: ~ | Ts: {format_utc_seconds(int(batch.ts_s[last]))}]"
+    def _closing(it):
+        open_iters.append(it)
+        return it
+
+    from kafka_topic_analyzer_tpu.utils.prefetch import prefetch
+
+    try:
+        if hasattr(backend, "update_shards"):
+            # Sharded scan: one batch stream per data shard, each restricted
+            # to its own partitions (records.py ordering contract), zipped so
+            # every device step carries one full batch per shard.
+            from kafka_topic_analyzer_tpu.parallel.mesh import assign_partitions
+
+            d = backend.config.data_shards
+            shard_parts = assign_partitions(pindex.ids, d)
+            iters = [
+                _closing(
+                    prefetch(
+                        source.batches(
+                            batch_size, partitions=parts, start_at=start_at
+                        ),
+                        prefetch_depth,
+                    )
+                )
+                if parts
+                else iter(())
+                for parts in shard_parts
+            ]
+            alive = [True] * d
+            while any(alive):
+                shard_batches: "list[RecordBatch | None]" = []
+                step_valid = 0
+                with profile.stage("ingest"):
+                    for i, it in enumerate(iters):
+                        b = next(it, None) if alive[i] else None
+                        if b is None:
+                            alive[i] = False
+                        else:
+                            step_valid += b.num_valid
+                            tracker.observe(b, b.partition)
+                            b = pindex.remap_batch(b)
+                        shard_batches.append(b)
+                if step_valid == 0 and not any(alive):
+                    break
+                with profile.stage("dispatch", items=step_valid):
+                    backend.update_shards(shard_batches)
+                seq += step_valid
+                maybe_snapshot()
+                spinner.set_message(f"[Sq: {seq} | T: {topic} | shards: {d}]")
+        else:
+            batches = _closing(
+                prefetch(
+                    source.batches(batch_size, start_at=start_at), prefetch_depth
+                )
             )
+            while True:
+                with profile.stage("ingest"):
+                    batch = next(batches, None)
+                if batch is None:
+                    break
+                nvalid = batch.num_valid
+                last = len(batch) - 1
+                last_partition = int(batch.partition[last])  # true id, pre-remap
+                tracker.observe(batch, batch.partition)
+                batch = pindex.remap_batch(batch)
+                with profile.stage("dispatch", items=nvalid, nbytes=batch.nbytes):
+                    backend.update(batch)
+                seq += nvalid
+                maybe_snapshot()
+                spinner.set_message(
+                    f"[Sq: {seq} | T: {topic} | P: {last_partition} | "
+                    f"O: ~ | Ts: {format_utc_seconds(int(batch.ts_s[last]))}]"
+                )
+    finally:
+        for it in open_iters:
+            if hasattr(it, "close"):
+                it.close()
 
     with profile.stage("finalize"):
         metrics = backend.finalize()

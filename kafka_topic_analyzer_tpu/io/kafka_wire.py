@@ -29,6 +29,7 @@ from __future__ import annotations
 import logging
 import socket
 import struct
+import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -70,7 +71,12 @@ def _hash_keys(
 
 
 class BrokerConnection:
-    """One blocking TCP connection to a broker."""
+    """One blocking TCP connection to a broker.
+
+    `request` is serialized by a lock: sharded scans prefetch per-shard
+    batch streams from worker threads (utils/prefetch.py) that share the
+    per-broker connections.
+    """
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0):
         self.host = host
@@ -78,6 +84,7 @@ class BrokerConnection:
         self.sock = socket.create_connection((host, port), timeout=timeout_s)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._corr = 0
+        self._lock = threading.Lock()
 
     def close(self) -> None:
         try:
@@ -99,13 +106,14 @@ class BrokerConnection:
         return b"".join(chunks)
 
     def request(self, api_key: int, api_version: int, body: bytes) -> kc.ByteReader:
-        self._corr += 1
-        corr = self._corr
-        self.sock.sendall(
-            kc.encode_request(api_key, api_version, corr, CLIENT_ID, body)
-        )
-        (length,) = struct.unpack(">i", self._recv_exact(4))
-        payload = self._recv_exact(length)
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            self.sock.sendall(
+                kc.encode_request(api_key, api_version, corr, CLIENT_ID, body)
+            )
+            (length,) = struct.unpack(">i", self._recv_exact(4))
+            payload = self._recv_exact(length)
         r = kc.ByteReader(payload)
         got_corr = r.i32()
         if got_corr != corr:
@@ -152,6 +160,7 @@ class KafkaWireSource(RecordSource):
             log.warning("ignoring unsupported consumer property %r", k)
 
         self._bootstrap = parse_bootstrap(bootstrap_servers)
+        self._conn_lock = threading.Lock()
         self._conns: Dict[Tuple[str, int], BrokerConnection] = {}
         self._brokers: Dict[int, Tuple[str, int]] = {}
         self._leaders: Dict[int, int] = {}
@@ -162,11 +171,12 @@ class KafkaWireSource(RecordSource):
 
     def _connect(self, host: str, port: int) -> BrokerConnection:
         key = (host, port)
-        conn = self._conns.get(key)
-        if conn is None:
-            conn = BrokerConnection(host, port, self.timeout_s)
-            self._conns[key] = conn
-        return conn
+        with self._conn_lock:
+            conn = self._conns.get(key)
+            if conn is None:
+                conn = BrokerConnection(host, port, self.timeout_s)
+                self._conns[key] = conn
+            return conn
 
     def _any_conn(self) -> BrokerConnection:
         errors = []

@@ -18,30 +18,36 @@ import numpy as np
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 from kafka_topic_analyzer_tpu.jax_support import jnp
 from kafka_topic_analyzer_tpu.ops.counters import I64_MAX, I64_MIN
-from kafka_topic_analyzer_tpu.results import U64_MAX
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class MessageMetricsState:
+    """Extremes are tracked per partition (the reference keeps only global
+    scalars, src/metric.rs:20-23): per-partition min/max is a new capability
+    in its own right, and it is what makes multi-topic fan-in reports exact
+    — any row slice of the state reconstructs that topic's extremes, and the
+    reference's global lines are reductions over rows (finalize)."""
+
     per_partition: jax.Array  # int64[P, 7]
-    earliest_s: jax.Array     # int64 scalar, I64_MAX until first record
-    latest_s: jax.Array       # int64 scalar, I64_MIN until first record
-    smallest: jax.Array       # int64 scalar, I64_MAX until first sized record
-    largest: jax.Array        # int64 scalar
+    earliest_s: jax.Array     # int64[P], I64_MAX until first record
+    latest_s: jax.Array       # int64[P], I64_MIN until first record
+    smallest: jax.Array       # int64[P], I64_MAX until first sized record
+    largest: jax.Array        # int64[P]
     overall_size: jax.Array   # int64 scalar
     overall_count: jax.Array  # int64 scalar
 
     @classmethod
     def init(cls, config: AnalyzerConfig) -> "MessageMetricsState":
+        p = config.num_partitions
         # Note: every leaf must be a distinct buffer — the TPU backend donates
         # the whole state, and XLA rejects donating one buffer twice.
         return cls(
-            per_partition=jnp.zeros((config.num_partitions, 7), dtype=jnp.int64),
-            earliest_s=jnp.int64(I64_MAX),
-            latest_s=jnp.int64(I64_MIN),
-            smallest=jnp.int64(I64_MAX),
-            largest=jnp.int64(0),
+            per_partition=jnp.zeros((p, 7), dtype=jnp.int64),
+            earliest_s=jnp.full((p,), I64_MAX, dtype=jnp.int64),
+            latest_s=jnp.full((p,), I64_MIN, dtype=jnp.int64),
+            smallest=jnp.full((p,), I64_MAX, dtype=jnp.int64),
+            largest=jnp.zeros((p,), dtype=jnp.int64),
             overall_size=jnp.int64(0),
             overall_count=jnp.int64(0),
         )
@@ -56,22 +62,6 @@ class MessageMetricsState:
             overall_size=self.overall_size + other.overall_size,
             overall_count=self.overall_count + other.overall_count,
         )
-
-
-def finalize_extremes(
-    earliest_s: int, latest_s: int, smallest: int, init_now_s: int
-) -> "tuple[int, int, int]":
-    """Map sentinel-initialized extremes to the reference's reporting values.
-
-    The reference initializes ``earliest_message`` to *scan start time* and
-    ``latest_message`` to epoch 0 (src/metric.rs:40-41), so the reported
-    earliest is ``min(now, min_ts)`` and latest is ``max(0, max_ts)``;
-    ``smallest_message`` reports u64::MAX → 0 handled via `results`.
-    """
-    earliest = min(init_now_s, earliest_s) if earliest_s != I64_MAX else init_now_s
-    latest = max(0, latest_s) if latest_s != I64_MIN else 0
-    smallest_u64 = U64_MAX if smallest == int(I64_MAX) else smallest
-    return earliest, latest, smallest_u64
 
 
 def state_to_numpy(state: MessageMetricsState) -> "dict[str, np.ndarray]":

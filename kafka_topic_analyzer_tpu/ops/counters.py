@@ -67,28 +67,38 @@ def counters_update(
 
 
 def extremes_update(
-    earliest_s,     # int64 scalar
-    latest_s,       # int64 scalar
-    smallest,       # int64 scalar (I64_MAX sentinel when unset)
-    largest,        # int64 scalar
+    earliest_s,     # int64[P], I64_MAX sentinel when unset
+    latest_s,       # int64[P], I64_MIN sentinel
+    smallest,       # int64[P], I64_MAX sentinel
+    largest,        # int64[P]
+    partition,      # int32[B]
     key_len,
     value_len,
     key_null,
     value_null,
     ts_s,           # int64[B]
     valid,
+    num_partitions: int,
 ):
-    """Update global min/max timestamp and message size."""
+    """Update per-partition min/max timestamp and message size via masked
+    scatter-min/max (padded records route to a scratch row)."""
     kn = valid & ~key_null
     vn = valid & ~value_null
     msg_size = (
         jnp.where(kn, key_len, 0).astype(jnp.int64)
         + jnp.where(vn, value_len, 0).astype(jnp.int64)
     )
+    p = num_partitions
+    idx = jnp.where(valid, partition, p)
     # Size extremes exclude tombstones (src/metric.rs:249-251).
-    sized = vn
-    smallest = jnp.minimum(smallest, jnp.min(jnp.where(sized, msg_size, I64_MAX)))
-    largest = jnp.maximum(largest, jnp.max(jnp.where(sized, msg_size, 0)))
-    earliest_s = jnp.minimum(earliest_s, jnp.min(jnp.where(valid, ts_s, I64_MAX)))
-    latest_s = jnp.maximum(latest_s, jnp.max(jnp.where(valid, ts_s, I64_MIN)))
-    return earliest_s, latest_s, smallest, largest
+    idx_sized = jnp.where(vn, partition, p)
+    ts_min = jnp.full((p + 1,), I64_MAX, jnp.int64).at[idx].min(ts_s)[:p]
+    ts_max = jnp.full((p + 1,), I64_MIN, jnp.int64).at[idx].max(ts_s)[:p]
+    sz_min = jnp.full((p + 1,), I64_MAX, jnp.int64).at[idx_sized].min(msg_size)[:p]
+    sz_max = jnp.zeros((p + 1,), jnp.int64).at[idx_sized].max(msg_size)[:p]
+    return (
+        jnp.minimum(earliest_s, ts_min),
+        jnp.maximum(latest_s, ts_max),
+        jnp.minimum(smallest, sz_min),
+        jnp.maximum(largest, sz_max),
+    )

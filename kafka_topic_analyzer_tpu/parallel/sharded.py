@@ -74,10 +74,10 @@ def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
     i64min = np.iinfo(np.int64).min
     metrics = MessageMetricsState(
         per_partition=np.zeros((d, p, 7), np.int64),
-        earliest_s=np.full((d,), i64max, np.int64),
-        latest_s=np.full((d,), i64min, np.int64),
-        smallest=np.full((d,), i64max, np.int64),
-        largest=np.zeros((d,), np.int64),
+        earliest_s=np.full((d, p), i64max, np.int64),
+        latest_s=np.full((d, p), i64min, np.int64),
+        smallest=np.full((d, p), i64max, np.int64),
+        largest=np.zeros((d, p), np.int64),
         overall_size=np.zeros((d,), np.int64),
         overall_count=np.zeros((d,), np.int64),
     )

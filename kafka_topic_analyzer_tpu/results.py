@@ -66,6 +66,14 @@ class TopicMetrics:
     distinct_keys_exact: Optional[int] = None
     #: Message-size quantiles (new capability).
     quantiles: Optional[QuantileSummary] = None
+    #: Per-partition extremes (new capability; also enables exact row
+    #: slicing for multi-topic fan-in): int64[P, 4] columns
+    #: (earliest_ts, latest_ts, smallest, largest) with raw sentinels
+    #: (I64_MAX/I64_MIN) where a partition never saw a record.
+    per_partition_extremes: Optional[np.ndarray] = None
+    #: Scan-start time used for the reference's earliest-message fallback
+    #: (src/metric.rs:40); kept so row slices can re-derive global lines.
+    init_now_s: Optional[int] = None
 
     # -- per-partition getters (reference getter semantics) ------------------
 
@@ -121,3 +129,68 @@ class TopicMetrics:
     def smallest_message_reported(self) -> int:
         """0 when never set (src/metric.rs:177-183)."""
         return 0 if self.smallest_message == U64_MAX else self.smallest_message
+
+
+I64_MAX_NP = np.iinfo(np.int64).max
+I64_MIN_NP = np.iinfo(np.int64).min
+
+
+def finalize_extremes(
+    earliest_raw: int, latest_raw: int, smallest_raw: int, init_now_s: int
+) -> "tuple[int, int, int]":
+    """Map sentinel-initialized extremes to the reference's reporting values
+    (single source of truth — backends and row slices all call this).
+
+    The reference initializes ``earliest_message`` to *scan start time* and
+    ``latest_message`` to epoch 0 (src/metric.rs:40-41), so the reported
+    earliest is ``min(now, min_ts)`` and latest ``max(0, max_ts)``;
+    ``smallest_message`` keeps u64::MAX until set (src/metric.rs:42).
+    """
+    earliest = (
+        min(init_now_s, earliest_raw) if earliest_raw != I64_MAX_NP else init_now_s
+    )
+    latest = max(0, latest_raw) if latest_raw != I64_MIN_NP else 0
+    smallest = U64_MAX if smallest_raw == I64_MAX_NP else smallest_raw
+    return earliest, latest, smallest
+
+
+def slice_rows(
+    metrics: TopicMetrics,
+    rows: "list[int]",
+    partition_ids: "list[int]",
+) -> TopicMetrics:
+    """Project a multi-topic (fan-in) result onto one topic's rows.
+
+    Exact for everything derived from per-row state (counters, extremes,
+    overall sums); cross-topic merged sketches (alive bitmap, HLL,
+    quantiles) cannot be un-merged and are dropped from the slice — they
+    live in the fan-in union report.
+    """
+    if metrics.per_partition_extremes is None:
+        raise ValueError("slice_rows needs per-partition extremes")
+    per = metrics.per_partition[rows]
+    ext = metrics.per_partition_extremes[rows]
+    earliest_raw = int(ext[:, 0].min()) if len(rows) else I64_MAX_NP
+    latest_raw = int(ext[:, 1].max()) if len(rows) else I64_MIN_NP
+    smallest_raw = int(ext[:, 2].min()) if len(rows) else I64_MAX_NP
+    largest = int(ext[:, 3].max()) if len(rows) else 0
+    now = metrics.init_now_s if metrics.init_now_s is not None else 0
+    earliest, latest, smallest = finalize_extremes(
+        earliest_raw, latest_raw, smallest_raw, now
+    )
+    overall_size = int(
+        per[:, CH["key_size_sum"]].sum() + per[:, CH["value_size_sum"]].sum()
+    )
+    overall_count = int(per[:, CH["total"]].sum())
+    return TopicMetrics(
+        partitions=list(partition_ids),
+        per_partition=per,
+        earliest_ts_s=earliest,
+        latest_ts_s=latest,
+        smallest_message=smallest,
+        largest_message=largest,
+        overall_size=overall_size,
+        overall_count=overall_count,
+        per_partition_extremes=ext,
+        init_now_s=metrics.init_now_s,
+    )

@@ -150,6 +150,12 @@ int32_t kta_synth_batch(const KtaSynthSpec* spec,
   const KtaSynthSpec s = *spec;
   const int key_len_total = 1 + s.key_digits;
 
+  // Stream bases depend only on the partition — mix once per slot of the
+  // round-robin, not once per record.
+  std::vector<uint64_t> bases(nparts);
+  for (int32_t j = 0; j < nparts; ++j)
+    bases[j] = splitmix64(s.seed ^ (static_cast<uint64_t>(parts[j]) << 40));
+
   parallel_for(n, threads, [&](int64_t a, int64_t b) {
     uint8_t keybuf[64];
     keybuf[0] = 'k';
@@ -157,9 +163,10 @@ int32_t kta_synth_batch(const KtaSynthSpec* spec,
       const int64_t g = lo + i;
       const int32_t p = parts[g % nparts];
       const int64_t o = g / nparts;
-      const uint64_t x =
-          splitmix64(s.seed ^ (static_cast<uint64_t>(p) << 40) ^
-                     static_cast<uint64_t>(o));
+      // Record o is the o-th output of a SplitMix64 stream with a mixed
+      // per-partition base (see io/synthetic.py — wire contract).
+      const uint64_t x = splitmix64(bases[g % nparts] +
+                                    static_cast<uint64_t>(o) * 0x9e3779b97f4a7c15ull);
 
       const bool key_null =
           static_cast<int64_t>(x % 1000ull) < s.key_null_permille;

@@ -1,0 +1,125 @@
+"""Multi-topic fan-in: per-topic slices must equal standalone scans, and
+the union must equal the sum/merge of parts."""
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.cli import main
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.multi import MultiTopicSource
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+from kafka_topic_analyzer_tpu.results import slice_rows
+
+
+def _spec(seed, partitions=2, messages=2000):
+    return SyntheticSpec(
+        num_partitions=partitions,
+        messages_per_partition=messages,
+        keys_per_partition=100,
+        tombstone_permille=150,
+        seed=seed,
+    )
+
+
+def test_fan_in_slices_match_standalone_scans():
+    specs = {"alpha": _spec(1, 2, 1500), "beta": _spec(2, 3, 2200)}
+    multi = MultiTopicSource([(t, SyntheticSource(s)) for t, s in specs.items()])
+    cfg = AnalyzerConfig(num_partitions=5, batch_size=512)
+    union = run_scan("m", multi, TpuBackend(cfg, init_now_s=10**10), 512).metrics
+
+    for topic, spec in specs.items():
+        solo_cfg = AnalyzerConfig(num_partitions=spec.num_partitions, batch_size=512)
+        solo = run_scan(
+            topic, SyntheticSource(spec),
+            CpuExactBackend(solo_cfg, init_now_s=10**10), 512,
+        ).metrics
+        rows = multi.rows_for(topic)
+        ids = [multi.true_partition(r) for r in rows]
+        sliced = slice_rows(union, rows, ids)
+        assert np.array_equal(sliced.per_partition, solo.per_partition)
+        assert sliced.earliest_ts_s == solo.earliest_ts_s
+        assert sliced.latest_ts_s == solo.latest_ts_s
+        assert sliced.smallest_message == solo.smallest_message
+        assert sliced.largest_message == solo.largest_message
+        assert sliced.overall_count == solo.overall_count
+        assert sliced.overall_size == solo.overall_size
+
+    assert union.overall_count == 2 * 1500 + 3 * 2200
+
+
+def test_union_alive_keys_is_sum_of_per_topic_counts():
+    # Aliveness is tracked per (topic, key) — slots are salted per topic so
+    # the count is mesh/interleaving-independent (io/multi.py docstring).
+    # Identical topics therefore count twice.
+    spec = _spec(7, 1, 800)
+    multi = MultiTopicSource(
+        [("a", SyntheticSource(spec)), ("b", SyntheticSource(spec))]
+    )
+    cfg = AnalyzerConfig(
+        num_partitions=2, batch_size=256, count_alive_keys=True,
+        alive_bitmap_bits=20,
+    )
+    union = run_scan("m", multi, TpuBackend(cfg, init_now_s=0), 256).metrics
+    solo = run_scan(
+        "a", SyntheticSource(spec),
+        CpuExactBackend(
+            AnalyzerConfig(num_partitions=1, batch_size=256,
+                           count_alive_keys=True, alive_bitmap_bits=20),
+            init_now_s=0,
+        ), 256,
+    ).metrics
+    assert union.alive_keys == 2 * solo.alive_keys
+
+
+def test_fan_in_alive_keys_mesh_independent():
+    """The same fan-in scan must report identical alive keys on any mesh."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    specs = [("a", _spec(3, 2, 900)), ("b", _spec(4, 2, 900))]
+    counts = []
+    for mesh in [(1, 1), (4, 1)]:
+        cfg = AnalyzerConfig(
+            num_partitions=4, batch_size=256, count_alive_keys=True,
+            alive_bitmap_bits=20, mesh_shape=mesh,
+        )
+        multi = MultiTopicSource([(t, SyntheticSource(s)) for t, s in specs])
+        backend = (
+            TpuBackend(cfg, init_now_s=0)
+            if mesh == (1, 1)
+            else ShardedTpuBackend(cfg, init_now_s=0)
+        )
+        counts.append(run_scan("m", multi, backend, 256).metrics.alive_keys)
+    assert counts[0] == counts[1]
+
+
+def test_duplicate_topics_rejected():
+    spec = _spec(1)
+    with pytest.raises(ValueError, match="duplicate"):
+        MultiTopicSource(
+            [("x", SyntheticSource(spec)), ("x", SyntheticSource(spec))]
+        )
+
+
+def test_cli_fan_in(capsys):
+    assert main([
+        "-t", "north,south,east",
+        "--source", "synthetic",
+        "--synthetic", "partitions=2,messages=400,keys=50,tombstones=150",
+        "--backend", "tpu", "-c", "--alive-bitmap-bits", "20",
+        "--distinct-keys",
+        "--quiet", "--native", "off",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert out.count("Calculating statistics...") == 3  # one report per topic
+    assert "Topic north" in out and "Topic east" in out
+    assert "FAN-IN UNION of 3 topics" in out
+    assert "Messages: 2400" in out  # 3 topics * 2 partitions * 400
+    assert "Alive keys (sum over topics):" in out
+    assert "Distinct keys (HLL est., union):" in out

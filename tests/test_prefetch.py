@@ -1,0 +1,32 @@
+"""Prefetch iterator: ordering, error propagation, disable switch."""
+
+import pytest
+
+from kafka_topic_analyzer_tpu.utils.prefetch import PrefetchIterator, prefetch
+
+
+def test_order_preserved():
+    assert list(prefetch(iter(range(100)), depth=3)) == list(range(100))
+
+
+def test_exception_propagates_in_position():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("boom")
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_depth_zero_is_passthrough():
+    src = iter([1, 2])
+    assert prefetch(src, depth=0) is src
+
+
+def test_tuple_items_not_mistaken_for_errors():
+    items = [("__error__", ValueError("x")), ("a", "b")]
+    assert list(PrefetchIterator(iter(items), depth=1)) == items

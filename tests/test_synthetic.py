@@ -73,7 +73,8 @@ def test_key_hashes_match_scalar_reference():
     from kafka_topic_analyzer_tpu.ops.fnv import splitmix64
 
     for i in range(len(part)):
-        x = splitmix64(SPEC.seed ^ (int(part[i]) << 40) ^ int(off[i]))
+        stream = splitmix64(SPEC.seed ^ (int(part[i]) << 40))
+        x = splitmix64((stream + int(off[i]) * 0x9E3779B97F4A7C15) & (2**64 - 1))
         if x % 1000 < SPEC.key_null_permille:
             assert f["key_hash32"][i] == 0
             continue
@@ -83,6 +84,21 @@ def test_key_hashes_match_scalar_reference():
         assert len(kb) == SPEC.key_len
         assert int(f["key_hash32"][i]) == fnv1a32_ref(kb)
         assert int(f["key_hash64"][i]) == fnv1a64(kb)
+
+
+def test_nearby_seeds_give_different_topics():
+    """Regression: seed and seed+1 must not produce permutations of the
+    same record multiset (the old seed^offset derivation did)."""
+    import dataclasses
+
+    a = RecordBatch.concat(list(SyntheticSource(SPEC).batches(4096)))
+    b = RecordBatch.concat(
+        list(
+            SyntheticSource(dataclasses.replace(SPEC, seed=SPEC.seed + 1)).batches(4096)
+        )
+    )
+    assert int(a.value_len.sum()) != int(b.value_len.sum())
+    assert int(a.key_null.sum()) != int(b.key_null.sum())
 
 
 def test_keys_are_partition_disjoint():
