@@ -1,0 +1,16 @@
+# Runtime image (parity with the reference's Dockerfile, which ships the
+# release binary on fedora:33 — and whose ENTRYPOINT is literally /usr/bin/bash,
+# a quirk not replicated here).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY kafka_topic_analyzer_tpu ./kafka_topic_analyzer_tpu
+COPY native ./native
+RUN pip install --no-cache-dir "jax[cpu]" numpy && pip install --no-cache-dir . \
+    && make -C native
+
+ENTRYPOINT ["kta"]

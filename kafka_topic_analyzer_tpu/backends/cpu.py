@@ -30,6 +30,22 @@ from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
 _QUANTILE_PROBS = (0.5, 0.9, 0.99)
 
 
+def _exact_quantiles(sizes: np.ndarray, counts: np.ndarray) -> QuantileSummary:
+    """Exact quantiles of a (size -> count) histogram (sizes sorted)."""
+    if len(sizes) == 0:
+        return QuantileSummary(list(_QUANTILE_PROBS), [float("nan")] * 3)
+    order = np.argsort(sizes)
+    sizes = sizes[order]
+    counts = counts[order]
+    cum = np.cumsum(counts)
+    total = int(cum[-1])
+    vals = []
+    for q in _QUANTILE_PROBS:
+        rank = max(0, min(total - 1, int(np.ceil(q * total)) - 1))
+        vals.append(float(sizes[int(np.searchsorted(cum, rank + 1))]))
+    return QuantileSummary(list(_QUANTILE_PROBS), vals)
+
+
 class CpuExactBackend(MetricBackend):
     def __init__(self, config: AnalyzerConfig, init_now_s: "int | None" = None):
         super().__init__(config)
@@ -55,8 +71,8 @@ class CpuExactBackend(MetricBackend):
         # Exact distinct-alive/ever-seen key tracking by 64-bit hash identity
         # (referee for the HLL sketch; collision probability ~2^-64).
         self._seen_keys: "set[int]" = set()
-        # Exact message sizes histogram referee for quantiles: store sizes
-        # compressed as a dict size->count (sizes are small ints in practice).
+        # Exact message sizes histogram referee for quantiles, keyed by
+        # (partition << 32 | size) so per-partition summaries are exact too.
         self._size_counts: Dict[int, int] = {}
 
     # -- update --------------------------------------------------------------
@@ -107,9 +123,10 @@ class CpuExactBackend(MetricBackend):
                     batch.key_hash32[keyed], vn[keyed]
                 )
         if self.config.enable_quantiles:
-            sizes, counts = np.unique(msg_size[sized], return_counts=True)
-            for s, c in zip(sizes.tolist(), counts.tolist()):
-                self._size_counts[s] = self._size_counts.get(s, 0) + c
+            keys = (part[sized].astype(np.int64) << 32) | msg_size[sized]
+            uniq, counts = np.unique(keys, return_counts=True)
+            for k, c in zip(uniq.tolist(), counts.tolist()):
+                self._size_counts[k] = self._size_counts.get(k, 0) + c
 
     def _update_alive_bitmap(self, h32: np.ndarray, alive: np.ndarray) -> None:
         """Last-writer-wins per slot within the batch, then packed-bit RMW.
@@ -149,18 +166,22 @@ class CpuExactBackend(MetricBackend):
             # bitwise_count avoids unpackbits' 8x temporary (4 GiB at 2^32).
             alive_keys = int(np.bitwise_count(self._alive_words).sum())
         quantiles = None
+        quantiles_pp = None
         if self.config.enable_quantiles and self._size_counts:
-            sizes = np.array(sorted(self._size_counts), dtype=np.int64)
-            counts = np.array(
-                [self._size_counts[int(s)] for s in sizes], dtype=np.int64
+            keys = np.array(sorted(self._size_counts), dtype=np.int64)
+            kcounts = np.array(
+                [self._size_counts[int(k)] for k in keys], dtype=np.int64
             )
-            cum = np.cumsum(counts)
-            total = int(cum[-1])
-            vals = []
-            for q in _QUANTILE_PROBS:
-                rank = max(0, min(total - 1, int(np.ceil(q * total)) - 1))
-                vals.append(float(sizes[int(np.searchsorted(cum, rank + 1))]))
-            quantiles = QuantileSummary(list(_QUANTILE_PROBS), vals)
+            sizes_all = keys & 0xFFFFFFFF
+            quantiles = _exact_quantiles(sizes_all, kcounts)
+            if self.config.quantiles_per_partition:
+                parts_of_key = keys >> 32
+                quantiles_pp = []
+                for p in range(self.config.num_partitions):
+                    sel = parts_of_key == p
+                    quantiles_pp.append(
+                        _exact_quantiles(sizes_all[sel], kcounts[sel])
+                    )
 
         return TopicMetrics(
             partitions=list(range(self.config.num_partitions)),
@@ -178,6 +199,7 @@ class CpuExactBackend(MetricBackend):
                 len(self._seen_keys) if self.config.enable_hll else None
             ),
             quantiles=quantiles,
+            quantiles_per_partition=quantiles_pp,
             per_partition_extremes=np.stack(
                 [self.earliest_s, self.latest_s, self.smallest, self.largest],
                 axis=1,

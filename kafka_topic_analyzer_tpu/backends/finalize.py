@@ -44,11 +44,24 @@ def metrics_from_state(state, config: AnalyzerConfig, init_now_s: int) -> TopicM
     if state.hll is not None:
         hll = hll_estimate(np.asarray(state.hll.regs))
     quantiles = None
+    quantiles_pp = None
     if state.quantiles is not None:
+        counts = np.asarray(state.quantiles.counts)
+        # Global quantiles from the exact sum of rows (DDSketch merge = add).
         vals = ddsketch_quantiles(
-            np.asarray(state.quantiles.counts), QUANTILE_PROBS, config.quantile_gamma
+            counts.sum(axis=0), QUANTILE_PROBS, config.quantile_gamma
         )
         quantiles = QuantileSummary(list(QUANTILE_PROBS), vals)
+        if config.quantiles_per_partition:
+            quantiles_pp = [
+                QuantileSummary(
+                    list(QUANTILE_PROBS),
+                    ddsketch_quantiles(
+                        counts[r], QUANTILE_PROBS, config.quantile_gamma
+                    ),
+                )
+                for r in range(counts.shape[0])
+            ]
     return TopicMetrics(
         partitions=list(range(config.num_partitions)),
         per_partition=np.asarray(m.per_partition),
@@ -61,6 +74,7 @@ def metrics_from_state(state, config: AnalyzerConfig, init_now_s: int) -> TopicM
         alive_keys=alive_keys,
         distinct_keys_hll=hll,
         quantiles=quantiles,
+        quantiles_per_partition=quantiles_pp,
         per_partition_extremes=extremes,
         init_now_s=init_now_s,
     )

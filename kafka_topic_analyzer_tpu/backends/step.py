@@ -109,6 +109,9 @@ def analyzer_step(
             vn,  # quantiles over sized (non-tombstone) messages, like min/max
             config.quantile_gamma,
             config.quantile_buckets,
+            partition=(
+                arrays["partition"] if config.quantiles_per_partition else None
+            ),
         )
         q_state = DDSketchState(counts=counts)
 

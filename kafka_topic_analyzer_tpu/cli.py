@@ -11,10 +11,28 @@ Extensions: ``--backend {cpu,tpu}`` (default cpu per BASELINE.json),
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 from typing import Dict, Optional
 
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+
+
+class UserInputError(ValueError):
+    """A bad flag/spec value (setup phase) — reported as one clean line.
+    Internal ValueErrors deliberately do NOT inherit this, so they keep
+    their tracebacks."""
+
+
+@contextlib.contextmanager
+def user_input_phase():
+    """Re-brand setup-phase ValueErrors as user input errors."""
+    try:
+        yield
+    except UserInputError:
+        raise
+    except ValueError as e:
+        raise UserInputError(e) from e
 
 
 def parse_kv_pairs(text: Optional[str]) -> Dict[str, str]:
@@ -68,6 +86,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Also estimate distinct keys with a HyperLogLog sketch")
     p.add_argument("--quantiles", action="store_true",
                    help="Also compute message-size quantiles (DDSketch)")
+    p.add_argument("--quantiles-per-partition", action="store_true",
+                   help="Track one size-quantile sketch per partition "
+                        "(implies --quantiles)")
     p.add_argument("--mesh", metavar="DATA[,SPACE]", default="1",
                    help="Device mesh shape: data shards[, space shards]")
     p.add_argument("--native", choices=["auto", "on", "off"], default="auto",
@@ -153,9 +174,13 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     from kafka_topic_analyzer_tpu.utils.progress import Spinner
     from kafka_topic_analyzer_tpu.utils.timefmt import format_utc_seconds
 
-    multi = MultiTopicSource(
-        [(t, make_source(args, topic=t, seed_salt=i)) for i, t in enumerate(topics)]
-    )
+    with user_input_phase():
+        multi = MultiTopicSource(
+            [
+                (t, make_source(args, topic=t, seed_salt=i))
+                for i, t in enumerate(topics)
+            ]
+        )
     if multi.is_empty():
         print(
             "Given topic has no content, no analysis possible. Exiting.",
@@ -163,16 +188,18 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         )
         sys.exit(-2)
 
-    mesh_shape = parse_mesh(args.mesh)
-    config = AnalyzerConfig(
-        num_partitions=len(multi.partitions()),
-        batch_size=args.batch_size,
-        count_alive_keys=args.count_alive_keys,
-        alive_bitmap_bits=args.alive_bitmap_bits,
-        enable_hll=args.distinct_keys,
-        enable_quantiles=args.quantiles,
-        mesh_shape=mesh_shape,
-    )
+    with user_input_phase():
+        mesh_shape = parse_mesh(args.mesh)
+        config = AnalyzerConfig(
+            num_partitions=len(multi.partitions()),
+            batch_size=args.batch_size,
+            count_alive_keys=args.count_alive_keys,
+            alive_bitmap_bits=args.alive_bitmap_bits,
+            enable_hll=args.distinct_keys,
+            enable_quantiles=args.quantiles,
+            quantiles_per_partition=args.quantiles_per_partition,
+            mesh_shape=mesh_shape,
+        )
     if args.backend == "tpu" and mesh_shape != (1, 1):
         from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
 
@@ -207,10 +234,12 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         sliced = slice_rows(union, rows, ids)
         start = {multi.true_partition(r): result.start_offsets[r] for r in rows}
         end = {multi.true_partition(r): result.end_offsets[r] for r in rows}
+        # Extensions render only the per-row lines a slice can carry (e.g.
+        # per-partition quantiles); merged union-only sketches are None here.
         sys.stdout.write(
             render_report(
                 topic, sliced, start, end, result.duration_secs,
-                show_alive_keys=False, show_extensions=False,
+                show_alive_keys=False, show_extensions=True,
             )
         )
 
@@ -241,12 +270,33 @@ def run_multi_topic(args, topics: "list[str]") -> int:
 
 
 def main(argv: "list[str] | None" = None) -> int:
+    from kafka_topic_analyzer_tpu.utils.log import init_logging
+
+    init_logging()  # env_logger parity: RUST_LOG / KTA_LOG (src/main.rs:30)
     args = build_parser().parse_args(argv)
+    from kafka_topic_analyzer_tpu.io.kafka_codec import KafkaProtocolError
+
+    try:
+        return _run(args)
+    except (OSError, KafkaProtocolError) as e:
+        # Environment/user-facing failures get one clean line, not a
+        # traceback (the reference panics here; we can do better).  Other
+        # exception types — including internal ValueErrors — keep their
+        # tracebacks so bugs stay diagnosable.
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except UserInputError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def _run(args) -> int:
     # Kafka topic names cannot contain commas, so "-t a,b,c" unambiguously
     # selects multi-topic fan-in (new capability; BASELINE.json config 5).
     if "," in args.topic:
         return run_multi_topic(args, [t for t in args.topic.split(",") if t])
-    source = make_source(args)
+    with user_input_phase():
+        source = make_source(args)
 
     # Empty-topic guard: exit(-2) like src/main.rs:98-101.
     if source.is_empty():
@@ -256,16 +306,18 @@ def main(argv: "list[str] | None" = None) -> int:
         )
         sys.exit(-2)
 
-    mesh_shape = parse_mesh(args.mesh)
-    config = AnalyzerConfig(
-        num_partitions=len(source.partitions()),
-        batch_size=args.batch_size,
-        count_alive_keys=args.count_alive_keys,
-        alive_bitmap_bits=args.alive_bitmap_bits,
-        enable_hll=args.distinct_keys,
-        enable_quantiles=args.quantiles,
-        mesh_shape=mesh_shape,
-    )
+    with user_input_phase():
+        mesh_shape = parse_mesh(args.mesh)
+        config = AnalyzerConfig(
+            num_partitions=len(source.partitions()),
+            batch_size=args.batch_size,
+            count_alive_keys=args.count_alive_keys,
+            alive_bitmap_bits=args.alive_bitmap_bits,
+            enable_hll=args.distinct_keys,
+            enable_quantiles=args.quantiles,
+            quantiles_per_partition=args.quantiles_per_partition,
+            mesh_shape=mesh_shape,
+        )
 
     from kafka_topic_analyzer_tpu.engine import run_scan
     from kafka_topic_analyzer_tpu.report import render_report

@@ -45,6 +45,10 @@ class AnalyzerConfig:
     hll_p: int = 14
     #: DDSketch message-size quantiles (new capability).
     enable_quantiles: bool = False
+    #: Track one sketch row per partition instead of a single global one
+    #: (BASELINE.json config 2: per-partition size histograms).  Global
+    #: quantiles remain exact — DDSketch rows merge by addition.
+    quantiles_per_partition: bool = False
     #: DDSketch relative accuracy alpha (gamma = (1+a)/(1-a)).
     quantile_alpha: float = 0.005
     #: Number of log-gamma buckets (covers sizes up to gamma^nbuckets).
@@ -70,6 +74,10 @@ class AnalyzerConfig:
     mesh_shape: Tuple[int, int] = (1, 1)
 
     def __post_init__(self) -> None:
+        if self.quantiles_per_partition and not self.enable_quantiles:
+            # Per-partition sketches imply the feature (frozen dataclass, so
+            # normalize via object.__setattr__).
+            object.__setattr__(self, "enable_quantiles", True)
         if self.num_partitions < 1:
             raise ValueError("num_partitions must be >= 1")
         if self.batch_size < 1:

@@ -14,12 +14,13 @@ from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_num_buckets
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class DDSketchState:
-    counts: jax.Array  # int64[nbuckets + 2]
+    counts: jax.Array  # int64[R, nbuckets + 2]; R = P or 1
 
     @classmethod
     def init(cls, config: AnalyzerConfig) -> "DDSketchState":
         n = ddsketch_num_buckets(config.quantile_buckets)
-        return cls(counts=jnp.zeros((n,), dtype=jnp.int64))
+        rows = config.num_partitions if config.quantiles_per_partition else 1
+        return cls(counts=jnp.zeros((rows, n), dtype=jnp.int64))
 
     def merge(self, other: "DDSketchState") -> "DDSketchState":
         return DDSketchState(counts=self.counts + other.counts)

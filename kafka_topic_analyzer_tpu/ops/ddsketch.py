@@ -29,17 +29,29 @@ def ddsketch_num_buckets(nbuckets: int) -> int:
     return nbuckets + 2  # zero bucket + log buckets + overflow
 
 
-def ddsketch_update(counts, sizes, active, gamma: float, nbuckets: int):
-    """Scatter-add one batch of sizes into ``int64[nbuckets+2]`` counts."""
+def ddsketch_update(
+    counts, sizes, active, gamma: float, nbuckets: int, partition=None
+):
+    """Scatter-add one batch of sizes into the bucket counts.
+
+    ``counts`` is ``int64[R, nbuckets+2]`` — one row per partition when
+    per-partition histograms are enabled (``partition`` given), else a
+    single row.  Rows merge by addition, so global quantiles over any row
+    subset are exact.
+    """
+    nb = nbuckets + 2
+    rows = counts.shape[0]
     x = sizes.astype(jnp.float32)
     log_gamma = np.float32(np.log(gamma))
     idx = jnp.ceil(jnp.log(jnp.maximum(x, 1.0)) / log_gamma).astype(jnp.int32) + 1
     idx = jnp.clip(idx, 1, nbuckets + 1)
     idx = jnp.where(sizes == 0, 0, idx)
-    idx = jnp.where(active, idx, nbuckets + 2)  # scratch bucket for masked
-    scratch = jnp.zeros((nbuckets + 3,), dtype=jnp.int64)
-    delta = scratch.at[idx].add(jnp.int64(1))[: nbuckets + 2]
-    return counts + delta
+    row = partition if partition is not None else jnp.int32(0)
+    flat = row * nb + idx
+    flat = jnp.where(active, flat, rows * nb)  # scratch slot for masked
+    scratch = jnp.zeros((rows * nb + 1,), dtype=jnp.int64)
+    delta = scratch.at[flat].add(jnp.int64(1))[: rows * nb]
+    return counts + delta.reshape(rows, nb)
 
 
 def ddsketch_merge(a, b):

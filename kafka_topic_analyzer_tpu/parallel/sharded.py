@@ -97,8 +97,11 @@ def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
         from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState
         from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_num_buckets
 
+        rows = config.num_partitions if config.quantiles_per_partition else 1
         quantiles = DDSketchState(
-            counts=np.zeros((d, ddsketch_num_buckets(config.quantile_buckets)), np.int64)
+            counts=np.zeros(
+                (d, rows, ddsketch_num_buckets(config.quantile_buckets)), np.int64
+            )
         )
     state = AnalyzerState(metrics=metrics, alive=alive, hll=hll, quantiles=quantiles)
     specs = _state_specs(config)

@@ -101,4 +101,17 @@ def _render_extensions(metrics: TopicMetrics) -> str:
             f"p{int(p * 100)}={v:.0f}B" for p, v in zip(metrics.quantiles.probs, metrics.quantiles.values)
         )
         lines.append(f"Message size quantiles: {qs}")
+    if metrics.quantiles_per_partition is not None:
+        import math
+
+        for p, summary in zip(metrics.partitions, metrics.quantiles_per_partition):
+            if any(math.isnan(v) for v in summary.values):
+                # No sized (non-tombstone) messages in this partition.
+                lines.append(f"  partition {p} size quantiles: n/a")
+                continue
+            qs = " ".join(
+                f"p{int(q * 100)}={v:.0f}B"
+                for q, v in zip(summary.probs, summary.values)
+            )
+            lines.append(f"  partition {p} size quantiles: {qs}")
     return ("\n".join(lines) + "\n") if lines else ""

@@ -66,6 +66,9 @@ class TopicMetrics:
     distinct_keys_exact: Optional[int] = None
     #: Message-size quantiles (new capability).
     quantiles: Optional[QuantileSummary] = None
+    #: Per-partition size quantiles, one entry per `partitions` row
+    #: (BASELINE.json config 2).
+    quantiles_per_partition: "Optional[list[QuantileSummary]]" = None
     #: Per-partition extremes (new capability; also enables exact row
     #: slicing for multi-topic fan-in): int64[P, 4] columns
     #: (earliest_ts, latest_ts, smallest, largest) with raw sentinels
@@ -182,6 +185,10 @@ def slice_rows(
         per[:, CH["key_size_sum"]].sum() + per[:, CH["value_size_sum"]].sum()
     )
     overall_count = int(per[:, CH["total"]].sum())
+    qpp = None
+    if metrics.quantiles_per_partition is not None:
+        # Per-partition sketches are per-row state — sliceable like extremes.
+        qpp = [metrics.quantiles_per_partition[r] for r in rows]
     return TopicMetrics(
         partitions=list(partition_ids),
         per_partition=per,
@@ -191,6 +198,7 @@ def slice_rows(
         largest_message=largest,
         overall_size=overall_size,
         overall_count=overall_count,
+        quantiles_per_partition=qpp,
         per_partition_extremes=ext,
         init_now_s=metrics.init_now_s,
     )

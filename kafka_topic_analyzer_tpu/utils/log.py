@@ -1,0 +1,36 @@
+"""Logging setup — the reference's env_logger convention.
+
+The reference initializes env_logger at startup (src/main.rs:30) and is
+driven by ``RUST_LOG``.  We honor the same variable (plus ``KTA_LOG``) so a
+user switching tools keeps their habits: ``RUST_LOG=warn kta ...``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "off": logging.CRITICAL,
+}
+
+
+def init_logging() -> None:
+    spec = os.environ.get("KTA_LOG") or os.environ.get("RUST_LOG") or "error"
+    # env_logger accepts "level" or "target=level,..." — take the bare level
+    # or the first bare segment.
+    level = logging.ERROR
+    for seg in spec.split(","):
+        if "=" not in seg and seg.strip().lower() in _LEVELS:
+            level = _LEVELS[seg.strip().lower()]
+            break
+    logging.basicConfig(
+        level=level,
+        format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
+    )

@@ -88,6 +88,22 @@ def test_ddsketch_within_alpha():
         assert q_sketch == pytest.approx(q_exact, rel=0.011)  # 2*alpha + rank slack
 
 
+def test_per_partition_quantiles_within_alpha():
+    cfg = AnalyzerConfig(
+        num_partitions=3, batch_size=2048, enable_quantiles=True,
+        quantiles_per_partition=True, quantile_alpha=0.005,
+    )
+    m_cpu, m_tpu = run_both(cfg)
+    assert len(m_cpu.quantiles_per_partition) == 3
+    assert len(m_tpu.quantiles_per_partition) == 3
+    for exact, sketch in zip(m_cpu.quantiles_per_partition, m_tpu.quantiles_per_partition):
+        for q_exact, q_sketch in zip(exact.values, sketch.values):
+            assert q_sketch == pytest.approx(q_exact, rel=0.011)
+    # Global line still matches the single-sketch path.
+    for q_exact, q_sketch in zip(m_cpu.quantiles.values, m_tpu.quantiles.values):
+        assert q_sketch == pytest.approx(q_exact, rel=0.011)
+
+
 def test_batch_padding_is_inert():
     cfg = AnalyzerConfig(num_partitions=3, batch_size=4096)
     # 15000 records into 4096-sized padded steps exercises padding heavily.
