@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
         "Apache Kafka topic (TPU-native rebuild)",
     )
     # --- reference-compatible surface (src/main.rs:32-67) -------------------
+    from kafka_topic_analyzer_tpu import __version__
+
+    # (The reference's -V banner self-reports a stale 0.4.1 — a quirk
+    # SURVEY.md §0 says not to replicate.)
+    p.add_argument("-V", "--version", action="version",
+                   version=f"kafka-topic-analyzer-tpu {__version__}")
     p.add_argument("-t", "--topic", required=True, metavar="TOPIC",
                    help="The topic to analyze")
     p.add_argument("-b", "--bootstrap-server", metavar="BOOTSTRAP_SERVER",
@@ -91,6 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(implies --quantiles)")
     p.add_argument("--mesh", metavar="DATA[,SPACE]", default="1",
                    help="Device mesh shape: data shards[, space shards]")
+    p.add_argument("--distributed", metavar="COORD:PORT,PID,NPROCS",
+                   help="Multi-host mode: initialize jax.distributed with the "
+                        "given coordinator address, process id and process "
+                        "count before building the mesh (collectives then "
+                        "span hosts over DCN)")
     p.add_argument("--native", choices=["auto", "on", "off"], default="auto",
                    help="Use the native C++ ingest shim when available")
     p.add_argument("--profile-dir", metavar="DIR",
@@ -291,6 +302,11 @@ def main(argv: "list[str] | None" = None) -> int:
 
 
 def _run(args) -> int:
+    if args.distributed:
+        from kafka_topic_analyzer_tpu.parallel.mesh import initialize_distributed
+
+        with user_input_phase():
+            initialize_distributed(args.distributed)
     # Kafka topic names cannot contain commas, so "-t a,b,c" unambiguously
     # selects multi-topic fan-in (new capability; BASELINE.json config 5).
     if "," in args.topic:

@@ -11,8 +11,9 @@ backend-contract strategy.
 Implemented versions (classic encoding, no flexible/tagged fields):
 - Metadata v1, ListOffsets v1, Fetch v4, ApiVersions v0
 - RecordBatch v2 ("magic 2", Kafka >= 0.11) with zigzag-varint records;
-  compression: none and gzip (zlib).  v0/v1 MessageSets are rejected with a
-  clear error.
+  compression: none, gzip (zlib), snappy (xerial framing) and LZ4 frames
+  via io/compression.py; zstd is rejected with a clear error.  v0/v1
+  MessageSets are rejected with a clear error.
 """
 
 from __future__ import annotations
@@ -434,6 +435,9 @@ def decode_api_versions_response(r: ByteReader) -> "dict[int, tuple[int, int]]":
 
 COMPRESSION_NONE = 0
 COMPRESSION_GZIP = 1
+COMPRESSION_SNAPPY = 2
+COMPRESSION_LZ4 = 3
+COMPRESSION_ZSTD = 4
 
 #: (timestamp_ms, key bytes|None, value bytes|None)
 RecordTuple = Tuple[int, Optional[bytes], Optional[bytes]]
@@ -469,6 +473,14 @@ def encode_record_batch(
         # not a bare zlib stream.
         co = zlib.compressobj(wbits=31)
         payload = co.compress(payload) + co.flush()
+    elif compression == COMPRESSION_SNAPPY:
+        from kafka_topic_analyzer_tpu.io.compression import snappy_compress_xerial
+
+        payload = snappy_compress_xerial(payload)
+    elif compression == COMPRESSION_LZ4:
+        from kafka_topic_analyzer_tpu.io.compression import lz4_compress_frame
+
+        payload = lz4_compress_frame(payload)
 
     # Fields covered by the CRC (everything from attributes onward).
     crcw = ByteWriter()
@@ -547,13 +559,17 @@ def decode_record_batches(
         if verify_crc and _crc32c(buf[crc_start:end]) != crc:
             raise KafkaProtocolError(f"record batch CRC mismatch at offset {base_offset}")
         codec = attributes & 0x07
-        if codec == COMPRESSION_GZIP:
-            # wbits=47: auto-detect gzip (RFC 1952) or zlib (RFC 1950) framing.
-            payload = zlib.decompress(payload, wbits=47)
-        elif codec != COMPRESSION_NONE:
-            raise KafkaProtocolError(
-                f"unsupported compression codec {codec} (supported: none, gzip)"
-            )
+        if codec != COMPRESSION_NONE:
+            from kafka_topic_analyzer_tpu.io.compression import decompress
+
+            try:
+                payload = decompress(codec, payload)
+            except Exception as e:
+                # Unsupported codec or corrupt payload: surface as a protocol
+                # error so callers (and the CLI) report one clean line.
+                raise KafkaProtocolError(
+                    f"record batch at offset {base_offset}: {e}"
+                ) from e
         rr = ByteReader(payload)
         for _ in range(num_records):
             length = rr.varint()

@@ -24,7 +24,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 2
+_ABI = 3
 _SO_PATH = os.path.join(_NATIVE_DIR, "build", f"libkta_ingest.v{_ABI}.so")
 
 _lock = threading.Lock()

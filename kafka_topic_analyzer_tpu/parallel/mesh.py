@@ -11,6 +11,28 @@ DATA_AXIS = "data"
 SPACE_AXIS = "space"
 
 
+def initialize_distributed(spec: str) -> None:
+    """Multi-host bring-up: ``"coordinator:port,process_id,num_processes"``.
+
+    After this, `jax.devices()` spans every host's chips and the same
+    (data, space) mesh extends over DCN — the collective merges in
+    sharded.py are unchanged, XLA routes them across hosts
+    (SURVEY.md §5.8).  Each host's engine should feed only its own data
+    shards' partitions (`assign_partitions` over the global shard count).
+    """
+    parts = spec.split(",")
+    if len(parts) != 3:
+        raise ValueError(
+            f"bad --distributed {spec!r}: expected coordinator:port,pid,nprocs"
+        )
+    coordinator, pid, nprocs = parts[0], int(parts[1]), int(parts[2])
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=nprocs,
+        process_id=pid,
+    )
+
+
 def make_mesh(
     data: int, space: int = 1, devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:

@@ -86,7 +86,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 2; }
+int32_t kta_version() { return 3; }
 
 // Last-writer-wins dedupe of alive-bitmap updates for one batch
 // (the host half of the packed transfer's pre-reduction; see
@@ -225,6 +225,197 @@ int32_t kta_hash_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
     }
   });
   return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Decompressors for Kafka record batches (kafka_codec.py): snappy raw blocks
+// (plus the xerial chunked framing Kafka's Java client emits) and LZ4 frames.
+// Python has neither in its stdlib; the shim supplies them so the wire client
+// covers the common broker compression codecs without extra dependencies.
+
+namespace {
+
+// Raw snappy block decode (format: preamble varint = uncompressed length,
+// then literal/copy tagged elements).  Returns bytes written or -1.
+int64_t snappy_raw(const uint8_t* in, int64_t in_len, uint8_t* out,
+                   int64_t out_cap) {
+  int64_t ip = 0;
+  // uncompressed length: LITTLE-endian base-128 varint (not zigzag)
+  uint64_t ulen = 0;
+  int shift = 0;
+  while (ip < in_len) {
+    uint8_t b = in[ip++];
+    ulen |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+    if (shift > 35) return -1;
+  }
+  if (static_cast<int64_t>(ulen) > out_cap) return -1;
+  int64_t op = 0;
+  while (ip < in_len) {
+    const uint8_t tag = in[ip++];
+    const int type = tag & 3;
+    if (type == 0) {  // literal
+      int64_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        const int extra = static_cast<int>(len) - 60;
+        if (ip + extra > in_len) return -1;
+        len = 0;
+        for (int i = 0; i < extra; ++i)
+          len |= static_cast<int64_t>(in[ip + i]) << (8 * i);
+        len += 1;
+        ip += extra;
+      }
+      if (ip + len > in_len || op + len > out_cap) return -1;
+      std::memcpy(out + op, in + ip, len);
+      ip += len;
+      op += len;
+    } else {  // copy
+      int64_t len = 0, offset = 0;
+      if (type == 1) {
+        if (ip >= in_len) return -1;
+        len = ((tag >> 2) & 7) + 4;
+        offset = (static_cast<int64_t>(tag >> 5) << 8) | in[ip++];
+      } else if (type == 2) {
+        if (ip + 2 > in_len) return -1;
+        len = (tag >> 2) + 1;
+        offset = in[ip] | (static_cast<int64_t>(in[ip + 1]) << 8);
+        ip += 2;
+      } else {
+        if (ip + 4 > in_len) return -1;
+        len = (tag >> 2) + 1;
+        offset = 0;
+        for (int i = 0; i < 4; ++i)
+          offset |= static_cast<int64_t>(in[ip + i]) << (8 * i);
+        ip += 4;
+      }
+      if (offset <= 0 || offset > op || op + len > out_cap) return -1;
+      // byte-by-byte: copies may overlap their own output (RLE)
+      for (int64_t i = 0; i < len; ++i, ++op) out[op] = out[op - offset];
+    }
+  }
+  return op == static_cast<int64_t>(ulen) ? op : -1;
+}
+
+inline uint32_t read_be32(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) | (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | p[3];
+}
+
+inline uint32_t read_le32(const uint8_t* p) {
+  return p[0] | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) | (static_cast<uint32_t>(p[3]) << 24);
+}
+
+// LZ4 block decode (literals + matches); returns bytes written or -1.
+int64_t lz4_block(const uint8_t* in, int64_t in_len, uint8_t* out,
+                  int64_t out_cap) {
+  int64_t ip = 0, op = 0;
+  while (ip < in_len) {
+    const uint8_t token = in[ip++];
+    int64_t lit = token >> 4;
+    if (lit == 15) {
+      while (ip < in_len) {
+        const uint8_t b = in[ip++];
+        lit += b;
+        if (b != 255) break;
+      }
+    }
+    if (ip + lit > in_len || op + lit > out_cap) return -1;
+    std::memcpy(out + op, in + ip, lit);
+    ip += lit;
+    op += lit;
+    if (ip >= in_len) break;  // last sequence has no match
+    if (ip + 2 > in_len) return -1;
+    const int64_t offset = in[ip] | (static_cast<int64_t>(in[ip + 1]) << 8);
+    ip += 2;
+    if (offset == 0 || offset > op) return -1;
+    int64_t mlen = (token & 0x0f);
+    if (mlen == 15) {
+      while (ip < in_len) {
+        const uint8_t b = in[ip++];
+        mlen += b;
+        if (b != 255) break;
+      }
+    }
+    mlen += 4;
+    if (op + mlen > out_cap) return -1;
+    for (int64_t i = 0; i < mlen; ++i, ++op) out[op] = out[op - offset];
+  }
+  return op;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Snappy: accepts Kafka's xerial framing (magic \x82SNAPPY\x00, then
+// [be32 block length][raw snappy block]...) or a bare raw block.
+// Returns bytes written to out, or -1 on malformed input / short out_cap.
+int64_t kta_snappy_decompress(const uint8_t* in, int64_t in_len, uint8_t* out,
+                              int64_t out_cap) {
+  if (!in || !out || in_len < 0) return -1;
+  static const uint8_t kXerial[8] = {0x82, 'S', 'N', 'A', 'P', 'P', 'Y', 0};
+  if (in_len >= 16 && std::memcmp(in, kXerial, 8) == 0) {
+    int64_t ip = 16;  // magic + version + compat (be32 each)
+    int64_t op = 0;
+    while (ip + 4 <= in_len) {
+      const int64_t blen = read_be32(in + ip);
+      ip += 4;
+      if (blen < 0 || ip + blen > in_len) return -1;
+      const int64_t n = snappy_raw(in + ip, blen, out + op, out_cap - op);
+      if (n < 0) return -1;
+      ip += blen;
+      op += n;
+    }
+    return ip == in_len ? op : -1;
+  }
+  return snappy_raw(in, in_len, out, out_cap);
+}
+
+// LZ4: accepts an LZ4 frame (magic 0x184D2204; content checksum and block
+// checksums tolerated/skipped, dictionaries unsupported) or a bare block.
+int64_t kta_lz4_decompress(const uint8_t* in, int64_t in_len, uint8_t* out,
+                           int64_t out_cap) {
+  if (!in || !out || in_len < 0) return -1;
+  if (in_len >= 7 && read_le32(in) == 0x184D2204u) {
+    int64_t ip = 4;
+    const uint8_t flg = in[ip];
+    ip += 2;  // FLG + BD
+    const bool content_size = flg & 0x08;
+    const bool block_checksum = flg & 0x10;
+    const bool content_checksum = flg & 0x04;
+    if (flg & 0x01) return -1;  // dictionaries unsupported
+    if (content_size) ip += 8;
+    ip += 1;  // header checksum
+    int64_t op = 0;
+    while (ip + 4 <= in_len) {
+      const uint32_t bsize = read_le32(in + ip);
+      ip += 4;
+      if (bsize == 0) {  // EndMark
+        if (content_checksum) ip += 4;
+        return op;
+      }
+      const bool uncompressed = bsize & 0x80000000u;
+      const int64_t blen = bsize & 0x7fffffffu;
+      if (ip + blen > in_len) return -1;
+      if (uncompressed) {
+        if (op + blen > out_cap) return -1;
+        std::memcpy(out + op, in + ip, blen);
+        op += blen;
+      } else {
+        const int64_t n = lz4_block(in + ip, blen, out + op, out_cap - op);
+        if (n < 0) return -1;
+        op += n;
+      }
+      ip += blen;
+      if (block_checksum) ip += 4;
+    }
+    return -1;  // missing EndMark
+  }
+  return lz4_block(in, in_len, out, out_cap);
 }
 
 }  // extern "C"

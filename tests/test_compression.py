@@ -1,0 +1,151 @@
+"""snappy/LZ4 decompression: native vs pure-Python vs handcrafted streams,
+and end-to-end through record batches + the fake broker."""
+
+import struct
+
+import pytest
+
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.compression import (
+    UnsupportedCodecError,
+    decompress,
+    lz4_compress_frame,
+    lz4_decompress,
+    lz4_decompress_py,
+    snappy_compress_xerial,
+    snappy_decompress,
+    snappy_decompress_py,
+)
+
+PAYLOADS = [
+    b"",
+    b"x",
+    b"hello snappy world " * 40,
+    bytes(range(256)) * 17,
+]
+
+
+@pytest.mark.parametrize("data", PAYLOADS)
+def test_snappy_literal_roundtrip_python(data):
+    assert snappy_decompress_py(snappy_compress_xerial(data)) == data
+
+
+@pytest.mark.parametrize("data", PAYLOADS)
+def test_snappy_literal_roundtrip_native_dispatch(data):
+    assert snappy_decompress(snappy_compress_xerial(data)) == data
+
+
+def _snappy_with_copy() -> "tuple[bytes, bytes]":
+    """Handcrafted raw snappy stream using a copy op (incl. RLE overlap)."""
+    # "abcd" literal, then copy len=8 offset=4 -> "abcdabcd" appended,
+    # then copy len=4 offset=1 (RLE of last byte 'd').
+    expected = b"abcd" + b"abcdabcd" + b"dddd"
+    out = bytearray()
+    out.append(len(expected))  # uncompressed length varint (<128)
+    out.append((4 - 1) << 2)  # literal, 4 bytes
+    out += b"abcd"
+    # copy kind 1: len 4..11, offset 11-bit: tag = ((len-4)<<2)|1 | (off>>8)<<5
+    out.append(((8 - 4) << 2) | 1)
+    out.append(4)  # offset low byte
+    out.append(((4 - 4) << 2) | 1)
+    out.append(1)
+    return bytes(out), expected
+
+
+def test_snappy_copy_ops_python_and_native():
+    raw, expected = _snappy_with_copy()
+    assert snappy_decompress_py(raw) == expected
+    assert snappy_decompress(raw) == expected
+
+
+@pytest.mark.parametrize("data", PAYLOADS)
+def test_lz4_frame_roundtrip(data):
+    assert lz4_decompress_py(lz4_compress_frame(data)) == data
+    assert lz4_decompress(lz4_compress_frame(data)) == data
+
+
+def test_lz4_block_with_matches():
+    # literals "abcd", match offset 4 len 8 (overlapping copy), then final
+    # literals "XY".  Token: lit=4, mlen=8-4=4 -> token 0x44.
+    block = bytes([0x44]) + b"abcd" + struct.pack("<H", 4) + bytes([0x20]) + b"XY"
+    expected = b"abcd" + b"abcdabcd" + b"XY"
+    assert lz4_decompress_py(block) == expected
+    assert lz4_decompress(block) == expected
+
+
+def test_corrupt_snappy_raises_without_buffer_churn():
+    # A tiny payload declaring a huge uncompressed length must fail fast
+    # (no 1 GiB allocation loop) with a clear error.
+    bogus = b"\xff\xff\xff\xff\x0f" + b"x"  # ulen varint ~2^34
+    with pytest.raises(ValueError, match="> 1 GiB cap"):
+        snappy_decompress(bogus)
+
+
+def test_truncated_lz4_literal_raises():
+    # Token promises 10 literal bytes but only 2 are present: must raise,
+    # not silently return truncated data.
+    with pytest.raises(ValueError, match="truncated lz4 literal"):
+        lz4_decompress_py(bytes([0xA0]) + b"ab")
+    with pytest.raises(ValueError, match="truncated lz4 literal"):
+        lz4_decompress(bytes([0xA0]) + b"ab")
+
+
+def test_truncated_snappy_literal_raises():
+    bogus = bytes([4]) + bytes([(4 - 1) << 2]) + b"ab"  # promises 4, has 2
+    with pytest.raises(ValueError, match="truncated snappy literal"):
+        snappy_decompress_py(bogus)
+
+
+def test_corrupt_compressed_batch_is_protocol_error():
+    buf = bytearray(kc.encode_record_batch(
+        [(0, 0, b"k", b"v" * 50)], kc.COMPRESSION_SNAPPY
+    ))
+    # Replace the whole compressed payload (past the 61-byte batch header)
+    # with garbage that parses as a huge snappy length declaration.
+    buf[61:] = b"\xff" * (len(buf) - 61)
+    with pytest.raises(kc.KafkaProtocolError, match="record batch at offset"):
+        list(kc.decode_record_batches(bytes(buf)))
+
+
+def test_zstd_rejected():
+    with pytest.raises(UnsupportedCodecError, match="zstd"):
+        decompress(4, b"\x28\xb5\x2f\xfd")
+
+
+@pytest.mark.parametrize(
+    "codec", [kc.COMPRESSION_SNAPPY, kc.COMPRESSION_LZ4]
+)
+def test_record_batch_roundtrip_compressed(codec):
+    records = [
+        (10, 1_600_000_000_000, b"key-a", b"value-a" * 10),
+        (11, 1_600_000_000_001, None, b"v"),
+        (12, 1_600_000_000_002, b"key-b", None),
+    ]
+    buf = kc.encode_record_batch(records, codec)
+    got = [(off, ts, k, v) for off, (ts, k, v) in kc.decode_record_batches(buf, verify_crc=True)]
+    assert got == records
+
+
+@pytest.mark.parametrize(
+    "codec", [kc.COMPRESSION_SNAPPY, kc.COMPRESSION_LZ4]
+)
+def test_wire_scan_with_compressed_broker(codec):
+    import sys
+
+    sys.path.insert(0, "tests")
+    from fake_broker import FakeBroker
+
+    from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+    rows = [(i, 1_600_000_000_000 + i, f"k{i % 9}".encode(), bytes(20 + i % 50))
+            for i in range(300)]
+    with FakeBroker("z.topic", {0: rows}, compression=codec) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "z.topic")
+        cfg = AnalyzerConfig(num_partitions=1, batch_size=128)
+        m = run_scan("z.topic", src, CpuExactBackend(cfg, init_now_s=0), 128).metrics
+        src.close()
+    assert m.overall_count == 300
+    assert m.overall_size == sum(len(k) + len(v) for _, _, k, v in rows)
