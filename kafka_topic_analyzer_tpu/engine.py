@@ -219,15 +219,21 @@ def run_scan(
                 nvalid = batch.num_valid
                 last = len(batch) - 1
                 last_partition = int(batch.partition[last])  # true id, pre-remap
+                last_offset = (
+                    str(int(batch.offsets[last]))
+                    if batch.offsets is not None
+                    else "~"  # gapless sources don't carry offsets
+                )
                 tracker.observe(batch, batch.partition)
                 batch = pindex.remap_batch(batch)
                 with profile.stage("dispatch", items=nvalid, nbytes=batch.nbytes):
                     backend.update(batch)
                 seq += nvalid
                 maybe_snapshot()
+                # indicatif-template message like src/kafka.rs:111-113.
                 spinner.set_message(
                     f"[Sq: {seq} | T: {topic} | P: {last_partition} | "
-                    f"O: ~ | Ts: {format_utc_seconds(int(batch.ts_s[last]))}]"
+                    f"O: {last_offset} | Ts: {format_utc_seconds(int(batch.ts_s[last]))}]"
                 )
     finally:
         for it in open_iters:

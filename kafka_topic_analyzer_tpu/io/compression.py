@@ -103,6 +103,8 @@ def _lz4_block_py(data: bytes, out: bytearray) -> None:
         lit = token >> 4
         if lit == 15:
             while True:
+                if ip >= n:
+                    raise ValueError("truncated lz4 length extension")
                 b = data[ip]
                 ip += 1
                 lit += b
@@ -121,14 +123,20 @@ def _lz4_block_py(data: bytes, out: bytearray) -> None:
         mlen = token & 0x0F
         if mlen == 15:
             while True:
+                if ip >= n:
+                    raise ValueError("truncated lz4 length extension")
                 b = data[ip]
                 ip += 1
                 mlen += b
                 if b != 255:
                     break
         mlen += 4
+        if len(out) + mlen > MAX_DECOMPRESSED:
+            raise ValueError("lz4 output exceeds 1 GiB cap")
         for _ in range(mlen):
             out.append(out[-offset])
+        if len(out) > MAX_DECOMPRESSED:
+            raise ValueError("lz4 output exceeds 1 GiB cap")
 
 
 def lz4_decompress_py(data: bytes) -> bytes:
@@ -154,6 +162,8 @@ def lz4_decompress_py(data: bytes) -> bytes:
                 out += block
             else:
                 _lz4_block_py(block, out)
+            if len(out) > MAX_DECOMPRESSED:
+                raise ValueError("lz4 output exceeds 1 GiB cap")
             if flg & 0x10:  # block checksum
                 ip += 4
         raise ValueError("lz4 frame missing EndMark")
@@ -248,9 +258,20 @@ def snappy_decompress(data: bytes) -> bytes:
 
 
 def lz4_decompress(data: bytes) -> bytes:
+    # Kafka's Java client omits the frame content size, so the only a-priori
+    # bound is the 255x worst case — far too big to allocate per batch.
+    # Grow on demand instead: -1 from the native decoder means either a
+    # short buffer or malformed input, so after reaching the bound the
+    # strict Python decoder delivers the verdict (raises on malformed).
     bound = min(_lz4_output_bound(data), MAX_DECOMPRESSED)
-    out = _native_decompress("kta_lz4_decompress", data, bound)
-    return out if out is not None else lz4_decompress_py(data)
+    cap = min(max(len(data) * 8, 1 << 20), bound)
+    while True:
+        out = _native_decompress("kta_lz4_decompress", data, cap)
+        if out is not None:
+            return out
+        if cap >= bound:
+            return lz4_decompress_py(data)
+        cap = min(cap * 16, bound)
 
 
 def decompress(codec: int, payload: bytes) -> bytes:

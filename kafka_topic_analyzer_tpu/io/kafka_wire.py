@@ -71,18 +71,23 @@ def _hash_keys(
 
 
 class BrokerConnection:
-    """One blocking TCP connection to a broker.
+    """One blocking TCP (optionally TLS) connection to a broker.
 
     `request` is serialized by a lock: sharded scans prefetch per-shard
     batch streams from worker threads (utils/prefetch.py) that share the
     per-broker connections.
     """
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+    def __init__(
+        self, host: str, port: int, timeout_s: float = 10.0, ssl_context=None
+    ):
         self.host = host
         self.port = port
-        self.sock = socket.create_connection((host, port), timeout=timeout_s)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock = socket.create_connection((host, port), timeout=timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if ssl_context is not None:
+            sock = ssl_context.wrap_socket(sock, server_hostname=host)
+        self.sock = sock
         self._corr = 0
         self._lock = threading.Lock()
 
@@ -156,6 +161,30 @@ class KafkaWireSource(RecordSource):
             overrides.pop("max.partition.fetch.bytes", 8 << 20)
         )
         self.verify_crc = overrides.pop("check.crcs", "false").lower() == "true"
+        # TLS, via the same librdkafka property names the reference's --ssl
+        # feature would use (Cargo.toml:19 features=["ssl"]).
+        self._ssl_context = None
+        protocol = overrides.pop("security.protocol", "plaintext").lower()
+        ca_location = overrides.pop("ssl.ca.location", None)
+        verify_certs = (
+            overrides.pop("enable.ssl.certificate.verification", "true").lower()
+            == "true"
+        )
+        if protocol in ("ssl", "tls"):
+            import ssl as _ssl
+
+            ctx = _ssl.create_default_context()
+            if ca_location:
+                ctx.load_verify_locations(ca_location)
+            if not verify_certs:
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            self._ssl_context = ctx
+        elif protocol not in ("plaintext",):
+            raise ValueError(
+                f"security.protocol {protocol!r} unsupported "
+                "(plaintext, ssl; SASL is not implemented)"
+            )
         for k in overrides:
             log.warning("ignoring unsupported consumer property %r", k)
 
@@ -174,7 +203,9 @@ class KafkaWireSource(RecordSource):
         with self._conn_lock:
             conn = self._conns.get(key)
             if conn is None:
-                conn = BrokerConnection(host, port, self.timeout_s)
+                conn = BrokerConnection(
+                    host, port, self.timeout_s, ssl_context=self._ssl_context
+                )
                 self._conns[key] = conn
             return conn
 

@@ -1,0 +1,61 @@
+"""Run every BASELINE benchmark config and emit one JSON report.
+
+Usage:
+    python -m kafka_topic_analyzer_tpu.tools.bench_all [--batch-size N]
+        [--steps N] [--out report.json]
+
+Each config runs through bench.py in a subprocess (fresh jit caches, honest
+per-config timing); the report maps config id → bench JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--batches", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=32)
+    ap.add_argument("--out", default="-")
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    args = ap.parse_args(argv)
+
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    report = {}
+    for cfg in [int(c) for c in args.configs.split(",") if c]:
+        cmd = [
+            sys.executable, os.path.join(repo, "bench.py"),
+            "--config", str(cfg),
+            "--batch-size", str(args.batch_size),
+            "--batches", str(args.batches),
+            "--steps", str(args.steps),
+        ]
+        print(f"bench_all: running config {cfg}...", file=sys.stderr)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            report[str(cfg)] = {"error": proc.stderr.strip()[-500:]}
+            continue
+        last = proc.stdout.strip().splitlines()[-1]
+        report[str(cfg)] = json.loads(last)
+        print(f"bench_all: config {cfg}: {last}", file=sys.stderr)
+
+    out = json.dumps(report, indent=2)
+    if args.out == "-":
+        print(out)
+    else:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+        print(f"bench_all: wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

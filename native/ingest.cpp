@@ -317,7 +317,8 @@ int64_t lz4_block(const uint8_t* in, int64_t in_len, uint8_t* out,
     const uint8_t token = in[ip++];
     int64_t lit = token >> 4;
     if (lit == 15) {
-      while (ip < in_len) {
+      for (;;) {
+        if (ip >= in_len) return -1;  // truncated length extension
         const uint8_t b = in[ip++];
         lit += b;
         if (b != 255) break;
@@ -334,7 +335,8 @@ int64_t lz4_block(const uint8_t* in, int64_t in_len, uint8_t* out,
     if (offset == 0 || offset > op) return -1;
     int64_t mlen = (token & 0x0f);
     if (mlen == 15) {
-      while (ip < in_len) {
+      for (;;) {
+        if (ip >= in_len) return -1;  // truncated length extension
         const uint8_t b = in[ip++];
         mlen += b;
         if (b != 255) break;

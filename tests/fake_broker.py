@@ -25,7 +25,9 @@ class FakeBroker:
         max_records_per_fetch: int = 500,
         start_offsets: Optional[Dict[int, int]] = None,
         end_offsets: Optional[Dict[int, int]] = None,
+        tls_context=None,
     ):
+        self.tls_context = tls_context
         self.topic = topic
         self.records = {
             p: sorted(rs, key=lambda r: r[0]) for p, rs in partition_records.items()
@@ -78,10 +80,24 @@ class FakeBroker:
             try:
                 conn, _ = self._server.accept()
             except OSError:
-                return
-            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+                return  # listener closed
+            # TLS handshake happens in the per-connection thread: one
+            # client's failed handshake (SSLError is an OSError) must not
+            # kill the accept loop.
+            t = threading.Thread(
+                target=self._handshake_and_serve, args=(conn,), daemon=True
+            )
             t.start()
             self._threads.append(t)
+
+    def _handshake_and_serve(self, conn: socket.socket) -> None:
+        if self.tls_context is not None:
+            try:
+                conn = self.tls_context.wrap_socket(conn, server_side=True)
+            except OSError:
+                conn.close()
+                return
+        self._serve(conn)
 
     def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytes]:
         chunks = []

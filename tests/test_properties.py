@@ -1,0 +1,125 @@
+"""Property-based tests (hypothesis): backend parity and codec roundtrips
+must hold for *arbitrary* record streams, not just the synthetic generator's
+distribution (SURVEY.md §4 backend-contract strategy, adversarial edition)."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+P = 3
+
+record = st.tuples(
+    st.integers(0, P - 1),                       # partition
+    st.one_of(st.none(), st.integers(0, 300)),   # key_len (None = null key)
+    st.one_of(st.none(), st.integers(0, 5000)),  # value_len (None = tombstone)
+    st.integers(-1, 2**33),                      # ts seconds (incl. epoch edge)
+    st.integers(0, 2**32 - 1),                   # key hash32
+)
+
+
+def _batch_from(rows):
+    n = len(rows)
+    b = RecordBatch.empty(n)
+    for i, (p, kl, vl, ts, h32) in enumerate(rows):
+        b.partition[i] = p
+        b.key_null[i] = kl is None
+        b.key_len[i] = 0 if kl is None else kl
+        b.value_null[i] = vl is None
+        b.value_len[i] = 0 if vl is None else vl
+        b.ts_s[i] = ts
+        b.key_hash32[i] = h32
+        b.key_hash64[i] = h32 * 2654435761 % 2**64
+        b.valid[i] = True
+    return b
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.lists(record, min_size=1, max_size=200), st.integers(1, 4))
+def test_cpu_tpu_parity_arbitrary_streams(rows, nbatches):
+    cfg = AnalyzerConfig(
+        num_partitions=P, batch_size=64, count_alive_keys=True,
+        alive_bitmap_bits=16,
+    )
+    cpu = CpuExactBackend(cfg, init_now_s=10**10)
+    tpu = TpuBackend(cfg, init_now_s=10**10)
+    chunks = np.array_split(np.arange(len(rows)), nbatches)
+    for chunk in chunks:
+        if len(chunk) == 0:
+            continue
+        batch = _batch_from([rows[i] for i in chunk])
+        for lo in range(0, len(batch), 64):
+            sub = batch.take(np.arange(lo, min(lo + 64, len(batch))))
+            cpu.update(sub)
+            tpu.update(sub)
+    a, b = cpu.finalize(), tpu.finalize()
+    assert np.array_equal(a.per_partition, b.per_partition)
+    assert a.earliest_ts_s == b.earliest_ts_s
+    assert a.latest_ts_s == b.latest_ts_s
+    assert a.smallest_message == b.smallest_message
+    assert a.largest_message == b.largest_message
+    assert a.alive_keys == b.alive_keys
+    assert np.array_equal(a.per_partition_extremes, b.per_partition_extremes)
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.lists(st.integers(-(2**62), 2**62), max_size=30))
+def test_varint_roundtrip_property(values):
+    w = kc.ByteWriter()
+    for v in values:
+        w.varint(v)
+    r = kc.ByteReader(w.done())
+    assert [r.varint() for _ in values] == values
+
+
+kafka_record = st.tuples(
+    st.integers(0, 2**40),                      # ts_ms
+    st.one_of(st.none(), st.binary(max_size=40)),
+    st.one_of(st.none(), st.binary(max_size=200)),
+)
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    st.integers(0, 2**40),                      # base offset
+    st.lists(kafka_record, min_size=1, max_size=30),
+    st.sampled_from([
+        kc.COMPRESSION_NONE, kc.COMPRESSION_GZIP,
+        kc.COMPRESSION_SNAPPY, kc.COMPRESSION_LZ4,
+    ]),
+)
+def test_record_batch_roundtrip_property(base, recs, codec):
+    rows = [(base + 2 * i, ts, k, v) for i, (ts, k, v) in enumerate(recs)]
+    buf = kc.encode_record_batch(rows, codec)
+    got = [
+        (off, ts, k, v)
+        for off, (ts, k, v) in kc.decode_record_batches(buf, verify_crc=True)
+    ]
+    assert got == rows
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.lists(
+    st.tuples(st.integers(0, 255), st.booleans(), st.booleans()),
+    min_size=1, max_size=300,
+))
+def test_dedupe_matches_sequential_replay(updates):
+    """Host dedupe (numpy + native) vs a literal insert/remove replay of
+    src/metric.rs:273-280."""
+    from kafka_topic_analyzer_tpu.packing import dedupe_slots_numpy
+
+    h32 = np.array([u[0] for u in updates], dtype=np.uint32)
+    active = np.array([u[1] for u in updates], dtype=bool)
+    alive = np.array([u[2] for u in updates], dtype=bool)
+    replay = {}
+    for h, act, al in updates:
+        if act:
+            replay[h & 0xFF] = al
+    slots, flags = dedupe_slots_numpy(h32, active, alive, bits=8)
+    assert dict(zip(slots.tolist(), flags.tolist())) == {
+        k: int(v) for k, v in replay.items()
+    }
