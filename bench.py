@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -65,6 +66,13 @@ def main() -> int:
         args.vmin = preset.get("vmin", args.vmin)
         args.vmax = preset.get("vmax", args.vmax)
         print(f"bench: config {args.config} — {preset['help']}", file=sys.stderr)
+
+    # Accelerator watchdog: a wedged TPU tunnel blocks the first device op
+    # forever (even backend init); fall back to host CPU (clearly flagged)
+    # instead of hanging the driver.
+    from kafka_topic_analyzer_tpu.jax_support import ensure_responsive_accelerator
+
+    degraded = not ensure_responsive_accelerator()
 
     import jax
 
@@ -133,12 +141,15 @@ def main() -> int:
         f"bench: {n} records in {dt:.3f}s on {jax.devices()[0].platform}",
         file=sys.stderr,
     )
-    print(json.dumps({
+    result = {
         "metric": "msgs_per_sec",
         "value": round(msgs_per_sec, 1),
         "unit": "msgs/s",
         "vs_baseline": round(msgs_per_sec / BASELINE_MSGS_PER_SEC, 2),
-    }))
+    }
+    if degraded:
+        result["degraded_cpu_fallback"] = True
+    print(json.dumps(result))
     return 0
 
 

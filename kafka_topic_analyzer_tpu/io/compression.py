@@ -135,8 +135,6 @@ def _lz4_block_py(data: bytes, out: bytearray) -> None:
             raise ValueError("lz4 output exceeds 1 GiB cap")
         for _ in range(mlen):
             out.append(out[-offset])
-        if len(out) > MAX_DECOMPRESSED:
-            raise ValueError("lz4 output exceeds 1 GiB cap")
 
 
 def lz4_decompress_py(data: bytes) -> bytes:

@@ -173,9 +173,9 @@ class KafkaWireSource(RecordSource):
         if protocol in ("ssl", "tls"):
             import ssl as _ssl
 
-            ctx = _ssl.create_default_context()
-            if ca_location:
-                ctx.load_verify_locations(ca_location)
+            # ssl.ca.location REPLACES the trust store (librdkafka semantics:
+            # pinning a private CA must not keep accepting public CAs).
+            ctx = _ssl.create_default_context(cafile=ca_location)
             if not verify_certs:
                 ctx.check_hostname = False
                 ctx.verify_mode = _ssl.CERT_NONE

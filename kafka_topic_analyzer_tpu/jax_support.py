@@ -35,6 +35,40 @@ def force_platform(platforms: str) -> None:
         pass
 
 
+def ensure_responsive_accelerator(timeout_s: float = 240.0) -> bool:
+    """Probe the default accelerator in a killable subprocess; on timeout or
+    failure, force the host CPU platform so the caller cannot hang on a
+    wedged device tunnel.  Returns True when the accelerator is healthy (or
+    an explicit platform override / prior verdict makes probing moot).
+
+    Used by bench.py and __graft_entry__; KTA_ACCEL_OK=1 short-circuits so
+    orchestrators (tools/bench_all.py) probe once for many children.
+    """
+    import subprocess
+    import sys
+
+    if os.environ.get("KTA_JAX_PLATFORMS") or os.environ.get("KTA_ACCEL_OK"):
+        return True
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.numpy.arange(4).sum().block_until_ready(); "
+             "print('ok')"],
+            capture_output=True, text=True, timeout=timeout_s, check=False,
+        )
+        if "ok" in probe.stdout:
+            return True
+    except subprocess.TimeoutExpired:
+        pass
+    print(
+        "WARNING: accelerator unresponsive — forcing the cpu platform; "
+        "results will NOT reflect TPU performance",
+        file=sys.stderr,
+    )
+    force_platform("cpu")
+    return False
+
+
 # Escape hatch for CLI users (e.g. run the tpu backend on the host CPU when
 # the accelerator tunnel is down): KTA_JAX_PLATFORMS=cpu.
 _override = os.environ.get("KTA_JAX_PLATFORMS")

@@ -29,7 +29,17 @@ def main(argv: "list[str] | None" = None) -> int:
     repo = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
+    # Probe the accelerator ONCE and pass the verdict to every child: a
+    # wedged tunnel would otherwise cost each config the full probe timeout.
+    from kafka_topic_analyzer_tpu.jax_support import ensure_responsive_accelerator
+
+    child_env = dict(os.environ)
     report = {}
+    if ensure_responsive_accelerator():
+        child_env.setdefault("KTA_ACCEL_OK", "1")
+    else:
+        child_env["KTA_JAX_PLATFORMS"] = "cpu"
+        report["degraded_cpu_fallback"] = True
     for cfg in [int(c) for c in args.configs.split(",") if c]:
         cmd = [
             sys.executable, os.path.join(repo, "bench.py"),
@@ -39,7 +49,7 @@ def main(argv: "list[str] | None" = None) -> int:
             "--steps", str(args.steps),
         ]
         print(f"bench_all: running config {cfg}...", file=sys.stderr)
-        proc = subprocess.run(cmd, capture_output=True, text=True)
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=child_env)
         if proc.returncode != 0:
             report[str(cfg)] = {"error": proc.stderr.strip()[-500:]}
             continue

@@ -127,49 +127,6 @@ def test_lz4_python_path_respects_cap(monkeypatch):
         comp.lz4_decompress_py(big)
 
 
-def test_tls_broker_survives_failed_handshake():
-    import ssl
-    import subprocess
-
-    d = "/tmp/kta_tls_test"
-    subprocess.run(["mkdir", "-p", d], check=True)
-    r = subprocess.run(
-        ["openssl", "req", "-x509", "-newkey", "rsa:2048",
-         "-keyout", f"{d}/key.pem", "-out", f"{d}/cert.pem",
-         "-days", "1", "-nodes", "-subj", "/CN=127.0.0.1",
-         "-addext", "subjectAltName=IP:127.0.0.1"],
-        capture_output=True,
-    )
-    if r.returncode != 0:
-        pytest.skip("openssl unavailable")
-    import sys
-
-    sys.path.insert(0, "tests")
-    from fake_broker import FakeBroker
-
-    from kafka_topic_analyzer_tpu.io.kafka_codec import KafkaProtocolError
-    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
-
-    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-    ctx.load_cert_chain(f"{d}/cert.pem", f"{d}/key.pem")
-    rows = [(0, 0, b"k", b"v")]
-    with FakeBroker("t", {0: rows}, tls_context=ctx) as broker:
-        # First client fails verification (system CAs only)...
-        with pytest.raises(KafkaProtocolError):
-            KafkaWireSource(
-                f"127.0.0.1:{broker.port}", "t",
-                overrides={"security.protocol": "ssl"},
-            )
-        # ...and the broker must still serve the next, trusting client.
-        src = KafkaWireSource(
-            f"127.0.0.1:{broker.port}", "t",
-            overrides={"security.protocol": "ssl",
-                       "ssl.ca.location": f"{d}/cert.pem"},
-        )
-        assert src.partitions() == [0]
-        src.close()
-
-
 def test_zstd_rejected():
     with pytest.raises(UnsupportedCodecError, match="zstd"):
         decompress(4, b"\x28\xb5\x2f\xfd")

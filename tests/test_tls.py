@@ -82,6 +82,26 @@ def test_tls_verification_can_be_disabled(certs):
         src.close()
 
 
+def test_tls_broker_survives_failed_handshake(certs):
+    from kafka_topic_analyzer_tpu.io.kafka_codec import KafkaProtocolError
+
+    _, cert = certs
+    with _tls_broker(certs) as broker:
+        # First client fails verification (system CAs only)...
+        with pytest.raises(KafkaProtocolError):
+            KafkaWireSource(
+                f"127.0.0.1:{broker.port}", "tls.topic",
+                overrides={"security.protocol": "ssl"},
+            )
+        # ...and the broker must still serve the next, trusting client.
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", "tls.topic",
+            overrides={"security.protocol": "ssl", "ssl.ca.location": cert},
+        )
+        assert src.partitions() == [0]
+        src.close()
+
+
 def test_unsupported_security_protocol():
     with pytest.raises(ValueError, match="sasl"):
         KafkaWireSource(
