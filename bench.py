@@ -57,6 +57,10 @@ def main() -> int:
     ap.add_argument("--vmax", type=int, default=420)
     ap.add_argument("--pallas", action="store_true",
                     help="use the Pallas MXU counter kernel")
+    ap.add_argument("--accuracy", action="store_true",
+                    help="also run the CPU-exact oracle over the same records "
+                         "and report sketch errors (BASELINE metric: msgs/s "
+                         "profiled + sketch error vs exact)")
     args = ap.parse_args()
     if args.config:
         preset = CONFIGS[args.config]
@@ -149,6 +153,38 @@ def main() -> int:
     }
     if degraded:
         result["degraded_cpu_fallback"] = True
+
+    if args.accuracy and (config.enable_hll or config.enable_quantiles):
+        # Sketch error vs the CPU-exact oracle — fed EXACTLY the sequence the
+        # device consumed (warmup batch + steps cycling the batch list), so
+        # the comparison measures sketch error, not dataset mismatch.
+        from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+
+        t_acc = time.perf_counter()
+        oracle = CpuExactBackend(config, init_now_s=0)
+        oracle.update(host_batches[0])  # the warmup step
+        for i in range(args.steps):
+            oracle.update(host_batches[i % len(host_batches)])
+        exact = oracle.finalize()
+        sketch = metrics
+        if config.enable_hll and exact.distinct_keys_exact:
+            result["hll_rel_error"] = round(
+                abs(sketch.distinct_keys_hll - exact.distinct_keys_exact)
+                / exact.distinct_keys_exact,
+                5,
+            )
+        if config.enable_quantiles and exact.quantiles is not None:
+            errs = [
+                abs(s - e) / e
+                for s, e in zip(sketch.quantiles.values, exact.quantiles.values)
+                if e
+            ]
+            result["quantile_rel_error_max"] = round(max(errs), 5) if errs else 0.0
+        print(
+            f"bench: accuracy referee took {time.perf_counter() - t_acc:.1f}s",
+            file=sys.stderr,
+        )
+
     print(json.dumps(result))
     return 0
 

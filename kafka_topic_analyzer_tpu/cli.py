@@ -112,6 +112,13 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SECONDS", help="Snapshot interval (default 60s)")
     p.add_argument("--resume", action="store_true",
                    help="Resume from a snapshot in --snapshot-dir if present")
+    p.add_argument("--dump-segments", metavar="DIR",
+                   help="While scanning, dump record metadata into .ktaseg "
+                        "chunks so the topic can be re-analyzed from disk "
+                        "(not combined with --resume)")
+    p.add_argument("--extremes-table", action="store_true",
+                   help="Also print a per-partition first/last-timestamp and "
+                        "min/max-size table (new capability)")
     p.add_argument("--stats", action="store_true",
                    help="Print per-stage throughput stats to stderr")
     p.add_argument("--quiet", action="store_true", help="No progress spinner")
@@ -186,12 +193,27 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     from kafka_topic_analyzer_tpu.utils.timefmt import format_utc_seconds
 
     with user_input_phase():
-        multi = MultiTopicSource(
-            [
-                (t, make_source(args, topic=t, seed_salt=i))
-                for i, t in enumerate(topics)
+        topic_sources = [
+            (t, make_source(args, topic=t, seed_salt=i))
+            for i, t in enumerate(topics)
+        ]
+        if args.dump_segments:
+            if args.resume:
+                raise ValueError(
+                    "--dump-segments cannot be combined with --resume "
+                    "(the dump would miss already-scanned records)"
+                )
+            from kafka_topic_analyzer_tpu.io.segfile import (
+                SegmentDumpWriter,
+                TeeSource,
+            )
+
+            # Tee per topic, before fan-in remaps partition ids to rows.
+            topic_sources = [
+                (t, TeeSource(s, SegmentDumpWriter(args.dump_segments, t)))
+                for t, s in topic_sources
             ]
-        )
+        multi = MultiTopicSource(topic_sources)
     if multi.is_empty():
         print(
             "Given topic has no content, no analysis possible. Exiting.",
@@ -236,6 +258,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     if args.stats:
         print("scan stages:", file=sys.stderr)
         print(result.profile.summary(), file=sys.stderr)
+    multi.close()  # flush per-topic segment dumps, release connections
 
     union = result.metrics
     # Per-topic reports: exact row slices with true partition ids.
@@ -253,6 +276,10 @@ def run_multi_topic(args, topics: "list[str]") -> int:
                 show_alive_keys=False, show_extensions=True,
             )
         )
+        if args.extremes_table:
+            from kafka_topic_analyzer_tpu.report import render_extremes_table
+
+            sys.stdout.write(render_extremes_table(sliced))
 
     # Union block: totals + merged sketches (not sliceable per topic).
     eq = "=" * 120
@@ -313,6 +340,20 @@ def _run(args) -> int:
         return run_multi_topic(args, [t for t in args.topic.split(",") if t])
     with user_input_phase():
         source = make_source(args)
+        if args.dump_segments:
+            if args.resume:
+                raise ValueError(
+                    "--dump-segments cannot be combined with --resume "
+                    "(the dump would miss already-scanned records)"
+                )
+            from kafka_topic_analyzer_tpu.io.segfile import (
+                SegmentDumpWriter,
+                TeeSource,
+            )
+
+            source = TeeSource(
+                source, SegmentDumpWriter(args.dump_segments, args.topic)
+            )
 
     # Empty-topic guard: exit(-2) like src/main.rs:98-101.
     if source.is_empty():
@@ -365,6 +406,8 @@ def _run(args) -> int:
     if args.stats:
         print("scan stages:", file=sys.stderr)
         print(result.profile.summary(), file=sys.stderr)
+    if hasattr(source, "close"):
+        source.close()  # flush segment dumps, release broker connections
 
     sys.stdout.write(
         render_report(
@@ -376,6 +419,10 @@ def _run(args) -> int:
             show_alive_keys=args.count_alive_keys,
         )
     )
+    if args.extremes_table:
+        from kafka_topic_analyzer_tpu.report import render_extremes_table
+
+        sys.stdout.write(render_extremes_table(result.metrics))
     return 0
 
 

@@ -8,7 +8,7 @@ columnar so batches map straight into `RecordBatch` arrays:
 
     magic      8s   b"KTASEG01"
     partition  i32
-    reserved   i32  (zero)
+    flags      i32  (bit0: per-record offsets column present)
     start_off  i64  (first offset in the file)
     count      i64
     key_len    i32[count]
@@ -18,8 +18,13 @@ columnar so batches map straight into `RecordBatch` arrays:
     ts_ms      i64[count]
     key_hash32 u32[count]   (fnv32 reference variant)
     key_hash64 u64[count]
+    [offsets   i64[count]]  iff flags bit0 — set when the source's offset
+                            space has gaps (log compaction), so watermarks
+                            and snapshot resume stay offset-exact
 
-Files are named ``{topic}-{partition}.ktaseg``.
+Files are named ``{topic}-{partition}.ktaseg`` or, for rolled dumps of one
+partition, ``{topic}-{partition}.c{chunk}.ktaseg`` — the reader orders a
+partition's chunks by start offset.
 """
 
 from __future__ import annotations
@@ -34,8 +39,9 @@ from kafka_topic_analyzer_tpu.io.source import RecordSource
 from kafka_topic_analyzer_tpu.records import RecordBatch
 
 MAGIC = b"KTASEG01"
-_HEADER = struct.Struct("<8sii qq")  # magic, partition, reserved, start, count
+_HEADER = struct.Struct("<8sii qq")  # magic, partition, flags, start, count
 HEADER_SIZE = _HEADER.size
+FLAG_OFFSETS = 1
 
 #: (column name, dtype) in file order; names match RecordBatch fields except
 #: ts_ms (stored at millisecond precision; RecordBatch carries seconds).
@@ -59,15 +65,22 @@ def write_segment(
     partition: int,
     start_offset: int,
     columns: Dict[str, np.ndarray],
+    offsets: "np.ndarray | None" = None,
 ) -> None:
     """Write one partition's columns to a .ktaseg file."""
     count = len(columns["key_len"])
+    flags = FLAG_OFFSETS if offsets is not None else 0
     with open(path, "wb") as f:
-        f.write(_HEADER.pack(MAGIC, partition, 0, start_offset, count))
+        f.write(_HEADER.pack(MAGIC, partition, flags, start_offset, count))
         for name, dtype in COLUMNS:
             arr = np.ascontiguousarray(columns[name], dtype=dtype)
             if arr.shape != (count,):
                 raise ValueError(f"{name}: bad shape {arr.shape}")
+            f.write(arr.tobytes())
+        if offsets is not None:
+            arr = np.ascontiguousarray(offsets, dtype=np.int64)
+            if arr.shape != (count,):
+                raise ValueError("offsets: bad shape")
             f.write(arr.tobytes())
 
 
@@ -106,15 +119,17 @@ class SegmentFile:
             header = f.read(HEADER_SIZE)
         if len(header) != HEADER_SIZE:
             raise ValueError(f"{path}: truncated header")
-        magic, partition, _, start_offset, count = _HEADER.unpack(header)
+        magic, partition, flags, start_offset, count = _HEADER.unpack(header)
         if magic != MAGIC:
             raise ValueError(f"{path}: bad magic {magic!r}")
         self.partition = partition
         self.start_offset = start_offset
         self.count = count
+        self.has_offsets = bool(flags & FLAG_OFFSETS)
         self._col_offsets: Dict[str, Tuple[int, np.dtype]] = {}
         off = HEADER_SIZE
-        for name, dtype in COLUMNS:
+        cols = list(COLUMNS) + ([("offsets", np.int64)] if self.has_offsets else [])
+        for name, dtype in cols:
             self._col_offsets[name] = (off, np.dtype(dtype))
             off += count * np.dtype(dtype).itemsize
         expected = off
@@ -122,6 +137,13 @@ class SegmentFile:
         if actual != expected:
             raise ValueError(f"{path}: size {actual} != expected {expected}")
         self._mm = np.memmap(path, dtype=np.uint8, mode="r")
+
+    @property
+    def end_offset(self) -> int:
+        """One past the last record's offset (offset-exact for gappy dumps)."""
+        if self.has_offsets and self.count:
+            return int(self.column("offsets", self.count - 1, self.count)[0]) + 1
+        return self.start_offset + self.count
 
     def column(self, name: str, lo: int = 0, hi: Optional[int] = None) -> np.ndarray:
         off, dtype = self._col_offsets[name]
@@ -132,7 +154,7 @@ class SegmentFile:
 
     def read_batch(self, lo: int, hi: int) -> RecordBatch:
         n = hi - lo
-        return RecordBatch(
+        batch = RecordBatch(
             partition=np.full(n, self.partition, dtype=np.int32),
             key_len=self.column("key_len", lo, hi).copy(),
             value_len=self.column("value_len", lo, hi).copy(),
@@ -143,20 +165,137 @@ class SegmentFile:
             key_hash64=self.column("key_hash64", lo, hi).copy(),
             valid=np.ones(n, dtype=np.bool_),
         )
+        if self.has_offsets:
+            batch.offsets = self.column("offsets", lo, hi).copy()
+        return batch
+
+
+class SegmentDumpWriter:
+    """Incrementally dump a scan's record metadata into rolled .ktaseg
+    chunks (``{topic}-{p}.c{N}.ktaseg``), one writer shared by a whole scan.
+
+    Buffers per partition and rolls a chunk to disk every
+    ``records_per_chunk`` records, so memory stays bounded regardless of
+    topic size.  Thread-safe across per-shard prefetch threads because each
+    partition is fed by exactly one shard (records.py contract) — state is
+    per partition.
+    """
+
+    def __init__(self, directory: str, topic: str, records_per_chunk: int = 1 << 18):
+        os.makedirs(directory, exist_ok=True)
+        # Refuse a directory that already holds this topic's segments: a
+        # shorter re-dump would leave stale chunks behind, and the reader
+        # would silently merge old and new records.
+        import re
+
+        pattern = re.compile(rf"^{re.escape(topic)}-\d+(?:\.c\d+)?\.ktaseg$")
+        stale = [f for f in os.listdir(directory) if pattern.match(f)]
+        if stale:
+            raise ValueError(
+                f"{directory!r} already contains {len(stale)} segment file(s) "
+                f"for topic {topic!r} (e.g. {stale[0]}) — remove them or "
+                "choose another directory"
+            )
+        self.directory = directory
+        self.topic = topic
+        self.records_per_chunk = records_per_chunk
+        self._buf: Dict[int, List[RecordBatch]] = {}
+        self._buffered: Dict[int, int] = {}
+        self._chunk_idx: Dict[int, int] = {}
+        self._written: Dict[int, int] = {}
+
+    def append(self, batch: RecordBatch) -> None:
+        valid = batch.valid
+        if not valid.all():
+            batch = batch.take(np.nonzero(valid)[0])
+        for p in np.unique(batch.partition):
+            sub = batch.take(np.nonzero(batch.partition == p)[0])
+            p = int(p)
+            self._buf.setdefault(p, []).append(sub)
+            self._buffered[p] = self._buffered.get(p, 0) + len(sub)
+            if self._buffered[p] >= self.records_per_chunk:
+                self._flush(p)
+
+    def _flush(self, p: int) -> None:
+        batches = self._buf.pop(p, [])
+        self._buffered[p] = 0
+        if not batches:
+            return
+        full = RecordBatch.concat(batches)
+        idx = self._chunk_idx.get(p, 0)
+        self._chunk_idx[p] = idx + 1
+        path = os.path.join(self.directory, f"{self.topic}-{p}.c{idx}.ktaseg")
+        # Gapless sources: chunk start = records already written; offset-
+        # carrying sources: the first record's true offset.
+        start = (
+            int(full.offsets[0])
+            if full.offsets is not None
+            else self._written.get(p, 0)
+        )
+        self._written[p] = self._written.get(p, 0) + len(full)
+        write_segment(
+            path,
+            p,
+            start,
+            {
+                "key_len": full.key_len,
+                "value_len": full.value_len,
+                "key_null": full.key_null.astype(np.uint8),
+                "value_null": full.value_null.astype(np.uint8),
+                "ts_ms": full.ts_s * 1000,
+                "key_hash32": full.key_hash32,
+                "key_hash64": full.key_hash64,
+            },
+            offsets=full.offsets,
+        )
+
+    def close(self) -> None:
+        for p in list(self._buf):
+            self._flush(p)
+
+
+class TeeSource(RecordSource):
+    """Wraps a source and dumps every yielded batch through a
+    `SegmentDumpWriter` — scan once from Kafka, re-analyze forever from
+    segments (``--dump-segments``)."""
+
+    def __init__(self, inner: RecordSource, writer: SegmentDumpWriter):
+        self.inner = inner
+        self.writer = writer
+
+    def partitions(self):
+        return self.inner.partitions()
+
+    def watermarks(self):
+        return self.inner.watermarks()
+
+    def is_empty(self):
+        return self.inner.is_empty()
+
+    def batches(self, batch_size, partitions=None, start_at=None):
+        for batch in self.inner.batches(batch_size, partitions, start_at):
+            self.writer.append(batch)
+            yield batch
+
+    def close(self):
+        self.writer.close()
+        if hasattr(self.inner, "close"):
+            self.inner.close()
 
 
 class SegmentFileSource(RecordSource):
-    """RecordSource over a directory of {topic}-{partition}.ktaseg files."""
+    """RecordSource over a directory of {topic}-{partition}[.cN].ktaseg
+    files; a partition's chunks are ordered by start offset."""
 
     def __init__(self, segment_dir: str, topic: str):
         self.segment_dir = segment_dir
         self.topic = topic
-        # Exact match on "{topic}-{int}.ktaseg": a prefix match would also
-        # swallow segments of topics like "{topic}-extra".
+        # Exact match on "{topic}-{int}[.c{int}].ktaseg": a prefix match
+        # would also swallow segments of topics like "{topic}-extra".
         import re
 
-        pattern = re.compile(rf"^{re.escape(topic)}-(\d+)\.ktaseg$")
-        self.segments: Dict[int, SegmentFile] = {}
+        pattern = re.compile(rf"^{re.escape(topic)}-(\d+)(?:\.c\d+)?\.ktaseg$")
+        self.segments: Dict[int, List[SegmentFile]] = {}
         for fname in sorted(os.listdir(segment_dir)):
             m = pattern.match(fname)
             if not m:
@@ -167,7 +306,19 @@ class SegmentFileSource(RecordSource):
                     f"{fname}: header partition {seg.partition} does not "
                     f"match filename"
                 )
-            self.segments[seg.partition] = seg
+            self.segments.setdefault(seg.partition, []).append(seg)
+        for p, chunks in self.segments.items():
+            chunks.sort(key=lambda s: s.start_offset)
+            for prev, nxt in zip(chunks, chunks[1:]):
+                if nxt.start_offset < prev.end_offset:
+                    raise ValueError(
+                        f"overlapping segment chunks for partition {p}: "
+                        f"{os.path.basename(prev.path)} ends at "
+                        f"{prev.end_offset} but "
+                        f"{os.path.basename(nxt.path)} starts at "
+                        f"{nxt.start_offset} — stale chunks from an older "
+                        "dump?"
+                    )
         if not self.segments:
             raise SystemExit(
                 f"no {topic}-*.ktaseg files in {segment_dir!r}"
@@ -177,8 +328,8 @@ class SegmentFileSource(RecordSource):
         return sorted(self.segments)
 
     def watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
-        start = {p: s.start_offset for p, s in self.segments.items()}
-        end = {p: s.start_offset + s.count for p, s in self.segments.items()}
+        start = {p: chunks[0].start_offset for p, chunks in self.segments.items()}
+        end = {p: chunks[-1].end_offset for p, chunks in self.segments.items()}
         return start, end
 
     def batches(
@@ -191,9 +342,16 @@ class SegmentFileSource(RecordSource):
         # Sequential per-partition chunks: fastest IO pattern, and the order
         # contract only requires per-partition offset order.
         for p in parts:
-            seg = self.segments[p]
-            first = 0
-            if start_at and p in start_at:
-                first = min(max(start_at[p] - seg.start_offset, 0), seg.count)
-            for lo in range(first, seg.count, batch_size):
-                yield seg.read_batch(lo, min(lo + batch_size, seg.count))
+            resume = start_at.get(p) if start_at else None
+            for seg in self.segments[p]:
+                first = 0
+                if resume is not None:
+                    if resume >= seg.end_offset:
+                        continue  # chunk fully below the resume point
+                    if seg.has_offsets:
+                        offs = np.asarray(seg.column("offsets"))
+                        first = int(np.searchsorted(offs, resume))
+                    else:
+                        first = min(max(resume - seg.start_offset, 0), seg.count)
+                for lo in range(first, seg.count, batch_size):
+                    yield seg.read_batch(lo, min(lo + batch_size, seg.count))

@@ -47,6 +47,7 @@ def main(argv: "list[str] | None" = None) -> int:
             "--batch-size", str(args.batch_size),
             "--batches", str(args.batches),
             "--steps", str(args.steps),
+            "--accuracy",  # the BASELINE metric includes sketch error
         ]
         print(f"bench_all: running config {cfg}...", file=sys.stderr)
         proc = subprocess.run(cmd, capture_output=True, text=True, env=child_env)
