@@ -116,6 +116,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="While scanning, dump record metadata into .ktaseg "
                         "chunks so the topic can be re-analyzed from disk "
                         "(not combined with --resume)")
+    p.add_argument("--json", action="store_true",
+                   help="Emit the report as JSON on stdout instead of the "
+                        "terminal tables")
     p.add_argument("--extremes-table", action="store_true",
                    help="Also print a per-partition first/last-timestamp and "
                         "min/max-size table (new capability)")
@@ -180,6 +183,21 @@ def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object
     )
 
 
+def wrap_with_dump(args, topic: str, source):
+    """Attach a segment-dump tee to a source when --dump-segments is set
+    (shared by the single- and multi-topic paths)."""
+    if not args.dump_segments:
+        return source
+    if args.resume:
+        raise UserInputError(
+            "--dump-segments cannot be combined with --resume "
+            "(the dump would miss already-scanned records)"
+        )
+    from kafka_topic_analyzer_tpu.io.segfile import SegmentDumpWriter, TeeSource
+
+    return TeeSource(source, SegmentDumpWriter(args.dump_segments, topic))
+
+
 def run_multi_topic(args, topics: "list[str]") -> int:
     """Fan-in scan of several topics through one backend: per-topic reports
     from row slices, plus a cross-topic union block whose sketch lines come
@@ -193,26 +211,11 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     from kafka_topic_analyzer_tpu.utils.timefmt import format_utc_seconds
 
     with user_input_phase():
+        # Dump tees attach per topic, before fan-in remaps partition ids.
         topic_sources = [
-            (t, make_source(args, topic=t, seed_salt=i))
+            (t, wrap_with_dump(args, t, make_source(args, topic=t, seed_salt=i)))
             for i, t in enumerate(topics)
         ]
-        if args.dump_segments:
-            if args.resume:
-                raise ValueError(
-                    "--dump-segments cannot be combined with --resume "
-                    "(the dump would miss already-scanned records)"
-                )
-            from kafka_topic_analyzer_tpu.io.segfile import (
-                SegmentDumpWriter,
-                TeeSource,
-            )
-
-            # Tee per topic, before fan-in remaps partition ids to rows.
-            topic_sources = [
-                (t, TeeSource(s, SegmentDumpWriter(args.dump_segments, t)))
-                for t, s in topic_sources
-            ]
         multi = MultiTopicSource(topic_sources)
     if multi.is_empty():
         print(
@@ -242,8 +245,10 @@ def run_multi_topic(args, topics: "list[str]") -> int:
 
         backend = make_backend(args.backend, config)
 
-    print(f"Subscribing to {', '.join(topics)} ({len(topics)}-topic fan-in)")
-    print("Starting message consumption...")
+    banner_out = sys.stderr if args.json else sys.stdout
+    print(f"Subscribing to {', '.join(topics)} ({len(topics)}-topic fan-in)",
+          file=banner_out)
+    print("Starting message consumption...", file=banner_out)
     with maybe_jax_trace(args.profile_dir):
         result = run_scan(
             args.topic,
@@ -261,13 +266,41 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     multi.close()  # flush per-topic segment dumps, release connections
 
     union = result.metrics
-    # Per-topic reports: exact row slices with true partition ids.
+    # Per-topic projections, computed once for both output formats.
+    slices = []
     for topic in topics:
         rows = multi.rows_for(topic)
         ids = [multi.true_partition(r) for r in rows]
         sliced = slice_rows(union, rows, ids)
         start = {multi.true_partition(r): result.start_offsets[r] for r in rows}
         end = {multi.true_partition(r): result.end_offsets[r] for r in rows}
+        slices.append((topic, sliced, start, end))
+
+    if args.json:
+        import json
+
+        doc: dict = {"topics": {}, "duration_secs": result.duration_secs}
+        for topic, sliced, start, end in slices:
+            doc["topics"][topic] = sliced.to_dict(start, end)
+        union_doc = {
+            "count": union.overall_count,
+            "size_bytes": union.overall_size,
+            "earliest_ts": union.earliest_ts_s,
+            "latest_ts": union.latest_ts_s,
+        }
+        if union.alive_keys is not None:
+            union_doc["alive_keys_sum_over_topics"] = union.alive_keys
+        if union.distinct_keys_hll is not None:
+            union_doc["distinct_keys_hll"] = union.distinct_keys_hll
+        if union.distinct_keys_exact is not None:
+            union_doc["distinct_keys_exact"] = union.distinct_keys_exact
+        if union.quantiles is not None:
+            union_doc["size_quantiles"] = union.quantiles.as_dict()
+        doc["union"] = union_doc
+        print(json.dumps(doc))
+        return 0
+    # Per-topic reports from the shared projections.
+    for topic, sliced, start, end in slices:
         # Extensions render only the per-row lines a slice can carry (e.g.
         # per-partition quantiles); merged union-only sketches are None here.
         sys.stdout.write(
@@ -339,21 +372,7 @@ def _run(args) -> int:
     if "," in args.topic:
         return run_multi_topic(args, [t for t in args.topic.split(",") if t])
     with user_input_phase():
-        source = make_source(args)
-        if args.dump_segments:
-            if args.resume:
-                raise ValueError(
-                    "--dump-segments cannot be combined with --resume "
-                    "(the dump would miss already-scanned records)"
-                )
-            from kafka_topic_analyzer_tpu.io.segfile import (
-                SegmentDumpWriter,
-                TeeSource,
-            )
-
-            source = TeeSource(
-                source, SegmentDumpWriter(args.dump_segments, args.topic)
-            )
+        source = wrap_with_dump(args, args.topic, make_source(args))
 
     # Empty-topic guard: exit(-2) like src/main.rs:98-101.
     if source.is_empty():
@@ -390,8 +409,9 @@ def _run(args) -> int:
 
         backend = make_backend(args.backend, config)
 
-    print(f"Subscribing to {args.topic}")
-    print("Starting message consumption...")
+    banner_out = sys.stderr if args.json else sys.stdout
+    print(f"Subscribing to {args.topic}", file=banner_out)
+    print("Starting message consumption...", file=banner_out)
     with maybe_jax_trace(args.profile_dir):
         result = run_scan(
             args.topic,
@@ -409,6 +429,14 @@ def _run(args) -> int:
     if hasattr(source, "close"):
         source.close()  # flush segment dumps, release broker connections
 
+    if args.json:
+        import json
+
+        doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)
+        doc["topic"] = args.topic
+        doc["duration_secs"] = result.duration_secs
+        print(json.dumps(doc))
+        return 0
     sys.stdout.write(
         render_report(
             args.topic,

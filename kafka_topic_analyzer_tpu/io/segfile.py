@@ -203,6 +203,13 @@ class SegmentDumpWriter:
         self._buffered: Dict[int, int] = {}
         self._chunk_idx: Dict[int, int] = {}
         self._written: Dict[int, int] = {}
+        #: True start offsets of the source (set via set_base_offsets):
+        #: offset-less (gapless) sources may still start above 0 after
+        #: retention, and chunk headers must not silently rebase to 0.
+        self._base: Dict[int, int] = {}
+
+    def set_base_offsets(self, start_offsets: Dict[int, int]) -> None:
+        self._base.update(start_offsets)
 
     def append(self, batch: RecordBatch) -> None:
         valid = batch.valid
@@ -225,12 +232,12 @@ class SegmentDumpWriter:
         idx = self._chunk_idx.get(p, 0)
         self._chunk_idx[p] = idx + 1
         path = os.path.join(self.directory, f"{self.topic}-{p}.c{idx}.ktaseg")
-        # Gapless sources: chunk start = records already written; offset-
-        # carrying sources: the first record's true offset.
+        # Offset-carrying sources: the first record's true offset; gapless
+        # sources: the source's start offset plus records already written.
         start = (
             int(full.offsets[0])
             if full.offsets is not None
-            else self._written.get(p, 0)
+            else self._base.get(p, 0) + self._written.get(p, 0)
         )
         self._written[p] = self._written.get(p, 0) + len(full)
         write_segment(
@@ -273,6 +280,7 @@ class TeeSource(RecordSource):
         return self.inner.is_empty()
 
     def batches(self, batch_size, partitions=None, start_at=None):
+        self.writer.set_base_offsets(self.inner.watermarks()[0])
         for batch in self.inner.batches(batch_size, partitions, start_at):
             self.writer.append(batch)
             yield batch

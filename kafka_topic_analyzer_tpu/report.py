@@ -95,22 +95,14 @@ def render_extremes_table(metrics: TopicMetrics) -> str:
     message bytes; sentinel rows (no records / no sized records) show n/a."""
     if metrics.per_partition_extremes is None:
         return ""
-    import numpy as np
-
-    i64 = np.iinfo(np.int64)
     rows: List[List[str]] = [["P", "First Ts", "Last Ts", "Min-Sz", "Max-Sz"]]
-    for p, (e, l, s, g) in zip(
-        metrics.partitions, metrics.per_partition_extremes.tolist()
-    ):
-        # smallest == sentinel means no sized (non-tombstone) record was
-        # seen, in which case largest's 0 is "never set", not an observation.
-        no_sized = s == i64.max
+    for p, e, l, s, g in metrics.extremes_decoded():
         rows.append([
             f"{p}",
-            format_utc_seconds(e) if e != i64.max else "n/a",
-            format_utc_seconds(l) if l != i64.min else "n/a",
-            "n/a" if no_sized else f"{s}",
-            "n/a" if no_sized else f"{g}",
+            format_utc_seconds(e) if e is not None else "n/a",
+            format_utc_seconds(l) if l is not None else "n/a",
+            f"{s}" if s is not None else "n/a",
+            f"{g}" if g is not None else "n/a",
         ])
     return "Per-partition extremes:\n" + render_table(rows)
 

@@ -133,6 +133,84 @@ class TopicMetrics:
         """0 when never set (src/metric.rs:177-183)."""
         return 0 if self.smallest_message == U64_MAX else self.smallest_message
 
+    def extremes_decoded(self):
+        """Per-partition extremes with sentinels decoded to None — the one
+        place that knows the encoding: earliest/smallest sentinel is
+        I64_MAX, latest is I64_MIN, and largest's 0 means "never set"
+        exactly when smallest is the sentinel (tombstone-only partitions).
+        Yields (partition, earliest|None, latest|None, smallest|None,
+        largest|None)."""
+        if self.per_partition_extremes is None:
+            return
+        for p, (e, l, s, g) in zip(
+            self.partitions, self.per_partition_extremes.tolist()
+        ):
+            no_sized = s == I64_MAX_NP
+            yield (
+                p,
+                None if e == I64_MAX_NP else e,
+                None if l == I64_MIN_NP else l,
+                None if no_sized else s,
+                None if no_sized else g,
+            )
+
+    def to_dict(
+        self,
+        start_offsets: "Optional[Dict[int, int]]" = None,
+        end_offsets: "Optional[Dict[int, int]]" = None,
+    ) -> dict:
+        """Machine-readable report (``--json``): the same numbers as the
+        terminal report, keyed by name."""
+        out: dict = {
+            "overall": {
+                "count": self.overall_count,
+                "size_bytes": self.overall_size,
+                "earliest_ts": self.earliest_ts_s,
+                "latest_ts": self.latest_ts_s,
+                "largest_message": self.largest_message,
+                "smallest_message": self.smallest_message_reported(),
+            },
+            "partitions": {},
+        }
+        for p in self.partitions:
+            row = {
+                name: int(self._row(p)[i])
+                for name, i in CH.items()
+            }
+            row["dirty_ratio"] = self.dirty_ratio(p)
+            row["key_size_avg"] = self.key_size_avg(p)
+            row["value_size_avg"] = self.value_size_avg(p)
+            row["message_size_avg"] = self.message_size_avg(p)
+            if start_offsets is not None:
+                row["start_offset"] = start_offsets[p]
+            if end_offsets is not None:
+                row["end_offset"] = end_offsets[p]
+            out["partitions"][str(p)] = row
+        if self.alive_keys is not None:
+            out["alive_keys"] = self.alive_keys
+        if self.distinct_keys_hll is not None:
+            out["distinct_keys_hll"] = self.distinct_keys_hll
+        if self.distinct_keys_exact is not None:
+            out["distinct_keys_exact"] = self.distinct_keys_exact
+        if self.quantiles is not None:
+            out["size_quantiles"] = self.quantiles.as_dict()
+        if self.quantiles_per_partition is not None:
+            out["size_quantiles_per_partition"] = {
+                str(p): q.as_dict()
+                for p, q in zip(self.partitions, self.quantiles_per_partition)
+            }
+        if self.per_partition_extremes is not None:
+            out["extremes_per_partition"] = {
+                str(p): {
+                    "earliest_ts": e,
+                    "latest_ts": l,
+                    "smallest": s,
+                    "largest": g,
+                }
+                for p, e, l, s, g in self.extremes_decoded()
+            }
+        return out
+
 
 I64_MAX_NP = np.iinfo(np.int64).max
 I64_MIN_NP = np.iinfo(np.int64).min

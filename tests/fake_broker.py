@@ -26,8 +26,12 @@ class FakeBroker:
         start_offsets: Optional[Dict[int, int]] = None,
         end_offsets: Optional[Dict[int, int]] = None,
         tls_context=None,
+        node_id: int = 0,
+        cluster: "Optional[FakeCluster]" = None,
     ):
         self.tls_context = tls_context
+        self.node_id = node_id
+        self.cluster = cluster
         self.topic = topic
         self.records = {
             p: sorted(rs, key=lambda r: r[0]) for p, rs in partition_records.items()
@@ -140,6 +144,11 @@ class FakeBroker:
             n = r.i32()
             for _ in range(max(n, 0)):
                 requested.append(r.string())
+            brokers = (
+                self.cluster.broker_addrs()
+                if self.cluster is not None
+                else {self.node_id: ("127.0.0.1", self.port)}
+            )
             topics: List[kc.TopicMetadata] = []
             for name in requested if requested else [self.topic]:
                 if name == self.topic:
@@ -148,7 +157,7 @@ class FakeBroker:
                             0,
                             name,
                             [
-                                kc.PartitionMetadata(0, p, 0)
+                                kc.PartitionMetadata(0, p, self._leader(p))
                                 for p in sorted(self.records)
                             ],
                         )
@@ -160,7 +169,7 @@ class FakeBroker:
                         )
                     )
             return kc.encode_metadata_response(
-                kc.MetadataResponse({0: ("127.0.0.1", self.port)}, 0, topics)
+                kc.MetadataResponse(brokers, 0, topics)
             )
         if api_key == kc.API_LIST_OFFSETS:
             _topic, parts = kc.decode_list_offsets_request(r)
@@ -182,6 +191,11 @@ class FakeBroker:
                 if rs is None:
                     out.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, b""))
                     continue
+                if self._leader(pid) != self.node_id:
+                    # A real broker rejects fetches for partitions it does
+                    # not lead.
+                    out.append((pid, kc.ERR_NOT_LEADER_FOR_PARTITION, -1, b""))
+                    continue
                 hw = self.end_offsets[pid]
                 selected = [rec for rec in rs if rec[0] >= fetch_offset]
                 selected = selected[: self.max_records_per_fetch]
@@ -193,3 +207,54 @@ class FakeBroker:
                 out.append((pid, 0, hw, record_set))
             return kc.encode_fetch_response(self.topic, out)
         raise AssertionError(f"fake broker: unsupported api {api_key}")
+
+    def _leader(self, partition: int) -> int:
+        if self.cluster is not None:
+            return self.cluster.leader(partition)
+        return self.node_id
+
+
+class FakeCluster:
+    """Several FakeBroker nodes sharing one topic; partition p is led by
+    node p % n_nodes.  Exercises the client's by-leader fetch grouping and
+    NOT_LEADER rerouting, which a single node never does."""
+
+    def __init__(
+        self,
+        topic: str,
+        partition_records: Dict[int, List[Record]],
+        n_nodes: int = 2,
+        **broker_kwargs,
+    ):
+        self.n_nodes = n_nodes
+        self.nodes = [
+            FakeBroker(
+                topic, partition_records, node_id=i, cluster=self, **broker_kwargs
+            )
+            for i in range(n_nodes)
+        ]
+
+    def leader(self, partition: int) -> int:
+        return partition % self.n_nodes
+
+    def broker_addrs(self) -> Dict[int, "tuple[str, int]"]:
+        return {b.node_id: ("127.0.0.1", b.port) for b in self.nodes}
+
+    def start(self) -> "FakeCluster":
+        for b in self.nodes:
+            b.start()
+        return self
+
+    def stop(self) -> None:
+        for b in self.nodes:
+            b.stop()
+
+    def __enter__(self) -> "FakeCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def bootstrap(self) -> str:
+        return ",".join(f"127.0.0.1:{b.port}" for b in self.nodes)

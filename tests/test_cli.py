@@ -79,6 +79,40 @@ def test_cli_kafka_source_end_to_end(capsys):
     assert "| 0    | 100  | 100   |" in out
 
 
+def test_cli_json_output(capsys):
+    import json
+
+    assert main([
+        "-t", "j.topic", "--source", "synthetic",
+        "--synthetic", "partitions=2,messages=300,keys=40,tombstones=200",
+        "--backend", "tpu", "-c", "--alive-bitmap-bits", "20",
+        "--quantiles", "--json", "--quiet", "--native", "off",
+    ]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["topic"] == "j.topic"
+    assert doc["overall"]["count"] == 600
+    assert set(doc["partitions"]) == {"0", "1"}
+    row = doc["partitions"]["0"]
+    assert row["total"] == 300
+    assert row["total"] == row["alive"] + row["tombstones"]
+    assert row["end_offset"] == 300
+    assert "alive_keys" in doc and "size_quantiles" in doc
+
+
+def test_cli_json_multi_topic(capsys):
+    import json
+
+    assert main([
+        "-t", "x,y", "--source", "synthetic",
+        "--synthetic", "partitions=1,messages=200,keys=20",
+        "--backend", "cpu", "--json", "--quiet", "--native", "off",
+    ]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc["topics"]) == {"x", "y"}
+    assert doc["union"]["count"] == 400
+    assert doc["topics"]["x"]["overall"]["count"] == 200
+
+
 def test_cli_empty_topic_exits_minus_2(capsys):
     with pytest.raises(SystemExit) as e:
         main([

@@ -16,7 +16,7 @@ from kafka_topic_analyzer_tpu.io import kafka_codec as kc
 from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource, parse_bootstrap
 from kafka_topic_analyzer_tpu.records import RecordBatch
 
-from fake_broker import FakeBroker
+from fake_broker import FakeBroker, FakeCluster
 
 
 # ---------------------------------------------------------------------------
@@ -143,6 +143,42 @@ def test_wire_missing_timestamps_map_to_epoch():
     with FakeBroker("wire.topic", {0: rows}) as broker:
         result = _scan_via_wire(broker)
     assert result.metrics.earliest_ts_s == 0  # unwrap_or(0) semantics
+
+
+def test_multi_broker_cluster_scan():
+    """Partitions led by different nodes: the client must group fetches by
+    leader and pull each partition from the right broker."""
+    records = {p: _mk_records(p, 150 + 37 * p) for p in range(5)}
+    with FakeCluster("wire.topic", records, n_nodes=3, max_records_per_fetch=60) as cluster:
+        src = KafkaWireSource(cluster.bootstrap, "wire.topic")
+        cfg = AnalyzerConfig(
+            num_partitions=5, batch_size=128, count_alive_keys=True,
+            alive_bitmap_bits=20,
+        )
+        be = CpuExactBackend(cfg, init_now_s=10**10)
+        result = run_scan("wire.topic", src, be, 128)
+        src.close()
+        # Every node served fetch traffic (each leads at least one partition).
+        assert all(node.fetch_count > 0 for node in cluster.nodes)
+    m = result.metrics
+    direct = _scan_direct(records, list(records))
+    assert np.array_equal(m.per_partition, direct.per_partition)
+    assert m.alive_keys == direct.alive_keys
+    assert m.overall_count == sum(len(r) for r in records.values())
+
+
+def test_multi_broker_bootstrap_via_single_node():
+    """Bootstrapping from ONE node must still discover and use the others."""
+    records = {p: _mk_records(p, 80) for p in range(4)}
+    with FakeCluster("wire.topic", records, n_nodes=2) as cluster:
+        one = f"127.0.0.1:{cluster.nodes[0].port}"
+        src = KafkaWireSource(one, "wire.topic")
+        cfg = AnalyzerConfig(num_partitions=4, batch_size=64)
+        be = CpuExactBackend(cfg, init_now_s=10**10)
+        m = run_scan("wire.topic", src, be, 64).metrics
+        src.close()
+        assert cluster.nodes[1].fetch_count > 0  # discovered via metadata
+    assert m.overall_count == 4 * 80
 
 
 def test_wire_all_records_beyond_watermark_terminates():

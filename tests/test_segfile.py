@@ -114,6 +114,31 @@ def test_dump_writer_roundtrip_with_gappy_offsets(tmp_path):
     assert int(resumed.offsets[0]) == 303  # first retained offset >= 301
 
 
+def test_dump_preserves_nonzero_start_of_gapless_source(tmp_path):
+    """Re-dumping an offset-less source that starts above 0 (retention) must
+    keep the true start offset, not rebase to 0."""
+    from kafka_topic_analyzer_tpu.io.segfile import SegmentDumpWriter, TeeSource
+
+    src_dir = tmp_path / "src"
+    dst_dir = tmp_path / "dst"
+    src_dir.mkdir()
+    src = SyntheticSource(SPEC)
+    write_segment_from_batches(
+        str(src_dir), "t", 0, list(src.batches(1000, partitions=[0])),
+        start_offset=1000,
+    )
+    reader = SegmentFileSource(str(src_dir), "t")
+    assert reader.watermarks()[0] == {0: 1000}
+    tee = TeeSource(reader, SegmentDumpWriter(str(dst_dir), "t"))
+    for _ in tee.batches(700):
+        pass
+    tee.close()
+    redump = SegmentFileSource(str(dst_dir), "t")
+    start, end = redump.watermarks()
+    assert start == {0: 1000}
+    assert end == {0: 1000 + SPEC.messages_per_partition}
+
+
 def test_corrupt_magic_rejected(seg_dir, tmp_path):
     bad = tmp_path / "t-9.ktaseg"
     data = bytearray(open(f"{seg_dir}/t-0.ktaseg", "rb").read())
