@@ -24,7 +24,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 3
+_ABI = 4
 _SO_PATH = os.path.join(_NATIVE_DIR, "build", f"libkta_ingest.v{_ABI}.so")
 
 _lock = threading.Lock()
@@ -85,6 +85,7 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
             lib.kta_synth_batch.restype = ctypes.c_int32
             lib.kta_hash_batch.restype = ctypes.c_int32
             lib.kta_dedupe_slots.restype = ctypes.c_int64
+            lib.kta_pack_batch.restype = ctypes.c_int64
         except Exception as e:  # remember the failure
             _load_error = e
             raise
@@ -206,6 +207,43 @@ def dedupe_slots_native(
     if count < 0:
         raise RuntimeError(f"kta_dedupe_slots failed with rc={count}")
     return slot_out[:count], alive_out[:count]
+
+
+def pack_batch_native(batch, config) -> "np.ndarray | None":
+    """Fused SoA→wire-format-v1 packing in C++ (see packing.py for the
+    layout contract).  Returns None when the shim rejects the batch (out of
+    range values) so the numpy path can raise its descriptive error."""
+    from kafka_topic_analyzer_tpu.packing import MAX_VALUE_LEN, packed_nbytes
+
+    lib = load_library()
+    b = config.batch_size
+    n = len(batch)
+    if n > b:
+        raise ValueError(f"batch of {n} exceeds batch_size {b}")
+    out = np.empty(packed_nbytes(config, b), dtype=np.uint8)
+    nbytes = lib.kta_pack_batch(
+        _as_ptr(batch.partition, ctypes.c_int32),
+        _as_ptr(batch.key_len, ctypes.c_int32),
+        _as_ptr(batch.value_len, ctypes.c_int32),
+        _as_ptr(batch.key_null.view(np.uint8), ctypes.c_uint8),
+        _as_ptr(batch.value_null.view(np.uint8), ctypes.c_uint8),
+        _as_ptr(batch.ts_s, ctypes.c_int64),
+        _as_ptr(batch.key_hash32, ctypes.c_uint32),
+        _as_ptr(batch.key_hash64, ctypes.c_uint64),
+        ctypes.c_int64(batch.num_valid),
+        ctypes.c_int64(b),
+        ctypes.c_int32(1 if config.count_alive_keys else 0),
+        ctypes.c_int32(config.alive_bitmap_bits),
+        ctypes.c_int32(1 if config.enable_hll else 0),
+        ctypes.c_int32(config.hll_p),
+        ctypes.c_int32(MAX_VALUE_LEN if config.use_pallas_counters else 0),
+        _as_ptr(out, ctypes.c_uint8),
+        ctypes.c_int64(out.nbytes),
+    )
+    if nbytes < 0:
+        return None
+    assert nbytes == out.nbytes, (nbytes, out.nbytes)
+    return out
 
 
 class NativeSyntheticSource(SyntheticSource):

@@ -171,6 +171,9 @@ def pack_batch(
         raise ValueError(
             f"partition index out of packed-transfer range [0, {MAX_PARTITIONS}]"
         )
+    if n and (batch.value_len.min() < 0 or batch.key_len.min() < 0):
+        # astype(uint) would silently wrap a negative length into gigabytes.
+        raise ValueError("negative key/value length in record batch")
     if (
         config.use_pallas_counters
         and batch.value_len.max(initial=0) > MAX_VALUE_LEN
@@ -182,6 +185,23 @@ def pack_batch(
             f"counter kernel's limit of {MAX_VALUE_LEN} bytes — disable "
             f"use_pallas_counters for such topics"
         )
+
+    if use_native:
+        # Fused C++ pack (columns + dedupe + HLL split in one pass).  A None
+        # return means the shim rejected the batch; the numpy path below
+        # re-derives the descriptive error.
+        try:
+            from kafka_topic_analyzer_tpu.io.native import (
+                native_available,
+                pack_batch_native,
+            )
+
+            if native_available():
+                out = pack_batch_native(batch, config)
+                if out is not None:
+                    return out
+        except ImportError:
+            pass
 
     out = np.zeros(packed_nbytes(config, b), dtype=np.uint8)
     header = np.zeros(4, dtype=np.int32)

@@ -86,7 +86,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 3; }
+int32_t kta_version() { return 4; }
 
 // Last-writer-wins dedupe of alive-bitmap updates for one batch
 // (the host half of the packed transfer's pre-reduction; see
@@ -228,6 +228,127 @@ int32_t kta_hash_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
 }
 
 }  // extern "C"
+
+// Fused batch packing: RecordBatch SoA columns -> wire-format-v1 buffer
+// (kafka_topic_analyzer_tpu/packing.py), including the host pre-reductions
+// (last-writer-wins bitmap dedupe via kta_dedupe_slots' table, HLL
+// (bucket, rho) split).  One C++ pass replaces several numpy conversions on
+// the per-batch hot path.  Layout contract lives in packing.py; keep in
+// sync (HEADER 16B; sections p i16 | klen u16 | vlen u32 | flags u8 |
+// ts i64 | [slot u32 | alive u8] | [idx u16 | rho u8]).
+// Returns total bytes written, or -1 on error (including key_len > u16 /
+// partition out of i16 range — mirrors pack_batch's validation).
+extern "C" int64_t kta_pack_batch(
+    const int32_t* partition, const int32_t* key_len, const int32_t* value_len,
+    const uint8_t* key_null, const uint8_t* value_null, const int64_t* ts_s,
+    const uint32_t* h32, const uint64_t* h64,
+    int64_t n_valid, int64_t batch_size,
+    int32_t with_alive, int32_t alive_bits, int32_t with_hll, int32_t hll_p,
+    int32_t value_len_cap,
+    uint8_t* out, int64_t out_cap) {
+  if (n_valid < 0 || n_valid > batch_size) return -1;
+  const int64_t b = batch_size;
+  int64_t need = 16 + b * (2 + 2 + 4 + 1 + 8);
+  if (with_alive) need += b * 5;
+  if (with_hll) need += b * 3;
+  if (need > out_cap) return -1;
+
+  std::memset(out, 0, need);
+  int64_t pos = 16;
+  // Section base pointers stay uint8_t*; elements are stored via memcpy —
+  // sections are only naturally aligned when batch_size is a multiple of 8,
+  // and typed stores through misaligned pointers are UB.
+  uint8_t* p16 = out + pos;
+  pos += b * 2;
+  uint8_t* kl16 = out + pos;
+  pos += b * 2;
+  uint8_t* vl32 = out + pos;
+  pos += b * 4;
+  uint8_t* fl8 = out + pos;
+  pos += b;
+  uint8_t* ts64 = out + pos;
+  pos += b * 8;
+
+  auto store = [](uint8_t* base, int64_t idx, auto v) {
+    std::memcpy(base + idx * static_cast<int64_t>(sizeof(v)), &v, sizeof(v));
+  };
+
+  std::atomic<bool> bad{false};
+  parallel_for(n_valid, 8, [&](int64_t a, int64_t e) {
+    for (int64_t i = a; i < e; ++i) {
+      if (partition[i] < 0 || partition[i] > 0x7fff || key_len[i] > 0xffff ||
+          value_len[i] < 0) {
+        bad.store(true);
+        return;
+      }
+      store(p16, i, static_cast<int16_t>(partition[i]));
+      store(kl16, i, static_cast<uint16_t>(key_len[i]));
+      store(vl32, i, static_cast<uint32_t>(value_len[i]));
+      fl8[i] = (key_null[i] ? 1 : 0) | (value_null[i] ? 2 : 0);
+      store(ts64, i, ts_s[i]);
+    }
+  });
+  if (bad.load()) return -1;
+  if (value_len_cap > 0) {
+    for (int64_t i = 0; i < n_valid; ++i)
+      if (value_len[i] > value_len_cap) return -1;
+  }
+
+  int64_t n_pairs = 0;
+  if (with_alive) {
+    uint8_t* slot32 = out + pos;
+    pos += b * 4;
+    uint8_t* alive8 = out + pos;
+    pos += b;
+    if (n_valid > 0) {
+      // active = valid & key non-null; alive = value non-null.  Dedupe into
+      // aligned temporaries, then memcpy into the (possibly unaligned)
+      // section.  (Empty batches skip this entirely — sharded scans pack
+      // empty shard batches every step.)
+      std::vector<uint8_t> active(n_valid), alive(n_valid);
+      for (int64_t i = 0; i < n_valid; ++i) {
+        active[i] = key_null[i] ? 0 : 1;
+        alive[i] = value_null[i] ? 0 : 1;
+      }
+      std::vector<uint32_t> slots(n_valid);
+      std::vector<uint8_t> flags(n_valid);
+      n_pairs = kta_dedupe_slots(h32, active.data(), alive.data(), n_valid,
+                                 alive_bits, slots.data(), flags.data());
+      if (n_pairs < 0) return -1;
+      std::memcpy(slot32, slots.data(), n_pairs * 4);
+      std::memcpy(alive8, flags.data(), n_pairs);
+    }
+  }
+  if (with_hll) {
+    uint8_t* idx16 = out + pos;
+    pos += b * 2;
+    uint8_t* rho8 = out + pos;
+    pos += b;
+    const int p = hll_p;
+    parallel_for(n_valid, 8, [&](int64_t a, int64_t e) {
+      for (int64_t i = a; i < e; ++i) {
+        if (key_null[i]) {
+          store(idx16, i, static_cast<uint16_t>(0));
+          rho8[i] = 0;
+          continue;
+        }
+        const uint64_t h = splitmix64(h64[i]);
+        store(idx16, i, static_cast<uint16_t>(h >> (64 - p)));
+        const uint64_t rest = h << p;
+        rho8[i] = rest == 0
+                      ? static_cast<uint8_t>(64 - p + 1)
+                      : static_cast<uint8_t>(__builtin_clzll(rest) + 1);
+      }
+    });
+  }
+
+  // Header: n_valid i32 | n_pairs i32 | reserved.
+  const int32_t hv = static_cast<int32_t>(n_valid);
+  const int32_t hp = static_cast<int32_t>(n_pairs);
+  std::memcpy(out, &hv, 4);
+  std::memcpy(out + 4, &hp, 4);
+  return need;
+}
 
 // ---------------------------------------------------------------------------
 // Decompressors for Kafka record batches (kafka_codec.py): snappy raw blocks

@@ -71,6 +71,59 @@ def test_dedupe_numpy_last_writer_wins():
     assert result == {5: 0, 6: 1, 7: 1}  # inactive slot 9 ignored
 
 
+def test_native_pack_semantics_match_numpy():
+    native = pytest.importorskip("kafka_topic_analyzer_tpu.io.native")
+    if not native.native_available():
+        pytest.skip("native shim unavailable")
+    batch = _batch()
+    a = pack_batch(batch, CFG, use_native=False)
+    b = pack_batch(batch, CFG, use_native=True)
+    ua, ub = unpack_numpy(a, CFG), unpack_numpy(b, CFG)
+    nv = int(ua["n_valid"])
+    assert nv == int(ub["n_valid"])
+    for name in ("partition", "key_len", "value_len", "key_null",
+                 "value_null", "ts_s", "hll_idx", "hll_rho"):
+        assert np.array_equal(ua[name][:nv], ub[name][:nv]), name
+    # Dedupe pair ORDER differs (sorted vs first-touch); counts must match
+    # exactly (dict comparison alone would mask duplicate emissions), then
+    # compare as dicts.
+    na, nb = int(ua["n_pairs"]), int(ub["n_pairs"])
+    assert na == nb
+    assert dict(zip(ua["alive_slot"][:na].tolist(), ua["alive_flag"][:na].tolist())) \
+        == dict(zip(ub["alive_slot"][:nb].tolist(), ub["alive_flag"][:nb].tolist()))
+
+
+def test_native_pack_odd_batch_size_and_empty():
+    """Alignment safety (batch_size not a multiple of 8) and empty batches
+    must stay on the native path, not silently fall back or crash."""
+    native = pytest.importorskip("kafka_topic_analyzer_tpu.io.native")
+    if not native.native_available():
+        pytest.skip("native shim unavailable")
+    import dataclasses
+
+    odd_cfg = dataclasses.replace(CFG, batch_size=517)
+    batch = next(SyntheticSource(SPEC).batches(400)).pad_to(517)
+    a = pack_batch(batch, odd_cfg, use_native=False)
+    b = native.pack_batch_native(batch, odd_cfg)
+    assert b is not None
+    ua, ub = unpack_numpy(a, odd_cfg), unpack_numpy(b, odd_cfg)
+    for name in ("partition", "key_len", "value_len", "ts_s"):
+        assert np.array_equal(ua[name][:400], ub[name][:400]), name
+    from kafka_topic_analyzer_tpu.records import RecordBatch
+
+    empty = native.pack_batch_native(RecordBatch.empty(0), odd_cfg)
+    assert empty is not None
+    ue = unpack_numpy(empty, odd_cfg)
+    assert int(ue["n_valid"]) == 0 and int(ue["n_pairs"]) == 0
+
+
+def test_pack_rejects_negative_lengths():
+    batch = _batch()
+    batch.value_len[2] = -5
+    with pytest.raises(ValueError, match="negative"):
+        pack_batch(batch, CFG, use_native=False)
+
+
 def test_dedupe_native_matches_numpy():
     native = pytest.importorskip("kafka_topic_analyzer_tpu.io.native")
     if not native.native_available():
