@@ -221,15 +221,16 @@ def pack_batch_native(batch, config) -> "np.ndarray | None":
     if n > b:
         raise ValueError(f"batch of {n} exceeds batch_size {b}")
     out = np.empty(packed_nbytes(config, b), dtype=np.uint8)
+    c = np.ascontiguousarray  # strided views would be read with wrong strides
     nbytes = lib.kta_pack_batch(
-        _as_ptr(batch.partition, ctypes.c_int32),
-        _as_ptr(batch.key_len, ctypes.c_int32),
-        _as_ptr(batch.value_len, ctypes.c_int32),
-        _as_ptr(batch.key_null.view(np.uint8), ctypes.c_uint8),
-        _as_ptr(batch.value_null.view(np.uint8), ctypes.c_uint8),
-        _as_ptr(batch.ts_s, ctypes.c_int64),
-        _as_ptr(batch.key_hash32, ctypes.c_uint32),
-        _as_ptr(batch.key_hash64, ctypes.c_uint64),
+        _as_ptr(c(batch.partition), ctypes.c_int32),
+        _as_ptr(c(batch.key_len), ctypes.c_int32),
+        _as_ptr(c(batch.value_len), ctypes.c_int32),
+        _as_ptr(c(batch.key_null).view(np.uint8), ctypes.c_uint8),
+        _as_ptr(c(batch.value_null).view(np.uint8), ctypes.c_uint8),
+        _as_ptr(c(batch.ts_s), ctypes.c_int64),
+        _as_ptr(c(batch.key_hash32), ctypes.c_uint32),
+        _as_ptr(c(batch.key_hash64), ctypes.c_uint64),
         ctypes.c_int64(batch.num_valid),
         ctypes.c_int64(b),
         ctypes.c_int32(1 if config.count_alive_keys else 0),

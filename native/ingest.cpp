@@ -273,11 +273,14 @@ extern "C" int64_t kta_pack_batch(
     std::memcpy(base + idx * static_cast<int64_t>(sizeof(v)), &v, sizeof(v));
   };
 
+  const int32_t vcap =
+      value_len_cap > 0 ? value_len_cap : 0x7fffffff;
   std::atomic<bool> bad{false};
   parallel_for(n_valid, 8, [&](int64_t a, int64_t e) {
     for (int64_t i = a; i < e; ++i) {
-      if (partition[i] < 0 || partition[i] > 0x7fff || key_len[i] > 0xffff ||
-          value_len[i] < 0) {
+      if (partition[i] < 0 || partition[i] > 0x7fff ||
+          key_len[i] < 0 || key_len[i] > 0xffff ||
+          value_len[i] < 0 || value_len[i] > vcap) {
         bad.store(true);
         return;
       }
@@ -289,10 +292,6 @@ extern "C" int64_t kta_pack_batch(
     }
   });
   if (bad.load()) return -1;
-  if (value_len_cap > 0) {
-    for (int64_t i = 0; i < n_valid; ++i)
-      if (value_len[i] > value_len_cap) return -1;
-  }
 
   int64_t n_pairs = 0;
   if (with_alive) {
