@@ -32,6 +32,22 @@ class UnsupportedCodecError(RuntimeError):
 # pure-Python decoders (fallback path)
 
 
+def _total(fn):
+    """Truncated streams index past the end in several places; map every
+    IndexError to the same ValueError a caller can handle (fuzzed by
+    tests/test_properties.py: decoders must be total over garbage)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        try:
+            return fn(*a, **k)
+        except IndexError as e:
+            raise ValueError("truncated compressed payload") from e
+
+    return wrapper
+
+
 def _snappy_raw_py(data: bytes) -> bytes:
     ip = 0
     ulen = 0
@@ -81,6 +97,7 @@ def _snappy_raw_py(data: bytes) -> bytes:
     return bytes(out)
 
 
+@_total
 def snappy_decompress_py(data: bytes) -> bytes:
     if data.startswith(XERIAL_MAGIC):
         ip = 16  # magic + version + compat
@@ -88,6 +105,11 @@ def snappy_decompress_py(data: bytes) -> bytes:
         while ip + 4 <= len(data):
             (blen,) = struct.unpack(">i", data[ip : ip + 4])
             ip += 4
+            # A negative/overlong block length must fail, not loop forever
+            # (this decoder's totality cannot depend on callers validating
+            # first).
+            if blen < 0 or ip + blen > len(data):
+                raise ValueError("bad xerial block length")
             out += _snappy_raw_py(data[ip : ip + blen])
             ip += blen
         return bytes(out)
@@ -137,6 +159,7 @@ def _lz4_block_py(data: bytes, out: bytearray) -> None:
             out.append(out[-offset])
 
 
+@_total
 def lz4_decompress_py(data: bytes) -> bytes:
     if len(data) >= 7 and struct.unpack("<I", data[:4])[0] == LZ4_FRAME_MAGIC:
         ip = 4
@@ -213,6 +236,8 @@ def _lz4_output_bound(data: bytes) -> int:
     if len(data) >= 7 and struct.unpack("<I", data[:4])[0] == LZ4_FRAME_MAGIC:
         flg = data[4]
         if flg & 0x08:
+            if len(data) < 14:
+                raise ValueError("truncated lz4 frame header")
             return struct.unpack("<Q", data[6:14])[0]
     return len(data) * 255 + 64
 
