@@ -110,7 +110,7 @@ class ByteReader:
         self.pos = pos
 
     def _take(self, n: int) -> bytes:
-        if self.pos + n > len(self.buf):
+        if n < 0 or self.pos < 0 or self.pos + n > len(self.buf):
             raise KafkaProtocolError(
                 f"truncated message: need {n} bytes at {self.pos}, have {len(self.buf)}"
             )
@@ -574,6 +574,12 @@ def decode_record_batches(
         for _ in range(num_records):
             length = rr.varint()
             rec_end = rr.pos + length
+            # A negative declared length would walk the reader backwards
+            # (negative positions slice "successfully" in Python).
+            if length < 0 or rec_end > len(payload):
+                raise KafkaProtocolError(
+                    f"record length {length} out of range at offset {base_offset}"
+                )
             rr.i8()  # attributes
             ts_delta = rr.varint()
             off_delta = rr.varint()

@@ -21,16 +21,18 @@ _LEVELS = {
 }
 
 
-def init_logging() -> None:
-    spec = os.environ.get("KTA_LOG") or os.environ.get("RUST_LOG") or "error"
-    # env_logger accepts "level" or "target=level,..." — take the bare level
-    # or the first bare segment.
-    level = logging.ERROR
+def parse_level(spec: str) -> int:
+    """env_logger accepts "level" or "target=level,..." — take the first
+    bare level segment; unknown specs fall back to ERROR."""
     for seg in spec.split(","):
         if "=" not in seg and seg.strip().lower() in _LEVELS:
-            level = _LEVELS[seg.strip().lower()]
-            break
+            return _LEVELS[seg.strip().lower()]
+    return logging.ERROR
+
+
+def init_logging() -> None:
+    spec = os.environ.get("KTA_LOG") or os.environ.get("RUST_LOG") or "error"
     logging.basicConfig(
-        level=level,
+        level=parse_level(spec),
         format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
     )

@@ -103,14 +103,34 @@ def test_record_batch_roundtrip_property(base, recs, codec):
 
 
 @settings(max_examples=200, deadline=None)
-@given(st.binary(max_size=400))
-def test_record_batch_decoder_total_on_garbage(buf):
+@given(st.binary(max_size=400), st.booleans())
+def test_record_batch_decoder_total_on_garbage(buf, verify_crc):
     """Feeding arbitrary bytes to the record-batch decoder must either
     yield records or raise KafkaProtocolError — never leak IndexError/
-    struct.error/etc. (a malicious or corrupt broker must not crash the
-    client with an undiagnosable traceback)."""
+    struct.error/etc.  Fuzzed with verify_crc BOTH ways: random bytes never
+    pass CRC32C, so only the False arm reaches the record-body parser."""
     try:
-        list(kc.decode_record_batches(buf, verify_crc=True))
+        list(kc.decode_record_batches(buf, verify_crc=verify_crc))
+    except kc.KafkaProtocolError:
+        pass
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    st.lists(kafka_record, min_size=1, max_size=5),
+    st.integers(0, 60),   # mutation position within the record payload
+    st.integers(1, 255),  # xor mask
+)
+def test_record_body_parser_total_on_mutated_batches(recs, mpos, mask):
+    """Mutate the *body* of an otherwise valid batch (CRC off) so the
+    record/varint parser itself gets fuzzed, not just the header checks."""
+    rows = [(i, ts, k, v) for i, (ts, k, v) in enumerate(recs)]
+    buf = bytearray(kc.encode_record_batch(rows))
+    body_start = 61  # fixed v2 batch header size
+    if len(buf) > body_start:
+        buf[body_start + mpos % (len(buf) - body_start)] ^= mask
+    try:
+        list(kc.decode_record_batches(bytes(buf), verify_crc=False))
     except kc.KafkaProtocolError:
         pass
 

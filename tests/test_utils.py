@@ -1,0 +1,77 @@
+"""Small utility coverage: env_logger-style level parsing, spinner, mesh
+partition assignment, profiling counters."""
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.parallel.mesh import assign_partitions
+from kafka_topic_analyzer_tpu.utils.profiling import ScanProfile
+from kafka_topic_analyzer_tpu.utils.progress import Spinner
+from kafka_topic_analyzer_tpu.utils.timefmt import format_utc_seconds
+
+
+def test_log_level_parsing():
+    import logging
+
+    from kafka_topic_analyzer_tpu.utils.log import parse_level
+
+    assert parse_level("debug") == logging.DEBUG
+    assert parse_level("warn") == logging.WARNING
+    assert parse_level("module=debug,info") == logging.INFO  # first bare seg
+    assert parse_level("nonsense") == logging.ERROR          # fallback
+    assert parse_level("trace") == logging.DEBUG
+    assert parse_level("off") == logging.CRITICAL
+
+
+def test_spinner_disabled_writes_nothing(capsys):
+    sp = Spinner(enabled=False)
+    sp.set_message("x")
+    sp.finish_with_message("done")
+    assert capsys.readouterr().err == ""
+
+
+def test_assign_partitions_round_robin():
+    assert assign_partitions([3, 1, 2, 0, 5], 2) == [[0, 2, 5], [1, 3]]
+    assert assign_partitions([0], 4) == [[0], [], [], []]
+
+
+def test_scan_profile_counters():
+    prof = ScanProfile()
+    with prof.stage("x", items=10):
+        pass
+    with prof.stage("x", items=5):
+        pass
+    st = prof.stages["x"]
+    assert st.items == 15
+    assert st.items_per_sec > 0
+    assert "x: " in prof.summary()
+
+
+def test_timefmt_chrono_display():
+    assert format_utc_seconds(0) == "1970-01-01 00:00:00 UTC"
+    assert format_utc_seconds(1_600_000_000) == "2020-09-13 12:26:40 UTC"
+
+
+def test_soak_pipeline(monkeypatch):
+    """Bounded soak: a few million records through the full engine with
+    prefetch, gated so default suite runs stay fast."""
+    import os
+
+    if not os.environ.get("KTA_STRESS"):
+        pytest.skip("set KTA_STRESS=1 for the soak run")
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+    spec = SyntheticSpec(
+        num_partitions=8, messages_per_partition=500_000, keys_per_partition=50_000
+    )
+    cfg = AnalyzerConfig(
+        num_partitions=8, batch_size=1 << 17, count_alive_keys=True,
+        alive_bitmap_bits=24, enable_hll=True, enable_quantiles=True,
+    )
+    m = run_scan(
+        "soak", SyntheticSource(spec), TpuBackend(cfg, init_now_s=0), 1 << 17
+    ).metrics
+    assert m.overall_count == 4_000_000
