@@ -68,9 +68,11 @@ class CpuExactBackend(MetricBackend):
         if config.count_alive_keys:
             nwords = 1 << max(config.alive_bitmap_bits - 5, 0)
             self._alive_words = np.zeros(nwords, dtype=np.uint32)
-        # Exact distinct-alive/ever-seen key tracking by 64-bit hash identity
-        # (referee for the HLL sketch; collision probability ~2^-64).
-        self._seen_keys: "set[int]" = set()
+        # Exact distinct-key tracking by 64-bit hash identity, one set per
+        # partition (referee for the HLL sketch and its per-partition rows;
+        # collision probability ~2^-64).  Global distinct = |union| — the
+        # same key CAN appear in several partitions in arbitrary streams.
+        self._seen_keys: "list[set[int]]" = [set() for _ in range(p)]
         # Exact message sizes histogram referee for quantiles, keyed by
         # (partition << 32 | size) so per-partition summaries are exact too.
         self._size_counts: Dict[int, int] = {}
@@ -117,7 +119,9 @@ class CpuExactBackend(MetricBackend):
 
         keyed = valid & ~batch.key_null
         if keyed.any():
-            self._seen_keys.update(batch.key_hash64[keyed].tolist())
+            for pid in np.unique(part[keyed]):
+                sel = keyed & (part == pid)
+                self._seen_keys[int(pid)].update(batch.key_hash64[sel].tolist())
             if self._alive_words is not None:
                 self._update_alive_bitmap(
                     batch.key_hash32[keyed], vn[keyed]
@@ -193,10 +197,17 @@ class CpuExactBackend(MetricBackend):
             overall_size=self.overall_size,
             overall_count=self.overall_count,
             alive_keys=alive_keys,
-            # Report the exact distinct count only when distinct-key counting
-            # was requested, so cpu/tpu reports stay line-compatible.
+            # Report the exact distinct counts only when distinct-key
+            # counting was requested, so cpu/tpu reports stay line-compatible.
             distinct_keys_exact=(
-                len(self._seen_keys) if self.config.enable_hll else None
+                len(set().union(*self._seen_keys))
+                if self.config.enable_hll
+                else None
+            ),
+            distinct_keys_exact_per_partition=(
+                [len(s) for s in self._seen_keys]
+                if self.config.distinct_keys_per_partition
+                else None
             ),
             quantiles=quantiles,
             quantiles_per_partition=quantiles_pp,

@@ -41,8 +41,14 @@ def metrics_from_state(state, config: AnalyzerConfig, init_now_s: int) -> TopicM
         words = np.asarray(state.alive.words)
         alive_keys = int(np.bitwise_count(words).sum())
     hll = None
+    hll_pp = None
     if state.hll is not None:
-        hll = hll_estimate(np.asarray(state.hll.regs))
+        regs = np.asarray(state.hll.regs)
+        # Global estimate from the union of rows (elementwise max is the HLL
+        # merge); per-partition estimates from each row.
+        hll = hll_estimate(regs.max(axis=0))
+        if config.distinct_keys_per_partition:
+            hll_pp = [hll_estimate(regs[r]) for r in range(regs.shape[0])]
     quantiles = None
     quantiles_pp = None
     if state.quantiles is not None:
@@ -73,6 +79,7 @@ def metrics_from_state(state, config: AnalyzerConfig, init_now_s: int) -> TopicM
         overall_count=int(m.overall_count),
         alive_keys=alive_keys,
         distinct_keys_hll=hll,
+        distinct_keys_hll_per_partition=hll_pp,
         quantiles=quantiles,
         quantiles_per_partition=quantiles_pp,
         per_partition_extremes=extremes,

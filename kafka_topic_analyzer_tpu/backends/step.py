@@ -97,7 +97,16 @@ def analyzer_step(
 
     hll_state = state.hll
     if hll_state is not None:
-        regs = hll_apply(hll_state.regs, arrays["hll_idx"], arrays["hll_rho"])
+        regs = hll_apply(
+            hll_state.regs,
+            arrays["hll_idx"],
+            arrays["hll_rho"],
+            partition=(
+                arrays["partition"]
+                if config.distinct_keys_per_partition
+                else None
+            ),
+        )
         hll_state = HLLState(regs=regs)
 
     q_state = state.quantiles

@@ -90,6 +90,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="log2 of alive-key bitmap slots (32 = reference-exact)")
     p.add_argument("--distinct-keys", action="store_true",
                    help="Also estimate distinct keys with a HyperLogLog sketch")
+    p.add_argument("--distinct-keys-per-partition", action="store_true",
+                   help="Track one HLL register file per partition "
+                        "(implies --distinct-keys)")
     p.add_argument("--quantiles", action="store_true",
                    help="Also compute message-size quantiles (DDSketch)")
     p.add_argument("--quantiles-per-partition", action="store_true",
@@ -232,6 +235,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             count_alive_keys=args.count_alive_keys,
             alive_bitmap_bits=args.alive_bitmap_bits,
             enable_hll=args.distinct_keys,
+            distinct_keys_per_partition=args.distinct_keys_per_partition,
             enable_quantiles=args.quantiles,
             quantiles_per_partition=args.quantiles_per_partition,
             mesh_shape=mesh_shape,
@@ -390,6 +394,7 @@ def _run(args) -> int:
             count_alive_keys=args.count_alive_keys,
             alive_bitmap_bits=args.alive_bitmap_bits,
             enable_hll=args.distinct_keys,
+            distinct_keys_per_partition=args.distinct_keys_per_partition,
             enable_quantiles=args.quantiles,
             quantiles_per_partition=args.quantiles_per_partition,
             mesh_shape=mesh_shape,

@@ -43,6 +43,10 @@ class AnalyzerConfig:
     #: HLL precision p (m = 2^p registers). p=14 → 0.81% standard error.
     #: Capped at 15 so bucket indices fit the packed transfer's u16 section.
     hll_p: int = 14
+    #: One register file per partition instead of a single global one
+    #: (implies enable_hll).  The global estimate stays exact HLL semantics:
+    #: rows union by elementwise max.
+    distinct_keys_per_partition: bool = False
     #: DDSketch message-size quantiles (new capability).
     enable_quantiles: bool = False
     #: Track one sketch row per partition instead of a single global one
@@ -78,6 +82,8 @@ class AnalyzerConfig:
             # Per-partition sketches imply the feature (frozen dataclass, so
             # normalize via object.__setattr__).
             object.__setattr__(self, "enable_quantiles", True)
+        if self.distinct_keys_per_partition and not self.enable_hll:
+            object.__setattr__(self, "enable_hll", True)
         if self.num_partitions < 1:
             raise ValueError("num_partitions must be >= 1")
         if self.batch_size < 1:

@@ -39,11 +39,14 @@ class AliveBitmapState:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class HLLState:
-    regs: jax.Array  # int32[2^p]
+    regs: jax.Array  # int32[R, 2^p]; R = P or 1
 
     @classmethod
     def init(cls, config: AnalyzerConfig) -> "HLLState":
-        return cls(regs=jnp.zeros((config.hll_m,), dtype=jnp.int32))
+        rows = (
+            config.num_partitions if config.distinct_keys_per_partition else 1
+        )
+        return cls(regs=jnp.zeros((rows, config.hll_m), dtype=jnp.int32))
 
     def merge(self, other: "HLLState") -> "HLLState":
         return HLLState(regs=jnp.maximum(self.regs, other.regs))

@@ -31,9 +31,10 @@ def _splitmix64_jnp(x):
     return x ^ (x >> jnp.uint64(31))
 
 
-def hll_update(regs, key_hash64, active, p: int):
-    """Scatter-max one batch of 64-bit key hashes into ``int32[2^p]`` regs."""
-    m = 1 << p
+def hll_split(key_hash64, active, p: int):
+    """All-device (bucket index, rho) derivation from 64-bit hashes — the
+    on-device twin of packing.py::hll_idx_rho_numpy, for callers that skip
+    host pre-reduction.  Feed the result to `hll_apply`."""
     h = _splitmix64_jnp(key_hash64)
     idx = (h >> (64 - p)).astype(jnp.int32)
     rest = h << p
@@ -43,17 +44,22 @@ def hll_update(regs, key_hash64, active, p: int):
         jnp.int32(64 - p + 1),
         lax.clz(rest).astype(jnp.int32) + 1,
     )
-    idx = jnp.where(active, idx, m)  # scratch register for masked records
-    scratch = jnp.zeros((m + 1,), dtype=jnp.int32)
-    delta = scratch.at[idx].max(rho)[:m]
-    return jnp.maximum(regs, delta)
+    rho = jnp.where(active, rho, 0)  # rho 0 is a no-op under scatter-max
+    return idx, rho
 
 
-def hll_apply(regs, idx, rho):
+def hll_apply(regs, idx, rho, partition=None):
     """Apply host pre-split HLL updates (packing.py::hll_idx_rho_numpy):
-    one scatter-max of rho into the register file.  Masked records carry
-    rho=0, which is a no-op under max."""
-    return regs.at[idx.astype(jnp.int32)].max(rho.astype(jnp.int32))
+    one scatter-max of rho into the register file ``int32[R, m]``.  With
+    ``partition`` given, each record updates its partition's row (R = P);
+    otherwise the single global row.  Masked records carry rho=0, a no-op
+    under max."""
+    rows, m = regs.shape
+    row = partition if partition is not None else jnp.int32(0)
+    flat = row * m + idx.astype(jnp.int32)
+    return (
+        regs.reshape(-1).at[flat].max(rho.astype(jnp.int32)).reshape(rows, m)
+    )
 
 
 def hll_merge(regs_a, regs_b):

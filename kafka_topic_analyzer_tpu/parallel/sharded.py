@@ -91,7 +91,8 @@ def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
     if config.enable_hll:
         from kafka_topic_analyzer_tpu.models.compaction import HLLState
 
-        hll = HLLState(regs=np.zeros((d, config.hll_m), np.int32))
+        rows = config.num_partitions if config.distinct_keys_per_partition else 1
+        hll = HLLState(regs=np.zeros((d, rows, config.hll_m), np.int32))
     quantiles = None
     if config.enable_quantiles:
         from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState

@@ -114,6 +114,12 @@ def _render_extensions(metrics: TopicMetrics) -> str:
         lines.append(f"Distinct keys (HLL est.): {round(metrics.distinct_keys_hll)}")
     if metrics.distinct_keys_exact is not None:
         lines.append(f"Distinct keys (exact): {metrics.distinct_keys_exact}")
+    if metrics.distinct_keys_hll_per_partition is not None:
+        for p, est in zip(metrics.partitions, metrics.distinct_keys_hll_per_partition):
+            lines.append(f"  partition {p} distinct keys (HLL est.): {round(est)}")
+    if metrics.distinct_keys_exact_per_partition is not None:
+        for p, n in zip(metrics.partitions, metrics.distinct_keys_exact_per_partition):
+            lines.append(f"  partition {p} distinct keys (exact): {n}")
     if metrics.quantiles is not None:
         qs = " ".join(
             f"p{int(p * 100)}={v:.0f}B" for p, v in zip(metrics.quantiles.probs, metrics.quantiles.values)

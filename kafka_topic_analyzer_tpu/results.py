@@ -62,6 +62,10 @@ class TopicMetrics:
     alive_keys: Optional[int] = None
     #: HLL estimate of distinct keys ever seen (new capability).
     distinct_keys_hll: Optional[float] = None
+    #: Per-partition HLL estimates, one per `partitions` row.
+    distinct_keys_hll_per_partition: "Optional[list[float]]" = None
+    #: Per-partition exact distinct counts (CPU oracle referee).
+    distinct_keys_exact_per_partition: "Optional[list[int]]" = None
     #: Exact distinct keys (CPU oracle only; referee for the HLL claim).
     distinct_keys_exact: Optional[int] = None
     #: Message-size quantiles (new capability).
@@ -192,6 +196,18 @@ class TopicMetrics:
             out["distinct_keys_hll"] = self.distinct_keys_hll
         if self.distinct_keys_exact is not None:
             out["distinct_keys_exact"] = self.distinct_keys_exact
+        if self.distinct_keys_hll_per_partition is not None:
+            out["distinct_keys_hll_per_partition"] = {
+                str(p): est
+                for p, est in zip(self.partitions, self.distinct_keys_hll_per_partition)
+            }
+        if self.distinct_keys_exact_per_partition is not None:
+            out["distinct_keys_exact_per_partition"] = {
+                str(p): n
+                for p, n in zip(
+                    self.partitions, self.distinct_keys_exact_per_partition
+                )
+            }
         if self.quantiles is not None:
             out["size_quantiles"] = self.quantiles.as_dict()
         if self.quantiles_per_partition is not None:
@@ -267,6 +283,12 @@ def slice_rows(
     if metrics.quantiles_per_partition is not None:
         # Per-partition sketches are per-row state — sliceable like extremes.
         qpp = [metrics.quantiles_per_partition[r] for r in rows]
+    hpp = None
+    if metrics.distinct_keys_hll_per_partition is not None:
+        hpp = [metrics.distinct_keys_hll_per_partition[r] for r in rows]
+    epp = None
+    if metrics.distinct_keys_exact_per_partition is not None:
+        epp = [metrics.distinct_keys_exact_per_partition[r] for r in rows]
     return TopicMetrics(
         partitions=list(partition_ids),
         per_partition=per,
@@ -277,6 +299,8 @@ def slice_rows(
         overall_size=overall_size,
         overall_count=overall_count,
         quantiles_per_partition=qpp,
+        distinct_keys_hll_per_partition=hpp,
+        distinct_keys_exact_per_partition=epp,
         per_partition_extremes=ext,
         init_now_s=metrics.init_now_s,
     )

@@ -30,3 +30,39 @@ def test_depth_zero_is_passthrough():
 def test_tuple_items_not_mistaken_for_errors():
     items = [("__error__", ValueError("x")), ("a", "b")]
     assert list(PrefetchIterator(iter(items), depth=1)) == items
+
+
+def test_no_thread_leak_after_scans():
+    """Engine scans — completed AND crashed — must not leak prefetch worker
+    threads (the close-on-exit contract)."""
+    import threading
+    import time
+
+    import pytest
+
+    from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+    spec = SyntheticSpec(num_partitions=3, messages_per_partition=2000)
+    cfg = AnalyzerConfig(num_partitions=3, batch_size=256)
+
+    class Boom(Exception):
+        pass
+
+    class Crashy(SyntheticSource):
+        def batches(self, *a, **k):
+            yield from list(super().batches(*a, **k))[:2]
+            raise Boom()
+
+    before = threading.active_count()
+    for _ in range(3):
+        run_scan("t", SyntheticSource(spec), CpuExactBackend(cfg, init_now_s=0), 256)
+        with pytest.raises(Boom):
+            run_scan("t", Crashy(spec), CpuExactBackend(cfg, init_now_s=0), 256)
+    # Workers terminate via the cancel event; give them a beat.
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before

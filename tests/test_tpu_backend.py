@@ -88,6 +88,23 @@ def test_ddsketch_within_alpha():
         assert q_sketch == pytest.approx(q_exact, rel=0.011)  # 2*alpha + rank slack
 
 
+def test_per_partition_hll_within_budget():
+    cfg = AnalyzerConfig(
+        num_partitions=3, batch_size=2048,
+        distinct_keys_per_partition=True, hll_p=12,
+    )
+    m_cpu, m_tpu = run_both(cfg)
+    assert m_cpu.distinct_keys_exact_per_partition == [400, 400, 400]
+    assert len(m_tpu.distinct_keys_hll_per_partition) == 3
+    for exact, est in zip(
+        m_cpu.distinct_keys_exact_per_partition,
+        m_tpu.distinct_keys_hll_per_partition,
+    ):
+        assert est == pytest.approx(exact, rel=0.1)  # p=12 → ~1.6% σ
+    # Global line = union of rows (partition-disjoint keys → 1200).
+    assert m_tpu.distinct_keys_hll == pytest.approx(1200, rel=0.1)
+
+
 def test_per_partition_quantiles_within_alpha():
     cfg = AnalyzerConfig(
         num_partitions=3, batch_size=2048, enable_quantiles=True,
