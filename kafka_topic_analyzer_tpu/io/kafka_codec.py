@@ -524,13 +524,22 @@ def _make_crc32c_table():
 _CRC32C_TABLE = _make_crc32c_table()
 
 
-def decode_record_batches(
-    buf: bytes, verify_crc: bool = False
-) -> Iterator[Tuple[int, RecordTuple]]:
-    """Yield (absolute_offset, (timestamp_ms, key, value)) for every record.
+@dataclasses.dataclass
+class BatchFrame:
+    """One parsed RecordBatch v2 frame with its payload decompressed —
+    the shared input of the per-record Python generator and the native
+    array decoder (io/native.py::decode_records_native)."""
 
-    Tolerates a trailing partial batch (brokers may truncate at max_bytes).
-    """
+    base_offset: int
+    first_ts: int
+    num_records: int
+    payload: bytes
+
+
+def iter_batch_frames(buf: bytes, verify_crc: bool = False) -> Iterator[BatchFrame]:
+    """Parse batch headers (CRC check, decompression) without touching
+    records.  Tolerates a trailing partial batch (brokers may truncate at
+    max_bytes)."""
     pos = 0
     n = len(buf)
     while pos + 17 <= n:  # base_offset + batch_length + leader_epoch + magic
@@ -555,6 +564,10 @@ def decode_record_batches(
         r.i16()  # producer epoch
         r.i32()  # base sequence
         num_records = r.i32()
+        if num_records < 0:
+            raise KafkaProtocolError(
+                f"negative record count at offset {base_offset}"
+            )
         payload = buf[r.pos : end]
         if verify_crc and _crc32c(buf[crc_start:end]) != crc:
             raise KafkaProtocolError(f"record batch CRC mismatch at offset {base_offset}")
@@ -570,26 +583,41 @@ def decode_record_batches(
                 raise KafkaProtocolError(
                     f"record batch at offset {base_offset}: {e}"
                 ) from e
-        rr = ByteReader(payload)
-        for _ in range(num_records):
-            length = rr.varint()
-            rec_end = rr.pos + length
-            # A negative declared length would walk the reader backwards
-            # (negative positions slice "successfully" in Python).
-            if length < 0 or rec_end > len(payload):
-                raise KafkaProtocolError(
-                    f"record length {length} out of range at offset {base_offset}"
-                )
-            rr.i8()  # attributes
-            ts_delta = rr.varint()
-            off_delta = rr.varint()
-            key = rr.varbytes()
-            value = rr.varbytes()
-            nheaders = rr.varint()
-            for _ in range(nheaders):
-                hk = rr.varbytes()
-                rr.varbytes()
-                del hk
-            rr.pos = rec_end  # tolerate unknown trailing record fields
-            yield base_offset + off_delta, (first_ts + ts_delta, key, value)
+        yield BatchFrame(base_offset, first_ts, num_records, payload)
         pos = end
+
+
+def decode_frame_records(frame: BatchFrame) -> Iterator[Tuple[int, RecordTuple]]:
+    """Per-record Python decode of one frame (reference implementation; the
+    hot path uses the native array decoder)."""
+    payload = frame.payload
+    rr = ByteReader(payload)
+    for _ in range(frame.num_records):
+        length = rr.varint()
+        rec_end = rr.pos + length
+        # A negative declared length would walk the reader backwards
+        # (negative positions slice "successfully" in Python).
+        if length < 0 or rec_end > len(payload):
+            raise KafkaProtocolError(
+                f"record length {length} out of range at offset {frame.base_offset}"
+            )
+        rr.i8()  # attributes
+        ts_delta = rr.varint()
+        off_delta = rr.varint()
+        key = rr.varbytes()
+        value = rr.varbytes()
+        nheaders = rr.varint()
+        for _ in range(nheaders):
+            hk = rr.varbytes()
+            rr.varbytes()
+            del hk
+        rr.pos = rec_end  # tolerate unknown trailing record fields
+        yield frame.base_offset + off_delta, (frame.first_ts + ts_delta, key, value)
+
+
+def decode_record_batches(
+    buf: bytes, verify_crc: bool = False
+) -> Iterator[Tuple[int, RecordTuple]]:
+    """Yield (absolute_offset, (timestamp_ms, key, value)) for every record."""
+    for frame in iter_batch_frames(buf, verify_crc):
+        yield from decode_frame_records(frame)

@@ -336,24 +336,46 @@ class KafkaWireSource(RecordSource):
                     next_offset[p] = max(next_offset[p], start_at[p])
         remaining = {p for p in parts if next_offset[p] < end[p]}
 
-        pend: List[Tuple[int, int, int, Optional[bytes], Optional[bytes]]] = []
-        # (partition, offset, ts_ms, key, value) accumulator flushed as
-        # RecordBatches (offsets ride along for snapshot resume).
+        # Accumulate RecordBatch *chunks* (one per accepted wire frame) and
+        # re-split to batch_size at flush; offsets ride along for snapshot
+        # resume.  Chunks come from the native frame decoder when available
+        # (the Python per-record generator is ~100x slower).
+        pend: List[RecordBatch] = []
+        pend_count = 0
 
         def flush(force: bool) -> Iterator[RecordBatch]:
-            while len(pend) >= batch_size or (force and pend):
-                chunk = pend[:batch_size]
-                del pend[:batch_size]
-                batch = records_to_batch(
-                    [(p, ts, k, v) for p, _off, ts, k, v in chunk],
-                    use_native=self.use_native_hashing,
+            nonlocal pend, pend_count
+            if not (pend_count >= batch_size or (force and pend_count)):
+                return
+            # Concat ONCE, yield consecutive slices, keep one remainder —
+            # re-concatenating per yielded batch would be O(R^2) copying.
+            full = RecordBatch.concat(pend)
+            lo = 0
+            while len(full) - lo >= batch_size or (force and lo < len(full)):
+                hi = min(lo + batch_size, len(full))
+                yield full.take(np.arange(lo, hi))
+                lo = hi
+            rest = full.take(np.arange(lo, len(full)))
+            pend = [rest] if len(rest) else []
+            pend_count = len(rest)
+
+        def push_chunk(chunk: RecordBatch) -> None:
+            nonlocal pend_count
+            if len(chunk):
+                pend.append(chunk)
+                pend_count += len(chunk)
+
+        use_native_decode = self.use_native_hashing
+        if use_native_decode:
+            try:
+                from kafka_topic_analyzer_tpu.io.native import (
+                    decode_records_native,
+                    native_available,
                 )
-                batch.offsets = np.fromiter(
-                    (off for _p, off, _ts, _k, _v in chunk),
-                    dtype=np.int64,
-                    count=len(chunk),
-                )
-                yield batch
+
+                use_native_decode = native_available()
+            except ImportError:
+                use_native_decode = False
 
         import time
 
@@ -407,18 +429,51 @@ class KafkaWireSource(RecordSource):
                     error_streak[p] = 0
                     consumed = 0
                     decoded = 0
-                    for off, (ts_ms, key, value) in kc.decode_record_batches(
+                    for frame in kc.iter_batch_frames(
                         fp.records, verify_crc=self.verify_crc
                     ):
-                        decoded += 1
-                        if off < next_offset[p]:
-                            continue  # compressed batches can start earlier
-                        if off >= end[p]:
-                            break
-                        pend.append((p, off, ts_ms, key, value))
-                        next_offset[p] = off + 1
-                        consumed += 1
-                        progressed = True
+                        chunk = (
+                            decode_records_native(frame)
+                            if use_native_decode
+                            else None
+                        )
+                        if chunk is not None:
+                            decoded += frame.num_records
+                            offs = chunk["offsets"]
+                            # Keep records in [next_offset, end): compressed
+                            # batches can start earlier; records past the
+                            # snapshot watermark are out of scope.
+                            mask = (offs >= next_offset[p]) & (offs < end[p])
+                            cnt = int(np.count_nonzero(mask))
+                            if cnt:
+                                push_chunk(_chunk_to_batch(chunk, mask, p))
+                                next_offset[p] = int(offs[mask][-1]) + 1
+                                consumed += cnt
+                                progressed = True
+                            continue
+                        # Python fallback (no shim, or malformed frame — the
+                        # reference decoder raises the precise error).
+                        rows = []
+                        row_offs = []
+                        for off, (ts_ms, key, value) in kc.decode_frame_records(
+                            frame
+                        ):
+                            decoded += 1
+                            if off < next_offset[p]:
+                                continue
+                            if off >= end[p]:
+                                break
+                            rows.append((p, ts_ms, key, value))
+                            row_offs.append(off)
+                            next_offset[p] = off + 1
+                            consumed += 1
+                            progressed = True
+                        if rows:
+                            batch = records_to_batch(
+                                rows, use_native=self.use_native_hashing
+                            )
+                            batch.offsets = np.array(row_offs, dtype=np.int64)
+                            push_chunk(batch)
                     if consumed == 0 and next_offset[p] < end[p]:
                         if fp.records and decoded == 0:
                             # A batch larger than partition_max_bytes came
@@ -450,6 +505,30 @@ class KafkaWireSource(RecordSource):
         self, rows: List[Tuple[int, int, Optional[bytes], Optional[bytes]]]
     ) -> RecordBatch:
         return records_to_batch(rows, use_native=self.use_native_hashing)
+
+
+def _chunk_to_batch(chunk: "dict[str, np.ndarray]", mask: np.ndarray, partition: int) -> RecordBatch:
+    """Native-decoded SoA frame (io/native.py::decode_records_native) →
+    RecordBatch for the masked records."""
+    idx = np.nonzero(mask)[0]
+    n = len(idx)
+    ts_ms = chunk["ts_ms"][idx]
+    # Missing timestamps (-1) report as 0 ms (``to_millis().unwrap_or(0)``,
+    # src/metric.rs:209) — matching records_to_batch.
+    ts_ms = np.where(ts_ms < 0, 0, ts_ms)
+    batch = RecordBatch(
+        partition=np.full(n, partition, dtype=np.int32),
+        key_len=chunk["key_len"][idx],
+        value_len=chunk["value_len"][idx],
+        key_null=chunk["key_null"][idx].astype(np.bool_),
+        value_null=chunk["value_null"][idx].astype(np.bool_),
+        ts_s=ts_ms // 1000,
+        key_hash32=chunk["key_hash32"][idx],
+        key_hash64=chunk["key_hash64"][idx],
+        valid=np.ones(n, dtype=np.bool_),
+    )
+    batch.offsets = chunk["offsets"][idx].copy()
+    return batch
 
 
 def records_to_batch(

@@ -86,7 +86,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 4; }
+int32_t kta_version() { return 5; }
 
 // Last-writer-wins dedupe of alive-bitmap updates for one batch
 // (the host half of the packed transfer's pre-reduction; see
@@ -227,7 +227,108 @@ int32_t kta_hash_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
   return 0;
 }
 
+// Kafka RecordBatch v2 record decoding: parse one decompressed batch
+// payload into fixed-width SoA columns, hashing key bytes inline — the hot
+// half of the wire client (the Python per-record generator measures ~225k
+// records/s; this decodes at tens of millions).  The caller (io/native.py /
+// kafka_codec.iter_batch_frames) has already handled framing, CRC and
+// decompression.  Returns the number of records decoded, or -1 on malformed
+// input (caller falls back to the Python decoder for a precise error).
+int64_t kta_decode_records(const uint8_t* payload, int64_t payload_len,
+                           int32_t num_records, int64_t base_offset,
+                           int64_t first_ts_ms,
+                           int64_t* offsets_out, int64_t* ts_ms_out,
+                           int32_t* key_len_out, int32_t* value_len_out,
+                           uint8_t* key_null_out, uint8_t* value_null_out,
+                           uint32_t* h32_out, uint64_t* h64_out);
+
 }  // extern "C"
+
+namespace {
+// Zigzag varint over [pos, len); false on truncation/overflow.
+inline bool read_zigzag(const uint8_t* p, int64_t len, int64_t& pos,
+                        int64_t& out) {
+  uint64_t z = 0;
+  int shift = 0;
+  while (pos < len) {
+    const uint8_t b = p[pos++];
+    z |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      out = static_cast<int64_t>(z >> 1) ^ -static_cast<int64_t>(z & 1);
+      return true;
+    }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+}  // namespace
+
+extern "C" int64_t kta_decode_records(
+    const uint8_t* payload, int64_t payload_len, int32_t num_records,
+    int64_t base_offset, int64_t first_ts_ms,
+    int64_t* offsets_out, int64_t* ts_ms_out,
+    int32_t* key_len_out, int32_t* value_len_out,
+    uint8_t* key_null_out, uint8_t* value_null_out,
+    uint32_t* h32_out, uint64_t* h64_out) {
+  if (!payload || payload_len < 0 || num_records < 0) return -1;
+  int64_t pos = 0;
+  for (int32_t i = 0; i < num_records; ++i) {
+    int64_t length;
+    if (!read_zigzag(payload, payload_len, pos, length)) return -1;
+    // Overflow-safe: a hostile 10-byte varint can encode ~2^63 and
+    // `pos + length` would overflow int64 (UB) and bypass the bound.
+    if (length < 0 || length > payload_len - pos) return -1;
+    const int64_t rec_end = pos + length;
+    if (pos >= rec_end) return -1;
+    ++pos;  // record attributes
+    int64_t ts_delta, off_delta, klen, vlen;
+    if (!read_zigzag(payload, rec_end, pos, ts_delta)) return -1;
+    if (!read_zigzag(payload, rec_end, pos, off_delta)) return -1;
+    if (!read_zigzag(payload, rec_end, pos, klen)) return -1;
+    if (klen < 0) {
+      key_null_out[i] = 1;
+      key_len_out[i] = 0;
+      h32_out[i] = 0;
+      h64_out[i] = 0;
+    } else {
+      if (klen > rec_end - pos || klen > 0x7fffffff) return -1;
+      key_null_out[i] = 0;
+      key_len_out[i] = static_cast<int32_t>(klen);
+      h32_out[i] = fnv1a32_ref(payload + pos, klen);
+      h64_out[i] = fnv1a64(payload + pos, klen);
+      pos += klen;
+    }
+    if (!read_zigzag(payload, rec_end, pos, vlen)) return -1;
+    if (vlen < 0) {
+      value_null_out[i] = 1;
+      value_len_out[i] = 0;
+    } else {
+      if (vlen > rec_end - pos || vlen > 0x7fffffff) return -1;
+      value_null_out[i] = 0;
+      value_len_out[i] = static_cast<int32_t>(vlen);
+      pos += vlen;  // value bytes never needed (SURVEY.md §3.4)
+    }
+    int64_t nheaders;
+    if (!read_zigzag(payload, rec_end, pos, nheaders)) return -1;
+    if (nheaders < 0) return -1;
+    for (int64_t h = 0; h < nheaders; ++h) {
+      int64_t hk, hv;
+      if (!read_zigzag(payload, rec_end, pos, hk)) return -1;
+      if (hk < 0 || hk > rec_end - pos) return -1;
+      pos += hk;
+      if (!read_zigzag(payload, rec_end, pos, hv)) return -1;
+      if (hv > 0) {
+        if (hv > rec_end - pos) return -1;
+        pos += hv;
+      }
+    }
+    offsets_out[i] = base_offset + off_delta;
+    ts_ms_out[i] = first_ts_ms + ts_delta;
+    pos = rec_end;  // tolerate unknown trailing record fields
+  }
+  return num_records;
+}
 
 // Fused batch packing: RecordBatch SoA columns -> wire-format-v1 buffer
 // (kafka_topic_analyzer_tpu/packing.py), including the host pre-reductions

@@ -5,6 +5,7 @@ configurable per-partition records — the cluster-free integration seam
 
 from __future__ import annotations
 
+import bisect
 import socket
 import struct
 import threading
@@ -47,6 +48,20 @@ class FakeBroker:
             p: (rs[-1][0] + 1 if rs else self.start_offsets[p])
             for p, rs in self.records.items()
         }
+        # Pre-encode each partition's records into fetch-sized record sets at
+        # startup: encoding per fetch in pure Python made the broker ~100x
+        # slower than the client it exists to test.
+        self._chunks: Dict[int, "list[tuple[int, int, bytes]]"] = {}
+        self._chunk_last_offsets: Dict[int, "list[int]"] = {}
+        for p, rs in self.records.items():
+            chunks = []
+            for lo in range(0, len(rs), max_records_per_fetch):
+                part = rs[lo : lo + max_records_per_fetch]
+                chunks.append(
+                    (part[0][0], part[-1][0], kc.encode_record_batch(part, compression))
+                )
+            self._chunks[p] = chunks
+            self._chunk_last_offsets[p] = [c[1] for c in chunks]
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind(("127.0.0.1", 0))
@@ -197,13 +212,12 @@ class FakeBroker:
                     out.append((pid, kc.ERR_NOT_LEADER_FOR_PARTITION, -1, b""))
                     continue
                 hw = self.end_offsets[pid]
-                selected = [rec for rec in rs if rec[0] >= fetch_offset]
-                selected = selected[: self.max_records_per_fetch]
-                record_set = (
-                    kc.encode_record_batch(selected, self.compression)
-                    if selected
-                    else b""
-                )
+                # First pre-encoded chunk whose last offset reaches the fetch
+                # position (it may start earlier; clients filter by offset,
+                # exactly as with real compacted batches).
+                chunks = self._chunks[pid]
+                i = bisect.bisect_left(self._chunk_last_offsets[pid], fetch_offset)
+                record_set = chunks[i][2] if i < len(chunks) else b""
                 out.append((pid, 0, hw, record_set))
             return kc.encode_fetch_response(self.topic, out)
         raise AssertionError(f"fake broker: unsupported api {api_key}")
