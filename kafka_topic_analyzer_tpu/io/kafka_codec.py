@@ -137,7 +137,11 @@ class ByteReader:
         n = self.i16()
         if n < 0:
             return None
-        return self._take(n).decode()
+        try:
+            return self._take(n).decode()
+        except UnicodeDecodeError as e:
+            # Untrusted wire input must not leak UnicodeDecodeError.
+            raise KafkaProtocolError(f"invalid UTF-8 string on the wire: {e}") from e
 
     def bytes_(self) -> Optional[bytes]:
         n = self.i32()

@@ -168,6 +168,41 @@ def test_decompressors_total_on_garbage(prefix, data, codec):
             pass
 
 
+@settings(max_examples=150, deadline=None)
+@given(st.binary(max_size=200))
+def test_response_decoders_total_on_garbage(buf):
+    """Broker responses are untrusted input: every response decoder must
+    raise only KafkaProtocolError on garbage framing (the fetch loop's
+    error handling depends on it)."""
+    for decoder in (
+        kc.decode_metadata_response,
+        kc.decode_list_offsets_response,
+        kc.decode_fetch_response,
+        kc.decode_api_versions_response,
+    ):
+        try:
+            decoder(kc.ByteReader(buf))
+        except kc.KafkaProtocolError:
+            pass
+        except MemoryError:
+            raise AssertionError("decoder allocated unbounded memory")
+
+
+def test_invalid_utf8_string_is_protocol_error():
+    """Regression: a broker host string with invalid UTF-8 must surface as
+    KafkaProtocolError, not UnicodeDecodeError (found by a directed probe
+    the random fuzz missed)."""
+    import pytest
+
+    w = kc.ByteWriter()
+    w.i32(1).i32(0)            # one broker, node_id 0
+    w.i16(2).raw(b"\xff\xfe")  # host: invalid UTF-8
+    w.i32(9092).string(None)   # port, rack
+    w.i32(0).i32(0)            # controller, topics
+    with pytest.raises(kc.KafkaProtocolError, match="UTF-8"):
+        kc.decode_metadata_response(kc.ByteReader(w.done()))
+
+
 @settings(max_examples=30, deadline=None)
 @given(st.lists(
     st.tuples(st.integers(0, 255), st.booleans(), st.booleans()),
