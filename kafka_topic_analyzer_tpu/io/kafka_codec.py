@@ -9,7 +9,8 @@ fake broker use these encoders/decoders, mirroring SURVEY.md §4's
 backend-contract strategy.
 
 Implemented versions (classic encoding, no flexible/tagged fields):
-- Metadata v1, ListOffsets v1, Fetch v4, ApiVersions v0
+- Metadata v1–v5 (v5 is the Kafka 4.0 floor after KIP-896; the client
+  negotiates via ApiVersions v0), ListOffsets v1, Fetch v4
 - RecordBatch v2 ("magic 2", Kafka >= 0.11) with zigzag-varint records;
   compression: none, gzip (zlib), snappy (xerial framing) and LZ4 frames
   via io/compression.py; zstd is rejected with a clear error.  v0/v1
@@ -197,10 +198,13 @@ def decode_request_header(buf: bytes) -> Tuple[int, int, int, Optional[str], Byt
 
 
 # ---------------------------------------------------------------------------
-# Metadata v1
+# Metadata v1 / v5 (classic encoding; v5 is the floor on Kafka 4.0 brokers
+# after KIP-896 removed pre-2.1 protocol versions)
 
 
-def encode_metadata_request(topics: Optional[List[str]]) -> bytes:
+def encode_metadata_request(
+    topics: Optional[List[str]], version: int = 1
+) -> bytes:
     w = ByteWriter()
     if topics is None:
         w.i32(-1)
@@ -208,6 +212,8 @@ def encode_metadata_request(topics: Optional[List[str]]) -> bytes:
         w.i32(len(topics))
         for t in topics:
             w.string(t)
+    if version >= 4:
+        w.i8(0)  # allow_auto_topic_creation = false (read-only tool)
     return w.done()
 
 
@@ -232,11 +238,15 @@ class MetadataResponse:
     topics: List[TopicMetadata]
 
 
-def encode_metadata_response(resp: MetadataResponse) -> bytes:
+def encode_metadata_response(resp: MetadataResponse, version: int = 1) -> bytes:
     w = ByteWriter()
+    if version >= 3:
+        w.i32(0)  # throttle_time_ms
     w.i32(len(resp.brokers))
     for node_id, (host, port) in resp.brokers.items():
         w.i32(node_id).string(host).i32(port).string(None)  # rack
+    if version >= 2:
+        w.string(None)  # cluster_id
     w.i32(resp.controller_id)
     w.i32(len(resp.topics))
     for t in resp.topics:
@@ -246,10 +256,14 @@ def encode_metadata_response(resp: MetadataResponse) -> bytes:
             w.i16(p.error).i32(p.partition).i32(p.leader)
             w.i32(1).i32(p.leader)  # replicas
             w.i32(1).i32(p.leader)  # isr
+            if version >= 5:
+                w.i32(0)  # offline_replicas: empty
     return w.done()
 
 
-def decode_metadata_response(r: ByteReader) -> MetadataResponse:
+def decode_metadata_response(r: ByteReader, version: int = 1) -> MetadataResponse:
+    if version >= 3:
+        r.i32()  # throttle_time_ms
     brokers = {}
     for _ in range(r.i32()):
         node_id = r.i32()
@@ -257,6 +271,8 @@ def decode_metadata_response(r: ByteReader) -> MetadataResponse:
         port = r.i32()
         r.string()  # rack
         brokers[node_id] = (host, port)
+    if version >= 2:
+        r.string()  # cluster_id
     controller = r.i32()
     topics = []
     for _ in range(r.i32()):
@@ -272,6 +288,9 @@ def decode_metadata_response(r: ByteReader) -> MetadataResponse:
                 r.i32()  # replicas
             for _ in range(r.i32()):
                 r.i32()  # isr
+            if version >= 5:
+                for _ in range(r.i32()):
+                    r.i32()  # offline_replicas
             parts.append(PartitionMetadata(perr, pid, leader))
         topics.append(TopicMetadata(err, name, parts))
     return MetadataResponse(brokers, controller, topics)
@@ -430,7 +449,12 @@ def decode_api_versions_response(r: ByteReader) -> "dict[int, tuple[int, int]]":
         raise KafkaProtocolError(f"ApiVersions error {err}")
     out = {}
     for _ in range(r.i32()):
-        out[r.i16()] = (r.i16(), r.i16())
+        # Read fields in explicit order: `out[r.i16()] = (r.i16(), r.i16())`
+        # evaluates the RHS before the key and scrambles the triples.
+        api_key = r.i16()
+        vmin = r.i16()
+        vmax = r.i16()
+        out[api_key] = (vmin, vmax)
     return out
 
 

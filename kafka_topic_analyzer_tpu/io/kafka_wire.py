@@ -90,6 +90,8 @@ class BrokerConnection:
         self.sock = sock
         self._corr = 0
         self._lock = threading.Lock()
+        #: ApiVersions handshake result, filled lazily ({} = legacy broker).
+        self.api_versions: "Optional[Dict[int, tuple[int, int]]]" = None
 
     def close(self) -> None:
         try:
@@ -191,6 +193,9 @@ class KafkaWireSource(RecordSource):
         self._bootstrap = parse_bootstrap(bootstrap_servers)
         self._conn_lock = threading.Lock()
         self._conns: Dict[Tuple[str, int], BrokerConnection] = {}
+        #: Hosts that slammed the connection on ApiVersions (pre-0.10): the
+        #: reconnect skips the handshake instead of looping.
+        self._assume_legacy: "set[Tuple[str, int]]" = set()
         self._brokers: Dict[int, Tuple[str, int]] = {}
         self._leaders: Dict[int, int] = {}
         self._watermarks: Optional[Tuple[Dict[int, int], Dict[int, int]]] = None
@@ -230,6 +235,71 @@ class KafkaWireSource(RecordSource):
             conn.close()
         self._conns.clear()
 
+    # -- protocol version negotiation ---------------------------------------
+
+    #: Preferred-first version candidates per API.  Metadata v5 is the floor
+    #: on Kafka 4.0 brokers (KIP-896 removed pre-2.1 versions); v1 keeps
+    #: very old brokers working.  The last entry doubles as the legacy
+    #: default when the broker predates ApiVersions.
+    _CANDIDATES = {
+        kc.API_METADATA: ("Metadata", (5, 1)),
+        kc.API_LIST_OFFSETS: ("ListOffsets", (1,)),
+        kc.API_FETCH: ("Fetch", (4,)),
+    }
+
+    def _evict(self, conn: BrokerConnection) -> None:
+        """Close and forget a connection whose stream may be dead/desynced
+        so the next use reconnects fresh."""
+        conn.close()
+        with self._conn_lock:
+            if self._conns.get((conn.host, conn.port)) is conn:
+                del self._conns[(conn.host, conn.port)]
+
+    def _version(self, conn: BrokerConnection, api_key: int) -> int:
+        if conn.api_versions is None:
+            if (conn.host, conn.port) in self._assume_legacy:
+                conn.api_versions = {}
+            else:
+                try:
+                    r = conn.request(kc.API_VERSIONS, 0, b"")
+                except kc.KafkaProtocolError as e:
+                    # Pre-0.10 brokers slam the connection on the unknown
+                    # request: remember the host as legacy (so the caller's
+                    # retry skips the handshake) and surface the failure —
+                    # the stream is dead either way.
+                    self._evict(conn)
+                    if "closed the connection" in str(e):
+                        self._assume_legacy.add((conn.host, conn.port))
+                    raise
+                except OSError as e:
+                    # Transient socket failure: evict (dead/desynced stream)
+                    # but do NOT guess legacy — the retry re-handshakes.
+                    self._evict(conn)
+                    raise kc.KafkaProtocolError(
+                        f"ApiVersions handshake failed: {e}"
+                    ) from e
+                try:
+                    conn.api_versions = kc.decode_api_versions_response(r)
+                except kc.KafkaProtocolError as e:
+                    # A cleanly-decoded error response (e.g. 35
+                    # UNSUPPORTED_VERSION): genuinely old broker.
+                    log.warning(
+                        "ApiVersions rejected (%s); assuming legacy broker", e
+                    )
+                    conn.api_versions = {}
+        name, candidates = self._CANDIDATES[api_key]
+        ranges = conn.api_versions
+        if not ranges or api_key not in ranges:
+            return candidates[-1]
+        lo, hi = ranges[api_key]
+        for v in candidates:
+            if lo <= v <= hi:
+                return v
+        raise kc.KafkaProtocolError(
+            f"broker supports {name} versions [{lo}, {hi}] but this client "
+            f"implements {sorted(candidates)}"
+        )
+
     # -- topology (src/kafka.rs:60-72) --------------------------------------
 
     def _load_metadata(self, retries: int = 5) -> None:
@@ -238,10 +308,11 @@ class KafkaWireSource(RecordSource):
         last_issue = ""
         for attempt in range(retries):
             conn = self._any_conn()
+            v = self._version(conn, kc.API_METADATA)
             r = conn.request(
-                kc.API_METADATA, 1, kc.encode_metadata_request([self.topic])
+                kc.API_METADATA, v, kc.encode_metadata_request([self.topic], v)
             )
-            md = kc.decode_metadata_response(r)
+            md = kc.decode_metadata_response(r, v)
             self._brokers = md.brokers
             topic_md = next((t for t in md.topics if t.name == self.topic), None)
             if topic_md is None or topic_md.error == kc.ERR_UNKNOWN_TOPIC_OR_PARTITION:
@@ -289,7 +360,7 @@ class KafkaWireSource(RecordSource):
             ):
                 r = conn.request(
                     kc.API_LIST_OFFSETS,
-                    1,
+                    self._version(conn, kc.API_LIST_OFFSETS),
                     kc.encode_list_offsets_request(
                         self.topic, [(p, ts) for p in parts]
                     ),
@@ -307,7 +378,7 @@ class KafkaWireSource(RecordSource):
         conn = self._leader_conn(partition)
         r = conn.request(
             kc.API_LIST_OFFSETS,
-            1,
+            self._version(conn, kc.API_LIST_OFFSETS),
             kc.encode_list_offsets_request(
                 self.topic, [(partition, kc.EARLIEST_TIMESTAMP)]
             ),
@@ -391,7 +462,7 @@ class KafkaWireSource(RecordSource):
                 conn = self._leader_conn(lparts[0])
                 r = conn.request(
                     kc.API_FETCH,
-                    4,
+                    self._version(conn, kc.API_FETCH),
                     kc.encode_fetch_request(
                         self.topic,
                         [(p, next_offset[p]) for p in sorted(lparts)],

@@ -1,7 +1,7 @@
 """In-process fake Kafka broker speaking the same wire protocol as the
-client (Metadata v1 / ListOffsets v1 / Fetch v4 / ApiVersions v0), serving
-configurable per-partition records — the cluster-free integration seam
-(SURVEY.md §4)."""
+client (Metadata v1–v5 / ListOffsets v1 / Fetch v4 / ApiVersions v0 with
+configurable advertised ranges), serving configurable per-partition records
+— the cluster-free integration seam (SURVEY.md §4)."""
 
 from __future__ import annotations
 
@@ -29,10 +29,21 @@ class FakeBroker:
         tls_context=None,
         node_id: int = 0,
         cluster: "Optional[FakeCluster]" = None,
+        api_ranges: "Optional[Dict[int, Tuple[int, int]]]" = None,
+        no_api_versions: bool = False,
     ):
         self.tls_context = tls_context
         self.node_id = node_id
         self.cluster = cluster
+        #: Advertised ApiVersions ranges; default mirrors a modern broker
+        #: (Metadata up to v5) so tests exercise the negotiated v5 path.
+        self.api_ranges = api_ranges or {
+            kc.API_FETCH: (0, 4),
+            kc.API_LIST_OFFSETS: (0, 1),
+            kc.API_METADATA: (0, 5),
+        }
+        #: Pretend to be an ancient broker with no ApiVersions support.
+        self.no_api_versions = no_api_versions
         self.topic = topic
         self.records = {
             p: sorted(rs, key=lambda r: r[0]) for p, rs in partition_records.items()
@@ -151,8 +162,13 @@ class FakeBroker:
 
     def _dispatch(self, api_key: int, api_version: int, r: kc.ByteReader) -> bytes:
         if api_key == kc.API_VERSIONS:
+            if self.no_api_versions:
+                # Ancient brokers answer with an UNSUPPORTED_VERSION error.
+                w = kc.ByteWriter()
+                w.i16(35).i32(0)
+                return w.done()
             return kc.encode_api_versions_response(
-                [(kc.API_FETCH, 0, 4), (kc.API_LIST_OFFSETS, 0, 1), (kc.API_METADATA, 0, 1)]
+                [(k, lo, hi) for k, (lo, hi) in sorted(self.api_ranges.items())]
             )
         if api_key == kc.API_METADATA:
             requested = []
@@ -183,8 +199,13 @@ class FakeBroker:
                             kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, name or "", []
                         )
                     )
+            if not (self.api_ranges[kc.API_METADATA][0] <= api_version
+                    <= self.api_ranges[kc.API_METADATA][1]):
+                raise AssertionError(
+                    f"client requested unadvertised Metadata v{api_version}"
+                )
             return kc.encode_metadata_response(
-                kc.MetadataResponse(brokers, 0, topics)
+                kc.MetadataResponse(brokers, 0, topics), version=api_version
             )
         if api_key == kc.API_LIST_OFFSETS:
             _topic, parts = kc.decode_list_offsets_request(r)

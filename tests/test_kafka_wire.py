@@ -145,6 +145,60 @@ def test_wire_missing_timestamps_map_to_epoch():
     assert result.metrics.earliest_ts_s == 0  # unwrap_or(0) semantics
 
 
+def test_version_negotiation_modern_broker():
+    """Default fake broker advertises Metadata up to v5 (Kafka 4.0 floor,
+    KIP-896) — the whole default suite runs over negotiated v5.  This test
+    pins the negotiation result explicitly."""
+    with FakeBroker("wire.topic", {0: _mk_records(0, 20)}) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "wire.topic")
+        conn = src._any_conn()
+        assert src._version(conn, kc.API_METADATA) == 5
+        assert src._version(conn, kc.API_FETCH) == 4
+        assert src.partitions() == [0]
+        src.close()
+
+
+def test_version_negotiation_legacy_and_ancient_brokers():
+    records = {0: _mk_records(0, 30)}
+    legacy_ranges = {
+        kc.API_FETCH: (0, 4), kc.API_LIST_OFFSETS: (0, 1),
+        kc.API_METADATA: (0, 1),
+    }
+    for kwargs in ({"api_ranges": legacy_ranges}, {"no_api_versions": True}):
+        with FakeBroker("wire.topic", records, **kwargs) as broker:
+            src = KafkaWireSource(f"127.0.0.1:{broker.port}", "wire.topic")
+            conn = src._any_conn()
+            assert src._version(conn, kc.API_METADATA) == 1
+            m = _scan_via_wire(broker)
+            assert m.metrics.overall_count == 30
+
+
+def test_version_negotiation_incompatible_broker():
+    ranges = {
+        kc.API_FETCH: (11, 17),  # too new: our Fetch v4 removed
+        kc.API_LIST_OFFSETS: (0, 9),
+        kc.API_METADATA: (0, 13),
+    }
+    with FakeBroker("wire.topic", {0: _mk_records(0, 5)}, api_ranges=ranges) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "wire.topic")
+        with pytest.raises(kc.KafkaProtocolError, match="Fetch versions"):
+            src._version(src._any_conn(), kc.API_FETCH)
+        src.close()
+
+
+def test_metadata_v5_roundtrip():
+    md = kc.MetadataResponse(
+        {0: ("h", 1), 2: ("i", 3)}, 0,
+        [kc.TopicMetadata(0, "t", [kc.PartitionMetadata(0, 7, 2)])],
+    )
+    for v in (1, 2, 3, 5):
+        buf = kc.encode_metadata_response(md, version=v)
+        got = kc.decode_metadata_response(kc.ByteReader(buf), version=v)
+        assert got.brokers == md.brokers
+        assert got.topics[0].partitions[0].partition == 7
+        assert got.topics[0].partitions[0].leader == 2
+
+
 def test_native_and_python_decode_paths_agree():
     """The C++ frame decoder and the Python per-record generator must yield
     byte-identical RecordBatch streams (fields, hashes, offsets) across
