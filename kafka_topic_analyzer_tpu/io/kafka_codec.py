@@ -27,7 +27,11 @@ from typing import Iterator, List, Optional, Tuple
 API_FETCH = 1
 API_LIST_OFFSETS = 2
 API_METADATA = 3
+API_SASL_HANDSHAKE = 17
 API_VERSIONS = 18
+API_SASL_AUTHENTICATE = 36
+
+ERR_SASL_AUTHENTICATION_FAILED = 58
 
 EARLIEST_TIMESTAMP = -2
 LATEST_TIMESTAMP = -1
@@ -456,6 +460,57 @@ def decode_api_versions_response(r: ByteReader) -> "dict[int, tuple[int, int]]":
         vmax = r.i16()
         out[api_key] = (vmin, vmax)
     return out
+
+
+# ---------------------------------------------------------------------------
+# SASL (handshake v1 + authenticate v0; PLAIN mechanism)
+
+
+def encode_sasl_handshake_request(mechanism: str) -> bytes:
+    return ByteWriter().string(mechanism).done()
+
+
+def decode_sasl_handshake_request(r: ByteReader) -> str:
+    return r.string() or ""
+
+
+def encode_sasl_handshake_response(error: int, mechanisms: List[str]) -> bytes:
+    w = ByteWriter()
+    w.i16(error).i32(len(mechanisms))
+    for m in mechanisms:
+        w.string(m)
+    return w.done()
+
+
+def decode_sasl_handshake_response(r: ByteReader) -> "tuple[int, list[str]]":
+    err = r.i16()
+    mechanisms = [r.string() or "" for _ in range(r.i32())]
+    return err, mechanisms
+
+
+def sasl_plain_token(username: str, password: str) -> bytes:
+    return b"\x00" + username.encode() + b"\x00" + password.encode()
+
+
+def encode_sasl_authenticate_request(auth_bytes: bytes) -> bytes:
+    return ByteWriter().bytes_(auth_bytes).done()
+
+
+def decode_sasl_authenticate_request(r: ByteReader) -> bytes:
+    return r.bytes_() or b""
+
+
+def encode_sasl_authenticate_response(
+    error: int, error_message: Optional[str] = None
+) -> bytes:
+    return ByteWriter().i16(error).string(error_message).bytes_(b"").done()
+
+
+def decode_sasl_authenticate_response(r: ByteReader) -> "tuple[int, Optional[str]]":
+    err = r.i16()
+    msg = r.string()
+    r.bytes_()  # server auth bytes (unused for PLAIN)
+    return err, msg
 
 
 # ---------------------------------------------------------------------------

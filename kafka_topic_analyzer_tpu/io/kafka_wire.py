@@ -79,7 +79,12 @@ class BrokerConnection:
     """
 
     def __init__(
-        self, host: str, port: int, timeout_s: float = 10.0, ssl_context=None
+        self,
+        host: str,
+        port: int,
+        timeout_s: float = 10.0,
+        ssl_context=None,
+        sasl_plain: "Optional[Tuple[str, str]]" = None,
     ):
         self.host = host
         self.port = port
@@ -92,6 +97,38 @@ class BrokerConnection:
         self._lock = threading.Lock()
         #: ApiVersions handshake result, filled lazily ({} = legacy broker).
         self.api_versions: "Optional[Dict[int, tuple[int, int]]]" = None
+        if sasl_plain is not None:
+            try:
+                self._authenticate_plain(*sasl_plain)
+            except BaseException:
+                self.close()  # don't leak the fd on failed auth
+                raise
+
+    def _authenticate_plain(self, username: str, password: str) -> None:
+        """SASL/PLAIN: SaslHandshake v1, then SaslAuthenticate v0 — must be
+        the first exchange on the connection (brokers reject anything else
+        before authentication)."""
+        r = self.request(
+            kc.API_SASL_HANDSHAKE, 1, kc.encode_sasl_handshake_request("PLAIN")
+        )
+        err, mechanisms = kc.decode_sasl_handshake_response(r)
+        if err:
+            raise kc.KafkaProtocolError(
+                f"SASL handshake failed (error {err}); broker offers "
+                f"mechanisms {mechanisms} — this client implements PLAIN"
+            )
+        r = self.request(
+            kc.API_SASL_AUTHENTICATE,
+            0,
+            kc.encode_sasl_authenticate_request(
+                kc.sasl_plain_token(username, password)
+            ),
+        )
+        err, msg = kc.decode_sasl_authenticate_response(r)
+        if err:
+            raise kc.KafkaProtocolError(
+                f"SASL authentication failed (error {err}): {msg or 'no detail'}"
+            )
 
     def close(self) -> None:
         try:
@@ -172,7 +209,27 @@ class KafkaWireSource(RecordSource):
             overrides.pop("enable.ssl.certificate.verification", "true").lower()
             == "true"
         )
-        if protocol in ("ssl", "tls"):
+        self._sasl_plain: "Optional[Tuple[str, str]]" = None
+        mechanism = overrides.pop("sasl.mechanism", "PLAIN").upper()
+        sasl_user = overrides.pop("sasl.username", None)
+        sasl_pass = overrides.pop("sasl.password", None)
+        if protocol in ("sasl_plaintext", "sasl_ssl"):
+            if mechanism != "PLAIN":
+                raise ValueError(
+                    f"sasl.mechanism {mechanism!r} unsupported (PLAIN only)"
+                )
+            if sasl_user is None or sasl_pass is None:
+                raise ValueError(
+                    "sasl_plaintext/sasl_ssl require sasl.username and "
+                    "sasl.password"
+                )
+            self._sasl_plain = (sasl_user, sasl_pass)
+        elif sasl_user is not None or sasl_pass is not None:
+            log.warning(
+                "sasl.username/sasl.password ignored: security.protocol is "
+                "%r (use sasl_plaintext or sasl_ssl)", protocol,
+            )
+        if protocol in ("ssl", "tls", "sasl_ssl"):
             import ssl as _ssl
 
             # ssl.ca.location REPLACES the trust store (librdkafka semantics:
@@ -182,10 +239,10 @@ class KafkaWireSource(RecordSource):
                 ctx.check_hostname = False
                 ctx.verify_mode = _ssl.CERT_NONE
             self._ssl_context = ctx
-        elif protocol not in ("plaintext",):
+        elif protocol not in ("plaintext", "sasl_plaintext"):
             raise ValueError(
                 f"security.protocol {protocol!r} unsupported "
-                "(plaintext, ssl; SASL is not implemented)"
+                "(plaintext, ssl, sasl_plaintext, sasl_ssl)"
             )
         for k in overrides:
             log.warning("ignoring unsupported consumer property %r", k)
@@ -209,7 +266,11 @@ class KafkaWireSource(RecordSource):
             conn = self._conns.get(key)
             if conn is None:
                 conn = BrokerConnection(
-                    host, port, self.timeout_s, ssl_context=self._ssl_context
+                    host,
+                    port,
+                    self.timeout_s,
+                    ssl_context=self._ssl_context,
+                    sasl_plain=self._sasl_plain,
                 )
                 self._conns[key] = conn
             return conn
@@ -308,7 +369,16 @@ class KafkaWireSource(RecordSource):
         last_issue = ""
         for attempt in range(retries):
             conn = self._any_conn()
-            v = self._version(conn, kc.API_METADATA)
+            try:
+                v = self._version(conn, kc.API_METADATA)
+            except kc.KafkaProtocolError as e:
+                # A pre-0.10 broker slams the connection on ApiVersions;
+                # _version evicted it and remembered the host as legacy, so
+                # the retry reconnects and skips the handshake.
+                if attempt + 1 >= retries:
+                    raise
+                log.warning("ApiVersions handshake failed (%s); retrying", e)
+                continue
             r = conn.request(
                 kc.API_METADATA, v, kc.encode_metadata_request([self.topic], v)
             )

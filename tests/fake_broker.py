@@ -31,7 +31,11 @@ class FakeBroker:
         cluster: "Optional[FakeCluster]" = None,
         api_ranges: "Optional[Dict[int, Tuple[int, int]]]" = None,
         no_api_versions: bool = False,
+        sasl_plain: "Optional[Tuple[str, str]]" = None,
     ):
+        #: When set, every connection must SASL/PLAIN-authenticate with
+        #: these credentials before any other API is served.
+        self.sasl_plain = sasl_plain
         self.tls_context = tls_context
         self.node_id = node_id
         self.cluster = cluster
@@ -144,6 +148,7 @@ class FakeBroker:
         return b"".join(chunks)
 
     def _serve(self, conn: socket.socket) -> None:
+        authed = self.sasl_plain is None
         with conn:
             while not self._stop.is_set():
                 head = self._recv_exact(conn, 4)
@@ -156,7 +161,30 @@ class FakeBroker:
                 api_key, api_version, corr, _client, r = kc.decode_request_header(
                     payload
                 )
-                body = self._dispatch(api_key, api_version, r)
+                if not authed and api_key not in (
+                    kc.API_SASL_HANDSHAKE, kc.API_SASL_AUTHENTICATE,
+                ):
+                    return  # real brokers drop unauthenticated requests
+                if api_key == kc.API_SASL_HANDSHAKE:
+                    mech = kc.decode_sasl_handshake_request(r)
+                    supported = self.sasl_plain is not None and mech == "PLAIN"
+                    body = kc.encode_sasl_handshake_response(
+                        0 if supported else 33, ["PLAIN"] if supported else []
+                    )
+                elif api_key == kc.API_SASL_AUTHENTICATE:
+                    token = kc.decode_sasl_authenticate_request(r)
+                    if self.sasl_plain is not None and token == kc.sasl_plain_token(
+                        *self.sasl_plain
+                    ):
+                        authed = True
+                        body = kc.encode_sasl_authenticate_response(0)
+                    else:
+                        body = kc.encode_sasl_authenticate_response(
+                            kc.ERR_SASL_AUTHENTICATION_FAILED,
+                            "Authentication failed: invalid credentials",
+                        )
+                else:
+                    body = self._dispatch(api_key, api_version, r)
                 resp = struct.pack(">i", 4 + len(body)) + struct.pack(">i", corr) + body
                 conn.sendall(resp)
 

@@ -103,7 +103,14 @@ def test_tls_broker_survives_failed_handshake(certs):
 
 
 def test_unsupported_security_protocol():
-    with pytest.raises(ValueError, match="sasl"):
+    with pytest.raises(ValueError, match="unsupported"):
+        KafkaWireSource(
+            "127.0.0.1:1", "x", overrides={"security.protocol": "kerberos"}
+        )
+
+
+def test_sasl_ssl_requires_credentials():
+    with pytest.raises(ValueError, match="sasl.username"):
         KafkaWireSource(
             "127.0.0.1:1", "x", overrides={"security.protocol": "sasl_ssl"}
         )
