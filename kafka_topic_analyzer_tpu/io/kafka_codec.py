@@ -584,13 +584,34 @@ def encode_record_batch(
     return head.done() + crc_part
 
 
-def _crc32c(data: bytes) -> int:
-    """CRC32-C (Castagnoli), table-driven — Kafka's record-batch checksum."""
+def _crc32c_py(data: bytes) -> int:
+    """Pure-Python CRC32-C (reference/fallback; ~100 ms/MB)."""
     table = _CRC32C_TABLE
     crc = 0xFFFFFFFF
     for b in data:
         crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
+
+
+_crc32c_impl = None  # resolved once on first use (per-frame hot path)
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC32-C (Castagnoli) — Kafka's record-batch checksum.  Uses the
+    native shim when available; otherwise the Python table loop."""
+    global _crc32c_impl
+    if _crc32c_impl is None:
+        try:
+            import ctypes
+
+            from kafka_topic_analyzer_tpu.io.native import load_library
+
+            lib = load_library()  # sets kta_crc32c.restype
+            fn = lib.kta_crc32c
+            _crc32c_impl = lambda d: int(fn(d, ctypes.c_int64(len(d))))  # noqa: E731
+        except Exception:
+            _crc32c_impl = _crc32c_py
+    return _crc32c_impl(data)
 
 
 def _make_crc32c_table():

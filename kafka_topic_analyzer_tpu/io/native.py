@@ -24,7 +24,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 5
+_ABI = 6
 _SO_PATH = os.path.join(_NATIVE_DIR, "build", f"libkta_ingest.v{_ABI}.so")
 
 _lock = threading.Lock()
@@ -87,6 +87,7 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
             lib.kta_dedupe_slots.restype = ctypes.c_int64
             lib.kta_pack_batch.restype = ctypes.c_int64
             lib.kta_decode_records.restype = ctypes.c_int64
+            lib.kta_crc32c.restype = ctypes.c_uint32
         except Exception as e:  # remember the failure
             _load_error = e
             raise

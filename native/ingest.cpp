@@ -86,7 +86,30 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 5; }
+int32_t kta_version() { return 6; }
+
+// CRC32-C (Castagnoli) over a byte buffer — Kafka's record-batch checksum.
+// Table-driven; the Python fallback (kafka_codec._crc32c) is a per-byte
+// interpreter loop that costs ~100 ms/MB, which made check.crcs=true
+// impractical.
+uint32_t kta_crc32c(const uint8_t* data, int64_t n) {
+  // Thread-safe magic static: ctypes releases the GIL, so concurrent first
+  // calls are real; a hand-rolled flag would race on the table writes.
+  static const std::vector<uint32_t> table = [] {
+    std::vector<uint32_t> t(256);
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k)
+        crc = (crc & 1) ? (crc >> 1) ^ 0x82f63b78u : crc >> 1;
+      t[i] = crc;
+    }
+    return t;
+  }();
+  uint32_t crc = 0xffffffffu;
+  for (int64_t i = 0; i < n; ++i)
+    crc = table[(crc ^ data[i]) & 0xff] ^ (crc >> 8);
+  return crc ^ 0xffffffffu;
+}
 
 // Last-writer-wins dedupe of alive-bitmap updates for one batch
 // (the host half of the packed transfer's pre-reduction; see

@@ -58,6 +58,23 @@ def test_partial_trailing_batch_tolerated():
     assert len(list(kc.decode_record_batches(truncated))) == 2
 
 
+def test_crc32c_native_matches_python():
+    import ctypes
+    import os
+
+    from kafka_topic_analyzer_tpu.io.kafka_codec import _crc32c_py
+    from kafka_topic_analyzer_tpu.io.native import load_library, native_available
+
+    if not native_available():
+        pytest.skip("native shim unavailable")  # fallback would self-compare
+    lib = load_library()
+    for data in (b"", b"a", b"123456789", os.urandom(100_001)):
+        native = int(lib.kta_crc32c(data, ctypes.c_int64(len(data))))
+        assert native == _crc32c_py(data)
+    # Known CRC32-C vector: "123456789" -> 0xE3069283.
+    assert _crc32c_py(b"123456789") == 0xE3069283
+
+
 def test_parse_bootstrap():
     assert parse_bootstrap("a:9092,b") == [("a", 9092), ("b", 9092)]
 
