@@ -115,6 +115,11 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SECONDS", help="Snapshot interval (default 60s)")
     p.add_argument("--resume", action="store_true",
                    help="Resume from a snapshot in --snapshot-dir if present")
+    p.add_argument("--from-timestamp", metavar="ISO8601|EPOCH_MS",
+                   help="Scan only records at or after this time (kafka "
+                        "source: broker-side ListOffsets timestamp lookup). "
+                        "Accepts epoch milliseconds or ISO-8601, e.g. "
+                        "2026-01-01T00:00:00")
     p.add_argument("--dump-segments", metavar="DIR",
                    help="While scanning, dump record metadata into .ktaseg "
                         "chunks so the topic can be re-analyzed from disk "
@@ -129,6 +134,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Print per-stage throughput stats to stderr")
     p.add_argument("--quiet", action="store_true", help="No progress spinner")
     return p
+
+
+def parse_timestamp_ms(text: str) -> int:
+    """Epoch milliseconds, or ISO-8601 (naive strings are taken as UTC).
+    Negative values are rejected — they collide with Kafka's ListOffsets
+    sentinels (-1 latest, -2 earliest) and would silently change scan
+    semantics."""
+    ms: "int | None" = None
+    try:
+        ms = int(text)
+    except ValueError:
+        import datetime
+
+        try:
+            dt = datetime.datetime.fromisoformat(text)
+        except ValueError as e:
+            raise ValueError(
+                f"bad --from-timestamp {text!r}: expected epoch ms or ISO-8601"
+            ) from e
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        ms = int(dt.timestamp() * 1000)
+    if ms < 0:
+        raise ValueError(
+            f"bad --from-timestamp {text!r}: must not be before the epoch"
+        )
+    return ms
 
 
 def parse_mesh(text: str) -> "tuple[int, int]":
@@ -214,6 +246,10 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     from kafka_topic_analyzer_tpu.utils.timefmt import format_utc_seconds
 
     with user_input_phase():
+        if args.from_timestamp:
+            raise ValueError(
+                "--from-timestamp is not supported with multi-topic fan-in yet"
+            )
         # Dump tees attach per topic, before fan-in remaps partition ids.
         topic_sources = [
             (t, wrap_with_dump(args, t, make_source(args, topic=t, seed_salt=i)))
@@ -376,7 +412,32 @@ def _run(args) -> int:
     if "," in args.topic:
         return run_multi_topic(args, [t for t in args.topic.split(",") if t])
     with user_input_phase():
+        # Cheap flag validation first — before any broker handshake or dump
+        # directory creation.
+        from_ts_ms = None
+        if args.from_timestamp:
+            if args.source != "kafka":
+                raise ValueError(
+                    "--from-timestamp requires --source kafka (broker-side "
+                    "timestamp index lookup)"
+                )
+            if args.resume:
+                raise ValueError(
+                    "--from-timestamp cannot be combined with --resume"
+                )
+            from_ts_ms = parse_timestamp_ms(args.from_timestamp)
         source = wrap_with_dump(args, args.topic, make_source(args))
+        start_at = None
+        if from_ts_ms is not None:
+            start_at = source.offsets_for_timestamp(from_ts_ms)
+            _, end = source.watermarks()
+            if all(start_at.get(p, 0) >= end[p] for p in end):
+                print(
+                    f"No records at or after {args.from_timestamp} — "
+                    "nothing to analyze.",
+                    file=sys.stderr,
+                )
+                return 0
 
     # Empty-topic guard: exit(-2) like src/main.rs:98-101.
     if source.is_empty():
@@ -427,6 +488,7 @@ def _run(args) -> int:
             snapshot_dir=args.snapshot_dir,
             snapshot_every_s=args.snapshot_every,
             resume=args.resume,
+            start_at=start_at,
         )
     if args.stats:
         print("scan stages:", file=sys.stderr)

@@ -91,6 +91,7 @@ def run_scan(
     snapshot_every_s: float = 60.0,
     resume: bool = False,
     prefetch_depth: int = 2,
+    start_at: "Optional[dict[int, int]]" = None,
 ) -> ScanResult:
     """Full earliest→latest scan of the topic through the backend.
 
@@ -106,8 +107,11 @@ def run_scan(
     t0 = time.monotonic()
     seq = 0
 
-    start_at = None
+    # Caller-provided start offsets (e.g. --from-timestamp lookup); a
+    # resumed snapshot's offsets take precedence below.
     tracker = _ProgressTracker(start_offsets)
+    if start_at:
+        tracker.next_offsets.update(start_at)
     can_snapshot = snapshot_dir is not None and hasattr(backend, "get_state")
     if snapshot_dir is not None and not hasattr(backend, "get_state"):
         import logging

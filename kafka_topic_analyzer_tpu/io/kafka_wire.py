@@ -413,52 +413,54 @@ class KafkaWireSource(RecordSource):
     def partitions(self) -> List[int]:
         return sorted(self._leaders)
 
-    def watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
-        if self._watermarks is not None:
-            return self._watermarks
-        start: Dict[int, int] = {}
-        end: Dict[int, int] = {}
+    def _list_offsets(self, ts: int) -> Dict[int, int]:
+        """One ListOffsets query (timestamp or earliest/latest sentinel)
+        across all partitions, grouped by leader."""
+        out: Dict[int, int] = {}
         by_leader: Dict[int, List[int]] = {}
         for p, leader in self._leaders.items():
             by_leader.setdefault(leader, []).append(p)
         for leader, parts in by_leader.items():
             host, port = self._brokers[leader]
             conn = self._connect(host, port)
-            for ts, dest in (
-                (kc.EARLIEST_TIMESTAMP, start),
-                (kc.LATEST_TIMESTAMP, end),
-            ):
-                r = conn.request(
-                    kc.API_LIST_OFFSETS,
-                    self._version(conn, kc.API_LIST_OFFSETS),
-                    kc.encode_list_offsets_request(
-                        self.topic, [(p, ts) for p in parts]
-                    ),
-                )
-                for pid, (err, off) in kc.decode_list_offsets_response(r).items():
-                    if err:
-                        raise kc.KafkaProtocolError(
-                            f"ListOffsets error {err} for partition {pid}"
-                        )
-                    dest[pid] = off
-        self._watermarks = (start, end)
+            r = conn.request(
+                kc.API_LIST_OFFSETS,
+                self._version(conn, kc.API_LIST_OFFSETS),
+                kc.encode_list_offsets_request(
+                    self.topic, [(p, ts) for p in parts]
+                ),
+            )
+            for pid, (err, off) in kc.decode_list_offsets_response(r).items():
+                if err:
+                    raise kc.KafkaProtocolError(
+                        f"ListOffsets error {err} for partition {pid}"
+                    )
+                out[pid] = off
+        return out
+
+    def watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        if self._watermarks is not None:
+            return self._watermarks
+        self._watermarks = (
+            self._list_offsets(kc.EARLIEST_TIMESTAMP),
+            self._list_offsets(kc.LATEST_TIMESTAMP),
+        )
         return self._watermarks
 
+    def offsets_for_timestamp(self, ts_ms: int) -> Dict[int, int]:
+        """Per-partition earliest offset whose record timestamp >= ts_ms
+        (ListOffsets timestamp lookup); partitions with no such record map
+        to their end watermark, so a subsequent scan reads nothing there."""
+        _, end = self.watermarks()
+        return {
+            pid: (off if off >= 0 else end[pid])
+            for pid, off in self._list_offsets(ts_ms).items()
+        }
+
     def _earliest_offset(self, partition: int) -> int:
-        conn = self._leader_conn(partition)
-        r = conn.request(
-            kc.API_LIST_OFFSETS,
-            self._version(conn, kc.API_LIST_OFFSETS),
-            kc.encode_list_offsets_request(
-                self.topic, [(partition, kc.EARLIEST_TIMESTAMP)]
-            ),
-        )
-        err, off = kc.decode_list_offsets_response(r)[partition]
-        if err:
-            raise kc.KafkaProtocolError(
-                f"ListOffsets error {err} for partition {partition}"
-            )
-        return off
+        """Fresh earliest offset for one partition (OFFSET_OUT_OF_RANGE
+        recovery when retention advances mid-scan)."""
+        return self._list_offsets(kc.EARLIEST_TIMESTAMP)[partition]
 
     # -- the read loop (src/kafka.rs:74-137, batched) ------------------------
 

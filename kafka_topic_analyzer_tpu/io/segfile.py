@@ -279,6 +279,9 @@ class TeeSource(RecordSource):
     def is_empty(self):
         return self.inner.is_empty()
 
+    def offsets_for_timestamp(self, ts_ms: int):
+        return self.inner.offsets_for_timestamp(ts_ms)
+
     def batches(self, batch_size, partitions=None, start_at=None):
         self.writer.set_base_offsets(self.inner.watermarks()[0])
         for batch in self.inner.batches(batch_size, partitions, start_at):

@@ -243,8 +243,16 @@ class FakeBroker:
                     results.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, -1))
                 elif ts == kc.EARLIEST_TIMESTAMP:
                     results.append((pid, 0, -1, self.start_offsets[pid]))
-                else:
+                elif ts == kc.LATEST_TIMESTAMP:
                     results.append((pid, 0, -1, self.end_offsets[pid]))
+                else:
+                    # Timestamp lookup: earliest offset whose record ts >= query
+                    # (-1 when no such record), like a real broker.
+                    hit = next(
+                        (off for off, rts, _k, _v in self.records[pid] if rts >= ts),
+                        -1,
+                    )
+                    results.append((pid, 0, ts, hit))
             return kc.encode_list_offsets_response(self.topic, results)
         if api_key == kc.API_FETCH:
             self.fetch_count += 1

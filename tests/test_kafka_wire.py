@@ -58,6 +58,37 @@ def test_partial_trailing_batch_tolerated():
     assert len(list(kc.decode_record_batches(truncated))) == 2
 
 
+def test_from_timestamp_scan():
+    """Scan from a point in time via the broker's timestamp index."""
+    # Partition 0: ts 1.6e12 + i*1000 ms at offsets 0..99.
+    rows = [(i, 1_600_000_000_000 + i * 1000, f"k{i}".encode(), bytes(10))
+            for i in range(100)]
+    with FakeBroker("ts.topic", {0: rows}) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "ts.topic")
+        # Cutoff mid-stream: first record with ts >= cutoff is offset 40.
+        offs = src.offsets_for_timestamp(1_600_000_000_000 + 39_500)
+        assert offs == {0: 40}
+        cfg = AnalyzerConfig(num_partitions=1, batch_size=32)
+        be = CpuExactBackend(cfg, init_now_s=10**10)
+        m = run_scan("ts.topic", src, be, 32, start_at=offs).metrics
+        assert m.overall_count == 60  # offsets 40..99
+        assert m.earliest_ts_s == (1_600_000_000_000 + 40_000) // 1000
+        # Cutoff beyond every record: nothing scanned.
+        offs2 = src.offsets_for_timestamp(2_000_000_000_000)
+        assert offs2 == {0: 100}  # end watermark
+        src.close()
+
+
+def test_cli_from_timestamp_flags():
+    from kafka_topic_analyzer_tpu.cli import parse_timestamp_ms
+
+    assert parse_timestamp_ms("1600000000000") == 1_600_000_000_000
+    assert parse_timestamp_ms("2020-09-13T12:26:40") == 1_600_000_000_000
+    assert parse_timestamp_ms("2020-09-13T12:26:40+00:00") == 1_600_000_000_000
+    with pytest.raises(ValueError, match="from-timestamp"):
+        parse_timestamp_ms("not-a-time")
+
+
 def test_crc32c_native_matches_python():
     import ctypes
     import os
