@@ -47,6 +47,8 @@ class SyntheticSpec:
     #: Per-mille of records with a null value (tombstones).
     tombstone_permille: int = 100
     value_len_min: int = 100
+    #: NOTE: the value-length draw uses 24 bits of the record hash, so the
+    #: effective spread (value_len_max - value_len_min + 1) caps at 2^24.
     value_len_max: int = 400
     #: Fixed decimal width of the key id inside the key string "k%0*d".
     key_digits: int = 11
