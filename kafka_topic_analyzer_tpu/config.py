@@ -103,6 +103,13 @@ class AnalyzerConfig:
                 raise ValueError(
                     "use_pallas_counters requires batch_size % 1024 == 0"
                 )
+            if self.mesh_shape != (1, 1):
+                # pallas_call outputs need explicit vma annotations under
+                # check_vma shard_map; not wired up yet (ROADMAP.md).
+                raise ValueError(
+                    "use_pallas_counters is single-device only for now "
+                    "(not supported under a sharded mesh)"
+                )
 
     @property
     def hll_m(self) -> int:

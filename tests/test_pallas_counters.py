@@ -64,6 +64,35 @@ def test_pallas_exact_at_16mb_values():
     assert np.array_equal(np.asarray(want), np.asarray(got))
 
 
+def test_pallas_backend_end_to_end_parity():
+    """The flag through the full TpuBackend (interpret mode on CPU; the
+    same kernel compiles on TPU)."""
+    from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+    spec = SyntheticSpec(
+        num_partitions=5, messages_per_partition=3000, keys_per_partition=80
+    )
+    cfg = AnalyzerConfig(num_partitions=5, batch_size=2048, use_pallas_counters=True)
+    a = run_scan("t", SyntheticSource(spec), CpuExactBackend(cfg, init_now_s=0), 2048).metrics
+    b = run_scan("t", SyntheticSource(spec), TpuBackend(cfg, init_now_s=0), 2048).metrics
+    assert np.array_equal(a.per_partition, b.per_partition)
+    assert np.array_equal(a.per_partition_extremes, b.per_partition_extremes)
+
+
+def test_pallas_rejected_under_mesh():
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+
+    with pytest.raises(ValueError, match="single-device"):
+        AnalyzerConfig(
+            num_partitions=2, batch_size=1024,
+            use_pallas_counters=True, mesh_shape=(2, 1),
+        )
+
+
 def test_bad_batch_size_rejected():
     a = _random_arrays(100, 2, seed=1)
     with pytest.raises(ValueError, match="multiple"):
