@@ -75,5 +75,23 @@ _override = os.environ.get("KTA_JAX_PLATFORMS")
 if _override:
     force_platform(_override)
 
+# Persistent XLA compilation cache: the analyzer compiles the same handful
+# of programs every run (one step per feature combination), and first TPU
+# compiles cost 20-40 s — cache them across processes.  KTA_CACHE_DIR
+# overrides the location; KTA_CACHE_DIR=off disables.
+_cache_dir = os.environ.get("KTA_CACHE_DIR")
+if _cache_dir != "off":
+    try:
+        if not _cache_dir:
+            _cache_dir = os.path.join(
+                os.path.expanduser("~"), ".cache", "kta-jax"
+            )
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        # (jax's default min-compile-time threshold of 1 s already skips
+        # caching trivial CPU programs while catching TPU compiles.)
+    except Exception:
+        pass  # cache is an optimization; never fail startup over it
+
 import jax.numpy as jnp  # noqa: E402,F401
 from jax import lax  # noqa: E402,F401
