@@ -21,29 +21,30 @@ from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 from kafka_topic_analyzer_tpu.records import RecordBatch
 from kafka_topic_analyzer_tpu.results import (
     COUNTER_CHANNELS,
+    QUANTILE_PROBS,
     QuantileSummary,
     TopicMetrics,
     finalize_extremes,
 )
 from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
 
-_QUANTILE_PROBS = (0.5, 0.9, 0.99)
-
 
 def _exact_quantiles(sizes: np.ndarray, counts: np.ndarray) -> QuantileSummary:
     """Exact quantiles of a (size -> count) histogram (sizes sorted)."""
     if len(sizes) == 0:
-        return QuantileSummary(list(_QUANTILE_PROBS), [float("nan")] * 3)
+        return QuantileSummary(
+            list(QUANTILE_PROBS), [float("nan")] * len(QUANTILE_PROBS)
+        )
     order = np.argsort(sizes)
     sizes = sizes[order]
     counts = counts[order]
     cum = np.cumsum(counts)
     total = int(cum[-1])
     vals = []
-    for q in _QUANTILE_PROBS:
+    for q in QUANTILE_PROBS:
         rank = max(0, min(total - 1, int(np.ceil(q * total)) - 1))
         vals.append(float(sizes[int(np.searchsorted(cum, rank + 1))]))
-    return QuantileSummary(list(_QUANTILE_PROBS), vals)
+    return QuantileSummary(list(QUANTILE_PROBS), vals)
 
 
 class CpuExactBackend(MetricBackend):

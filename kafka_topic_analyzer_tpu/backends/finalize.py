@@ -8,12 +8,11 @@ from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_quantiles
 from kafka_topic_analyzer_tpu.ops.hll import hll_estimate
 from kafka_topic_analyzer_tpu.results import (
+    QUANTILE_PROBS,
     QuantileSummary,
     TopicMetrics,
     finalize_extremes,
 )
-
-QUANTILE_PROBS = (0.5, 0.9, 0.99)
 
 
 def metrics_from_state(state, config: AnalyzerConfig, init_now_s: int) -> TopicMetrics:

@@ -96,12 +96,10 @@ class TpuBackend(MetricBackend):
         with jax.default_device(self.device):
             self.state = AnalyzerState.init(config)
         self._step = jax.jit(make_packed_step(config), donate_argnums=(0,))
-        self.batches_seen = 0
 
     def update(self, batch: RecordBatch) -> None:
         buf = pack_batch(batch, self.config, use_native=self.use_native)
         self.state = self._step(self.state, jax.device_put(buf, self.device))
-        self.batches_seen += 1
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.state)

@@ -177,18 +177,7 @@ def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object
     if args.source == "synthetic":
         from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
 
-        kv = parse_kv_pairs(args.synthetic)
-        seed_raw = kv.get("seed")
-        spec = SyntheticSpec(
-            num_partitions=int(kv.get("partitions", 1)),
-            messages_per_partition=int(kv.get("messages", 1_000_000)),
-            keys_per_partition=int(kv.get("keys", 10_000)),
-            key_null_permille=int(kv.get("key_null", 50)),
-            tombstone_permille=int(kv.get("tombstones", 100)),
-            value_len_min=int(kv.get("vmin", 100)),
-            value_len_max=int(kv.get("vmax", 400)),
-            seed=(int(seed_raw, 0) if seed_raw is not None else 0x5EED) + seed_salt,
-        )
+        spec = SyntheticSpec.from_kv(parse_kv_pairs(args.synthetic), seed_salt)
         use_native = args.native in ("auto", "on")
         if use_native:
             try:
@@ -231,6 +220,18 @@ def wrap_with_dump(args, topic: str, source):
     from kafka_topic_analyzer_tpu.io.segfile import SegmentDumpWriter, TeeSource
 
     return TeeSource(source, SegmentDumpWriter(args.dump_segments, topic))
+
+
+
+def _make_cli_backend(args, config: AnalyzerConfig, mesh_shape):
+    """cpu oracle, single-device tpu, or sharded mesh backend per flags."""
+    if args.backend == "tpu" and mesh_shape != (1, 1):
+        from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+        return ShardedTpuBackend(config)
+    from kafka_topic_analyzer_tpu.backends.base import make_backend
+
+    return make_backend(args.backend, config)
 
 
 def run_multi_topic(args, topics: "list[str]") -> int:
@@ -276,14 +277,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             quantiles_per_partition=args.quantiles_per_partition,
             mesh_shape=mesh_shape,
         )
-    if args.backend == "tpu" and mesh_shape != (1, 1):
-        from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
-
-        backend = ShardedTpuBackend(config)
-    else:
-        from kafka_topic_analyzer_tpu.backends.base import make_backend
-
-        backend = make_backend(args.backend, config)
+    backend = _make_cli_backend(args, config, mesh_shape)
 
     banner_out = sys.stderr if args.json else sys.stdout
     print(f"Subscribing to {', '.join(topics)} ({len(topics)}-topic fan-in)",
@@ -466,14 +460,7 @@ def _run(args) -> int:
     from kafka_topic_analyzer_tpu.utils.profiling import maybe_jax_trace
     from kafka_topic_analyzer_tpu.utils.progress import Spinner
 
-    if args.backend == "tpu" and mesh_shape != (1, 1):
-        from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
-
-        backend = ShardedTpuBackend(config)
-    else:
-        from kafka_topic_analyzer_tpu.backends.base import make_backend
-
-        backend = make_backend(args.backend, config)
+    backend = _make_cli_backend(args, config, mesh_shape)
 
     banner_out = sys.stderr if args.json else sys.stdout
     print(f"Subscribing to {args.topic}", file=banner_out)

@@ -66,6 +66,22 @@ class SyntheticSpec:
             f"K/p={self.keys_per_partition}, seed={self.seed:#x})"
         )
 
+    @classmethod
+    def from_kv(cls, kv: "dict[str, str]", seed_salt: int = 0) -> "SyntheticSpec":
+        """Build a spec from the CLI's comma-separated k=v surface (shared
+        by the analyzer CLI and tools/make_segments)."""
+        seed_raw = kv.get("seed")
+        return cls(
+            num_partitions=int(kv.get("partitions", 1)),
+            messages_per_partition=int(kv.get("messages", 1_000_000)),
+            keys_per_partition=int(kv.get("keys", 10_000)),
+            key_null_permille=int(kv.get("key_null", 50)),
+            tombstone_permille=int(kv.get("tombstones", 100)),
+            value_len_min=int(kv.get("vmin", 100)),
+            value_len_max=int(kv.get("vmax", 400)),
+            seed=(int(seed_raw, 0) if seed_raw is not None else 0x5EED) + seed_salt,
+        )
+
 
 def synth_fields(
     spec: SyntheticSpec, partition: np.ndarray, offset: np.ndarray

@@ -13,7 +13,6 @@ from __future__ import annotations
 import dataclasses
 
 import jax
-import numpy as np
 
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 from kafka_topic_analyzer_tpu.jax_support import jnp
@@ -63,9 +62,3 @@ class MessageMetricsState:
             overall_count=self.overall_count + other.overall_count,
         )
 
-
-def state_to_numpy(state: MessageMetricsState) -> "dict[str, np.ndarray]":
-    return {
-        f.name: np.asarray(getattr(state, f.name))
-        for f in dataclasses.fields(MessageMetricsState)
-    }

@@ -30,6 +30,11 @@ CH = {name: i for i, name in enumerate(COUNTER_CHANNELS)}
 U64_MAX = (1 << 64) - 1
 
 
+#: The quantiles every backend reports (single source: a cpu/tpu mismatch
+#: here would silently break parity comparisons).
+QUANTILE_PROBS = (0.5, 0.9, 0.99)
+
+
 @dataclasses.dataclass
 class QuantileSummary:
     """Message-size quantiles (new capability; not in the reference)."""

@@ -18,18 +18,7 @@ from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
 
 
 def spec_from_kv(text: "str | None") -> SyntheticSpec:
-    kv = parse_kv_pairs(text)
-    seed_raw = kv.get("seed")
-    return SyntheticSpec(
-        num_partitions=int(kv.get("partitions", 1)),
-        messages_per_partition=int(kv.get("messages", 1_000_000)),
-        keys_per_partition=int(kv.get("keys", 10_000)),
-        key_null_permille=int(kv.get("key_null", 50)),
-        tombstone_permille=int(kv.get("tombstones", 100)),
-        value_len_min=int(kv.get("vmin", 100)),
-        value_len_max=int(kv.get("vmax", 400)),
-        seed=int(seed_raw, 0) if seed_raw is not None else 0x5EED,
-    )
+    return SyntheticSpec.from_kv(parse_kv_pairs(text))
 
 
 def main(argv: "list[str] | None" = None) -> int:
