@@ -297,12 +297,35 @@ def lz4_decompress(data: bytes) -> bytes:
         cap = min(cap * 16, bound)
 
 
+def gzip_decompress(payload: bytes) -> bytes:
+    """Bounded gzip/zlib inflate — same MAX_DECOMPRESSED cap the snappy and
+    LZ4 paths enforce, so a corrupt or hostile batch can't balloon ~1000x
+    into memory unchecked."""
+    d = zlib.decompressobj(wbits=47)
+    out = d.decompress(payload, MAX_DECOMPRESSED)
+    if d.unconsumed_tail:
+        raise ValueError(
+            f"gzip batch exceeds decompressed size cap ({MAX_DECOMPRESSED} B)"
+        )
+    out += d.flush()
+    if len(out) > MAX_DECOMPRESSED:
+        raise ValueError(
+            f"gzip batch exceeds decompressed size cap ({MAX_DECOMPRESSED} B)"
+        )
+    # zlib.decompress raised on truncated streams; a decompressobj only
+    # signals it via eof.  Trailing bytes after a complete stream stay
+    # ignored (old zlib.decompress(wbits=47) behavior).
+    if not d.eof:
+        raise ValueError("truncated gzip stream")
+    return out
+
+
 def decompress(codec: int, payload: bytes) -> bytes:
     """Kafka record-batch attribute codec → decompressed payload."""
     if codec == 0:
         return payload
     if codec == 1:  # gzip (RFC1952; wbits=47 auto-detects zlib too)
-        return zlib.decompress(payload, wbits=47)
+        return gzip_decompress(payload)
     if codec == 2:
         return snappy_decompress(payload)
     if codec == 3:

@@ -533,7 +533,11 @@ OffsetRecord = Tuple[int, int, Optional[bytes], Optional[bytes]]
 def encode_record_batch(
     records: List[OffsetRecord],
     compression: int = COMPRESSION_NONE,
+    last_offset: Optional[int] = None,
 ) -> bytes:
+    """``last_offset`` overrides the batch header's covered range (default:
+    the last record's offset) — a compacted log's batches keep their
+    original last_offset_delta even when the tail records were removed."""
     if not records:
         return b""
     base_offset = records[0][0]
@@ -568,7 +572,10 @@ def encode_record_batch(
     # Fields covered by the CRC (everything from attributes onward).
     crcw = ByteWriter()
     crcw.i16(compression)  # attributes (low bits = codec)
-    crcw.i32(records[-1][0] - base_offset)  # last_offset_delta
+    crcw.i32(
+        (last_offset if last_offset is not None else records[-1][0])
+        - base_offset
+    )  # last_offset_delta
     crcw.i64(first_ts).i64(max_ts)
     crcw.i64(-1).i16(-1).i32(-1)  # producer id/epoch, base sequence
     crcw.i32(len(records))
@@ -638,6 +645,10 @@ class BatchFrame:
     first_ts: int
     num_records: int
     payload: bytes
+    #: One past the last offset this batch COVERS (base + last_offset_delta
+    #: + 1).  On compacted topics this can exceed the last retained record's
+    #: offset — the fetch loop uses it to advance past removed ranges.
+    end_offset: int = -1
 
 
 def iter_batch_frames(buf: bytes, verify_crc: bool = False) -> Iterator[BatchFrame]:
@@ -661,7 +672,7 @@ def iter_batch_frames(buf: bytes, verify_crc: bool = False) -> Iterator[BatchFra
         crc = r.u32()
         crc_start = r.pos
         attributes = r.i16()
-        r.i32()  # last_offset_delta
+        last_offset_delta = r.i32()
         first_ts = r.i64()
         r.i64()  # max_ts
         r.i64()  # producer id
@@ -687,7 +698,13 @@ def iter_batch_frames(buf: bytes, verify_crc: bool = False) -> Iterator[BatchFra
                 raise KafkaProtocolError(
                     f"record batch at offset {base_offset}: {e}"
                 ) from e
-        yield BatchFrame(base_offset, first_ts, num_records, payload)
+        yield BatchFrame(
+            base_offset,
+            first_ts,
+            num_records,
+            payload,
+            end_offset=base_offset + max(last_offset_delta, 0) + 1,
+        )
         pos = end
 
 

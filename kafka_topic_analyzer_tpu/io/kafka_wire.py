@@ -42,6 +42,10 @@ log = logging.getLogger(__name__)
 
 CLIENT_ID = "topic-analyzer"  # src/kafka.rs:36
 
+#: Ceiling for the auto-grown per-partition fetch size (librdkafka caps
+#: message.max.bytes at ~1 GB; also keeps the i32 wire field safe).
+MAX_PARTITION_FETCH_BYTES = 1 << 30
+
 
 def _hash_keys(
     keys: List[Optional[bytes]], use_native: bool = True
@@ -168,13 +172,22 @@ class BrokerConnection:
 
 
 def parse_bootstrap(bootstrap_servers: str) -> List[Tuple[str, int]]:
-    """Comma-separated host[:port] list (src/main.rs:45-51)."""
+    """Comma-separated host[:port] list (src/main.rs:45-51).
+
+    IPv6: ``[2001:db8::1]:9092`` (bracketed, RFC 3986 style) and bare
+    ``::1`` (multiple colons ⇒ whole string is the host, default port)."""
     out = []
     for hp in bootstrap_servers.split(","):
         hp = hp.strip()
         if not hp:
             continue
-        host, _, port = hp.rpartition(":") if ":" in hp else (hp, "", "")
+        if hp.startswith("["):  # bracketed IPv6, optional :port
+            host, _, rest = hp[1:].partition("]")
+            port = rest[1:] if rest.startswith(":") else ""
+        elif hp.count(":") > 1:  # bare IPv6 literal, no port
+            host, port = hp, ""
+        else:
+            host, _, port = hp.rpartition(":") if ":" in hp else (hp, "", "")
         out.append((host or hp, int(port) if port else 9092))
     return out
 
@@ -524,24 +537,42 @@ class KafkaWireSource(RecordSource):
 
         error_streak: Dict[int, int] = {p: 0 for p in parts}
         max_error_streak = 100
+        # Consecutive fetches for a partition that neither consumed records
+        # nor advanced the offset (possible under response-budget pressure
+        # from sibling partitions) — bounded so a pathological broker can't
+        # livelock the scan.  The bound scales with partition count: the
+        # rotated fetch order guarantees a starved partition heads the
+        # request within len(parts) rounds.
+        stall_streak: Dict[int, int] = {p: 0 for p in parts}
+        max_stall = max(max_error_streak, 4 * len(parts))
 
+        fetch_round = 0
         while remaining:
             by_leader: Dict[int, List[int]] = {}
             for p in remaining:
                 by_leader.setdefault(self._leaders[p], []).append(p)
             progressed = False
+            fetch_round += 1
             for leader, lparts in by_leader.items():
                 conn = self._leader_conn(lparts[0])
+                pmax_sent = self.partition_max_bytes
+                # KIP-74: brokers fill the response budget in request
+                # order, so rotate the partition list each round — without
+                # this, partitions at the tail of a large sorted list can
+                # be starved of response bytes indefinitely.
+                lp = sorted(lparts)
+                k = fetch_round % len(lp)
+                order = lp[k:] + lp[:k]
                 r = conn.request(
                     kc.API_FETCH,
                     self._version(conn, kc.API_FETCH),
                     kc.encode_fetch_request(
                         self.topic,
-                        [(p, next_offset[p]) for p in sorted(lparts)],
+                        [(p, next_offset[p]) for p in order],
                         self.max_wait_ms,
                         self.min_bytes,
                         self.max_bytes,
-                        self.partition_max_bytes,
+                        pmax_sent,
                     ),
                 )
                 for fp in kc.decode_fetch_response(r):
@@ -571,17 +602,20 @@ class KafkaWireSource(RecordSource):
                         continue
                     error_streak[p] = 0
                     consumed = 0
-                    decoded = 0
+                    # One past the highest offset COVERED by a complete
+                    # frame (batch headers keep last_offset_delta across
+                    # compaction, so this advances past removed ranges).
+                    max_frame_end = -1
                     for frame in kc.iter_batch_frames(
                         fp.records, verify_crc=self.verify_crc
                     ):
+                        max_frame_end = max(max_frame_end, frame.end_offset)
                         chunk = (
                             decode_records_native(frame)
                             if use_native_decode
                             else None
                         )
                         if chunk is not None:
-                            decoded += frame.num_records
                             offs = chunk["offsets"]
                             # Keep records in [next_offset, end): compressed
                             # batches can start earlier; records past the
@@ -601,7 +635,6 @@ class KafkaWireSource(RecordSource):
                         for off, (ts_ms, key, value) in kc.decode_frame_records(
                             frame
                         ):
-                            decoded += 1
                             if off < next_offset[p]:
                                 continue
                             if off >= end[p]:
@@ -617,24 +650,74 @@ class KafkaWireSource(RecordSource):
                             )
                             batch.offsets = np.array(row_offs, dtype=np.int64)
                             push_chunk(batch)
-                    if consumed == 0 and next_offset[p] < end[p]:
-                        if fp.records and decoded == 0:
-                            # A batch larger than partition_max_bytes came
-                            # back truncated: grow the limit and refetch.
-                            self.partition_max_bytes *= 2
-                            log.warning(
-                                "partition %d: batch exceeds fetch size, "
-                                "growing max.partition.fetch.bytes to %d",
-                                p,
-                                self.partition_max_bytes,
-                            )
+                    if consumed:
+                        stall_streak[p] = 0
+                    elif next_offset[p] < end[p]:
+                        if max_frame_end > next_offset[p]:
+                            # Complete frames cover our fetch position but
+                            # every retained record is out of range —
+                            # compaction removed the rest of the covered
+                            # span.  Batch headers keep last_offset_delta
+                            # across compaction, so skip to one past it.
+                            next_offset[p] = min(max_frame_end, end[p])
+                            stall_streak[p] = 0
                             progressed = True
+                        elif not fp.records:
+                            if p == order[0]:
+                                # We led this request, and brokers return
+                                # at least one complete batch for the first
+                                # partition with data (KIP-74
+                                # minOneMessage) — empty is authoritative:
+                                # nothing retained in [next_offset, end).
+                                next_offset[p] = end[p]
+                                progressed = True
+                            else:
+                                # A non-leading partition can be starved by
+                                # siblings (response budget) or by its own
+                                # batch exceeding the per-partition limit;
+                                # rotation brings it to the front within
+                                # len(parts) rounds for the authoritative
+                                # answer.
+                                stall_streak[p] += 1
+                                if stall_streak[p] >= max_stall:
+                                    raise kc.KafkaProtocolError(
+                                        f"partition {p}: {stall_streak[p]} "
+                                        "consecutive empty fetches"
+                                    )
                         else:
-                            # Nothing left for us below the snapshot-time
-                            # watermark (empty fetch, or every decoded offset
-                            # already >= end): compaction removed the rest.
-                            next_offset[p] = end[p]
-                            progressed = True
+                            # Frames present but none complete at/past our
+                            # position: the response was truncated by a byte
+                            # limit.  If the per-partition limit was binding
+                            # (response filled it), grow it; otherwise the
+                            # response-level budget cut us short — refetch,
+                            # budget frees as other partitions drain.
+                            if len(fp.records) >= pmax_sent:
+                                if pmax_sent >= MAX_PARTITION_FETCH_BYTES:
+                                    raise kc.KafkaProtocolError(
+                                        f"partition {p}: cannot decode fetch"
+                                        f" response even at max.partition."
+                                        f"fetch.bytes={pmax_sent}"
+                                    )
+                                self.partition_max_bytes = min(
+                                    max(self.partition_max_bytes, pmax_sent * 2),
+                                    MAX_PARTITION_FETCH_BYTES,
+                                )
+                                log.warning(
+                                    "partition %d: batch exceeds fetch size,"
+                                    " growing max.partition.fetch.bytes to %d",
+                                    p,
+                                    self.partition_max_bytes,
+                                )
+                                stall_streak[p] = 0
+                                progressed = True
+                            else:
+                                stall_streak[p] += 1
+                                if stall_streak[p] >= max_stall:
+                                    raise kc.KafkaProtocolError(
+                                        f"partition {p}: {stall_streak[p]} "
+                                        "consecutive fetches with no "
+                                        "progress (truncated responses)"
+                                    )
                     if next_offset[p] >= end[p]:
                         remaining.discard(p)
                 yield from flush(force=False)

@@ -35,6 +35,16 @@ U64_MAX = (1 << 64) - 1
 QUANTILE_PROBS = (0.5, 0.9, 0.99)
 
 
+def _avg(size_sum: int, alive: int) -> int:
+    """Floor(sum/alive) — the reference divides by *alive*, not total or
+    key_non_null (src/metric.rs:132-139), and guards on ``sum > 0``.  A
+    partition whose retained records are all keyed tombstones has sum > 0
+    with alive == 0; the reference panics there (divide-by-zero,
+    src/metric.rs:134-138).  Deliberate divergence: report 0 instead of
+    crashing after a completed scan."""
+    return size_sum // alive if size_sum > 0 and alive > 0 else 0
+
+
 @dataclasses.dataclass
 class QuantileSummary:
     """Message-size quantiles (new capability; not in the reference)."""
@@ -114,18 +124,13 @@ class TopicMetrics:
         return int(self._row(p)[CH["value_size_sum"]])
 
     def key_size_avg(self, p: int) -> int:
-        """Floor(sum/alive) — the reference divides by *alive*, not total or
-        key_non_null (src/metric.rs:132-139), and guards on ``sum > 0``."""
-        s = self.key_size_sum(p)
-        return s // self.alive(p) if s > 0 else 0
+        return _avg(self.key_size_sum(p), self.alive(p))
 
     def value_size_avg(self, p: int) -> int:
-        s = self.value_size_sum(p)
-        return s // self.alive(p) if s > 0 else 0
+        return _avg(self.value_size_sum(p), self.alive(p))
 
     def message_size_avg(self, p: int) -> int:
-        s = self.key_size_sum(p) + self.value_size_sum(p)
-        return s // self.alive(p) if s > 0 else 0
+        return _avg(self.key_size_sum(p) + self.value_size_sum(p), self.alive(p))
 
     def dirty_ratio(self, p: int) -> float:
         """Percentage of tombstones, computed in float32 exactly like

@@ -32,7 +32,24 @@ class FakeBroker:
         api_ranges: "Optional[Dict[int, Tuple[int, int]]]" = None,
         no_api_versions: bool = False,
         sasl_plain: "Optional[Tuple[str, str]]" = None,
+        honor_partition_max_bytes: bool = False,
+        honor_max_bytes: bool = False,
+        coverage_overrides: "Optional[Dict[int, Dict[int, int]]]" = None,
     ):
+        #: partition → {chunk_index: last_covered_offset}: emulates a
+        #: compacted log where a batch's last_offset_delta extends past its
+        #: last *retained* record (the log cleaner preserves batch offset
+        #: ranges when it removes records).
+        self.coverage_overrides = coverage_overrides or {}
+        #: When True, fetch responses concatenate chunks from the fetch
+        #: position onward and hard-truncate at the request's
+        #: partition_max_bytes — emulating a real broker's byte-limited
+        #: (possibly mid-batch-truncated) responses.
+        self.honor_partition_max_bytes = honor_partition_max_bytes
+        #: When True, the request-level max_bytes is enforced across
+        #: partitions in REQUEST order (KIP-74): once the budget is spent,
+        #: later partitions get empty record sets.
+        self.honor_max_bytes = honor_max_bytes
         #: When set, every connection must SASL/PLAIN-authenticate with
         #: these credentials before any other API is served.
         self.sasl_plain = sasl_plain
@@ -70,10 +87,15 @@ class FakeBroker:
         self._chunk_last_offsets: Dict[int, "list[int]"] = {}
         for p, rs in self.records.items():
             chunks = []
-            for lo in range(0, len(rs), max_records_per_fetch):
+            for ci, lo in enumerate(range(0, len(rs), max_records_per_fetch)):
                 part = rs[lo : lo + max_records_per_fetch]
+                last = self.coverage_overrides.get(p, {}).get(ci, part[-1][0])
                 chunks.append(
-                    (part[0][0], part[-1][0], kc.encode_record_batch(part, compression))
+                    (
+                        part[0][0],
+                        last,
+                        kc.encode_record_batch(part, compression, last_offset=last),
+                    )
                 )
             self._chunks[p] = chunks
             self._chunk_last_offsets[p] = [c[1] for c in chunks]
@@ -258,6 +280,8 @@ class FakeBroker:
             self.fetch_count += 1
             _topic, parts, _mw, _mb, _xb = kc.decode_fetch_request(r)
             out = []
+            budget = _xb if self.honor_max_bytes else None
+            served_any = False
             for pid, fetch_offset, _pmax in parts:
                 rs = self.records.get(pid)
                 if rs is None:
@@ -274,7 +298,27 @@ class FakeBroker:
                 # exactly as with real compacted batches).
                 chunks = self._chunks[pid]
                 i = bisect.bisect_left(self._chunk_last_offsets[pid], fetch_offset)
-                record_set = chunks[i][2] if i < len(chunks) else b""
+                if self.honor_partition_max_bytes:
+                    buf = bytearray()
+                    for j in range(i, len(chunks)):
+                        buf += chunks[j][2]
+                        if len(buf) >= _pmax:
+                            break
+                    record_set = bytes(buf[:_pmax])
+                else:
+                    record_set = chunks[i][2] if i < len(chunks) else b""
+                if budget is not None:
+                    cut = max(budget, 0)
+                    if not served_any and len(record_set) >= 12:
+                        # KIP-74: the first batch of the response is always
+                        # returned whole, even when it exceeds max_bytes —
+                        # guarantees the consumer can make progress.
+                        (blen,) = struct.unpack_from(">i", record_set, 8)
+                        cut = max(cut, 12 + blen)
+                    record_set = record_set[:cut]
+                    budget -= len(record_set)
+                if record_set:
+                    served_any = True
                 out.append((pid, 0, hw, record_set))
             return kc.encode_fetch_response(self.topic, out)
         raise AssertionError(f"fake broker: unsupported api {api_key}")

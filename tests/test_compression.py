@@ -127,6 +127,36 @@ def test_lz4_python_path_respects_cap(monkeypatch):
         comp.lz4_decompress_py(big)
 
 
+def test_gzip_path_respects_cap(monkeypatch):
+    import gzip
+
+    import kafka_topic_analyzer_tpu.io.compression as comp
+
+    # Tiny cap so a gzip bomb trips it without big allocations.
+    monkeypatch.setattr(comp, "MAX_DECOMPRESSED", 1000)
+    bomb = gzip.compress(b"x" * 50_000)
+    with pytest.raises(ValueError, match="cap"):
+        comp.decompress(1, bomb)
+    # In-cap payloads still round-trip (both gzip and bare-zlib framing).
+    assert comp.decompress(1, gzip.compress(b"ok" * 100)) == b"ok" * 100
+    import zlib
+
+    assert comp.decompress(1, zlib.compress(b"ok" * 100)) == b"ok" * 100
+
+
+def test_gzip_truncated_stream_rejected():
+    import gzip
+
+    from kafka_topic_analyzer_tpu.io.compression import decompress as dec
+
+    payload = gzip.compress(b"x" * 1000)
+    with pytest.raises(ValueError, match="truncated"):
+        dec(1, payload[:-8])  # trailer cut off
+    # Trailing garbage after a complete stream stays tolerated, matching
+    # the previous zlib.decompress(wbits=47) behavior.
+    assert dec(1, payload + b"junk") == b"x" * 1000
+
+
 def test_zstd_rejected():
     with pytest.raises(UnsupportedCodecError, match="zstd"):
         decompress(4, b"\x28\xb5\x2f\xfd")

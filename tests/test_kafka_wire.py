@@ -110,6 +110,17 @@ def test_parse_bootstrap():
     assert parse_bootstrap("a:9092,b") == [("a", 9092), ("b", 9092)]
 
 
+def test_parse_bootstrap_ipv6():
+    # Bracketed with and without port, and bare IPv6 literals (which contain
+    # multiple colons and must not be split at the last one).
+    assert parse_bootstrap("[::1]:9093") == [("::1", 9093)]
+    assert parse_bootstrap("[2001:db8::1]") == [("2001:db8::1", 9092)]
+    assert parse_bootstrap("::1") == [("::1", 9092)]
+    assert parse_bootstrap("[::1]:9093,plain:9094,2001:db8::2") == [
+        ("::1", 9093), ("plain", 9094), ("2001:db8::2", 9092),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # end-to-end against the fake broker
 
@@ -321,6 +332,81 @@ def test_wire_all_records_beyond_watermark_terminates():
     ) as broker:
         result = _scan_via_wire(broker)
     assert result.metrics.overall_count == 10
+
+
+def test_wire_compacted_batch_before_truncated_batch_not_skipped():
+    """Regression: a fetch response whose first batch retains only records
+    BELOW the fetch position (its last_offset_delta covers compacted-away
+    offsets) while the next batch is truncated by partition_max_bytes must
+    advance to the covered batch end and refetch — not conclude the
+    partition is exhausted and skip to the watermark."""
+    batch_a = _mk_records(0, 10)                       # offsets 0..9
+    batch_b = [(15 + i, 1_600_000_100_000 + i, b"late", bytes(20))
+               for i in range(5)]                      # offsets 15..19
+    with FakeBroker(
+        "wire.topic", {0: batch_a + batch_b},
+        max_records_per_fetch=10,  # chunk 1 = batch_a, chunk 2 = batch_b
+        honor_partition_max_bytes=True,
+        # Batch A's on-disk range covers compacted-away 10..14, so a fetch
+        # at offset 10 serves batch A again.
+        coverage_overrides={0: {0: 14}},
+    ) as broker:
+        a_len = len(broker._chunks[0][0][2])
+        # First fetch returns A + a truncated sliver of B.
+        result = _scan_via_wire(
+            broker,
+            overrides={"max.partition.fetch.bytes": str(a_len + 10)},
+        )
+    assert result.metrics.overall_count == 15  # 10 from A, 5 from B
+
+
+def test_wire_last_retained_batch_before_fetch_position_terminates():
+    """The dual of the refetch regression above: when the compacted batch
+    preceding the fetch position is the LAST data in the partition, its
+    covered end (base + last_offset_delta + 1) reaches the watermark, so
+    the scan must terminate — not grow the fetch size forever."""
+    batch_a = _mk_records(0, 10)  # offsets 0..9; watermark snapshot says 15
+    with FakeBroker(
+        "wire.topic", {0: batch_a}, end_offsets={0: 15},
+        honor_partition_max_bytes=True,
+        coverage_overrides={0: {0: 14}},  # batch covers 10..14 on disk
+    ) as broker:
+        result = _scan_via_wire(broker)
+    assert result.metrics.overall_count == 10
+
+
+def test_wire_response_budget_starvation_not_mistaken_for_end():
+    """KIP-74: when the request-level fetch.max.bytes budget is spent on
+    earlier partitions, later ones come back EMPTY despite having data.
+    The client must rotate the fetch order and keep going — not conclude
+    the starved partitions are compacted away."""
+    records = {p: _mk_records(p, 50) for p in range(3)}
+    with FakeBroker(
+        "wire.topic", records, max_records_per_fetch=10,
+        honor_partition_max_bytes=True, honor_max_bytes=True,
+    ) as broker:
+        one_chunk = len(broker._chunks[0][0][2])
+        # Budget fits ~one chunk per response: every round starves two of
+        # the three partitions.
+        result = _scan_via_wire(
+            broker, overrides={"fetch.max.bytes": str(one_chunk + 10)}
+        )
+    assert result.metrics.overall_count == 150
+
+
+def test_wire_oversized_batch_grows_fetch_size():
+    """A single batch larger than max.partition.fetch.bytes comes back
+    truncated (no complete frame): the client must double the limit until
+    the batch fits."""
+    rows = [(i, 1_600_000_000_000 + i, b"k%d" % i, bytes(200))
+            for i in range(20)]
+    with FakeBroker(
+        "wire.topic", {0: rows}, honor_partition_max_bytes=True,
+    ) as broker:
+        result = _scan_via_wire(
+            broker, overrides={"max.partition.fetch.bytes": "64"}
+        )
+    assert result.metrics.overall_count == 20
 
 
 def test_gzip_uses_real_gzip_framing():

@@ -87,3 +87,35 @@ def test_derived_metric_semantics():
     # u64::MAX smallest reports as 0 (src/metric.rs:177-183).
     m.smallest_message = U64_MAX
     assert m.smallest_message_reported() == 0
+
+
+def test_all_keyed_tombstones_partition_renders():
+    """A partition retaining only keyed tombstones has size sums > 0 with
+    alive == 0.  The reference panics on the divide (src/metric.rs:134-138);
+    deliberate divergence: averages report 0 and the report still renders."""
+    # 5 total, 5 tombstones, 0 alive, 0 key_null, 5 key_non_null,
+    # key bytes 50, value bytes 0.
+    per = np.array([[5, 5, 0, 0, 5, 50, 0]], dtype=np.int64)
+    m = TopicMetrics(
+        partitions=[0],
+        per_partition=per,
+        earliest_ts_s=0,
+        latest_ts_s=1_600_000_000,
+        smallest_message=U64_MAX,
+        largest_message=0,
+        overall_size=50,
+        overall_count=5,
+        alive_keys=0,
+    )
+    assert m.key_size_avg(0) == 0
+    assert m.value_size_avg(0) == 0
+    assert m.message_size_avg(0) == 0
+    out = render_report(
+        topic="tomb",
+        metrics=m,
+        start_offsets={0: 0},
+        end_offsets={0: 5},
+        duration_secs=1,
+        show_alive_keys=False,
+    )
+    assert "| 0 | 0    | 5    |" in out
