@@ -225,6 +225,15 @@ def wrap_with_dump(args, topic: str, source):
 
 def _make_cli_backend(args, config: AnalyzerConfig, mesh_shape):
     """cpu oracle, single-device tpu, or sharded mesh backend per flags."""
+    if args.backend == "tpu":
+        # A wedged accelerator tunnel blocks forever inside backend init;
+        # probe it in a killable subprocess first and degrade to the host
+        # CPU platform (with a warning) instead of hanging the tool.
+        from kafka_topic_analyzer_tpu.jax_support import (
+            ensure_responsive_accelerator,
+        )
+
+        ensure_responsive_accelerator()
     if args.backend == "tpu" and mesh_shape != (1, 1):
         from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
 

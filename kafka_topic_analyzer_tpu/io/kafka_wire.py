@@ -652,6 +652,13 @@ class KafkaWireSource(RecordSource):
                             push_chunk(batch)
                     if consumed:
                         stall_streak[p] = 0
+                        if max_frame_end > next_offset[p]:
+                            # The consumed batch's covered range extends
+                            # past its last retained record (tail
+                            # compaction): advance to the covered end so
+                            # the next fetch doesn't re-serve this batch
+                            # just to discard it.
+                            next_offset[p] = min(max_frame_end, end[p])
                     elif next_offset[p] < end[p]:
                         if max_frame_end > next_offset[p]:
                             # Complete frames cover our fetch position but

@@ -41,7 +41,8 @@ def ensure_responsive_accelerator(timeout_s: float = 240.0) -> bool:
     wedged device tunnel.  Returns True when the accelerator is healthy (or
     an explicit platform override / prior verdict makes probing moot).
 
-    Used by bench.py and __graft_entry__; KTA_ACCEL_OK=1 short-circuits so
+    Used by bench.py, __graft_entry__, and the CLI's tpu backend path
+    (cli.py::_make_cli_backend); KTA_ACCEL_OK=1 short-circuits so
     orchestrators (tools/bench_all.py) probe once for many children.
     """
     import subprocess
@@ -49,6 +50,10 @@ def ensure_responsive_accelerator(timeout_s: float = 240.0) -> bool:
 
     if os.environ.get("KTA_JAX_PLATFORMS") or os.environ.get("KTA_ACCEL_OK"):
         return True
+    try:
+        timeout_s = float(os.environ.get("KTA_ACCEL_TIMEOUT") or timeout_s)
+    except ValueError:
+        pass  # malformed override: keep the default, like the other knobs
     try:
         probe = subprocess.run(
             [sys.executable, "-c",

@@ -6,6 +6,10 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The accelerator watchdog (jax_support.ensure_responsive_accelerator) is
+# moot on the forced-CPU test platform; short-circuit it so CLI tests don't
+# pay a subprocess probe each (its own tests delenv this).
+os.environ.setdefault("KTA_ACCEL_OK", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
