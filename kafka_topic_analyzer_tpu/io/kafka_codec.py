@@ -568,6 +568,10 @@ def encode_record_batch(
         from kafka_topic_analyzer_tpu.io.compression import lz4_compress_frame
 
         payload = lz4_compress_frame(payload)
+    elif compression == COMPRESSION_ZSTD:
+        from kafka_topic_analyzer_tpu.io.compression import zstd_compress_frame
+
+        payload = zstd_compress_frame(payload)
 
     # Fields covered by the CRC (everything from attributes onward).
     crcw = ByteWriter()

@@ -1,5 +1,6 @@
-"""snappy/LZ4 decompression: native vs pure-Python vs handcrafted streams,
-and end-to-end through record batches + the fake broker."""
+"""gzip/snappy/LZ4/zstd decompression: native vs pure-Python vs handcrafted
+streams, and end-to-end through record batches + the fake broker (zstd
+specifics live in test_zstd.py)."""
 
 import struct
 
@@ -157,13 +158,13 @@ def test_gzip_truncated_stream_rejected():
     assert dec(1, payload + b"junk") == b"x" * 1000
 
 
-def test_zstd_rejected():
-    with pytest.raises(UnsupportedCodecError, match="zstd"):
-        decompress(4, b"\x28\xb5\x2f\xfd")
+def test_unknown_codec_rejected():
+    with pytest.raises(UnsupportedCodecError, match="unknown compression"):
+        decompress(5, b"\x00")
 
 
 @pytest.mark.parametrize(
-    "codec", [kc.COMPRESSION_SNAPPY, kc.COMPRESSION_LZ4]
+    "codec", [kc.COMPRESSION_SNAPPY, kc.COMPRESSION_LZ4, kc.COMPRESSION_ZSTD]
 )
 def test_record_batch_roundtrip_compressed(codec):
     records = [
@@ -177,7 +178,7 @@ def test_record_batch_roundtrip_compressed(codec):
 
 
 @pytest.mark.parametrize(
-    "codec", [kc.COMPRESSION_SNAPPY, kc.COMPRESSION_LZ4]
+    "codec", [kc.COMPRESSION_SNAPPY, kc.COMPRESSION_LZ4, kc.COMPRESSION_ZSTD]
 )
 def test_wire_scan_with_compressed_broker(codec):
     import sys

@@ -1,0 +1,205 @@
+"""zstd codec: ctypes-libzstd fast path and the pure-Python RFC 8878
+decoder (io/zstd_py.py), cross-checked against each other and fuzzed like
+the sibling codecs (librdkafka gives the reference zstd support for free,
+/root/reference/Cargo.toml:19)."""
+
+import os
+import random
+import struct
+
+import pytest
+
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io import zstd_py
+from kafka_topic_analyzer_tpu.io.compression import (
+    _load_libzstd,
+    decompress,
+    zstd_compress_frame,
+    zstd_decompress,
+)
+
+CASES = [
+    b"",
+    b"a",
+    b"hello world " * 50,
+    bytes(1000),                                   # RLE-friendly
+]
+
+
+def _corpus():
+    rng = random.Random(7)
+    out = list(CASES)
+    out.append(os.urandom(1000))                   # incompressible
+    out.append(bytes(rng.choices(b"abcdefgh", k=5000)))   # Huffman-friendly
+    out.append((b"key-%d value payload " * 200) % tuple(range(200)))
+    out.append(os.urandom(300_000))                # multi-block
+    out.append(bytes(rng.choices(range(256), k=200_000)))
+    return out
+
+
+@pytest.mark.parametrize("level", [1, 3, 19])
+def test_python_decoder_matches_libzstd(level):
+    if _load_libzstd() is None:
+        pytest.skip("libzstd unavailable: nothing to cross-check against")
+    for data in _corpus():
+        comp = zstd_compress_frame(data, level)
+        assert zstd_decompress(comp) == data           # ctypes path
+        assert zstd_py.decompress(comp, 1 << 30) == data  # pure Python
+
+
+def test_literal_frame_fallback_roundtrip():
+    """The literal-only encoder (used when libzstd is absent) emits valid
+    frames both decoders accept — including multi-block (>128 KiB)."""
+    for data in (b"", b"abc", os.urandom(300_000)):
+        import kafka_topic_analyzer_tpu.io.compression as comp_mod
+
+        saved = comp_mod._libzstd
+        comp_mod._libzstd = None  # force the literal encoder
+        try:
+            frame = zstd_compress_frame(data)
+        finally:
+            comp_mod._libzstd = saved
+        assert zstd_py.decompress(frame, 1 << 30) == data
+        assert zstd_decompress(frame) == data
+
+
+def _stream_compress_chunked(data: bytes, chunk: int = 1000) -> bytes:
+    """ZSTD_compressStream2 fed in chunks so the frame header carries NO
+    content size — the shape real stream-compressing Kafka producers emit
+    (the one-shot ZSTD_compress always pledges the size)."""
+    import ctypes
+
+    lib = _load_libzstd()
+    lib.ZSTD_createCCtx.restype = ctypes.c_void_p
+    lib.ZSTD_compressStream2.restype = ctypes.c_size_t
+
+    class Buf(ctypes.Structure):
+        _fields_ = [
+            ("ptr", ctypes.c_void_p),
+            ("size", ctypes.c_size_t),
+            ("pos", ctypes.c_size_t),
+        ]
+
+    cctx = lib.ZSTD_createCCtx()
+    cap = int(lib.ZSTD_compressBound(len(data))) + 1024
+    dst = ctypes.create_string_buffer(cap)
+    outbuf = Buf(ctypes.cast(dst, ctypes.c_void_p), cap, 0)
+    pos = 0
+    while True:
+        piece = data[pos : pos + chunk]
+        pos += len(piece)
+        last = pos >= len(data)
+        src = ctypes.create_string_buffer(piece, len(piece))
+        inbuf = Buf(ctypes.cast(src, ctypes.c_void_p), len(piece), 0)
+        while True:
+            ret = int(lib.ZSTD_compressStream2(
+                ctypes.c_void_p(cctx), ctypes.byref(outbuf),
+                ctypes.byref(inbuf), 2 if last else 0,
+            ))
+            assert not lib.ZSTD_isError(ret)
+            if inbuf.pos >= inbuf.size and (not last or ret == 0):
+                break
+        if last:
+            break
+    lib.ZSTD_freeCCtx(ctypes.c_void_p(cctx))
+    return dst.raw[: outbuf.pos]
+
+
+def test_streamed_frames_without_content_size():
+    """The production-common frame shape: no declared content size, decoded
+    via ZSTD_decompressStream (and the pure-Python block loop)."""
+    if _load_libzstd() is None:
+        pytest.skip("libzstd unavailable")
+    rng = random.Random(3)
+    for data in (
+        b"hello world " * 500,
+        os.urandom(100_000),
+        bytes(rng.choices(b"abcdef", k=300_000)),
+    ):
+        comp = _stream_compress_chunked(data)
+        lib = _load_libzstd()
+        fcs = int(lib.ZSTD_getFrameContentSize(comp, len(comp)))
+        assert fcs == (1 << 64) - 1  # CONTENTSIZE_UNKNOWN
+        assert zstd_decompress(comp) == data
+        assert zstd_py.decompress(comp, 1 << 30) == data
+
+
+def test_match_offset_cannot_cross_frame_boundary():
+    """Frames are independent: a match in frame 2 reaching into frame 1's
+    output is corrupt (libzstd rejects it; so must the Python decoder).
+    Frame 2 is hand-built with RLE sequence tables: literals 'DEF' then one
+    sequence (ll=3, offset=5, ml=4) — offset 5 exceeds the 3 bytes this
+    frame has produced."""
+    f1 = zstd_compress_frame(b"ABCDEFGH", 1)
+    block = b"\x18DEF" + bytes([0x01, 0x54, 0x03, 0x03, 0x01, 0x08])
+    h = 1 | (2 << 1) | (len(block) << 3)
+    f2 = (
+        struct.pack("<IB", zstd_py.ZSTD_MAGIC, 0x20)
+        + b"\x07"  # declared content size 7
+        + struct.pack("<I", h)[:3]
+        + block
+    )
+    with pytest.raises(ValueError, match="frame start"):
+        zstd_py.decompress(f2, 1 << 20)  # invalid even standalone
+    with pytest.raises(ValueError, match="frame start"):
+        zstd_py.decompress(f1 + f2, 1 << 20)
+
+
+def test_multi_frame_and_skippable():
+    a = zstd_compress_frame(b"first frame ", 3)
+    skip = struct.pack("<II", 0x184D2A53, 5) + b"xxxxx"
+    b = zstd_compress_frame(b"second", 19)
+    assert zstd_py.decompress(a + skip + b, 1 << 30) == b"first frame second"
+
+
+def test_python_decoder_respects_cap():
+    comp = zstd_compress_frame(b"x" * 50_000, 3)
+    with pytest.raises(ValueError, match="cap"):
+        zstd_py.decompress(comp, 1000)
+
+
+def test_dictionary_frames_rejected():
+    # Single-segment frame with a nonzero 1-byte dictionary id.
+    frame = struct.pack("<IB", zstd_py.ZSTD_MAGIC, 0x21) + b"\x07" + b"\x00" * 8
+    with pytest.raises(ValueError, match="dictionar"):
+        zstd_py.decompress(frame, 1 << 20)
+
+
+def test_fuzz_garbage_and_truncations_total():
+    """Decoder totality: arbitrary garbage, truncations, and bit flips must
+    raise ValueError or return bytes — never crash, hang, or leak another
+    exception type (same contract as the snappy/LZ4 fuzz suites)."""
+    rng = random.Random(11)
+    base = zstd_compress_frame(bytes(rng.choices(b"abcdef", k=3000)), 19)
+    for i in range(200):
+        buf = bytearray(base)
+        mode = i % 3
+        if mode == 0:
+            buf = bytearray(rng.randbytes(rng.randrange(1, 200)))
+        elif mode == 1:
+            buf = buf[: rng.randrange(1, len(buf))]
+        else:
+            for _ in range(rng.randrange(1, 6)):
+                buf[rng.randrange(len(buf))] ^= rng.randrange(1, 256)
+        try:
+            zstd_py.decompress(bytes(buf), 1 << 20)
+        except ValueError:
+            pass
+
+
+def test_record_batch_roundtrip_zstd():
+    records = [
+        (10, 1_600_000_000_000, b"key-a", b"value-a" * 10),
+        (11, 1_600_000_000_001, None, b"v"),
+        (12, 1_600_000_000_002, b"key-b", None),
+    ]
+    buf = kc.encode_record_batch(records, kc.COMPRESSION_ZSTD)
+    got = [
+        (off, ts, k, v)
+        for off, (ts, k, v) in kc.decode_record_batches(buf, verify_crc=True)
+    ]
+    assert got == records
+
+
+def test_codec_dispatch():
+    assert decompress(4, zstd_compress_frame(b"payload")) == b"payload"
