@@ -303,7 +303,10 @@ def gzip_decompress(payload: bytes) -> bytes:
     LZ4 paths enforce, so a corrupt or hostile batch can't balloon ~1000x
     into memory unchecked."""
     d = zlib.decompressobj(wbits=47)
-    out = d.decompress(payload, MAX_DECOMPRESSED)
+    try:
+        out = d.decompress(payload, MAX_DECOMPRESSED)
+    except zlib.error as e:
+        raise ValueError(f"corrupt gzip stream: {e}") from e
     if d.unconsumed_tail:
         raise ValueError(
             f"gzip batch exceeds decompressed size cap ({MAX_DECOMPRESSED} B)"

@@ -595,6 +595,76 @@ def encode_record_batch(
     return head.done() + crc_part
 
 
+def _encode_legacy_message(
+    offset: int,
+    ts_ms: int,
+    key: Optional[bytes],
+    value: Optional[bytes],
+    magic: int,
+    attributes: int = 0,
+) -> bytes:
+    body = bytearray([magic, attributes])
+    if magic == 1:
+        body += struct.pack(">q", ts_ms)
+    body += struct.pack(">i", -1) if key is None else (
+        struct.pack(">i", len(key)) + key
+    )
+    body += struct.pack(">i", -1) if value is None else (
+        struct.pack(">i", len(value)) + value
+    )
+    msg = struct.pack(">I", zlib.crc32(bytes(body))) + bytes(body)
+    return struct.pack(">qi", offset, len(msg)) + msg
+
+
+def encode_message_set(
+    records: List[OffsetRecord],
+    magic: int = 1,
+    compression: int = COMPRESSION_NONE,
+    log_append_time: bool = False,
+) -> bytes:
+    """Legacy MessageSet v0/v1 encoder (tests / fake-broker fixtures for
+    pre-0.11 segments).  Compressed sets use the wrapper-message scheme:
+    the wrapper's offset is the last inner message's absolute offset, and
+    magic-1 inner messages carry relative offsets starting at 0 (KIP-31);
+    magic-0 inner messages keep absolute offsets."""
+    if magic not in (0, 1):
+        raise ValueError("legacy message sets are magic 0 or 1")
+    if not records:
+        return b""
+    if compression == COMPRESSION_NONE:
+        return b"".join(
+            _encode_legacy_message(off, ts, k, v, magic)
+            for off, ts, k, v in records
+        )
+    base = records[0][0]
+    inner = b"".join(
+        _encode_legacy_message(
+            # KIP-31 relative offsets are deltas from the first inner
+            # message (gaps from compaction are preserved), not 0..n-1.
+            (off - base) if magic == 1 else off, ts, k, v, magic
+        )
+        for off, ts, k, v in records
+    )
+    from kafka_topic_analyzer_tpu.io import compression as comp_mod
+
+    if compression == COMPRESSION_GZIP:
+        co = zlib.compressobj(wbits=31)
+        payload = co.compress(inner) + co.flush()
+    elif compression == COMPRESSION_SNAPPY:
+        payload = comp_mod.snappy_compress_xerial(inner)
+    elif compression == COMPRESSION_LZ4:
+        payload = comp_mod.lz4_compress_frame(inner)
+    elif compression == COMPRESSION_ZSTD:
+        raise ValueError("zstd requires RecordBatch v2 (magic 2)")
+    else:
+        raise ValueError(f"unknown compression codec {compression}")
+    attrs = compression | (0x08 if (log_append_time and magic == 1) else 0)
+    wrapper_ts = records[-1][1] if magic == 1 else -1
+    return _encode_legacy_message(
+        records[-1][0], wrapper_ts, None, payload, magic, attrs
+    )
+
+
 def _crc32c_py(data: bytes) -> int:
     """Pure-Python CRC32-C (reference/fallback; ~100 ms/MB)."""
     table = _CRC32C_TABLE
@@ -653,12 +723,112 @@ class BatchFrame:
     #: + 1).  On compacted topics this can exceed the last retained record's
     #: offset — the fetch loop uses it to advance past removed ranges.
     end_offset: int = -1
+    #: Pre-decoded records for legacy MessageSet v0/v1 entries (magic 0/1):
+    #: [(abs_offset, ts_ms, key, value)].  When set, `payload` is empty and
+    #: the per-record decoders read from here (the native array decoder
+    #: returns None so callers fall back).
+    legacy_records: Optional[list] = None
+
+
+def _decode_legacy_entry(
+    buf: bytes, pos: int, end: int, verify_crc: bool, depth: int = 0
+) -> "list[tuple[int, int, Optional[bytes], Optional[bytes]]]":
+    """One MessageSet v0/v1 entry → [(abs_offset, ts_ms, key, value)].
+    Compressed entries are wrapper messages whose value is a nested
+    MessageSet (exactly one level in valid data — enforced).  Offset
+    rules: magic-1 wrappers carry the absolute offset of the LAST inner
+    message while inner messages store relative offsets (KIP-31, gaps
+    preserved); magic-0 wrappers hold absolute inner offsets."""
+    if end - pos < 26:  # header(12) + crc(4) + magic+attrs(2) + klen+vlen(8)
+        raise KafkaProtocolError("legacy message below minimum size")
+    offset = struct.unpack_from(">q", buf, pos)[0]
+    crc = struct.unpack_from(">I", buf, pos + 12)[0]
+    magic = buf[pos + 16]
+    attributes = buf[pos + 17]
+    if verify_crc and zlib.crc32(buf[pos + 16 : end]) != crc:
+        raise KafkaProtocolError(
+            f"legacy message CRC mismatch at offset {offset}"
+        )
+    p = pos + 18
+    ts_ms = -1
+    if magic == 1:
+        if p + 8 > end:
+            raise KafkaProtocolError("truncated v1 message timestamp")
+        ts_ms = struct.unpack_from(">q", buf, p)[0]
+        p += 8
+    if p + 4 > end:
+        raise KafkaProtocolError("truncated legacy message key")
+    (klen,) = struct.unpack_from(">i", buf, p)
+    p += 4
+    key = None
+    if klen >= 0:
+        if p + klen > end:
+            raise KafkaProtocolError("truncated legacy message key")
+        key = buf[p : p + klen]
+        p += klen
+    if p + 4 > end:
+        raise KafkaProtocolError("truncated legacy message value")
+    (vlen,) = struct.unpack_from(">i", buf, p)
+    p += 4
+    value = None
+    if vlen >= 0:
+        if p + vlen > end:
+            raise KafkaProtocolError("truncated legacy message value")
+        value = buf[p : p + vlen]
+        p += vlen
+    codec = attributes & 0x07
+    if codec == COMPRESSION_NONE:
+        return [(offset, ts_ms, key, value)]
+    # Wrapper message: decompress and recurse into the inner MessageSet.
+    if depth >= 1:
+        # Valid Kafka data nests exactly one wrapper level; deeper nesting
+        # would multiply the per-decompression memory cap per level.
+        raise KafkaProtocolError("nested compressed wrapper messages")
+    if value is None:
+        raise KafkaProtocolError("compressed wrapper message with null value")
+    from kafka_topic_analyzer_tpu.io.compression import decompress
+
+    try:
+        inner_buf = decompress(codec, value)
+    except KafkaProtocolError:
+        raise
+    except Exception as e:
+        raise KafkaProtocolError(
+            f"legacy wrapper message at offset {offset}: {e}"
+        ) from e
+    inner: "list[tuple[int, int, Optional[bytes], Optional[bytes]]]" = []
+    ipos = 0
+    while ipos + 12 <= len(inner_buf):
+        (isize,) = struct.unpack_from(">i", inner_buf, ipos + 8)
+        iend = ipos + 12 + isize
+        if isize <= 0 or iend > len(inner_buf):
+            raise KafkaProtocolError("truncated inner message set")
+        inner.extend(
+            _decode_legacy_entry(inner_buf, ipos, iend, verify_crc, depth + 1)
+        )
+        ipos = iend
+    if not inner:
+        return []
+    if magic == 1:
+        # KIP-31: wrapper offset = last inner's ABSOLUTE offset, inner
+        # offsets are relative — so base = wrapper - last, unconditionally.
+        # Old producers that wrote absolute inner offsets get base == 0,
+        # which this handles too (the official clients do the same).
+        base = offset - inner[-1][0]
+        inner = [(base + o, ts, k, v) for o, ts, k, v in inner]
+    if magic == 1 and attributes & 0x08:
+        # LogAppendTime: the wrapper's timestamp applies to every record.
+        inner = [(o, ts_ms, k, v) for o, _ts, k, v in inner]
+    return inner
 
 
 def iter_batch_frames(buf: bytes, verify_crc: bool = False) -> Iterator[BatchFrame]:
     """Parse batch headers (CRC check, decompression) without touching
     records.  Tolerates a trailing partial batch (brokers may truncate at
-    max_bytes)."""
+    max_bytes).  Legacy MessageSet v0/v1 entries (pre-0.11 segments that
+    survive on upgraded clusters) are decoded eagerly into
+    ``legacy_records`` — the magic byte sits at entry offset 16 in all
+    three formats, so mixed-format record sets stream through one loop."""
     pos = 0
     n = len(buf)
     while pos + 17 <= n:  # base_offset + batch_length + leader_epoch + magic
@@ -668,9 +838,22 @@ def iter_batch_frames(buf: bytes, verify_crc: bool = False) -> Iterator[BatchFra
         if batch_length <= 0 or end > n:
             return  # partial trailing batch
         magic = buf[pos + 16]
+        if magic in (0, 1):
+            records = _decode_legacy_entry(buf, pos, end, verify_crc)
+            if records:
+                yield BatchFrame(
+                    base_offset=records[0][0],
+                    first_ts=records[0][1],
+                    num_records=len(records),
+                    payload=b"",
+                    end_offset=records[-1][0] + 1,
+                    legacy_records=records,
+                )
+            pos = end
+            continue
         if magic != 2:
             raise KafkaProtocolError(
-                f"unsupported record format magic={magic} (need magic 2 / Kafka >= 0.11)"
+                f"unsupported record format magic={magic} (need magic <= 2)"
             )
         r = ByteReader(buf, pos + 17)
         crc = r.u32()
@@ -715,6 +898,10 @@ def iter_batch_frames(buf: bytes, verify_crc: bool = False) -> Iterator[BatchFra
 def decode_frame_records(frame: BatchFrame) -> Iterator[Tuple[int, RecordTuple]]:
     """Per-record Python decode of one frame (reference implementation; the
     hot path uses the native array decoder)."""
+    if frame.legacy_records is not None:
+        for off, ts_ms, key, value in frame.legacy_records:
+            yield off, (ts_ms, key, value)
+        return
     payload = frame.payload
     rr = ByteReader(payload)
     for _ in range(frame.num_records):

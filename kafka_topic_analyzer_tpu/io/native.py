@@ -217,6 +217,8 @@ def decode_records_native(frame) -> "dict[str, np.ndarray] | None":
     (the Python per-record generator manages ~225k records/s; this runs at
     tens of millions).  Returns None on malformed input so the caller can
     fall back to the Python decoder for a precise error."""
+    if getattr(frame, "legacy_records", None) is not None:
+        return None  # MessageSet v0/v1: the Python per-record path decodes
     lib = load_library()
     n = frame.num_records
     # num_records is an untrusted wire field: a valid record needs >= 7

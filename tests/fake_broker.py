@@ -35,7 +35,11 @@ class FakeBroker:
         honor_partition_max_bytes: bool = False,
         honor_max_bytes: bool = False,
         coverage_overrides: "Optional[Dict[int, Dict[int, int]]]" = None,
+        message_magic: int = 2,
     ):
+        #: 2 = RecordBatch v2 (default); 0/1 = legacy MessageSet entries,
+        #: emulating pre-0.11 segments retained on upgraded clusters.
+        self.message_magic = message_magic
         #: partition → {chunk_index: last_covered_offset}: emulates a
         #: compacted log where a batch's last_offset_delta extends past its
         #: last *retained* record (the log cleaner preserves batch offset
@@ -90,13 +94,15 @@ class FakeBroker:
             for ci, lo in enumerate(range(0, len(rs), max_records_per_fetch)):
                 part = rs[lo : lo + max_records_per_fetch]
                 last = self.coverage_overrides.get(p, {}).get(ci, part[-1][0])
-                chunks.append(
-                    (
-                        part[0][0],
-                        last,
-                        kc.encode_record_batch(part, compression, last_offset=last),
+                if message_magic == 2:
+                    encoded = kc.encode_record_batch(
+                        part, compression, last_offset=last
                     )
-                )
+                else:
+                    encoded = kc.encode_message_set(
+                        part, magic=message_magic, compression=compression
+                    )
+                chunks.append((part[0][0], last, encoded))
             self._chunks[p] = chunks
             self._chunk_last_offsets[p] = [c[1] for c in chunks]
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

@@ -1,0 +1,214 @@
+"""Legacy MessageSet v0/v1 decode (pre-0.11 segments that survive on
+upgraded clusters; librdkafka reads these transparently so the reference
+does too — /root/reference/Cargo.toml:19, consumed blindly at
+src/kafka.rs:93).  Covers uncompressed sets, compressed wrapper-message
+recursion with relative/absolute inner offsets, LogAppendTime wrappers,
+CRC verification, mixed-format record sets, end-to-end scans through the
+fake broker, and truncation/garbage fuzz."""
+
+import random
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+from fake_broker import FakeBroker
+
+RECORDS = [
+    (100, 1_600_000_000_000, b"k1", b"v1"),
+    (101, 1_600_000_000_123, None, b"v2"),       # null key
+    (105, 1_600_000_001_000, b"k3", None),       # tombstone, offset gap
+    (106, 1_600_000_002_000, b"", b""),          # empty (not null) k/v
+]
+
+
+def _decode(buf, verify_crc=True):
+    return [
+        (off, ts, k, v)
+        for off, (ts, k, v) in kc.decode_record_batches(buf, verify_crc=verify_crc)
+    ]
+
+
+@pytest.mark.parametrize("magic", [0, 1])
+def test_uncompressed_roundtrip(magic):
+    buf = kc.encode_message_set(RECORDS, magic=magic)
+    got = _decode(buf)
+    if magic == 1:
+        assert got == RECORDS
+    else:  # v0 has no timestamps: they read as -1 ("missing")
+        assert got == [(o, -1, k, v) for o, _, k, v in RECORDS]
+
+
+@pytest.mark.parametrize("magic", [0, 1])
+@pytest.mark.parametrize(
+    "codec", [kc.COMPRESSION_GZIP, kc.COMPRESSION_SNAPPY, kc.COMPRESSION_LZ4]
+)
+def test_compressed_wrapper_roundtrip(magic, codec):
+    buf = kc.encode_message_set(RECORDS, magic=magic, compression=codec)
+    got = _decode(buf)
+    if magic == 1:
+        assert got == RECORDS  # relative inner offsets resolved via wrapper
+    else:
+        assert got == [(o, -1, k, v) for o, _, k, v in RECORDS]
+
+
+def test_v1_wrapper_log_append_time():
+    buf = kc.encode_message_set(
+        RECORDS, magic=1, compression=kc.COMPRESSION_GZIP, log_append_time=True
+    )
+    got = _decode(buf)
+    wrapper_ts = RECORDS[-1][1]
+    assert got == [(o, wrapper_ts, k, v) for o, _, k, v in RECORDS]
+
+
+def test_v1_wrapper_compacted_first_inner():
+    """The log cleaner can remove the FIRST inner record of a wrapper, so
+    relative offsets need not start at 0; base = wrapper - last holds
+    regardless."""
+    inner = b"".join(
+        kc._encode_legacy_message(rel, ts, k, v, 1)
+        for rel, (_, ts, k, v) in zip([2, 3, 5], RECORDS[:3])
+    )
+    co = zlib.compressobj(wbits=31)
+    payload = co.compress(inner) + co.flush()
+    buf = kc._encode_legacy_message(
+        105, RECORDS[2][1], None, payload, 1, kc.COMPRESSION_GZIP
+    )
+    assert [o for o, *_ in _decode(buf)] == [102, 103, 105]
+
+
+def test_malformed_legacy_entries_raise_protocol_error():
+    """Undersized entries and nested wrappers must surface as
+    KafkaProtocolError, never IndexError/struct.error/RecursionError."""
+    # 17-byte tail claiming magic 1 with batch_length 5.
+    tiny = struct.pack(">qi", 0, 5) + b"\x00\x00\x00\x00\x01"
+    with pytest.raises(kc.KafkaProtocolError, match="minimum size"):
+        _decode(tiny, verify_crc=False)
+    # Wrapper nested inside a wrapper.
+    lvl1 = kc.encode_message_set(
+        RECORDS[:1], magic=1, compression=kc.COMPRESSION_GZIP
+    )
+    co = zlib.compressobj(wbits=31)
+    payload = co.compress(lvl1) + co.flush()
+    lvl2 = kc._encode_legacy_message(
+        0, 0, None, payload, 1, kc.COMPRESSION_GZIP
+    )
+    with pytest.raises(kc.KafkaProtocolError, match="nested"):
+        _decode(lvl2, verify_crc=False)
+
+
+def test_v1_wrapper_absolute_inner_offsets():
+    """Some old producers wrote absolute inner offsets even in magic-1
+    wrappers; base = wrapper_offset - last_inner then comes out 0, so the
+    unconditional rule handles both conventions."""
+    inner = b"".join(
+        kc._encode_legacy_message(off, ts, k, v, 1)
+        for off, ts, k, v in RECORDS
+    )
+    co = zlib.compressobj(wbits=31)
+    payload = co.compress(inner) + co.flush()
+    buf = kc._encode_legacy_message(
+        RECORDS[-1][0], RECORDS[-1][1], None, payload, 1, kc.COMPRESSION_GZIP
+    )
+    assert _decode(buf) == RECORDS
+
+
+def test_crc_verification():
+    buf = bytearray(kc.encode_message_set(RECORDS[:1], magic=1))
+    buf[-1] ^= 0xFF  # flip a value byte: CRC32 over the message body breaks
+    with pytest.raises(kc.KafkaProtocolError, match="CRC"):
+        _decode(bytes(buf), verify_crc=True)
+    assert len(_decode(bytes(buf), verify_crc=False)) == 1  # unchecked path
+
+
+def test_mixed_format_record_set():
+    """A fetch response can contain old magic-0/1 entries followed by
+    modern v2 batches (segments written across upgrades)."""
+    v0 = kc.encode_message_set([(0, -1, b"a", b"x")], magic=0)
+    v1 = kc.encode_message_set([(1, 1_600_000_000_000, b"b", b"y")], magic=1)
+    v2 = kc.encode_record_batch([(2, 1_600_000_001_000, b"c", b"z")])
+    got = _decode(v0 + v1 + v2)
+    assert [o for o, *_ in got] == [0, 1, 2]
+    assert [k for _, _, k, _ in got] == [b"a", b"b", b"c"]
+
+
+def test_partial_trailing_legacy_entry_tolerated():
+    full = kc.encode_message_set(RECORDS, magic=1)
+    truncated = full + full[:20]  # 12-byte header + part of the message
+    assert _decode(truncated) == RECORDS
+
+
+def test_fuzz_truncations_and_garbage():
+    rng = random.Random(5)
+    base = kc.encode_message_set(
+        RECORDS * 5, magic=1, compression=kc.COMPRESSION_GZIP
+    )
+    for i in range(150):
+        if i % 2:
+            buf = base[: rng.randrange(1, len(base))]
+        else:
+            buf = bytearray(base)
+            for _ in range(rng.randrange(1, 5)):
+                buf[rng.randrange(len(buf))] ^= rng.randrange(1, 256)
+            buf = bytes(buf)
+        try:
+            _decode(buf, verify_crc=False)
+        except kc.KafkaProtocolError:
+            pass  # the only acceptable failure mode
+
+
+@pytest.mark.parametrize("magic", [0, 1])
+def test_wire_scan_legacy_broker(magic):
+    """End-to-end: a broker serving magic-0/1 segments scans correctly,
+    including through the native-decode code path (which must fall back to
+    Python for legacy frames)."""
+    rows = [
+        (i, 1_600_000_000_000 + i * 1000,
+         f"k{i % 7}".encode() if i % 3 else None,
+         None if i % 11 == 5 else bytes(10 + i % 30))
+        for i in range(400)
+    ]
+    with FakeBroker(
+        "old.topic", {0: rows, 1: rows[:123]},
+        message_magic=magic, max_records_per_fetch=90,
+    ) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "old.topic")
+        cfg = AnalyzerConfig(
+            num_partitions=2, batch_size=128, count_alive_keys=True,
+            alive_bitmap_bits=20,
+        )
+        result = run_scan("old.topic", src, CpuExactBackend(cfg, init_now_s=10**10), 128)
+        src.close()
+    m = result.metrics
+    assert m.overall_count == 400 + 123
+    assert m.overall_size == sum(
+        (len(k) if k else 0) + (len(v) if v else 0) for _, _, k, v in rows
+    ) + sum(
+        (len(k) if k else 0) + (len(v) if v else 0) for _, _, k, v in rows[:123]
+    )
+    if magic == 1:
+        assert m.earliest_ts_s == 1_600_000_000
+    else:
+        assert m.earliest_ts_s == 0  # v0: no timestamps -> unwrap_or(0)
+
+
+@pytest.mark.parametrize("codec", [kc.COMPRESSION_GZIP, kc.COMPRESSION_SNAPPY])
+def test_wire_scan_legacy_compressed_broker(codec):
+    rows = [(i, 1_600_000_000_000 + i, f"k{i}".encode(), bytes(20))
+            for i in range(200)]
+    with FakeBroker(
+        "old.topic", {0: rows}, message_magic=1, compression=codec,
+        max_records_per_fetch=60,
+    ) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "old.topic")
+        cfg = AnalyzerConfig(num_partitions=1, batch_size=64)
+        m = run_scan("old.topic", src, CpuExactBackend(cfg, init_now_s=0), 64).metrics
+        src.close()
+    assert m.overall_count == 200
