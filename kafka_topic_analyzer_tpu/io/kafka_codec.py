@@ -463,7 +463,7 @@ def decode_api_versions_response(r: ByteReader) -> "dict[int, tuple[int, int]]":
 
 
 # ---------------------------------------------------------------------------
-# SASL (handshake v1 + authenticate v0; PLAIN mechanism)
+# SASL (handshake v1 + authenticate v0; PLAIN + SCRAM-SHA-256/512)
 
 
 def encode_sasl_handshake_request(mechanism: str) -> bytes:
@@ -501,16 +501,222 @@ def decode_sasl_authenticate_request(r: ByteReader) -> bytes:
 
 
 def encode_sasl_authenticate_response(
-    error: int, error_message: Optional[str] = None
+    error: int,
+    error_message: Optional[str] = None,
+    auth_bytes: bytes = b"",
 ) -> bytes:
-    return ByteWriter().i16(error).string(error_message).bytes_(b"").done()
+    return (
+        ByteWriter().i16(error).string(error_message).bytes_(auth_bytes).done()
+    )
 
 
-def decode_sasl_authenticate_response(r: ByteReader) -> "tuple[int, Optional[str]]":
+def decode_sasl_authenticate_response(
+    r: ByteReader,
+) -> "tuple[int, Optional[str], bytes]":
     err = r.i16()
     msg = r.string()
-    r.bytes_()  # server auth bytes (unused for PLAIN)
-    return err, msg
+    auth = r.bytes_() or b""  # SCRAM server-first/server-final ride here
+    return err, msg, auth
+
+
+# -- SCRAM (RFC 5802/7677 over Kafka's SaslAuthenticate round trips) --------
+
+SCRAM_MECHANISMS = {"SCRAM-SHA-256": "sha256", "SCRAM-SHA-512": "sha512"}
+
+
+def _scram_saslname(name: str) -> str:
+    """RFC 5802 saslname escaping for the n= attribute."""
+    return name.replace("=", "=3D").replace(",", "=2C")
+
+
+def _scram_parse(msg: bytes) -> "dict[str, str]":
+    out = {}
+    try:
+        text = msg.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise KafkaProtocolError(f"non-UTF-8 SCRAM server message: {e}") from e
+    for part in text.split(","):
+        if len(part) >= 2 and part[1] == "=":
+            out[part[0]] = part[2:]
+    return out
+
+
+def _scram_hi(hash_name: str, password: bytes, salt: bytes, it: int) -> bytes:
+    import hashlib
+
+    return hashlib.pbkdf2_hmac(hash_name, password, salt, it)
+
+
+class ScramClient:
+    """Client side of one SCRAM exchange (no channel binding, like the
+    Kafka clients).  Usage: first_message() → broker; final_message(
+    server_first) → broker; verify_server_final(server_final)."""
+
+    def __init__(self, mechanism: str, username: str, password: str):
+        import base64
+        import os as _os
+
+        self.hash_name = SCRAM_MECHANISMS[mechanism]
+        self.password = password.encode("utf-8")
+        self.nonce = base64.b64encode(_os.urandom(24)).decode()
+        self._first_bare = f"n={_scram_saslname(username)},r={self.nonce}"
+        self._auth_message: Optional[bytes] = None
+        self._salted: Optional[bytes] = None
+
+    def first_message(self) -> bytes:
+        return ("n,," + self._first_bare).encode("utf-8")
+
+    def final_message(self, server_first: bytes) -> bytes:
+        import base64
+        import hashlib
+        import hmac as _hmac
+
+        attrs = _scram_parse(server_first)
+        if "e" in attrs:
+            raise KafkaProtocolError(f"SCRAM server error: {attrs['e']}")
+        try:
+            full_nonce = attrs["r"]
+            salt = base64.b64decode(attrs["s"])
+            iterations = int(attrs["i"])
+        except (KeyError, ValueError) as e:
+            raise KafkaProtocolError(
+                f"malformed SCRAM server-first message: {e}"
+            ) from e
+        if not full_nonce.startswith(self.nonce):
+            raise KafkaProtocolError(
+                "SCRAM server nonce does not extend the client nonce"
+            )
+        if iterations < 4096 or iterations > 10_000_000:
+            # RFC 7677 / Kafka's ScramMechanism.minIterations: a lower
+            # count is a MITM downgrade making offline cracking cheap.
+            raise KafkaProtocolError(
+                f"SCRAM iteration count {iterations} out of range "
+                "(4096..10M)"
+            )
+        without_proof = f"c=biws,r={full_nonce}"  # biws = b64("n,,")
+        self._auth_message = ",".join(
+            [self._first_bare, server_first.decode("utf-8"), without_proof]
+        ).encode("utf-8")
+        self._salted = _scram_hi(
+            self.hash_name, self.password, salt, iterations
+        )
+        client_key = _hmac.new(
+            self._salted, b"Client Key", self.hash_name
+        ).digest()
+        stored_key = hashlib.new(self.hash_name, client_key).digest()
+        signature = _hmac.new(
+            stored_key, self._auth_message, self.hash_name
+        ).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, signature))
+        return (
+            without_proof + ",p=" + base64.b64encode(proof).decode()
+        ).encode("utf-8")
+
+    def verify_server_final(self, server_final: bytes) -> None:
+        import base64
+        import hmac as _hmac
+
+        attrs = _scram_parse(server_final)
+        if "e" in attrs:
+            raise KafkaProtocolError(f"SCRAM server error: {attrs['e']}")
+        if "v" not in attrs or self._salted is None:
+            raise KafkaProtocolError("malformed SCRAM server-final message")
+        try:
+            got = base64.b64decode(attrs["v"], validate=True)
+        except Exception as e:
+            raise KafkaProtocolError(
+                f"malformed SCRAM server signature: {e}"
+            ) from e
+        server_key = _hmac.new(
+            self._salted, b"Server Key", self.hash_name
+        ).digest()
+        expected = _hmac.new(
+            server_key, self._auth_message, self.hash_name
+        ).digest()
+        if not _hmac.compare_digest(got, expected):
+            raise KafkaProtocolError(
+                "SCRAM server signature verification failed "
+                "(broker does not know the password)"
+            )
+
+
+class ScramServer:
+    """Server side, for the credential-enforcing fake broker (and as the
+    client's test oracle).  One instance per connection attempt."""
+
+    def __init__(
+        self,
+        mechanism: str,
+        username: str,
+        password: str,
+        iterations: int = 4096,
+        salt: Optional[bytes] = None,
+    ):
+        import os as _os
+
+        self.hash_name = SCRAM_MECHANISMS[mechanism]
+        self.username = username
+        self.password = password.encode("utf-8")
+        self.iterations = iterations
+        self.salt = salt if salt is not None else _os.urandom(16)
+        self._client_first_bare: Optional[str] = None
+        self._server_first: Optional[str] = None
+        self._user_ok = False
+
+    def handle_first(self, client_first: bytes) -> bytes:
+        import base64
+        import os as _os
+
+        text = client_first.decode("utf-8")
+        if not text.startswith("n,,"):
+            raise ValueError("expected gs2 header 'n,,'")
+        self._client_first_bare = text[3:]
+        attrs = _scram_parse(self._client_first_bare.encode())
+        # Real brokers look credentials up by username; an unknown user
+        # completes the exchange (no information leak) but always fails
+        # the proof check.
+        self._user_ok = attrs.get("n") == _scram_saslname(self.username)
+        nonce = attrs.get("r", "") + base64.b64encode(_os.urandom(18)).decode()
+        self._server_first = (
+            f"r={nonce},s={base64.b64encode(self.salt).decode()},"
+            f"i={self.iterations}"
+        )
+        return self._server_first.encode("utf-8")
+
+    def handle_final(self, client_final: bytes) -> "tuple[bool, bytes]":
+        import base64
+        import hashlib
+        import hmac as _hmac
+
+        attrs = _scram_parse(client_final)
+        cf_text = client_final.decode("utf-8")
+        without_proof = cf_text[: cf_text.rfind(",p=")]
+        auth_message = ",".join(
+            [self._client_first_bare or "", self._server_first or "",
+             without_proof]
+        ).encode("utf-8")
+        salted = _scram_hi(
+            self.hash_name, self.password, self.salt, self.iterations
+        )
+        client_key = _hmac.new(salted, b"Client Key", self.hash_name).digest()
+        stored_key = hashlib.new(self.hash_name, client_key).digest()
+        signature = _hmac.new(stored_key, auth_message, self.hash_name).digest()
+        try:
+            proof = base64.b64decode(attrs.get("p", ""))
+        except ValueError:
+            proof = b""
+        recovered = bytes(a ^ b for a, b in zip(proof, signature))
+        if (
+            not self._user_ok
+            or len(proof) != len(signature)
+            or not _hmac.compare_digest(
+                hashlib.new(self.hash_name, recovered).digest(), stored_key
+            )
+        ):
+            return False, b"e=invalid-proof"
+        server_key = _hmac.new(salted, b"Server Key", self.hash_name).digest()
+        server_sig = _hmac.new(server_key, auth_message, self.hash_name).digest()
+        return True, b"v=" + base64.b64encode(server_sig)
 
 
 # ---------------------------------------------------------------------------

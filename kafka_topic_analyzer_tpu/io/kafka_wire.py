@@ -88,8 +88,10 @@ class BrokerConnection:
         port: int,
         timeout_s: float = 10.0,
         ssl_context=None,
-        sasl_plain: "Optional[Tuple[str, str]]" = None,
+        sasl: "Optional[Tuple[str, str, str]]" = None,
     ):
+        """``sasl`` is (mechanism, username, password); mechanism one of
+        PLAIN, SCRAM-SHA-256, SCRAM-SHA-512."""
         self.host = host
         self.port = port
         sock = socket.create_connection((host, port), timeout=timeout_s)
@@ -101,38 +103,52 @@ class BrokerConnection:
         self._lock = threading.Lock()
         #: ApiVersions handshake result, filled lazily ({} = legacy broker).
         self.api_versions: "Optional[Dict[int, tuple[int, int]]]" = None
-        if sasl_plain is not None:
+        if sasl is not None:
             try:
-                self._authenticate_plain(*sasl_plain)
+                self._authenticate(*sasl)
             except BaseException:
                 self.close()  # don't leak the fd on failed auth
                 raise
 
-    def _authenticate_plain(self, username: str, password: str) -> None:
-        """SASL/PLAIN: SaslHandshake v1, then SaslAuthenticate v0 — must be
-        the first exchange on the connection (brokers reject anything else
-        before authentication)."""
+    def _sasl_handshake(self, mechanism: str) -> None:
         r = self.request(
-            kc.API_SASL_HANDSHAKE, 1, kc.encode_sasl_handshake_request("PLAIN")
+            kc.API_SASL_HANDSHAKE, 1, kc.encode_sasl_handshake_request(mechanism)
         )
         err, mechanisms = kc.decode_sasl_handshake_response(r)
         if err:
             raise kc.KafkaProtocolError(
                 f"SASL handshake failed (error {err}); broker offers "
-                f"mechanisms {mechanisms} — this client implements PLAIN"
+                f"mechanisms {mechanisms} — this client asked for {mechanism}"
             )
+
+    def _sasl_round(self, auth_bytes: bytes) -> bytes:
+        """One SaslAuthenticate round trip → server auth bytes."""
         r = self.request(
             kc.API_SASL_AUTHENTICATE,
             0,
-            kc.encode_sasl_authenticate_request(
-                kc.sasl_plain_token(username, password)
-            ),
+            kc.encode_sasl_authenticate_request(auth_bytes),
         )
-        err, msg = kc.decode_sasl_authenticate_response(r)
+        err, msg, server_bytes = kc.decode_sasl_authenticate_response(r)
         if err:
             raise kc.KafkaProtocolError(
                 f"SASL authentication failed (error {err}): {msg or 'no detail'}"
             )
+        return server_bytes
+
+    def _authenticate(self, mechanism: str, username: str, password: str) -> None:
+        """SaslHandshake v1 + SaslAuthenticate v0 exchange(s) — must be the
+        first traffic on the connection (brokers reject anything else
+        before authentication).  PLAIN is one round; SCRAM is two (RFC
+        5802 client-first/client-final), with the server's signature
+        verified so a spoofed broker can't fake success."""
+        self._sasl_handshake(mechanism)
+        if mechanism == "PLAIN":
+            self._sasl_round(kc.sasl_plain_token(username, password))
+            return
+        scram = kc.ScramClient(mechanism, username, password)
+        server_first = self._sasl_round(scram.first_message())
+        server_final = self._sasl_round(scram.final_message(server_first))
+        scram.verify_server_final(server_final)
 
     def close(self) -> None:
         try:
@@ -222,21 +238,22 @@ class KafkaWireSource(RecordSource):
             overrides.pop("enable.ssl.certificate.verification", "true").lower()
             == "true"
         )
-        self._sasl_plain: "Optional[Tuple[str, str]]" = None
+        self._sasl: "Optional[Tuple[str, str, str]]" = None
         mechanism = overrides.pop("sasl.mechanism", "PLAIN").upper()
         sasl_user = overrides.pop("sasl.username", None)
         sasl_pass = overrides.pop("sasl.password", None)
         if protocol in ("sasl_plaintext", "sasl_ssl"):
-            if mechanism != "PLAIN":
+            if mechanism != "PLAIN" and mechanism not in kc.SCRAM_MECHANISMS:
                 raise ValueError(
-                    f"sasl.mechanism {mechanism!r} unsupported (PLAIN only)"
+                    f"sasl.mechanism {mechanism!r} unsupported "
+                    "(PLAIN, SCRAM-SHA-256, SCRAM-SHA-512)"
                 )
             if sasl_user is None or sasl_pass is None:
                 raise ValueError(
                     "sasl_plaintext/sasl_ssl require sasl.username and "
                     "sasl.password"
                 )
-            self._sasl_plain = (sasl_user, sasl_pass)
+            self._sasl = (mechanism, sasl_user, sasl_pass)
         elif sasl_user is not None or sasl_pass is not None:
             log.warning(
                 "sasl.username/sasl.password ignored: security.protocol is "
@@ -283,7 +300,7 @@ class KafkaWireSource(RecordSource):
                     port,
                     self.timeout_s,
                     ssl_context=self._ssl_context,
-                    sasl_plain=self._sasl_plain,
+                    sasl=self._sasl,
                 )
                 self._conns[key] = conn
             return conn

@@ -32,6 +32,7 @@ class FakeBroker:
         api_ranges: "Optional[Dict[int, Tuple[int, int]]]" = None,
         no_api_versions: bool = False,
         sasl_plain: "Optional[Tuple[str, str]]" = None,
+        sasl_scram: "Optional[Tuple[str, str, str]]" = None,
         honor_partition_max_bytes: bool = False,
         honor_max_bytes: bool = False,
         coverage_overrides: "Optional[Dict[int, Dict[int, int]]]" = None,
@@ -57,6 +58,10 @@ class FakeBroker:
         #: When set, every connection must SASL/PLAIN-authenticate with
         #: these credentials before any other API is served.
         self.sasl_plain = sasl_plain
+        #: (mechanism, username, password) with mechanism SCRAM-SHA-256 or
+        #: SCRAM-SHA-512: connections must complete the two-round SCRAM
+        #: exchange before any other API is served.
+        self.sasl_scram = sasl_scram
         self.tls_context = tls_context
         self.node_id = node_id
         self.cluster = cluster
@@ -175,8 +180,17 @@ class FakeBroker:
             got += len(chunk)
         return b"".join(chunks)
 
+    def _offered_mechanisms(self) -> "list[str]":
+        out = []
+        if self.sasl_plain is not None:
+            out.append("PLAIN")
+        if self.sasl_scram is not None:
+            out.append(self.sasl_scram[0])
+        return out
+
     def _serve(self, conn: socket.socket) -> None:
-        authed = self.sasl_plain is None
+        authed = self.sasl_plain is None and self.sasl_scram is None
+        scram_state = None  # in-flight kc.ScramServer for this connection
         with conn:
             while not self._stop.is_set():
                 head = self._recv_exact(conn, 4)
@@ -195,13 +209,35 @@ class FakeBroker:
                     return  # real brokers drop unauthenticated requests
                 if api_key == kc.API_SASL_HANDSHAKE:
                     mech = kc.decode_sasl_handshake_request(r)
-                    supported = self.sasl_plain is not None and mech == "PLAIN"
-                    body = kc.encode_sasl_handshake_response(
-                        0 if supported else 33, ["PLAIN"] if supported else []
-                    )
+                    offered = self._offered_mechanisms()
+                    if mech in offered:
+                        if mech != "PLAIN":
+                            scram_state = kc.ScramServer(*self.sasl_scram)
+                        body = kc.encode_sasl_handshake_response(0, offered)
+                    else:
+                        body = kc.encode_sasl_handshake_response(33, offered)
                 elif api_key == kc.API_SASL_AUTHENTICATE:
                     token = kc.decode_sasl_authenticate_request(r)
-                    if self.sasl_plain is not None and token == kc.sasl_plain_token(
+                    if scram_state is not None:
+                        if scram_state._server_first is None:
+                            body = kc.encode_sasl_authenticate_response(
+                                0, None, scram_state.handle_first(token)
+                            )
+                        else:
+                            ok, final = scram_state.handle_final(token)
+                            if ok:
+                                authed = True
+                                body = kc.encode_sasl_authenticate_response(
+                                    0, None, final
+                                )
+                            else:
+                                body = kc.encode_sasl_authenticate_response(
+                                    kc.ERR_SASL_AUTHENTICATION_FAILED,
+                                    "Authentication failed: invalid "
+                                    "credentials",
+                                )
+                            scram_state = None
+                    elif self.sasl_plain is not None and token == kc.sasl_plain_token(
                         *self.sasl_plain
                     ):
                         authed = True

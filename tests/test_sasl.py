@@ -67,10 +67,126 @@ def test_sasl_client_against_non_sasl_broker():
 
 
 def test_unsupported_mechanism():
-    with pytest.raises(ValueError, match="PLAIN only"):
+    with pytest.raises(ValueError, match="sasl.mechanism"):
         KafkaWireSource(
             "127.0.0.1:1", "x",
             overrides={"security.protocol": "sasl_plaintext",
-                       "sasl.mechanism": "SCRAM-SHA-512",
+                       "sasl.mechanism": "GSSAPI",
                        "sasl.username": "u", "sasl.password": "p"},
         )
+
+
+# ---------------------------------------------------------------------------
+# SCRAM-SHA-256 / SCRAM-SHA-512 (RFC 5802 over SaslAuthenticate rounds)
+
+
+def _scram_creds(mech):
+    return {"security.protocol": "sasl_plaintext", "sasl.mechanism": mech,
+            "sasl.username": "scout", "sasl.password": "hunter2"}
+
+
+@pytest.mark.parametrize("mech", ["SCRAM-SHA-256", "SCRAM-SHA-512"])
+def test_scram_scan_with_good_credentials(mech):
+    with FakeBroker(
+        "s.topic", {0: ROWS}, sasl_scram=(mech, "scout", "hunter2")
+    ) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", "s.topic", overrides=_scram_creds(mech)
+        )
+        cfg = AnalyzerConfig(num_partitions=1, batch_size=64)
+        m = run_scan("s.topic", src, CpuExactBackend(cfg, init_now_s=0), 64).metrics
+        src.close()
+    assert m.overall_count == 120
+
+
+@pytest.mark.parametrize("mech", ["SCRAM-SHA-256", "SCRAM-SHA-512"])
+def test_scram_bad_password_rejected(mech):
+    with FakeBroker(
+        "s.topic", {0: ROWS}, sasl_scram=(mech, "scout", "hunter2")
+    ) as broker:
+        bad = dict(_scram_creds(mech), **{"sasl.password": "wrong"})
+        with pytest.raises(KafkaProtocolError, match="authentication failed"):
+            KafkaWireSource(f"127.0.0.1:{broker.port}", "s.topic", overrides=bad)
+
+
+def test_scram_wrong_username_rejected():
+    with FakeBroker(
+        "s.topic", {0: ROWS}, sasl_scram=("SCRAM-SHA-256", "scout", "hunter2")
+    ) as broker:
+        bad = dict(_scram_creds("SCRAM-SHA-256"), **{"sasl.username": "other"})
+        with pytest.raises(KafkaProtocolError, match="authentication failed"):
+            KafkaWireSource(f"127.0.0.1:{broker.port}", "s.topic", overrides=bad)
+
+
+def test_scram_mechanism_mismatch():
+    """Broker offering only SCRAM-SHA-512 must reject a -256 handshake with
+    the offered list in the error."""
+    with FakeBroker(
+        "s.topic", {0: ROWS}, sasl_scram=("SCRAM-SHA-512", "scout", "hunter2")
+    ) as broker:
+        with pytest.raises(KafkaProtocolError, match="SCRAM-SHA-512"):
+            KafkaWireSource(
+                f"127.0.0.1:{broker.port}", "s.topic",
+                overrides=_scram_creds("SCRAM-SHA-256"),
+            )
+
+
+def test_scram_client_verifies_server_signature():
+    """A broker that accepts the proof but returns a wrong server signature
+    (spoofed broker that doesn't know the password) must be rejected by the
+    CLIENT."""
+    from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+
+    client = kc.ScramClient("SCRAM-SHA-256", "scout", "hunter2")
+    server = kc.ScramServer("SCRAM-SHA-256", "scout", "hunter2")
+    first = client.first_message()
+    server_first = server.handle_first(first)
+    final = client.final_message(server_first)
+    ok, server_final = server.handle_final(final)
+    assert ok
+    client.verify_server_final(server_final)  # good signature passes
+    with pytest.raises(KafkaProtocolError, match="server signature"):
+        client.verify_server_final(b"v=" + b"QUJDREVGRw==")
+
+
+def test_scram_downgrade_and_malformed_server_messages_rejected():
+    """MITM defenses: an iteration count below RFC 7677's 4096 floor is a
+    downgrade attack; malformed server bytes must raise the protocol error
+    (one clean CLI line), not binascii/Unicode tracebacks."""
+    from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+
+    client = kc.ScramClient("SCRAM-SHA-256", "u", "p")
+    with pytest.raises(KafkaProtocolError, match="iteration count"):
+        client.final_message(b"r=%snonce,s=c2FsdA==,i=1" % client.nonce.encode())
+    client2 = kc.ScramClient("SCRAM-SHA-256", "u", "p")
+    with pytest.raises(KafkaProtocolError, match="non-UTF-8"):
+        client2.final_message(b"\xff\xfe\x00")
+    client3 = kc.ScramClient("SCRAM-SHA-256", "u", "p")
+    server = kc.ScramServer("SCRAM-SHA-256", "u", "p")
+    client3.final_message(server.handle_first(client3.first_message()))
+    with pytest.raises(KafkaProtocolError, match="malformed SCRAM server"):
+        client3.verify_server_final(b"v=!!!not-base64")
+
+
+def test_scram_rfc7677_vector():
+    """RFC 7677's published SCRAM-SHA-256 test vector, driven through both
+    sides with the vector's fixed nonces/salt."""
+    import base64
+
+    from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+
+    client = kc.ScramClient("SCRAM-SHA-256", "user", "pencil")
+    client.nonce = "rOprNGfwEbeRWgbNEkqO"
+    client._first_bare = f"n=user,r={client.nonce}"
+    server_first = (
+        b"r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+        b"s=W22ZaJ0SNY7soEsUEjb6gQ==,i=4096"
+    )
+    final = client.final_message(server_first)
+    assert final == (
+        b"c=biws,r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+        b"p=dHzbZapWIk4jUhN+Ute9ytag9zjfMHgsqmmiz7AndVQ="
+    )
+    client.verify_server_final(
+        b"v=6rriTRBi23WpRR/wtup+mMhUZUn/dB5nLTJRsjl95G4="
+    )
