@@ -243,6 +243,38 @@ def _make_cli_backend(args, config: AnalyzerConfig, mesh_shape):
     return make_backend(args.backend, config)
 
 
+def parse_from_timestamp_flag(args) -> "int | None":
+    """Validate --from-timestamp's flag combination and parse it to ms
+    (shared by the single- and multi-topic paths)."""
+    if not args.from_timestamp:
+        return None
+    if args.source != "kafka":
+        raise ValueError(
+            "--from-timestamp requires --source kafka (broker-side "
+            "timestamp index lookup)"
+        )
+    if args.resume:
+        raise ValueError("--from-timestamp cannot be combined with --resume")
+    return parse_timestamp_ms(args.from_timestamp)
+
+
+def resolve_start_offsets(source, from_ts_ms, label):
+    """(start_at, exhausted): per-partition first offsets at/after the
+    cutoff via the broker timestamp index; exhausted=True (with the
+    message already printed) when nothing remains at or after it."""
+    if from_ts_ms is None:
+        return None, False
+    start_at = source.offsets_for_timestamp(from_ts_ms)
+    _, end = source.watermarks()
+    if all(start_at.get(p, 0) >= end[p] for p in end):
+        print(
+            f"No records at or after {label} — nothing to analyze.",
+            file=sys.stderr,
+        )
+        return None, True
+    return start_at, False
+
+
 def run_multi_topic(args, topics: "list[str]") -> int:
     """Fan-in scan of several topics through one backend: per-topic reports
     from row slices, plus a cross-topic union block whose sketch lines come
@@ -256,10 +288,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     from kafka_topic_analyzer_tpu.utils.timefmt import format_utc_seconds
 
     with user_input_phase():
-        if args.from_timestamp:
-            raise ValueError(
-                "--from-timestamp is not supported with multi-topic fan-in yet"
-            )
+        from_ts_ms = parse_from_timestamp_flag(args)
         # Dump tees attach per topic, before fan-in remaps partition ids.
         topic_sources = [
             (t, wrap_with_dump(args, t, make_source(args, topic=t, seed_salt=i)))
@@ -272,6 +301,11 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             file=sys.stderr,
         )
         sys.exit(-2)
+    start_at, exhausted = resolve_start_offsets(
+        multi, from_ts_ms, args.from_timestamp
+    )
+    if exhausted:
+        return 0
 
     with user_input_phase():
         mesh_shape = parse_mesh(args.mesh)
@@ -302,6 +336,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             snapshot_dir=args.snapshot_dir,
             snapshot_every_s=args.snapshot_every,
             resume=args.resume,
+            start_at=start_at,
         )
     if args.stats:
         print("scan stages:", file=sys.stderr)
@@ -417,30 +452,13 @@ def _run(args) -> int:
     with user_input_phase():
         # Cheap flag validation first — before any broker handshake or dump
         # directory creation.
-        from_ts_ms = None
-        if args.from_timestamp:
-            if args.source != "kafka":
-                raise ValueError(
-                    "--from-timestamp requires --source kafka (broker-side "
-                    "timestamp index lookup)"
-                )
-            if args.resume:
-                raise ValueError(
-                    "--from-timestamp cannot be combined with --resume"
-                )
-            from_ts_ms = parse_timestamp_ms(args.from_timestamp)
+        from_ts_ms = parse_from_timestamp_flag(args)
         source = wrap_with_dump(args, args.topic, make_source(args))
-        start_at = None
-        if from_ts_ms is not None:
-            start_at = source.offsets_for_timestamp(from_ts_ms)
-            _, end = source.watermarks()
-            if all(start_at.get(p, 0) >= end[p] for p in end):
-                print(
-                    f"No records at or after {args.from_timestamp} — "
-                    "nothing to analyze.",
-                    file=sys.stderr,
-                )
-                return 0
+        start_at, exhausted = resolve_start_offsets(
+            source, from_ts_ms, args.from_timestamp
+        )
+        if exhausted:
+            return 0
 
     # Empty-topic guard: exit(-2) like src/main.rs:98-101.
     if source.is_empty():

@@ -405,14 +405,20 @@ def _zstd_stream_decompress(lib, data: bytes) -> "bytes | None":
                 return None
             if inbuf.pos == in_before and outbuf.pos == 0:
                 return None  # no progress: treat as corrupt
-            out += chunk.raw[: outbuf.pos]
+            out += ctypes.string_at(chunk, outbuf.pos)
             if len(out) > MAX_DECOMPRESSED:
                 raise ValueError(
                     f"zstd batch exceeds decompressed size cap "
                     f"({MAX_DECOMPRESSED} B)"
                 )
-            if inbuf.pos >= inbuf.size and outbuf.pos < outbuf.size:
-                break  # input drained and output not full: done
+            if inbuf.pos >= inbuf.size and (
+                ret == 0 or outbuf.pos < outbuf.size
+            ):
+                # Input drained and either the frame completed (ret == 0 —
+                # even when the output chunk filled exactly) or the decoder
+                # flushed everything it could (not full ⇒ it wants more
+                # input: truncated, handled below).
+                break
         if ret != 0:
             return None  # truncated final frame
         return bytes(out)

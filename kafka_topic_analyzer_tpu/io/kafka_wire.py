@@ -12,10 +12,17 @@ reproduces the reference consumer's observable behavior (src/kafka.rs):
   ``enable.auto.commit=false`` + a fresh UUID group id per run
   (src/kafka.rs:28-34), i.e. group membership never has an observable
   effect — so this client fetches directly from partition leaders;
-- ``--librdkafka`` overrides map onto the fetch knobs this client has
+- ``--librdkafka`` overrides map onto this client's knobs: fetch tuning
   (fetch.wait.max.ms, fetch.min.bytes, fetch.max.bytes,
-  max.partition.fetch.bytes); unknown keys are ignored with a warning, like
-  librdkafka logs unknown properties.
+  max.partition.fetch.bytes, fetch.error.backoff.ms, check.crcs,
+  receive.message.max.bytes), socket tuning (socket.timeout.ms,
+  socket.connection.setup.timeout.ms, broker.address.family,
+  socket.keepalive.enable, socket.nagle.disable,
+  socket.send/receive.buffer.bytes), TLS and SASL properties.  Properties
+  that are valid librdkafka consumer config but can have no effect here
+  (KNOWN_NOOP_PROPERTIES — group/commit settings the reference disables
+  anyway) are accepted silently; truly unknown keys warn, like librdkafka
+  logs unknown properties.
 
 Record metadata is extracted batch-at-a-time: key/value lengths, null flags,
 second-granularity timestamps (truncated toward zero like Rust's ``/ 1000``,
@@ -26,6 +33,7 @@ available (numpy fallback otherwise).  Payload bytes never leave this module
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import socket
 import struct
@@ -45,6 +53,37 @@ CLIENT_ID = "topic-analyzer"  # src/kafka.rs:36
 #: Ceiling for the auto-grown per-partition fetch size (librdkafka caps
 #: message.max.bytes at ~1 GB; also keeps the i32 wire field safe).
 MAX_PARTITION_FETCH_BYTES = 1 << 30
+
+#: librdkafka property names that are VALID for the reference's consumer
+#: (src/kafka.rs:24-44 sets several of them) but have no observable effect
+#: in this client by design: no consumer group is ever formed (the
+#: reference never commits), there is no producer, and log tuning is
+#: handled by Python logging.  Accepted silently (debug log) rather than
+#: warned about, so reference-style invocations stay quiet.
+KNOWN_NOOP_PROPERTIES = frozenset({
+    "group.id", "session.timeout.ms", "heartbeat.interval.ms",
+    "max.poll.interval.ms", "enable.auto.commit", "auto.commit.interval.ms",
+    "auto.offset.reset", "enable.partition.eof", "enable.auto.offset.store",
+    "queue.buffering.max.ms", "queued.min.messages",
+    "queued.max.messages.kbytes", "client.id", "reconnect.backoff.ms",
+    "reconnect.backoff.max.ms", "statistics.interval.ms",
+    "api.version.request", "broker.version.fallback", "debug", "log_level",
+    "allow.auto.create.topics", "client.rack", "metadata.max.age.ms",
+    "topic.metadata.refresh.interval.ms",
+})
+
+
+@dataclasses.dataclass
+class SocketOptions:
+    """Socket-level knobs mapped from librdkafka property names."""
+
+    connect_timeout_s: float = 30.0
+    #: 0 = any family; socket.AF_INET / AF_INET6 to pin (broker.address.family)
+    family: int = 0
+    keepalive: bool = False      # socket.keepalive.enable
+    nodelay: bool = True         # socket.nagle.disable (our default: on)
+    sndbuf: int = 0              # socket.send.buffer.bytes (0 = OS default)
+    rcvbuf: int = 0              # socket.receive.buffer.bytes
 
 
 def _hash_keys(
@@ -89,13 +128,42 @@ class BrokerConnection:
         timeout_s: float = 10.0,
         ssl_context=None,
         sasl: "Optional[Tuple[str, str, str]]" = None,
+        sock_opts: Optional[SocketOptions] = None,
     ):
         """``sasl`` is (mechanism, username, password); mechanism one of
         PLAIN, SCRAM-SHA-256, SCRAM-SHA-512."""
         self.host = host
         self.port = port
-        sock = socket.create_connection((host, port), timeout=timeout_s)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        opts = sock_opts or SocketOptions()
+        if opts.family:
+            # Pinned address family (broker.address.family=v4/v6):
+            # create_connection can't filter, so resolve explicitly.
+            infos = socket.getaddrinfo(
+                host, port, opts.family, socket.SOCK_STREAM
+            )
+            if not infos:
+                raise OSError(f"no address of requested family for {host}")
+            af, kind, proto, _cn, addr = infos[0]
+            sock = socket.socket(af, kind, proto)
+            sock.settimeout(opts.connect_timeout_s)
+            try:
+                sock.connect(addr)
+            except BaseException:
+                sock.close()
+                raise
+        else:
+            sock = socket.create_connection(
+                (host, port), timeout=opts.connect_timeout_s
+            )
+        sock.settimeout(timeout_s)
+        if opts.nodelay:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if opts.keepalive:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        if opts.sndbuf:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, opts.sndbuf)
+        if opts.rcvbuf:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, opts.rcvbuf)
         if ssl_context is not None:
             sock = ssl_context.wrap_socket(sock, server_hostname=host)
         self.sock = sock
@@ -218,17 +286,58 @@ class KafkaWireSource(RecordSource):
         use_native_hashing: bool = True,
     ):
         self.topic = topic
-        self.timeout_s = timeout_s
         self.use_native_hashing = use_native_hashing
         overrides = dict(overrides or {})
         # librdkafka-name knobs this client honors (others warned+ignored).
         self.max_wait_ms = int(overrides.pop("fetch.wait.max.ms", 100))
         self.min_bytes = int(overrides.pop("fetch.min.bytes", 1))
         self.max_bytes = int(overrides.pop("fetch.max.bytes", 64 << 20))
+        # receive.message.max.bytes bounds whole responses in librdkafka;
+        # honoring it as a response-budget cap keeps the operational intent.
+        recv_max = overrides.pop("receive.message.max.bytes", None)
+        if recv_max is not None:
+            self.max_bytes = min(self.max_bytes, int(recv_max))
         self.partition_max_bytes = int(
             overrides.pop("max.partition.fetch.bytes", 8 << 20)
         )
         self.verify_crc = overrides.pop("check.crcs", "false").lower() == "true"
+        self.timeout_s = (
+            float(overrides.pop("socket.timeout.ms", timeout_s * 1000.0))
+            / 1000.0
+        )
+        #: Pause between fetch rounds when nothing progressed (leader
+        #: churn, budget starvation) — librdkafka's fetch.error.backoff.ms.
+        self.error_backoff_ms = int(
+            overrides.pop("fetch.error.backoff.ms", self.max_wait_ms)
+        )
+        family_name = overrides.pop("broker.address.family", "any").lower()
+        try:
+            family = {
+                "any": 0,
+                "v4": socket.AF_INET,
+                "v6": socket.AF_INET6,
+            }[family_name]
+        except KeyError:
+            raise ValueError(
+                f"broker.address.family {family_name!r} invalid "
+                "(any, v4, v6)"
+            ) from None
+        self._sock_opts = SocketOptions(
+            connect_timeout_s=float(
+                overrides.pop("socket.connection.setup.timeout.ms", 30_000)
+            ) / 1000.0,
+            family=family,
+            keepalive=(
+                overrides.pop("socket.keepalive.enable", "false").lower()
+                == "true"
+            ),
+            nodelay=(
+                overrides.pop("socket.nagle.disable", "true").lower()
+                == "true"
+            ),
+            sndbuf=int(overrides.pop("socket.send.buffer.bytes", 0)),
+            rcvbuf=int(overrides.pop("socket.receive.buffer.bytes", 0)),
+        )
         # TLS, via the same librdkafka property names the reference's --ssl
         # feature would use (Cargo.toml:19 features=["ssl"]).
         self._ssl_context = None
@@ -275,7 +384,10 @@ class KafkaWireSource(RecordSource):
                 "(plaintext, ssl, sasl_plaintext, sasl_ssl)"
             )
         for k in overrides:
-            log.warning("ignoring unsupported consumer property %r", k)
+            if k in KNOWN_NOOP_PROPERTIES:
+                log.debug("property %r accepted (no effect in this client)", k)
+            else:
+                log.warning("ignoring unsupported consumer property %r", k)
 
         self._bootstrap = parse_bootstrap(bootstrap_servers)
         self._conn_lock = threading.Lock()
@@ -301,6 +413,7 @@ class KafkaWireSource(RecordSource):
                     self.timeout_s,
                     ssl_context=self._ssl_context,
                     sasl=self._sasl,
+                    sock_opts=self._sock_opts,
                 )
                 self._conns[key] = conn
             return conn
@@ -748,7 +861,7 @@ class KafkaWireSource(RecordSource):
             if not progressed and remaining:
                 # Nothing moved this round (e.g. leader churn): brief pause
                 # so error responses don't busy-spin the broker.
-                time.sleep(self.max_wait_ms / 1000.0)
+                time.sleep(self.error_backoff_ms / 1000.0)
         yield from flush(force=True)
 
     def _records_to_batch(

@@ -82,6 +82,15 @@ class MultiTopicSource(RecordSource):
                 end[self._row_of[(topic, p)]] = v
         return start, end
 
+    def offsets_for_timestamp(self, ts_ms: int) -> Dict[int, int]:
+        """Per-row first offset with record ts >= ts_ms (broker timestamp
+        index per topic, remapped into dense row space)."""
+        out: Dict[int, int] = {}
+        for topic, src in self.topic_sources:
+            for p, off in src.offsets_for_timestamp(ts_ms).items():
+                out[self._row_of[(topic, p)]] = off
+        return out
+
     def batches(
         self,
         batch_size: int,

@@ -121,6 +121,56 @@ def test_parse_bootstrap_ipv6():
     ]
 
 
+def test_librdkafka_passthrough_knobs(caplog):
+    """The broadened --librdkafka surface: socket/fetch knobs map onto the
+    client, reference-style properties (src/kafka.rs:24-44) are accepted
+    silently, and only truly unknown names warn."""
+    import logging
+
+    records = {0: _mk_records(0, 30)}
+    with FakeBroker("wire.topic", records) as broker:
+        with caplog.at_level(logging.DEBUG, "kafka_topic_analyzer_tpu.io.kafka_wire"):
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", "wire.topic",
+                overrides={
+                    "socket.timeout.ms": "5000",
+                    "socket.connection.setup.timeout.ms": "4000",
+                    "fetch.error.backoff.ms": "250",
+                    "receive.message.max.bytes": "1000000",
+                    "broker.address.family": "v4",
+                    "socket.keepalive.enable": "true",
+                    "socket.send.buffer.bytes": "65536",
+                    "socket.receive.buffer.bytes": "65536",
+                    # reference defaults (src/kafka.rs:24-44): no warnings
+                    "auto.offset.reset": "earliest",
+                    "enable.auto.commit": "false",
+                    "enable.partition.eof": "false",
+                    "enable.auto.offset.store": "false",
+                    "queue.buffering.max.ms": "1000",
+                    "group.id": "topic-analyzer--x",
+                    # genuinely unknown: one warning
+                    "definitely.not.a.property": "1",
+                },
+            )
+        assert src.timeout_s == 5.0
+        assert src.error_backoff_ms == 250
+        assert src.max_bytes == 1_000_000
+        assert src._sock_opts.connect_timeout_s == 4.0
+        assert src._sock_opts.keepalive and src._sock_opts.rcvbuf == 65536
+        assert sum(len(b) for b in src.batches(64)) == 30  # v4 pin works
+        src.close()
+    warned = [r.message for r in caplog.records if r.levelno >= logging.WARNING]
+    assert any("definitely.not.a.property" in m for m in warned)
+    assert not any("queue.buffering" in m or "group.id" in m for m in warned)
+
+
+def test_invalid_address_family_rejected():
+    with pytest.raises(ValueError, match="broker.address.family"):
+        KafkaWireSource(
+            "127.0.0.1:1", "x", overrides={"broker.address.family": "ipv9"}
+        )
+
+
 # ---------------------------------------------------------------------------
 # end-to-end against the fake broker
 

@@ -99,6 +99,75 @@ def test_fan_in_alive_keys_mesh_independent():
     assert counts[0] == counts[1]
 
 
+def test_fan_in_from_timestamp():
+    """--from-timestamp over multi-topic fan-in: each topic's broker
+    timestamp index resolves to row-space start offsets."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from fake_broker import FakeBroker
+
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+    def rows(n):
+        return [(i, 1_600_000_000_000 + i * 1000, f"k{i}".encode(), bytes(10))
+                for i in range(n)]
+
+    with FakeBroker("alpha", {0: rows(100)}) as b1, \
+         FakeBroker("beta", {0: rows(60)}) as b2:
+        multi = MultiTopicSource([
+            ("alpha", KafkaWireSource(f"127.0.0.1:{b1.port}", "alpha")),
+            ("beta", KafkaWireSource(f"127.0.0.1:{b2.port}", "beta")),
+        ])
+        cutoff = 1_600_000_000_000 + 39_500  # first record >= : offset 40
+        start_at = multi.offsets_for_timestamp(cutoff)
+        assert start_at == {0: 40, 1: 40}
+        cfg = AnalyzerConfig(num_partitions=2, batch_size=64)
+        m = run_scan(
+            "m", multi, CpuExactBackend(cfg, init_now_s=10**10), 64,
+            start_at=start_at,
+        ).metrics
+        multi.close()
+    assert m.overall_count == (100 - 40) + (60 - 40)
+    assert m.earliest_ts_s == (1_600_000_000_000 + 40_000) // 1000
+
+
+def test_cli_fan_in_from_timestamp(capsys, monkeypatch):
+    """The full CLI path for -t a,b --from-timestamp: validation no longer
+    rejects the combination, each topic resolves its own timestamp index,
+    and the per-topic reports reflect the cutoff."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from fake_broker import FakeBroker
+
+    import kafka_topic_analyzer_tpu.cli as cli_mod
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+    def rows(n):
+        return [(i, 1_600_000_000_000 + i * 1000, f"k{i}".encode(), bytes(10))
+                for i in range(n)]
+
+    with FakeBroker("alpha", {0: rows(100)}) as b1, \
+         FakeBroker("beta", {0: rows(60)}) as b2:
+        ports = {"alpha": b1.port, "beta": b2.port}
+
+        def make_source(args, topic=None, seed_salt=0):
+            t = topic or args.topic
+            return KafkaWireSource(f"127.0.0.1:{ports[t]}", t)
+
+        monkeypatch.setattr(cli_mod, "make_source", make_source)
+        rc = main([
+            "-t", "alpha,beta", "-b", "ignored:9092",
+            "--from-timestamp", str(1_600_000_000_000 + 39_500),
+            "--backend", "cpu", "--quiet",
+        ])
+        assert rc == 0
+    out = capsys.readouterr().out
+    assert "Topic alpha" in out and "Topic beta" in out
+    assert "Messages: 80" in out  # union: 60 + 20 after the cutoff
+
+
 def test_duplicate_topics_rejected():
     spec = _spec(1)
     with pytest.raises(ValueError, match="duplicate"):

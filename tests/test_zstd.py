@@ -124,6 +124,19 @@ def test_streamed_frames_without_content_size():
         assert zstd_py.decompress(comp, 1 << 30) == data
 
 
+def test_streamed_frame_exact_chunk_fill():
+    """A streamed frame whose output exactly fills the decode chunk buffer
+    must complete on the fast path (regression: the loop once required a
+    non-full final chunk and demoted these to the pure-Python decoder)."""
+    if _load_libzstd() is None:
+        pytest.skip("libzstd unavailable")
+    from kafka_topic_analyzer_tpu.io.compression import _zstd_stream_decompress
+
+    data = b"A" * (256 * 1024)  # compresses tiny -> chunk_size = 256 KiB
+    comp = _stream_compress_chunked(data)
+    assert _zstd_stream_decompress(_load_libzstd(), comp) == data
+
+
 def test_match_offset_cannot_cross_frame_boundary():
     """Frames are independent: a match in frame 2 reaching into frame 1's
     output is corrupt (libzstd rejects it; so must the Python decoder).
