@@ -223,6 +223,16 @@ def wrap_with_dump(args, topic: str, source):
 
 
 
+def _not_report_process(args) -> bool:
+    """Multi-host runs produce ONE report: every process scans and takes
+    part in the collective finalize, but only process 0 renders output."""
+    if not args.distributed:
+        return False
+    import jax
+
+    return jax.process_index() != 0
+
+
 def _make_cli_backend(args, config: AnalyzerConfig, mesh_shape):
     """cpu oracle, single-device tpu, or sharded mesh backend per flags."""
     if args.backend == "tpu":
@@ -342,6 +352,8 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         print("scan stages:", file=sys.stderr)
         print(result.profile.summary(), file=sys.stderr)
     multi.close()  # flush per-topic segment dumps, release connections
+    if _not_report_process(args):
+        return 0  # multi-host: one report, from process 0
 
     union = result.metrics
     # Per-topic projections, computed once for both output formats.
@@ -509,6 +521,8 @@ def _run(args) -> int:
         print(result.profile.summary(), file=sys.stderr)
     if hasattr(source, "close"):
         source.close()  # flush segment dumps, release broker connections
+    if _not_report_process(args):
+        return 0  # multi-host: one report, from process 0
 
     if args.json:
         import json

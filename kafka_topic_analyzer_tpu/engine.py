@@ -112,7 +112,22 @@ def run_scan(
     tracker = _ProgressTracker(start_offsets)
     if start_at:
         tracker.next_offsets.update(start_at)
-    can_snapshot = snapshot_dir is not None and hasattr(backend, "get_state")
+    can_snapshot = (
+        snapshot_dir is not None
+        and hasattr(backend, "get_state")
+        and getattr(backend, "snapshot_capable", True)
+    )
+    if (
+        snapshot_dir is not None
+        and hasattr(backend, "get_state")
+        and not getattr(backend, "snapshot_capable", True)
+    ):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "snapshots are not supported under multi-controller runs "
+            "(state shards are not process-addressable); continuing without"
+        )
     if snapshot_dir is not None and not hasattr(backend, "get_state"):
         import logging
 
@@ -170,39 +185,52 @@ def run_scan(
         if hasattr(backend, "update_shards"):
             # Sharded scan: one batch stream per data shard, each restricted
             # to its own partitions (records.py ordering contract), zipped so
-            # every device step carries one full batch per shard.
+            # every device step carries one full batch per shard.  Under
+            # multi-controller (jax.distributed), this process feeds only
+            # the data rows it hosts (backend.local_rows) — the turnkey
+            # multi-host contract: run the same CLI on every host.
             from kafka_topic_analyzer_tpu.parallel.mesh import assign_partitions
 
             d = backend.config.data_shards
             shard_parts = assign_partitions(pindex.ids, d)
-            iters = [
-                _closing(
+            feed_rows = list(getattr(backend, "local_rows", range(d)))
+            # Collective steps must stay in lockstep across processes, so
+            # per-round continuation is a global agreement, not a local one.
+            lockstep = getattr(backend, "global_any", None)
+            multiproc = lockstep is not None and len(feed_rows) < d
+            iters = {
+                r: _closing(
                     prefetch(
                         source.batches(
-                            batch_size, partitions=parts, start_at=start_at
+                            batch_size,
+                            partitions=shard_parts[r],
+                            start_at=start_at,
                         ),
                         prefetch_depth,
                     )
                 )
-                if parts
+                if shard_parts[r]
                 else iter(())
-                for parts in shard_parts
-            ]
-            alive = [True] * d
-            while any(alive):
-                shard_batches: "list[RecordBatch | None]" = []
+                for r in feed_rows
+            }
+            alive = {r: True for r in feed_rows}
+            while True:
+                shard_batches: "list[RecordBatch | None]" = [None] * d
                 step_valid = 0
                 with profile.stage("ingest"):
-                    for i, it in enumerate(iters):
-                        b = next(it, None) if alive[i] else None
+                    for r in feed_rows:
+                        b = next(iters[r], None) if alive[r] else None
                         if b is None:
-                            alive[i] = False
+                            alive[r] = False
                         else:
                             step_valid += b.num_valid
                             tracker.observe(b, b.partition)
                             b = pindex.remap_batch(b)
-                        shard_batches.append(b)
-                if step_valid == 0 and not any(alive):
+                        shard_batches[r] = b
+                have_data = step_valid > 0
+                if multiproc:
+                    have_data = lockstep(have_data)
+                if not have_data:
                     break
                 with profile.stage("dispatch", items=step_valid):
                     backend.update_shards(shard_batches)

@@ -491,6 +491,11 @@ def decompress(codec: int, payload: bytes) -> bytes:
     """Kafka record-batch attribute codec → decompressed payload."""
     if codec == 0:
         return payload
+    if isinstance(payload, (bytearray, memoryview)):
+        # The wire client hands out zero-copy bytearray slices; the ctypes
+        # codec fast paths (c_char_p) need real bytes.  Compressed payloads
+        # are the small side of the pipe, so this copy is cheap.
+        payload = bytes(payload)
     if codec == 1:  # gzip (RFC1952; wbits=47 auto-detects zlib too)
         return gzip_decompress(payload)
     if codec == 2:

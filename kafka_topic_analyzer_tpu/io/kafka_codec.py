@@ -895,7 +895,16 @@ def _crc32c(data: bytes) -> int:
 
             lib = load_library()  # sets kta_crc32c.restype
             fn = lib.kta_crc32c
-            _crc32c_impl = lambda d: int(fn(d, ctypes.c_int64(len(d))))  # noqa: E731
+
+            def _native_crc(d):
+                if isinstance(d, bytearray):
+                    # zero-copy: ctypes' default conversion accepts bytes
+                    # only, but a bytearray exposes its buffer directly.
+                    buf = (ctypes.c_ubyte * len(d)).from_buffer(d)
+                    return int(fn(buf, ctypes.c_int64(len(d))))
+                return int(fn(d, ctypes.c_int64(len(d))))
+
+            _crc32c_impl = _native_crc
         except Exception:
             _crc32c_impl = _crc32c_py
     return _crc32c_impl(data)

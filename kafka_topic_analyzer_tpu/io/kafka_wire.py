@@ -224,18 +224,22 @@ class BrokerConnection:
         except OSError:
             pass
 
-    def _recv_exact(self, n: int) -> bytes:
-        chunks = []
+    def _recv_exact(self, n: int) -> bytearray:
+        # recv_into a single preallocated buffer: fetch responses run to
+        # tens of MB, so chunk-list assembly (or a final bytes() copy)
+        # would duplicate every byte.  ByteReader and the frame decoders
+        # only slice/unpack, so handing back the bytearray is safe.
+        buf = bytearray(n)
+        view = memoryview(buf)
         got = 0
         while got < n:
-            chunk = self.sock.recv(n - got)
-            if not chunk:
+            r = self.sock.recv_into(view[got:])
+            if r == 0:
                 raise kc.KafkaProtocolError(
                     f"broker {self.host}:{self.port} closed the connection"
                 )
-            chunks.append(chunk)
-            got += len(chunk)
-        return b"".join(chunks)
+            got += r
+        return buf
 
     def request(self, api_key: int, api_version: int, body: bytes) -> kc.ByteReader:
         with self._lock:

@@ -51,6 +51,32 @@ def make_mesh(
     return Mesh(grid, (DATA_AXIS, SPACE_AXIS))
 
 
+def local_data_rows(mesh: Mesh) -> List[int]:
+    """Data-axis rows of ``mesh`` owned entirely by THIS process.
+
+    The turnkey multi-host contract (SURVEY.md §5.8): every host runs the
+    same CLI command; each host's engine feeds exactly the data shards
+    whose devices it hosts, so no manual per-host partition wiring is
+    needed.  A data row that straddles processes has no single feeding
+    host — reject it with the fix (data_shards divisible by process
+    count) rather than silently dropping records.
+    """
+    me = jax.process_index()
+    grid = mesh.devices
+    rows = []
+    for d in range(grid.shape[0]):
+        owners = {dev.process_index for dev in grid[d].flat}
+        if owners == {me}:
+            rows.append(d)
+        elif me in owners:
+            raise ValueError(
+                f"mesh data row {d} spans processes {sorted(owners)}; "
+                "choose data_shards divisible by the process count so "
+                "every data shard has one feeding host"
+            )
+    return rows
+
+
 def assign_partitions(partitions: List[int], data_shards: int) -> List[List[int]]:
     """Round-robin partitions over data shards (shard d gets partitions[d::D]).
 

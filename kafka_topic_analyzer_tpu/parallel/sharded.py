@@ -66,6 +66,17 @@ def _state_specs(config: AnalyzerConfig) -> AnalyzerState:
     return AnalyzerState(metrics=metrics, alive=alive, hll=hll, quantiles=quantiles)
 
 
+def _global_put(x: np.ndarray, mesh, spec) -> jax.Array:
+    """Place a host-replicated numpy value as a global sharded array.
+
+    `jax.device_put` only accepts shardings whose devices are all
+    addressable; under multi-controller (`jax.distributed`) each process
+    holds the same host value, so materializing per-shard via callback
+    builds the same global array on every process."""
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
 def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
     """Host-built stacked state (leading 'data' axis), placed with shardings."""
     d = config.data_shards
@@ -106,9 +117,7 @@ def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
         )
     state = AnalyzerState(metrics=metrics, alive=alive, hll=hll, quantiles=quantiles)
     specs = _state_specs(config)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
-    )
+    return jax.tree.map(lambda x, s: _global_put(x, mesh, s), state, specs)
 
 
 class ShardedTpuBackend(MetricBackend):
@@ -139,6 +148,15 @@ class ShardedTpuBackend(MetricBackend):
         self._specs = _state_specs(config)
         self._buf_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         self.use_native = use_native
+        # Multi-controller support: the data rows THIS process feeds, and
+        # whether device transfers must go through the process-local API.
+        from kafka_topic_analyzer_tpu.parallel.mesh import local_data_rows
+
+        self.local_rows = local_data_rows(self.mesh)
+        self._multiprocess = jax.process_count() > 1
+        #: Snapshots np.asarray the full state; non-addressable shards make
+        #: that impossible per-process — engine skips snapshots when False.
+        self.snapshot_capable = not self._multiprocess
 
         config_ = config
 
@@ -213,21 +231,66 @@ class ShardedTpuBackend(MetricBackend):
     # -- update --------------------------------------------------------------
 
     def update_shards(self, batches: List[Optional[RecordBatch]]) -> None:
+        """One collective step; ``batches[d]`` feeds data row ``d``.
+
+        Under multi-controller, entries for rows another process hosts are
+        ignored here (that process supplies them in ITS call) — the engine
+        passes None for them.  Every process must call this in lockstep:
+        the compiled step is a global program."""
         d = self.config.data_shards
         if len(batches) != d:
             raise ValueError(f"expected {d} shard batches, got {len(batches)}")
         per_shard = np.stack(
             [
                 pack_batch(
-                    b if b is not None else RecordBatch.empty(0),
+                    batches[r] if batches[r] is not None else RecordBatch.empty(0),
                     self.config,
                     use_native=self.use_native,
                 )
-                for b in batches
+                for r in self.local_rows
             ]
         )
-        bufs = jax.device_put(per_shard, self._buf_sharding)
+        if self._multiprocess:
+            bufs = jax.make_array_from_process_local_data(
+                self._buf_sharding,
+                per_shard,
+                global_shape=(d,) + per_shard.shape[1:],
+            )
+        else:
+            bufs = jax.device_put(per_shard, self._buf_sharding)
         self.state = self._step(self.state, bufs)
+
+    def global_any(self, flag: bool) -> bool:
+        """All-process OR of a host flag, via a psum over the data axis.
+
+        The multi-host scan loop's agreement point: processes drain their
+        shard streams at different times, but collective steps must stay in
+        lockstep — each round every process contributes "I still have
+        data", and the loop continues iff anyone does.  Same result on
+        every process (it's a collective), so break decisions stay
+        consistent and deadlock-free."""
+        if not hasattr(self, "_any_fn"):
+            def body(x):
+                return lax.psum(x, DATA_AXIS)
+
+            self._any_fn = jax.jit(
+                jax.shard_map(
+                    body,
+                    mesh=self.mesh,
+                    in_specs=P(DATA_AXIS),
+                    out_specs=P(),
+                )
+            )
+        local = np.full((len(self.local_rows),), int(flag), np.int32)
+        if self._multiprocess:
+            arr = jax.make_array_from_process_local_data(
+                self._buf_sharding,
+                local,
+                global_shape=(self.config.data_shards,),
+            )
+        else:
+            arr = jax.device_put(local, self._buf_sharding)
+        return bool(np.asarray(self._any_fn(arr)).sum() > 0)
 
     def update(self, batch: RecordBatch) -> None:
         """Split a mixed batch by partition→shard (partition % D)."""

@@ -1,0 +1,416 @@
+"""End-to-end pipeline benchmark: ONE measured msgs/s figure covering
+broker → wire client → record decode → pack → device → reduce.
+
+This is the apples-to-apples comparison to the reference's published
+590,221 msgs/s (demo_output.png; formula src/main.rs:130 =
+overall_count / max(secs, 1)): the reference's number times the whole
+consume pipeline, whereas ``bench.py`` times the device path with
+pre-materialized batches.  Here the records cross a real loopback TCP
+socket as Kafka Fetch v4 responses and the scan runs through the real
+engine (`engine.run_scan`) — the same code path as ``kta --source kafka``.
+
+The serving side must be far faster than the client under test, so the
+broker never encodes per record at fetch time.  It pre-encodes a small
+set of **template RecordBatches** (base_offset 0) and serves every offset
+window as a template copy with the base_offset header patched in place.
+That is valid Kafka wire data: the v2 batch CRC32-C covers attributes
+onward and explicitly EXCLUDES base_offset/batch_length/leader_epoch/
+magic/crc (io/kafka_codec.py:encode_record_batch), and record offset
+deltas are relative to base_offset — so an 8-byte patch retargets a batch
+to any window at memcpy speed.  Distinct templates carry distinct key
+sets, so HLL/alive-key paths still see `templates × records_per_batch`
+unique keys cycling through the topic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+
+BASELINE_MSGS_PER_SEC = 590_221.0
+
+
+def build_templates(
+    records_per_batch: int,
+    n_templates: int,
+    vmin: int,
+    vmax: int,
+    seed: int = 7,
+    compression: int = kc.COMPRESSION_NONE,
+    tombstone_every: int = 0,
+) -> List[bytes]:
+    """Encode ``n_templates`` RecordBatches (base_offset 0) with disjoint
+    key sets and seeded value sizes in [vmin, vmax]."""
+    rng = np.random.default_rng(seed)
+    base_ts = 1_767_225_600_000  # 2026-01-01T00:00:00Z, ms
+    out = []
+    for t in range(n_templates):
+        sizes = rng.integers(vmin, vmax + 1, size=records_per_batch)
+        recs: List[kc.OffsetRecord] = []
+        for i in range(records_per_batch):
+            key = b"k%04d-%08d" % (t, i)
+            if tombstone_every and i % tombstone_every == (t % tombstone_every):
+                value = None
+            else:
+                value = bytes(int(sizes[i]))
+            recs.append((i, base_ts + i, key, value))
+        out.append(kc.encode_record_batch(recs, compression=compression))
+    return out
+
+
+class TemplateBroker:
+    """Loopback Kafka broker serving base_offset-patched template batches.
+
+    Speaks exactly the APIs the wire client negotiates (ApiVersions v0,
+    Metadata v1–v5, ListOffsets v1, Fetch v4) and honors both byte budgets
+    of a Fetch request — partition_max_bytes per partition and the KIP-74
+    request-level max_bytes (first batch always served whole).
+    """
+
+    def __init__(
+        self,
+        topic: str,
+        partitions: int,
+        windows_per_partition: int,
+        templates: List[bytes],
+        records_per_batch: int,
+    ):
+        self.topic = topic
+        self.partitions = list(range(partitions))
+        self.windows = windows_per_partition
+        self.templates = templates
+        self.R = records_per_batch
+        self.end_offset = windows_per_partition * records_per_batch
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "TemplateBroker":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "TemplateBroker":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = bytearray()
+        while len(buf) < n:
+            try:
+                chunk = conn.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return bytes(buf)
+
+    # -- serving -------------------------------------------------------------
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                head = self._recv_exact(conn, 4)
+                if head is None:
+                    return
+                (length,) = struct.unpack(">i", head)
+                payload = self._recv_exact(conn, length)
+                if payload is None:
+                    return
+                api_key, api_version, corr, _client, r = (
+                    kc.decode_request_header(payload)
+                )
+                body = self._dispatch(api_key, api_version, r)
+                conn.sendall(
+                    struct.pack(">ii", 4 + len(body), corr) + body
+                )
+
+    def _record_set(self, fetch_offset: int, pmax: int, min_one: bool) -> bytes:
+        """Contiguous patched template copies from the window containing
+        ``fetch_offset`` up to ``pmax`` bytes.  With ``min_one`` the first
+        batch is served even when it exceeds the budget — KIP-74's
+        minOneMessage guarantee, which the wire client's starvation logic
+        relies on."""
+        w = fetch_offset // self.R  # align down; clients skip low offsets
+        if w >= self.windows:
+            return b""
+        out = bytearray()
+        while w < self.windows and (
+            len(out) < pmax or (min_one and not out)
+        ):
+            buf = bytearray(self.templates[w % len(self.templates)])
+            struct.pack_into(">q", buf, 0, w * self.R)
+            out += buf
+            w += 1
+        return bytes(out)
+
+    def _dispatch(self, api_key: int, api_version: int, r: kc.ByteReader) -> bytes:
+        if api_key == kc.API_VERSIONS:
+            return kc.encode_api_versions_response(
+                [
+                    (kc.API_FETCH, 0, 4),
+                    (kc.API_LIST_OFFSETS, 0, 1),
+                    (kc.API_METADATA, 0, 5),
+                ]
+            )
+        if api_key == kc.API_METADATA:
+            requested = []
+            n = r.i32()
+            for _ in range(max(n, 0)):
+                requested.append(r.string())
+            topics = [
+                kc.TopicMetadata(
+                    0,
+                    self.topic,
+                    [kc.PartitionMetadata(0, p, 0) for p in self.partitions],
+                )
+                if name == self.topic
+                else kc.TopicMetadata(
+                    kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, name or "", []
+                )
+                for name in (requested if requested else [self.topic])
+            ]
+            return kc.encode_metadata_response(
+                kc.MetadataResponse({0: ("127.0.0.1", self.port)}, 0, topics),
+                version=api_version,
+            )
+        if api_key == kc.API_LIST_OFFSETS:
+            _topic, parts = kc.decode_list_offsets_request(r)
+            results = []
+            for pid, ts in parts:
+                if pid not in self.partitions:
+                    results.append(
+                        (pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, -1)
+                    )
+                elif ts == kc.EARLIEST_TIMESTAMP:
+                    results.append((pid, 0, -1, 0))
+                elif ts == kc.LATEST_TIMESTAMP:
+                    results.append((pid, 0, -1, self.end_offset))
+                else:
+                    results.append((pid, 0, ts, 0))
+            return kc.encode_list_offsets_response(self.topic, results)
+        if api_key == kc.API_FETCH:
+            _topic, parts, _mw, _mb, max_bytes = kc.decode_fetch_request(r)
+            out: List[Tuple[int, int, int, bytes]] = []
+            budget = max_bytes
+            served_any = False
+            for pid, fetch_offset, pmax in parts:
+                if pid not in self.partitions:
+                    out.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, b""))
+                    continue
+                record_set = self._record_set(
+                    fetch_offset, min(pmax, budget), min_one=not served_any
+                )
+                if record_set:
+                    served_any = True
+                budget = max(0, budget - len(record_set))
+                out.append((pid, 0, self.end_offset, record_set))
+            return kc.encode_fetch_response(self.topic, out)
+        raise AssertionError(f"bench broker: unsupported api {api_key}")
+
+
+def _broker_child(pipe, topic, partitions, windows, R, n_templates,
+                  vmin, vmax, compression, tombstone_every) -> None:
+    """Subprocess entry: build templates, serve, report the port, block.
+
+    The broker must live in its own process — in-process serving steals
+    GIL time from the client under test and the measurement stops being
+    a client-side number."""
+    templates = build_templates(
+        R, n_templates, vmin, vmax,
+        compression=compression, tombstone_every=tombstone_every,
+    )
+    broker = TemplateBroker(topic, partitions, windows, templates, R)
+    broker.start()
+    pipe.send(broker.port)
+    pipe.recv()  # parent says stop (or EOFError on parent death)
+
+
+class BrokerProcess:
+    """TemplateBroker in a child process; context manager yields the port."""
+
+    def __init__(self, **kw):
+        self._kw = kw
+
+    def __enter__(self) -> int:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        self._parent, child = ctx.Pipe()
+        self._proc = ctx.Process(
+            target=_broker_child,
+            args=(
+                child,
+                self._kw["topic"], self._kw["partitions"], self._kw["windows"],
+                self._kw["R"], self._kw["n_templates"], self._kw["vmin"],
+                self._kw["vmax"], self._kw["compression"],
+                self._kw.get("tombstone_every", 0),
+            ),
+            daemon=True,
+        )
+        self._proc.start()
+        if not self._parent.poll(120):
+            self._proc.terminate()
+            raise RuntimeError("bench broker failed to start within 120s")
+        return self._parent.recv()
+
+    def __exit__(self, *exc) -> None:
+        try:
+            self._parent.send("stop")
+        except OSError:
+            pass
+        self._proc.join(5)
+        if self._proc.is_alive():
+            self._proc.terminate()
+
+
+def run(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--partitions", type=int, default=16)
+    ap.add_argument("--records", type=int, default=50_000_000,
+                    help="total logical records served across partitions")
+    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--records-per-batch", type=int, default=4096,
+                    help="records per wire RecordBatch (template size)")
+    ap.add_argument("--templates", type=int, default=16,
+                    help="distinct templates (keys = templates x "
+                         "records-per-batch)")
+    ap.add_argument("--features", default="counters",
+                    help="comma set: counters,alive,hll,quantiles "
+                         "(default matches the reference's headline scan)")
+    ap.add_argument("--backend", default="tpu", choices=["cpu", "tpu"])
+    ap.add_argument("--vmin", type=int, default=100)
+    ap.add_argument("--vmax", type=int, default=420)
+    ap.add_argument("--compression", default="none",
+                    choices=["none", "gzip", "snappy", "lz4", "zstd"])
+    ap.add_argument("--tombstone-every", type=int, default=0,
+                    help="make every Nth template record a tombstone "
+                         "(0 = none)")
+    ap.add_argument("--alive-bits", type=int, default=26)
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    feats = {f.strip() for f in args.features.split(",") if f.strip()}
+    R = args.records_per_batch
+    windows = max(1, args.records // (args.partitions * R))
+    total = windows * R * args.partitions
+
+    comp = {
+        "none": kc.COMPRESSION_NONE,
+        "gzip": kc.COMPRESSION_GZIP,
+        "snappy": kc.COMPRESSION_SNAPPY,
+        "lz4": kc.COMPRESSION_LZ4,
+        "zstd": kc.COMPRESSION_ZSTD,
+    }[args.compression]
+
+    from kafka_topic_analyzer_tpu.backends.base import make_backend
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+    from kafka_topic_analyzer_tpu.utils.progress import Spinner
+
+    config = AnalyzerConfig(
+        num_partitions=args.partitions,
+        batch_size=args.batch_size,
+        count_alive_keys="alive" in feats,
+        alive_bitmap_bits=args.alive_bits,
+        enable_hll="hll" in feats,
+        enable_quantiles="quantiles" in feats,
+    )
+    if args.backend == "tpu":
+        from kafka_topic_analyzer_tpu.jax_support import (
+            ensure_responsive_accelerator,
+        )
+
+        ensure_responsive_accelerator()
+    backend = make_backend(args.backend, config)
+
+    with BrokerProcess(
+        topic="bench-e2e", partitions=args.partitions, windows=windows,
+        R=R, n_templates=args.templates, vmin=args.vmin, vmax=args.vmax,
+        compression=comp, tombstone_every=args.tombstone_every,
+    ) as port:
+        source = KafkaWireSource(f"127.0.0.1:{port}", "bench-e2e")
+        t0 = time.perf_counter()
+        result = run_scan(
+            "bench-e2e",
+            source,
+            backend,
+            batch_size=args.batch_size,
+            spinner=Spinner(enabled=False),
+        )
+        if hasattr(backend, "block_until_ready"):
+            backend.block_until_ready()
+        elapsed = time.perf_counter() - t0
+        source.close()
+
+    got = int(result.metrics.overall_count)
+    if got != total:
+        print(
+            f"bench-e2e: scanned {got} records, expected {total}",
+            file=sys.stderr,
+        )
+        return 1
+    value = total / elapsed
+    if not args.quiet:
+        print(
+            f"# e2e: {total} records, {args.partitions} partitions, "
+            f"{elapsed:.2f}s, backend={args.backend}, "
+            f"features={sorted(feats)}, compression={args.compression}",
+            file=sys.stderr,
+        )
+        print(result.profile.summary(), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "e2e_msgs_per_sec",
+                "value": round(value),
+                "unit": "msgs/s",
+                "vs_baseline": round(value / BASELINE_MSGS_PER_SEC, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -1,0 +1,111 @@
+"""Incremental accelerator-tunnel probe: pinpoint WHERE a device workload
+stops responding (init / small transfer / small compile / large transfer /
+analyzer-step compile / steady-state steps).
+
+Each stage prints a flushed line with its latency before moving on, so a
+hang names its stage (the driver's log shows the last line that made it
+out).  Usage: ``python -m kafka_topic_analyzer_tpu.tools.tunnel_probe
+[--stop-after STAGE]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _stage(name):
+    print(f"probe: [{name}] start", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+
+    def done(extra: str = "") -> None:
+        dt = time.perf_counter() - t0
+        print(
+            f"probe: [{name}] ok in {dt:.2f}s {extra}",
+            file=sys.stderr, flush=True,
+        )
+
+    return done
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stop-after", default="steps",
+                    choices=["init", "put1", "jit1", "put20m", "step",
+                             "steps"])
+    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    args = ap.parse_args()
+
+    done = _stage("init")
+    import kafka_topic_analyzer_tpu.jax_support  # noqa: F401  (x64 config)
+    import jax
+
+    dev = jax.devices()[0]
+    done(f"device={dev}")
+    if args.stop_after == "init":
+        return 0
+
+    done = _stage("put1")
+    import numpy as np
+
+    x = jax.device_put(np.arange(8, dtype=np.int32))
+    jax.block_until_ready(x)
+    done()
+    if args.stop_after == "put1":
+        return 0
+
+    done = _stage("jit1")
+    y = jax.jit(lambda a: a * 2 + 1)(x)
+    jax.block_until_ready(y)
+    done(f"sum={int(y.sum())}")
+    if args.stop_after == "jit1":
+        return 0
+
+    done = _stage("put20m")
+    big = np.random.default_rng(0).integers(
+        0, 255, size=20 << 20, dtype=np.uint8
+    )
+    t0 = time.perf_counter()
+    bigd = jax.device_put(big)
+    jax.block_until_ready(bigd)
+    dt = time.perf_counter() - t0
+    done(f"{len(big) / dt / 1e9:.3f} GB/s")
+    if args.stop_after == "put20m":
+        return 0
+
+    done = _stage("step-compile")
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.io.native import NativeSyntheticSource
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSpec
+
+    config = AnalyzerConfig(num_partitions=4, batch_size=args.batch_size)
+    spec = SyntheticSpec(
+        num_partitions=4,
+        messages_per_partition=args.batch_size // 4,
+        keys_per_partition=10_000,
+        seed=0xBEEF,
+    )
+    src = NativeSyntheticSource(spec)
+    batch = next(iter(src.batches(args.batch_size))).pad_to(args.batch_size)
+    backend = TpuBackend(config, init_now_s=0)
+    backend.update(batch)
+    backend.block_until_ready()
+    done()
+    if args.stop_after == "step":
+        return 0
+
+    done = _stage("steps")
+    t0 = time.perf_counter()
+    n = 8
+    for _ in range(n):
+        backend.update(batch)
+    backend.block_until_ready()
+    dt = time.perf_counter() - t0
+    done(f"{n * args.batch_size / dt / 1e6:.2f}M rec/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Child process for the turnkey multi-host test (test_multihost.py).
+
+Each process runs the SAME code — the turnkey contract (SURVEY.md §5.8):
+initialize jax.distributed, build the global (data, space) mesh, and let
+the engine feed exactly the data rows this process hosts
+(`ShardedTpuBackend.local_rows`).  Process 0 writes the merged metrics
+dict as JSON; the parent test compares it against a single-process run.
+
+Usage: python multihost_child.py <pid> <nprocs> <port> <out.json>
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault("KTA_ACCEL_OK", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kafka_topic_analyzer_tpu.jax_support import force_platform  # noqa: E402
+
+force_platform("cpu")
+
+import jax  # noqa: E402
+
+
+def main() -> int:
+    pid, nprocs, port, out_path = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    )
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert len(jax.devices()) == 8, jax.devices()
+    assert jax.local_device_count() == 4
+
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.synthetic import (
+        SyntheticSource,
+        SyntheticSpec,
+    )
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    spec = SyntheticSpec(
+        num_partitions=6,
+        messages_per_partition=5000,
+        keys_per_partition=500,
+        key_null_permille=50,
+        tombstone_permille=100,
+        seed=42,
+    )
+    config = AnalyzerConfig(
+        num_partitions=6,
+        batch_size=2048,
+        count_alive_keys=True,
+        alive_bitmap_bits=16,
+        enable_hll=True,
+        enable_quantiles=True,
+        mesh_shape=(8, 1),
+    )
+    backend = ShardedTpuBackend(config)
+    # The turnkey contract under test: this process feeds only its rows.
+    assert len(backend.local_rows) == 4, backend.local_rows
+    source = SyntheticSource(spec)
+    result = run_scan("mh-topic", source, backend, batch_size=2048)
+
+    if jax.process_index() == 0:
+        doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)
+        with open(out_path, "w") as f:
+            json.dump(doc, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""Turnkey multi-host scan: two jax.distributed processes over localhost
+(4 virtual CPU devices each → one global 8-device mesh) produce exactly
+the metrics a single-process sharded scan produces.
+
+This is the test the reference cannot have (it is single-threaded,
+src/kafka.rs:92-135); it locks the multi-controller contract:
+process-local shard feeding (mesh.local_data_rows), lockstep collective
+steps with the global_any agreement round, and the collective finalize.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CHILD = os.path.join(_HERE, "multihost_child.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_reference() -> dict:
+    """The same scan on this process's own 8-device mesh (conftest env)."""
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.synthetic import (
+        SyntheticSource,
+        SyntheticSpec,
+    )
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    spec = SyntheticSpec(
+        num_partitions=6,
+        messages_per_partition=5000,
+        keys_per_partition=500,
+        key_null_permille=50,
+        tombstone_permille=100,
+        seed=42,
+    )
+    config = AnalyzerConfig(
+        num_partitions=6,
+        batch_size=2048,
+        count_alive_keys=True,
+        alive_bitmap_bits=16,
+        enable_hll=True,
+        enable_quantiles=True,
+        mesh_shape=(8, 1),
+    )
+    backend = ShardedTpuBackend(config)
+    result = run_scan(
+        "mh-topic", SyntheticSource(spec), backend, batch_size=2048
+    )
+    return result.metrics.to_dict(result.start_offsets, result.end_offsets)
+
+
+def test_two_process_scan_matches_single_process(tmp_path):
+    out = tmp_path / "mh_metrics.json"
+    port = _free_port()
+    env = dict(os.environ)
+    # The child pins its own platform/device-count env before importing jax.
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _CHILD, str(pid), "2", str(port), str(out)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=300)
+            outs.append((p.returncode, stdout, stderr))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multi-host children timed out; partial: {outs}")
+    for rc, stdout, stderr in outs:
+        assert rc == 0, f"child failed rc={rc}\nstdout:{stdout}\nstderr:{stderr}"
+
+    got = json.loads(out.read_text())
+    # Round-trip the reference through JSON too: quantile dict keys are
+    # floats in-memory and strings on the wire.
+    want = json.loads(json.dumps(_single_process_reference()))
+    assert got == want
