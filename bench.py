@@ -38,6 +38,57 @@ CONFIGS = {
 }
 
 
+def supervised_main() -> int:
+    """Run the bench in a killable child with a hard deadline; on a hang
+    (this round's observed axon-tunnel failure mode: init or the first
+    real device op blocks forever with idle relay sockets), kill it and
+    rerun on the host CPU platform with the degraded flag set.
+
+    Guarantees the driver ALWAYS gets its one JSON line.  The child is
+    this same script with KTA_BENCH_CHILD=1; KTA_BENCH_DEADLINE (seconds,
+    default 900) bounds the accelerator attempt.
+    """
+    import subprocess
+
+    deadline = float(os.environ.get("KTA_BENCH_DEADLINE") or 900)
+    env = dict(os.environ)
+    env["KTA_BENCH_CHILD"] = "1"
+    # The probe subprocess is skipped in the child: this wrapper IS the
+    # watchdog, and back-to-back client inits have been observed to hang
+    # the tunnel (see BENCH_NOTES.md round 2).
+    env.setdefault("KTA_ACCEL_OK", "1")
+    for attempt, extra in ((1, {}), (2, {"KTA_JAX_PLATFORMS": "cpu",
+                                         "KTA_DEGRADED": "1"})):
+        env.update(extra)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                env=env, timeout=deadline if attempt == 1 else None,
+            )
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            rc = None
+            print(
+                f"bench: accelerator attempt exceeded {deadline:.0f}s "
+                "(tunnel hang) — rerunning on host CPU, degraded",
+                file=sys.stderr, flush=True,
+            )
+        if rc is not None and rc >= 0:
+            # Normal exit (success or a deterministic failure like a
+            # usage error): report it faithfully — degrading would just
+            # rerun the same failure and misattribute it to the chip.
+            return rc
+        if attempt == 2:
+            return 1  # fallback child killed by a signal: genuine failure
+        if rc is not None:
+            print(
+                f"bench: accelerator attempt died on signal {-rc} — "
+                "rerunning on host CPU, degraded",
+                file=sys.stderr, flush=True,
+            )
+    return 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS),
@@ -76,7 +127,10 @@ def main() -> int:
     # instead of hanging the driver.
     from kafka_topic_analyzer_tpu.jax_support import ensure_responsive_accelerator
 
-    degraded = not ensure_responsive_accelerator()
+    degraded = (
+        not ensure_responsive_accelerator()
+        or os.environ.get("KTA_DEGRADED") == "1"
+    )
 
     import jax
 
@@ -190,4 +244,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get("KTA_BENCH_CHILD") == "1":
+        sys.exit(main())
+    sys.exit(supervised_main())

@@ -143,7 +143,9 @@ class ByteReader:
         if n < 0:
             return None
         try:
-            return self._take(n).decode()
+            # bytes() first: response buffers may be memoryviews (the
+            # zero-copy receive path) and memoryview has no .decode.
+            return bytes(self._take(n)).decode()
         except UnicodeDecodeError as e:
             # Untrusted wire input must not leak UnicodeDecodeError.
             raise KafkaProtocolError(f"invalid UTF-8 string on the wire: {e}") from e
@@ -153,6 +155,23 @@ class ByteReader:
         if n < 0:
             return None
         return self._take(n)
+
+    def bytes_view(self) -> "Optional[memoryview]":
+        """Like bytes_ but zero-copy: a memoryview over the buffer.  For
+        bulk fields (fetch record sets run to tens of MB) where the caller
+        only slices/unpacks.  (memoryview truthiness follows __len__, like
+        bytes — an empty view is falsy.)"""
+        n = self.i32()
+        if n < 0:
+            return None
+        if n > len(self.buf) - self.pos:
+            raise KafkaProtocolError(
+                f"truncated message: need {n} bytes at {self.pos}, "
+                f"have {len(self.buf)}"
+            )
+        v = memoryview(self.buf)[self.pos : self.pos + n]
+        self.pos += n
+        return v
 
     def varint(self) -> int:
         shift = 0
@@ -429,8 +448,12 @@ def decode_fetch_response(r: ByteReader) -> List[FetchedPartition]:
             for _ in range(r.i32()):  # aborted txns
                 r.i64()
                 r.i64()
-            records = r.bytes_() or b""
-            out.append(FetchedPartition(pid, err, hw, records))
+            records = r.bytes_view()
+            out.append(
+                FetchedPartition(
+                    pid, err, hw, records if records is not None else b""
+                )
+            )
     return out
 
 
@@ -515,8 +538,10 @@ def decode_sasl_authenticate_response(
 ) -> "tuple[int, Optional[str], bytes]":
     err = r.i16()
     msg = r.string()
-    auth = r.bytes_() or b""  # SCRAM server-first/server-final ride here
-    return err, msg, auth
+    # bytes() guard: response buffers may be memoryviews (zero-copy
+    # receive) and SCRAM parsing splits/decodes the token.
+    auth = r.bytes_()  # SCRAM server-first/server-final rides here
+    return err, msg, bytes(auth) if auth is not None else b""
 
 
 # -- SCRAM (RFC 5802/7677 over Kafka's SaslAuthenticate round trips) --------

@@ -224,13 +224,17 @@ class BrokerConnection:
         except OSError:
             pass
 
-    def _recv_exact(self, n: int) -> bytearray:
-        # recv_into a single preallocated buffer: fetch responses run to
-        # tens of MB, so chunk-list assembly (or a final bytes() copy)
-        # would duplicate every byte.  ByteReader and the frame decoders
-        # only slice/unpack, so handing back the bytearray is safe.
-        buf = bytearray(n)
-        view = memoryview(buf)
+    def _recv_exact(self, n: int) -> "memoryview":
+        # recv_into one preallocated buffer: fetch responses run to tens
+        # of MB, so chunk-list assembly (or a final bytes() copy) would
+        # duplicate every byte.  numpy's allocator skips the zero-fill a
+        # bytearray(n) would pay (a full extra memset pass at 64 MB).
+        # ByteReader and the frame decoders slice/unpack memoryviews;
+        # string fields go through bytes() at the decode site.
+        import numpy as _np
+
+        buf = _np.empty(n, dtype=_np.uint8)
+        view = memoryview(buf).cast("B")
         got = 0
         while got < n:
             r = self.sock.recv_into(view[got:])
@@ -239,7 +243,36 @@ class BrokerConnection:
                     f"broker {self.host}:{self.port} closed the connection"
                 )
             got += r
-        return buf
+        return view
+
+    def send_request(self, api_key: int, api_version: int, body: bytes) -> int:
+        """Pipelining half 1: send only, return the correlation id.
+
+        Kafka responds strictly in request order per connection, so a
+        caller that owns the connection may send the next fetch before
+        reading the previous response (the wire client's send-ahead).
+        Callers sharing a connection must use `request` instead — split
+        halves from two threads would race for each other's bytes."""
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            self.sock.sendall(
+                kc.encode_request(api_key, api_version, corr, CLIENT_ID, body)
+            )
+            return corr
+
+    def read_response(self, corr: int) -> kc.ByteReader:
+        """Pipelining half 2: read the next response; must match ``corr``."""
+        with self._lock:
+            (length,) = struct.unpack(">i", self._recv_exact(4))
+            payload = self._recv_exact(length)
+        r = kc.ByteReader(payload)
+        got_corr = r.i32()
+        if got_corr != corr:
+            raise kc.KafkaProtocolError(
+                f"correlation id mismatch: sent {corr}, got {got_corr}"
+            )
+        return r
 
     def request(self, api_key: int, api_version: int, body: bytes) -> kc.ByteReader:
         with self._lock:
@@ -617,6 +650,26 @@ class KafkaWireSource(RecordSource):
         partitions: Optional[List[int]] = None,
         start_at: Optional[Dict[int, int]] = None,
     ) -> Iterator[RecordBatch]:
+        # Fetch connections are private to this iterator: sharded scans
+        # run one batches() stream per shard from worker threads, and the
+        # pipelined send/read halves cannot share a socket with another
+        # stream (responses would be claimed by the wrong reader).
+        own_conns: Dict[Tuple[str, int], BrokerConnection] = {}
+        try:
+            yield from self._batches_impl(
+                batch_size, partitions, start_at, own_conns
+            )
+        finally:
+            for c in own_conns.values():
+                c.close()
+
+    def _batches_impl(
+        self,
+        batch_size: int,
+        partitions: Optional[List[int]],
+        start_at: Optional[Dict[int, int]],
+        own_conns: "Dict[Tuple[str, int], BrokerConnection]",
+    ) -> Iterator[RecordBatch]:
         start, end = self.watermarks()
         parts = sorted(partitions) if partitions is not None else self.partitions()
         next_offset = {p: start[p] for p in parts}
@@ -659,8 +712,10 @@ class KafkaWireSource(RecordSource):
         if use_native_decode:
             try:
                 from kafka_topic_analyzer_tpu.io.native import (
+                    decode_record_set_native,
                     decode_records_native,
                     native_available,
+                    scan_record_set_native,
                 )
 
                 use_native_decode = native_available()
@@ -680,6 +735,24 @@ class KafkaWireSource(RecordSource):
         stall_streak: Dict[int, int] = {p: 0 for p in parts}
         max_stall = max(max_error_streak, 4 * len(parts))
 
+        inflight: "Dict[int, tuple]" = {}
+
+        def own_conn(partition: int) -> BrokerConnection:
+            host, port = self._brokers[self._leaders[partition]]
+            key = (host, port)
+            c = own_conns.get(key)
+            if c is None:
+                c = BrokerConnection(
+                    host,
+                    port,
+                    self.timeout_s,
+                    ssl_context=self._ssl_context,
+                    sasl=self._sasl,
+                    sock_opts=self._sock_opts,
+                )
+                own_conns[key] = c
+            return c
+
         fetch_round = 0
         while remaining:
             by_leader: Dict[int, List[int]] = {}
@@ -688,8 +761,7 @@ class KafkaWireSource(RecordSource):
             progressed = False
             fetch_round += 1
             for leader, lparts in by_leader.items():
-                conn = self._leader_conn(lparts[0])
-                pmax_sent = self.partition_max_bytes
+                conn = own_conn(lparts[0])
                 # KIP-74: brokers fill the response budget in request
                 # order, so rotate the partition list each round — without
                 # this, partitions at the tail of a large sorted list can
@@ -697,19 +769,109 @@ class KafkaWireSource(RecordSource):
                 lp = sorted(lparts)
                 k = fetch_round % len(lp)
                 order = lp[k:] + lp[:k]
-                r = conn.request(
-                    kc.API_FETCH,
-                    self._version(conn, kc.API_FETCH),
-                    kc.encode_fetch_request(
-                        self.topic,
-                        [(p, next_offset[p]) for p in order],
-                        self.max_wait_ms,
-                        self.min_bytes,
-                        self.max_bytes,
+                # Pipelining: if last round sent ahead for this leader,
+                # its response is already in flight.  A stale in-flight
+                # (connection changed, or it no longer covers this
+                # round's partitions) is drained and discarded — the
+                # stream stays ordered either way.
+                fl = inflight.pop(leader, None)
+                if fl is not None and (
+                    fl[0] is not conn or not set(lp) <= set(fl[3])
+                ):
+                    try:
+                        fl[0].read_response(fl[1])
+                    except Exception:
+                        fl[0].close()
+                        own_conns.pop((fl[0].host, fl[0].port), None)
+                        conn = own_conn(lparts[0])
+                    fl = None
+                if fl is None:
+                    pmax_sent = self.partition_max_bytes
+                    corr = conn.send_request(
+                        kc.API_FETCH,
+                        self._version(conn, kc.API_FETCH),
+                        kc.encode_fetch_request(
+                            self.topic,
+                            [(p, next_offset[p]) for p in order],
+                            self.max_wait_ms,
+                            self.min_bytes,
+                            self.max_bytes,
+                            pmax_sent,
+                        ),
+                    )
+                    fl = (
+                        conn,
+                        corr,
+                        {p: next_offset[p] for p in order},
+                        order,
                         pmax_sent,
-                    ),
-                )
-                for fp in kc.decode_fetch_response(r):
+                    )
+                conn, corr, sent_offsets, order, pmax_sent = fl
+                r = conn.read_response(corr)
+                fps = kc.decode_fetch_response(r)
+                # Send-ahead: while this response's records decode below,
+                # let the broker build the NEXT one.  A cheap native
+                # header scan of each partition's record set yields the
+                # exact offsets processing will arrive at (covered_end,
+                # compaction-aware); only clean all-native responses
+                # qualify, and a post-processing mismatch discards the
+                # speculative response (correctness never depends on the
+                # speculation being right).
+                spec_sent = False
+                #: Clean full-prefix scan results, reused by the decode
+                #: below so the header (and CRC) walk isn't paid twice.
+                scans: "Dict[int, tuple[int, int, int]]" = {}
+                if use_native_decode and remaining:
+                    clean = True
+                    spec: Dict[int, int] = {}
+                    for fp in fps:
+                        p = fp.partition
+                        if p not in remaining:
+                            continue
+                        if fp.error or len(fp.records) == 0:
+                            clean = False
+                            break
+                        nrec, used, covered = scan_record_set_native(
+                            fp.records, self.verify_crc
+                        )
+                        if used != len(fp.records) or nrec <= 0:
+                            clean = False
+                            break
+                        scans[p] = (nrec, used, covered)
+                        if covered <= next_offset[p]:
+                            clean = False
+                            break
+                        spec[p] = min(covered, end[p])
+                    if clean and spec:
+                        lp2 = sorted(
+                            p for p in order
+                            if p in spec and spec[p] < end[p]
+                        )
+                        if lp2:
+                            k2 = (fetch_round + 1) % len(lp2)
+                            order2 = lp2[k2:] + lp2[:k2]
+                            pmax2 = self.partition_max_bytes
+                            corr2 = conn.send_request(
+                                kc.API_FETCH,
+                                self._version(conn, kc.API_FETCH),
+                                kc.encode_fetch_request(
+                                    self.topic,
+                                    [(p, spec[p]) for p in order2],
+                                    self.max_wait_ms,
+                                    self.min_bytes,
+                                    self.max_bytes,
+                                    pmax2,
+                                ),
+                            )
+                            inflight[leader] = (
+                                conn,
+                                corr2,
+                                {p: spec[p] for p in order2},
+                                order2,
+                                pmax2,
+                            )
+                            spec_sent = True
+                for fp in fps:
                     p = fp.partition
                     if p not in remaining:
                         continue
@@ -740,8 +902,34 @@ class KafkaWireSource(RecordSource):
                     # frame (batch headers keep last_offset_delta across
                     # compaction, so this advances past removed ranges).
                     max_frame_end = -1
+                    data = fp.records
+                    if use_native_decode and data:
+                        # Whole-response fast path: every leading complete
+                        # uncompressed v2 frame decodes in ONE native call
+                        # (io/native.py::decode_record_set_native); only
+                        # the remainder (compressed/legacy/truncated)
+                        # takes the per-frame loop below.
+                        soa, used, covered = decode_record_set_native(
+                            data, self.verify_crc, prescan=scans.get(p)
+                        )
+                        if used:
+                            max_frame_end = max(max_frame_end, covered)
+                            offs = soa["offsets"]
+                            mask = (offs >= next_offset[p]) & (offs < end[p])
+                            cnt = int(np.count_nonzero(mask))
+                            if cnt:
+                                push_chunk(_chunk_to_batch(soa, mask, p))
+                                next_offset[p] = int(offs[mask][-1]) + 1
+                                consumed += cnt
+                                progressed = True
+                            data = data[used:] if used < len(data) else b""
+                    if not isinstance(data, (bytes, bytearray)):
+                        # The remainder (compressed/legacy/truncated frames)
+                        # goes through the per-frame Python decoders, which
+                        # expect a real bytes-like (str decode, hashing).
+                        data = bytes(data)
                     for frame in kc.iter_batch_frames(
-                        fp.records, verify_crc=self.verify_crc
+                        data, verify_crc=self.verify_crc
                     ):
                         max_frame_end = max(max_frame_end, frame.end_offset)
                         chunk = (
@@ -803,7 +991,7 @@ class KafkaWireSource(RecordSource):
                             next_offset[p] = min(max_frame_end, end[p])
                             stall_streak[p] = 0
                             progressed = True
-                        elif not fp.records:
+                        elif len(fp.records) == 0:
                             if p == order[0]:
                                 # We led this request, and brokers return
                                 # at least one complete batch for the first
@@ -861,6 +1049,21 @@ class KafkaWireSource(RecordSource):
                                     )
                     if next_offset[p] >= end[p]:
                         remaining.discard(p)
+                if spec_sent:
+                    fl2 = inflight.get(leader)
+                    if fl2 is not None and any(
+                        p in remaining and next_offset[p] != off
+                        for p, off in fl2[2].items()
+                    ):
+                        # Speculation missed (compressed tail, error,
+                        # truncation): drain and discard so the next round
+                        # fetches from the authoritative offsets.
+                        inflight.pop(leader, None)
+                        try:
+                            fl2[0].read_response(fl2[1])
+                        except Exception:
+                            fl2[0].close()
+                            own_conns.pop((fl2[0].host, fl2[0].port), None)
                 yield from flush(force=False)
             if not progressed and remaining:
                 # Nothing moved this round (e.g. leader churn): brief pause

@@ -24,7 +24,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 6
+_ABI = 7
 _SO_PATH = os.path.join(_NATIVE_DIR, "build", f"libkta_ingest.v{_ABI}.so")
 
 _lock = threading.Lock()
@@ -87,6 +87,8 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
             lib.kta_dedupe_slots.restype = ctypes.c_int64
             lib.kta_pack_batch.restype = ctypes.c_int64
             lib.kta_decode_records.restype = ctypes.c_int64
+            lib.kta_scan_record_set.restype = ctypes.c_int64
+            lib.kta_decode_record_set.restype = ctypes.c_int64
             lib.kta_crc32c.restype = ctypes.c_uint32
         except Exception as e:  # remember the failure
             _load_error = e
@@ -256,6 +258,95 @@ def decode_records_native(frame) -> "dict[str, np.ndarray] | None":
     if rc != n:
         return None
     return out
+
+
+def scan_record_set_native(
+    buf, verify_crc: bool = False
+) -> "tuple[int, int, int]":
+    """Header-jump walk of a record set's native-decodable prefix:
+    (record_count, consumed_bytes, covered_end) without touching records.
+    The wire client's send-ahead uses covered_end as the speculative next
+    fetch offset while the full decode proceeds."""
+    lib = load_library()
+    data = np.frombuffer(buf, dtype=np.uint8)
+    consumed = ctypes.c_int64(0)
+    covered = ctypes.c_int64(-1)
+    n = lib.kta_scan_record_set(
+        _as_ptr(data, ctypes.c_uint8),
+        ctypes.c_int64(len(data)),
+        ctypes.c_int32(1 if verify_crc else 0),
+        ctypes.byref(consumed),
+        ctypes.byref(covered),
+    )
+    if n < 0:
+        return 0, 0, -1
+    return int(n), int(consumed.value), int(covered.value)
+
+
+def decode_record_set_native(
+    buf,
+    verify_crc: bool = False,
+    prescan: "tuple[int, int, int] | None" = None,
+) -> "tuple[dict[str, np.ndarray], int, int] | None":
+    """Decode the native-decodable PREFIX of a whole fetch record set
+    (consecutive complete uncompressed v2 frames) in one C++ call.
+
+    Returns (SoA columns, consumed_bytes, covered_end) — covered_end is
+    the compaction-aware max of base_offset+last_offset_delta+1 across
+    decoded frames (-1 when none).  None when the shim is unavailable.
+    Frames past `consumed` (compressed, legacy MessageSet, truncated tail,
+    malformed) are the caller's per-frame path; a malformed frame inside
+    the prefix returns consumed=0 so that path can raise precisely.
+
+    ``prescan``: a scan_record_set_native result for this buffer, so a
+    caller that already walked the headers (the send-ahead speculation)
+    doesn't pay the scan — or its CRC pass — a second time."""
+    lib = load_library()
+    data = np.frombuffer(buf, dtype=np.uint8)
+    consumed = ctypes.c_int64(0)
+    if prescan is not None:
+        n = prescan[0]
+        verify_crc = False  # the prescan already checksummed the prefix
+    else:
+        n = lib.kta_scan_record_set(
+            _as_ptr(data, ctypes.c_uint8),
+            ctypes.c_int64(len(data)),
+            ctypes.c_int32(1 if verify_crc else 0),
+            ctypes.byref(consumed),
+            None,
+        )
+    if n <= 0:
+        return {}, 0, -1
+    out = {
+        "offsets": np.empty(n, dtype=np.int64),
+        "ts_ms": np.empty(n, dtype=np.int64),
+        "key_len": np.empty(n, dtype=np.int32),
+        "value_len": np.empty(n, dtype=np.int32),
+        "key_null": np.empty(n, dtype=np.uint8),
+        "value_null": np.empty(n, dtype=np.uint8),
+        "key_hash32": np.empty(n, dtype=np.uint32),
+        "key_hash64": np.empty(n, dtype=np.uint64),
+    }
+    covered = ctypes.c_int64(-1)
+    rc = lib.kta_decode_record_set(
+        _as_ptr(data, ctypes.c_uint8),
+        ctypes.c_int64(len(data)),
+        ctypes.c_int32(1 if verify_crc else 0),
+        ctypes.c_int64(n),
+        _as_ptr(out["offsets"], ctypes.c_int64),
+        _as_ptr(out["ts_ms"], ctypes.c_int64),
+        _as_ptr(out["key_len"], ctypes.c_int32),
+        _as_ptr(out["value_len"], ctypes.c_int32),
+        _as_ptr(out["key_null"], ctypes.c_uint8),
+        _as_ptr(out["value_null"], ctypes.c_uint8),
+        _as_ptr(out["key_hash32"], ctypes.c_uint32),
+        _as_ptr(out["key_hash64"], ctypes.c_uint64),
+        ctypes.byref(consumed),
+        ctypes.byref(covered),
+    )
+    if rc != n:
+        return {}, 0, -1  # malformed inside prefix: per-frame path reports
+    return out, int(consumed.value), int(covered.value)
 
 
 def pack_batch_native(batch, config) -> "np.ndarray | None":

@@ -86,7 +86,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 6; }
+int32_t kta_version() { return 7; }
 
 // CRC32-C (Castagnoli) over a byte buffer — Kafka's record-batch checksum.
 // Table-driven; the Python fallback (kafka_codec._crc32c) is a per-byte
@@ -351,6 +351,127 @@ extern "C" int64_t kta_decode_records(
     pos = rec_end;  // tolerate unknown trailing record fields
   }
   return num_records;
+}
+
+namespace {
+
+inline int64_t be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
+  return static_cast<int64_t>(v);
+}
+inline int32_t be32(const uint8_t* p) {
+  uint32_t v = 0;
+  for (int i = 0; i < 4; ++i) v = (v << 8) | p[i];
+  return static_cast<int32_t>(v);
+}
+inline int16_t be16(const uint8_t* p) {
+  return static_cast<int16_t>((uint16_t(p[0]) << 8) | p[1]);
+}
+
+// One RecordBatch v2 frame header at `pos` of the NATIVE-decodable kind:
+// complete, magic 2, uncompressed, sane record count, CRC ok (when asked).
+// Returns true and fills the fields; false means the caller must stop the
+// native walk here (a Python path decodes the frame — compressed, legacy
+// MessageSet, truncated tail — or raises the precise protocol error).
+struct FrameHeader {
+  int64_t base_offset;
+  int64_t first_ts;
+  int64_t end;           // byte offset one past the frame
+  int64_t payload_pos;   // first record byte
+  int64_t covered_end;   // base_offset + max(last_offset_delta, 0) + 1
+  int32_t num_records;
+};
+
+inline bool native_frame_at(const uint8_t* buf, int64_t len, int64_t pos,
+                            int32_t verify_crc, FrameHeader* fh) {
+  if (pos + 61 > len) return false;          // header incomplete
+  const int64_t batch_length = be32(buf + pos + 8);
+  if (batch_length <= 0) return false;
+  const int64_t end = pos + 12 + batch_length;
+  if (end > len) return false;               // partial trailing frame
+  if (buf[pos + 16] != 2) return false;      // legacy MessageSet v0/v1
+  const int16_t attributes = be16(buf + pos + 21);
+  if ((attributes & 0x07) != 0) return false;  // compressed
+  const int32_t num_records = be32(buf + pos + 57);
+  const int64_t payload_pos = pos + 61;
+  // Untrusted count: a valid record needs >= 7 payload bytes.
+  if (num_records < 0 || num_records > (end - payload_pos) / 7) return false;
+  if (verify_crc) {
+    const uint32_t crc = static_cast<uint32_t>(be32(buf + pos + 17));
+    if (kta_crc32c(buf + pos + 21, end - (pos + 21)) != crc) return false;
+  }
+  const int32_t last_offset_delta = be32(buf + pos + 23);
+  fh->base_offset = be64(buf + pos);
+  fh->first_ts = be64(buf + pos + 27);
+  fh->end = end;
+  fh->payload_pos = payload_pos;
+  fh->num_records = num_records;
+  fh->covered_end =
+      fh->base_offset + (last_offset_delta > 0 ? last_offset_delta : 0) + 1;
+  return true;
+}
+
+}  // namespace
+
+// Count the records in the native-decodable PREFIX of a record set (a
+// Fetch response's per-partition records field): consecutive complete,
+// uncompressed, magic-2 frames.  The count sizes the caller's output
+// arrays for kta_decode_record_set; the walk is a header jump per frame
+// (no record parsing), so it costs ~nothing next to the decode.
+extern "C" int64_t kta_scan_record_set(const uint8_t* buf, int64_t len,
+                                       int32_t verify_crc,
+                                       int64_t* consumed_out,
+                                       int64_t* covered_out) {
+  if (!buf || len < 0) return -1;
+  int64_t pos = 0, total = 0, covered = -1;
+  FrameHeader fh;
+  while (native_frame_at(buf, len, pos, verify_crc, &fh)) {
+    total += fh.num_records;
+    if (fh.covered_end > covered) covered = fh.covered_end;
+    pos = fh.end;
+  }
+  if (consumed_out) *consumed_out = pos;
+  if (covered_out) *covered_out = covered;
+  return total;
+}
+
+// Decode the native-decodable prefix of a record set in ONE call: every
+// frame's records into contiguous SoA columns (the per-frame
+// kta_decode_records core, pointer-shifted per frame).  Replaces the
+// per-frame Python loop of header parse + ctypes call + numpy slicing —
+// the wire client's remaining hot-path overhead after round 1 made the
+// record decode itself native (io/kafka_wire.py::batches).
+// Returns records decoded (== kta_scan_record_set's count), or -1 on a
+// malformed frame (callers re-walk with the Python decoder for the
+// precise error).  consumed_out: bytes of prefix handled; covered_end_out:
+// max over frames of (base_offset + last_offset_delta + 1), the
+// compaction-aware scan position advance.
+extern "C" int64_t kta_decode_record_set(
+    const uint8_t* buf, int64_t len, int32_t verify_crc, int64_t capacity,
+    int64_t* offsets_out, int64_t* ts_ms_out,
+    int32_t* key_len_out, int32_t* value_len_out,
+    uint8_t* key_null_out, uint8_t* value_null_out,
+    uint32_t* h32_out, uint64_t* h64_out,
+    int64_t* consumed_out, int64_t* covered_end_out) {
+  if (!buf || len < 0 || capacity < 0) return -1;
+  int64_t pos = 0, n = 0, covered = -1;
+  FrameHeader fh;
+  while (native_frame_at(buf, len, pos, verify_crc, &fh)) {
+    if (n + fh.num_records > capacity) return -1;
+    const int64_t got = kta_decode_records(
+        buf + fh.payload_pos, fh.end - fh.payload_pos, fh.num_records,
+        fh.base_offset, fh.first_ts,
+        offsets_out + n, ts_ms_out + n, key_len_out + n, value_len_out + n,
+        key_null_out + n, value_null_out + n, h32_out + n, h64_out + n);
+    if (got != fh.num_records) return -1;
+    n += got;
+    if (fh.covered_end > covered) covered = fh.covered_end;
+    pos = fh.end;
+  }
+  if (consumed_out) *consumed_out = pos;
+  if (covered_end_out) *covered_end_out = covered;
+  return n;
 }
 
 // Fused batch packing: RecordBatch SoA columns -> wire-format-v1 buffer
