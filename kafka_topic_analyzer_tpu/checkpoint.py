@@ -35,13 +35,22 @@ from kafka_topic_analyzer_tpu.models.state import AnalyzerState
 SNAPSHOT_NAME = "scan_snapshot.npz"
 
 
+#: Config fields that change neither state shapes nor fold semantics —
+#: pure execution strategy, safe to flip across a resume (the pallas and
+#: lax counter paths are bit-identical, tests/test_pallas_counters.py).
+_EXECUTION_ONLY_FIELDS = ("use_pallas_counters",)
+
+
 def config_fingerprint(config: AnalyzerConfig, topic: str) -> str:
     """Snapshot compatibility key: anything that changes state shapes or
     fold semantics participates."""
+    fields = dataclasses.asdict(config)
+    for k in _EXECUTION_ONLY_FIELDS:
+        fields.pop(k, None)
     payload = json.dumps(
         # state_version: bump whenever the AnalyzerState layout changes so
         # stale snapshots are rejected instead of shape-erroring.
-        {"topic": topic, "state_version": 2, **dataclasses.asdict(config)},
+        {"topic": topic, "state_version": 2, **fields},
         sort_keys=True,
     )
     return hashlib.sha256(payload.encode()).hexdigest()[:16]

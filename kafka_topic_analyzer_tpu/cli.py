@@ -100,6 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(implies --quantiles)")
     p.add_argument("--mesh", metavar="DATA[,SPACE]", default="1",
                    help="Device mesh shape: data shards[, space shards]")
+    p.add_argument("--pallas", action="store_true",
+                   help="Use the Pallas MXU counter kernel for the "
+                        "per-partition counters (tpu backend; requires "
+                        "batch-size % 1024 == 0)")
     p.add_argument("--distributed", metavar="COORD:PORT,PID,NPROCS",
                    help="Multi-host mode: initialize jax.distributed with the "
                         "given coordinator address, process id and process "
@@ -329,6 +333,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             enable_quantiles=args.quantiles,
             quantiles_per_partition=args.quantiles_per_partition,
             mesh_shape=mesh_shape,
+            use_pallas_counters=args.pallas,
         )
     backend = _make_cli_backend(args, config, mesh_shape)
 
@@ -492,6 +497,7 @@ def _run(args) -> int:
             enable_quantiles=args.quantiles,
             quantiles_per_partition=args.quantiles_per_partition,
             mesh_shape=mesh_shape,
+            use_pallas_counters=args.pallas,
         )
 
     from kafka_topic_analyzer_tpu.engine import run_scan

@@ -60,9 +60,10 @@ class AnalyzerConfig:
 
     #: Use the Pallas MXU one-hot-matmul kernel for the per-partition counter
     #: reduction (ops/pallas_counters.py) instead of the XLA scatter-add.
-    #: Requires num_partitions <= 128, batch_size a multiple of 1024, and
-    #: value lengths < 16 MiB (validated in __post_init__ / pack time).
-    #: Off by default until benchmarked faster on the target hardware.
+    #: Requires batch_size a multiple of 1024 (validated in __post_init__)
+    #: and value lengths < 16 MiB (pack time); partitions beyond 128 tile
+    #: the kernel grid.  Off by default until benchmarked faster on the
+    #: target hardware.
     use_pallas_counters: bool = False
 
     # --- host→device transfer ----------------------------------------------
@@ -94,22 +95,10 @@ class AnalyzerConfig:
             raise ValueError("hll_p must be in [4, 15]")
         if self.quantile_buckets < 8:
             raise ValueError("quantile_buckets must be >= 8")
-        if self.use_pallas_counters:
-            if self.num_partitions > 128:
-                raise ValueError(
-                    "use_pallas_counters supports at most 128 partitions"
-                )
-            if self.batch_size % 1024:
-                raise ValueError(
-                    "use_pallas_counters requires batch_size % 1024 == 0"
-                )
-            if self.mesh_shape != (1, 1):
-                # pallas_call outputs need explicit vma annotations under
-                # check_vma shard_map; not wired up yet (ROADMAP.md).
-                raise ValueError(
-                    "use_pallas_counters is single-device only for now "
-                    "(not supported under a sharded mesh)"
-                )
+        if self.use_pallas_counters and self.batch_size % 1024:
+            raise ValueError(
+                "use_pallas_counters requires batch_size % 1024 == 0"
+            )
 
     @property
     def hll_m(self) -> int:

@@ -22,13 +22,14 @@ Channel plane layout (rows of the [16, P] accumulator):
     8     value_size_sum hi digit
     9..15 zero padding (MXU-friendly row count)
 
+Partition counts beyond 128 tile the grid's leading dimension (one
+accumulator pass per 128-partition tile), and the kernel runs under
+`shard_map` meshes (parallel/sharded.py relaxes the vma check for it).
 Enabled by ``AnalyzerConfig.use_pallas_counters``; the lax scatter path
 remains the default until the kernel is benchmarked faster on real hardware.
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import numpy as np
@@ -43,12 +44,14 @@ BLOCK = 1024
 #: (2^18 · 4095 ≈ 1.07e9).
 MAX_CALL = 1 << 18
 PLANES = 16
-#: One 128-lane tile of partitions per call.
-MAX_KERNEL_PARTITIONS = 128
+#: Partitions per 128-lane output tile; wider topics tile the grid's
+#: leading dimension (one accumulator pass per tile).
+PART_TILE = 128
 
 
-def _kernel(part_ref, klen_ref, vlen_ref, kn_ref, vn_ref, valid_ref, out_ref, acc_ref, *, p_pad: int):
-    i = pl.program_id(0)
+def _kernel(part_ref, klen_ref, vlen_ref, kn_ref, vn_ref, valid_ref, out_ref, acc_ref):
+    j = pl.program_id(0)  # partition tile
+    i = pl.program_id(1)  # record block
 
     @pl.when(i == 0)
     def _():
@@ -81,10 +84,12 @@ def _kernel(part_ref, klen_ref, vlen_ref, kn_ref, vn_ref, valid_ref, out_ref, ac
     planes += [zeros] * (PLANES - len(planes))
     contrib = jnp.stack(planes).astype(jnp.float32)        # [16, BLOCK]
 
-    # One-hot over partitions; invalid records carry partition 0 but all
-    # their contribution planes are 0, so they add nothing.
-    iota = jax.lax.broadcasted_iota(jnp.int32, (BLOCK, p_pad), 1)
-    one_hot = (part[:, None] == iota).astype(jnp.float32)  # [BLOCK, P_pad]
+    # One-hot over this tile's partition range [j·128, (j+1)·128); invalid
+    # records carry partition 0 but all their contribution planes are 0,
+    # so they add nothing.
+    iota = jax.lax.broadcasted_iota(jnp.int32, (BLOCK, PART_TILE), 1)
+    iota = iota + j * PART_TILE
+    one_hot = (part[:, None] == iota).astype(jnp.float32)  # [BLOCK, 128]
 
     # precision=HIGHEST: without it the MXU may run f32 operands through
     # bf16 passes, whose 8-bit mantissa cannot represent the 12-bit digit
@@ -95,37 +100,43 @@ def _kernel(part_ref, klen_ref, vlen_ref, kn_ref, vn_ref, valid_ref, out_ref, ac
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
-    )                                                       # [16, P_pad]
+    )                                                       # [16, 128] tile j
     acc_ref[:] += block_out.astype(jnp.int32)
 
-    @pl.when(i == pl.num_programs(0) - 1)
+    @pl.when(i == pl.num_programs(1) - 1)
     def _():
         out_ref[:] = acc_ref[:]
 
 
-def _call(part, klen, vlen, kn, vn, valid, num_partitions: int, interpret: bool):
+def _call(part, klen, vlen, kn, vn, valid, p_pad: int, interpret: bool):
     n = part.shape[0]
     assert n % BLOCK == 0 and n <= MAX_CALL
+    assert p_pad % PART_TILE == 0
     rows = n // 128
-    if num_partitions > MAX_KERNEL_PARTITIONS:
-        raise ValueError(
-            f"pallas counter kernel supports up to {MAX_KERNEL_PARTITIONS} "
-            f"partitions (got {num_partitions}); use the lax path for wider topics"
-        )
-    p_pad = MAX_KERNEL_PARTITIONS
 
     def shape2d(x):
         return x.reshape(rows, 128)
 
     block_rows = BLOCK // 128
-    in_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
+    # Under a check_vma shard_map the output aval must declare its
+    # varying mesh axes; the reduction preserves the inputs' variance
+    # (per-device records → per-device counts), so propagate their vma.
+    vma = getattr(jax.typeof(part), "vma", None)
+    out_aval = (
+        jax.ShapeDtypeStruct((PLANES, p_pad), jnp.int32, vma=vma)
+        if vma
+        else jax.ShapeDtypeStruct((PLANES, p_pad), jnp.int32)
+    )
+    # Partition tiles lead the grid so each tile streams all record
+    # blocks through its own accumulator pass (i innermost).
+    in_spec = pl.BlockSpec((block_rows, 128), lambda j, i: (i, 0))
     out = pl.pallas_call(
-        functools.partial(_kernel, p_pad=p_pad),
-        grid=(rows // block_rows,),
+        _kernel,
+        grid=(p_pad // PART_TILE, rows // block_rows),
         in_specs=[in_spec] * 6,
-        out_specs=pl.BlockSpec((PLANES, p_pad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((PLANES, p_pad), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((PLANES, p_pad), jnp.int32)],
+        out_specs=pl.BlockSpec((PLANES, PART_TILE), lambda j, i: (0, j)),
+        out_shape=out_aval,
+        scratch_shapes=[pltpu.VMEM((PLANES, PART_TILE), jnp.int32)],
         interpret=interpret,
     )(
         shape2d(part), shape2d(klen), shape2d(vlen),
@@ -160,13 +171,20 @@ def pallas_counters_update(
     klen = key_len.astype(jnp.int32)
     vlen = value_len.astype(jnp.int32)
 
-    total = jnp.zeros((PLANES, 128), dtype=jnp.int64)
+    p_pad = -(-num_partitions // PART_TILE) * PART_TILE
+    total = jnp.zeros((PLANES, p_pad), dtype=jnp.int64)
+    # Under a check_vma shard_map the kernel output varies over the mesh
+    # axes its inputs vary over; the zeros accumulator starts replicated
+    # and must be explicitly cast to match before the add.
+    axes = tuple(sorted(getattr(jax.typeof(partition), "vma", frozenset())))
+    if axes:
+        total = jax.lax.pvary(total, axes)
     for lo in range(0, b, MAX_CALL):
         hi = min(lo + MAX_CALL, b)
         sl = slice(lo, hi)
         total = total + _call(
             part[sl], klen[sl], vlen[sl], kn[sl], vn[sl], v32[sl],
-            num_partitions, interpret,
+            p_pad, interpret,
         ).astype(jnp.int64)
 
     p = num_partitions

@@ -26,7 +26,7 @@ def _random_arrays(b, p, seed, big_values=False):
     )
 
 
-@pytest.mark.parametrize("p", [1, 3, 16, 64])
+@pytest.mark.parametrize("p", [1, 3, 16, 64, 200, 300])
 def test_pallas_matches_lax(p):
     b = 4 * BLOCK
     a = _random_arrays(b, p, seed=p)
@@ -83,14 +83,43 @@ def test_pallas_backend_end_to_end_parity():
     assert np.array_equal(a.per_partition_extremes, b.per_partition_extremes)
 
 
-def test_pallas_rejected_under_mesh():
+def test_pallas_under_sharded_mesh_matches_lax():
+    """The kernel runs inside shard_map (check_vma relaxed): a sharded
+    scan with the Pallas counter path reports the same metrics as the
+    default lax scatter path on the same records."""
     from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.synthetic import (
+        SyntheticSource,
+        SyntheticSpec,
+    )
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
 
-    with pytest.raises(ValueError, match="single-device"):
-        AnalyzerConfig(
-            num_partitions=2, batch_size=1024,
-            use_pallas_counters=True, mesh_shape=(2, 1),
+    spec = SyntheticSpec(
+        num_partitions=5,
+        messages_per_partition=3000,
+        keys_per_partition=200,
+        key_null_permille=50,
+        tombstone_permille=100,
+        seed=77,
+    )
+
+    def scan(use_pallas: bool):
+        cfg = AnalyzerConfig(
+            num_partitions=5,
+            batch_size=1024,
+            mesh_shape=(4, 2),
+            use_pallas_counters=use_pallas,
         )
+        backend = ShardedTpuBackend(cfg)
+        return run_scan(
+            "t", SyntheticSource(spec), backend, batch_size=1024
+        ).metrics
+
+    a, b = scan(False), scan(True)
+    assert np.array_equal(a.per_partition, b.per_partition)
+    assert a.overall_count == b.overall_count
+    assert a.overall_size == b.overall_size
 
 
 def test_bad_batch_size_rejected():
