@@ -9,8 +9,10 @@ RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
 WORKDIR /app
 COPY pyproject.toml README.md ./
 COPY kafka_topic_analyzer_tpu ./kafka_topic_analyzer_tpu
-COPY native ./native
 RUN pip install --no-cache-dir "jax[cpu]" numpy && pip install --no-cache-dir . \
-    && make -C native
+    # Warm-build the native shim into the INSTALLED copy (cd out of /app so
+    # the import resolves site-packages, not the source tree).
+    && cd /tmp \
+    && python -c "from kafka_topic_analyzer_tpu.io.native import load_library; load_library()"
 
 ENTRYPOINT ["kta"]

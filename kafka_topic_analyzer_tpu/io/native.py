@@ -20,12 +20,36 @@ import numpy as np
 from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
 from kafka_topic_analyzer_tpu.records import RecordBatch
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+#: The C++ source ships INSIDE the package (package-data in pyproject) so
+#: an installed wheel can build it on first use, not just a checkout.
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
 _ABI = 7
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", f"libkta_ingest.v{_ABI}.so")
+_SO_NAME = f"libkta_ingest.v{_ABI}.so"
+
+
+def _build_dir() -> str:
+    """Prefer the in-tree build dir; for read-only installs (site-packages
+    owned by root, containers) fall back to a per-user cache.  The cache
+    key includes a hash of ingest.cpp, not just the ABI — the ABI is an
+    interface version, so a source bugfix without an interface change
+    must still invalidate the cached binary (in-tree builds get this from
+    make's mtime check)."""
+    in_tree = os.path.join(_NATIVE_DIR, "build")
+    if os.access(_NATIVE_DIR, os.W_OK) or os.path.exists(
+        os.path.join(in_tree, _SO_NAME)
+    ):
+        return in_tree
+    import hashlib
+
+    with open(os.path.join(_NATIVE_DIR, "ingest.cpp"), "rb") as f:
+        src = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "kta-native", f"v{_ABI}-{src}"
+    )
 
 _lock = threading.Lock()
 _lib: "ctypes.CDLL | None" = None
@@ -49,9 +73,10 @@ class _KtaSynthSpec(ctypes.Structure):
     ]
 
 
-def _build() -> None:
+def _build(build_dir: str) -> None:
+    os.makedirs(build_dir, exist_ok=True)
     subprocess.run(
-        ["make", "-C", _NATIVE_DIR, "-s"],
+        ["make", "-C", _NATIVE_DIR, "-s", f"BUILD={build_dir}"],
         check=True,
         capture_output=True,
         text=True,
@@ -71,15 +96,16 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
         if _load_error is not None:
             raise _load_error
         try:
-            if not os.path.exists(_SO_PATH):
+            so_path = os.path.join(_build_dir(), _SO_NAME)
+            if not os.path.exists(so_path):
                 if not build_if_missing:
-                    raise FileNotFoundError(_SO_PATH)
-                _build()
-            lib = ctypes.CDLL(_SO_PATH)
+                    raise FileNotFoundError(so_path)
+                _build(os.path.dirname(so_path))
+            lib = ctypes.CDLL(so_path)
             lib.kta_version.restype = ctypes.c_int32
             if lib.kta_version() != _ABI:
                 raise RuntimeError(
-                    f"libkta_ingest ABI mismatch: {_SO_PATH} reports "
+                    f"libkta_ingest ABI mismatch: {so_path} reports "
                     f"{lib.kta_version()}, expected {_ABI}"
                 )
             lib.kta_synth_batch.restype = ctypes.c_int32

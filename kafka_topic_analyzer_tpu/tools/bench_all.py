@@ -58,6 +58,33 @@ def main(argv: "list[str] | None" = None) -> int:
         report[str(cfg)] = json.loads(last)
         print(f"bench_all: config {cfg}: {last}", file=sys.stderr)
 
+    # End-to-end pipeline figure (broker → wire client → decode → pack →
+    # device) next to the device-path numbers — the apples-to-apples
+    # comparison to the reference's published 590,221 msgs/s
+    # (demo_output.png, src/main.rs:130).
+    cmd = [
+        sys.executable, "-m", "kafka_topic_analyzer_tpu.tools.bench_e2e",
+        "--backend", "tpu", "--quiet",
+    ]
+    print("bench_all: running e2e pipeline...", file=sys.stderr)
+    try:
+        # Same hang discipline as bench.py's supervisor: a wedged device
+        # step must not block the report the driver is waiting for.
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, env=child_env, cwd=repo,
+            timeout=float(os.environ.get("KTA_BENCH_DEADLINE") or 900),
+        )
+    except subprocess.TimeoutExpired:
+        proc = None
+    if proc is None:
+        report["e2e"] = {"error": "timed out (accelerator hang?)"}
+    elif proc.returncode != 0:
+        report["e2e"] = {"error": proc.stderr.strip()[-500:]}
+    else:
+        last = proc.stdout.strip().splitlines()[-1]
+        report["e2e"] = json.loads(last)
+        print(f"bench_all: e2e: {last}", file=sys.stderr)
+
     out = json.dumps(report, indent=2)
     if args.out == "-":
         print(out)
