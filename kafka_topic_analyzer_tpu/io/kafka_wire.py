@@ -654,12 +654,19 @@ class KafkaWireSource(RecordSource):
         # run one batches() stream per shard from worker threads, and the
         # pipelined send/read halves cannot share a socket with another
         # stream (responses would be claimed by the wrong reader).
-        own_conns: Dict[Tuple[str, int], BrokerConnection] = {}
+        own_conns: Dict[int, BrokerConnection] = {}
+        pools: "list" = []
         try:
             yield from self._batches_impl(
-                batch_size, partitions, start_at, own_conns
+                batch_size, partitions, start_at, own_conns, pools
             )
         finally:
+            # Drain worker threads BEFORE closing their sockets: a close
+            # under an active reader is a fd-reuse race (and outright
+            # thread-unsafe on SSLSocket).  Workers unblock within the
+            # socket timeout at worst.
+            for pl in pools:
+                pl.shutdown(wait=True, cancel_futures=True)
             for c in own_conns.values():
                 c.close()
 
@@ -668,7 +675,8 @@ class KafkaWireSource(RecordSource):
         batch_size: int,
         partitions: Optional[List[int]],
         start_at: Optional[Dict[int, int]],
-        own_conns: "Dict[Tuple[str, int], BrokerConnection]",
+        own_conns: "Dict[int, BrokerConnection]",
+        pools: "list",
     ) -> Iterator[RecordBatch]:
         start, end = self.watermarks()
         parts = sorted(partitions) if partitions is not None else self.partitions()
@@ -736,22 +744,163 @@ class KafkaWireSource(RecordSource):
         max_stall = max(max_error_streak, 4 * len(parts))
 
         inflight: "Dict[int, tuple]" = {}
+        conn_lock = threading.Lock()
 
-        def own_conn(partition: int) -> BrokerConnection:
-            host, port = self._brokers[self._leaders[partition]]
-            key = (host, port)
-            c = own_conns.get(key)
-            if c is None:
-                c = BrokerConnection(
-                    host,
-                    port,
-                    self.timeout_s,
-                    ssl_context=self._ssl_context,
-                    sasl=self._sasl,
-                    sock_opts=self._sock_opts,
+        def own_conn(leader: int) -> BrokerConnection:
+            # Keyed by LEADER id, not (host, port): fetch_leader threads run
+            # per leader, and two leader ids advertising the same address
+            # (load balancer, port forward) must NOT share a socket — the
+            # pipelined send/read halves from two threads would race for
+            # each other's response bytes.
+            host, port = self._brokers[leader]
+            with conn_lock:
+                c = own_conns.get(leader)
+                if c is not None and (c.host, c.port) != (host, port):
+                    # Leader moved (metadata reload): reconnect.
+                    c.close()
+                    own_conns.pop(leader, None)
+                    c = None
+                if c is None:
+                    c = BrokerConnection(
+                        host,
+                        port,
+                        self.timeout_s,
+                        ssl_context=self._ssl_context,
+                        sasl=self._sasl,
+                        sock_opts=self._sock_opts,
+                    )
+                    own_conns[leader] = c
+                return c
+
+        def fetch_leader(leader: int, lparts: List[int], fetch_round: int):
+            """Phase 1 of a round, one leader: (re)send, read, decode —
+            ALL the heavy work (socket IO, native scan + record-set
+            decode) with no shared-state mutation beyond this leader's
+            own connection and inflight slot.  Runs concurrently across
+            leaders; phase 2 (the serial loop below) does bookkeeping."""
+            conn = own_conn(leader)
+            # KIP-74: brokers fill the response budget in request order,
+            # so rotate the partition list each round — without this,
+            # partitions at the tail of a large sorted list can be
+            # starved of response bytes indefinitely.
+            lp = sorted(lparts)
+            k = fetch_round % len(lp)
+            order = lp[k:] + lp[:k]
+            # Pipelining: if last round sent ahead for this leader, its
+            # response is already in flight.  A stale in-flight
+            # (connection changed, or it no longer covers this round's
+            # partitions) is drained and discarded — the stream stays
+            # ordered either way.
+            fl = inflight.pop(leader, None)
+            if fl is not None and (
+                fl[0] is not conn or not set(lp) <= set(fl[3])
+            ):
+                try:
+                    fl[0].read_response(fl[1])
+                except Exception:
+                    fl[0].close()
+                    with conn_lock:
+                        if own_conns.get(leader) is fl[0]:
+                            own_conns.pop(leader, None)
+                    conn = own_conn(leader)
+                fl = None
+            if fl is None:
+                pmax_sent = self.partition_max_bytes
+                corr = conn.send_request(
+                    kc.API_FETCH,
+                    self._version(conn, kc.API_FETCH),
+                    kc.encode_fetch_request(
+                        self.topic,
+                        [(p, next_offset[p]) for p in order],
+                        self.max_wait_ms,
+                        self.min_bytes,
+                        self.max_bytes,
+                        pmax_sent,
+                    ),
                 )
-                own_conns[key] = c
-            return c
+                fl = (
+                    conn,
+                    corr,
+                    {p: next_offset[p] for p in order},
+                    order,
+                    pmax_sent,
+                )
+            conn, corr, sent_offsets, order, pmax_sent = fl
+            r = conn.read_response(corr)
+            fps = kc.decode_fetch_response(r)
+            # Send-ahead: while this response's records decode, let the
+            # broker build the NEXT one.  A cheap native header scan of
+            # each partition's record set yields the exact offsets
+            # processing will arrive at (covered_end, compaction-aware);
+            # only clean all-native responses qualify, and a
+            # post-processing mismatch discards the speculative response
+            # (correctness never depends on the speculation being right).
+            spec_sent = False
+            #: Clean full-prefix scan results, reused by the decode so
+            #: the header (and CRC) walk isn't paid twice.
+            scans: "Dict[int, tuple[int, int, int]]" = {}
+            if use_native_decode:
+                clean = True
+                spec: Dict[int, int] = {}
+                for fp in fps:
+                    p = fp.partition
+                    if p not in remaining:
+                        continue
+                    if fp.error or len(fp.records) == 0:
+                        clean = False
+                        break
+                    nrec, used, covered = scan_record_set_native(
+                        fp.records, self.verify_crc
+                    )
+                    if used != len(fp.records) or nrec <= 0:
+                        clean = False
+                        break
+                    scans[p] = (nrec, used, covered)
+                    if covered <= next_offset[p]:
+                        clean = False
+                        break
+                    spec[p] = min(covered, end[p])
+                if clean and spec:
+                    lp2 = sorted(
+                        p for p in order if p in spec and spec[p] < end[p]
+                    )
+                    if lp2:
+                        k2 = (fetch_round + 1) % len(lp2)
+                        order2 = lp2[k2:] + lp2[:k2]
+                        pmax2 = self.partition_max_bytes
+                        corr2 = conn.send_request(
+                            kc.API_FETCH,
+                            self._version(conn, kc.API_FETCH),
+                            kc.encode_fetch_request(
+                                self.topic,
+                                [(p, spec[p]) for p in order2],
+                                self.max_wait_ms,
+                                self.min_bytes,
+                                self.max_bytes,
+                                pmax2,
+                            ),
+                        )
+                        inflight[leader] = (
+                            conn,
+                            corr2,
+                            {p: spec[p] for p in order2},
+                            order2,
+                            pmax2,
+                        )
+                        spec_sent = True
+            # Pre-decode the clean full-prefix record sets here (the
+            # expensive, GIL-releasing half); masking and state updates
+            # stay in phase 2.
+            soas: "Dict[int, tuple]" = {}
+            for fp in fps:
+                p = fp.partition
+                if p in scans:
+                    soas[p] = decode_record_set_native(
+                        fp.records, self.verify_crc, prescan=scans[p]
+                    )
+            return (leader, fps, scans, soas, spec_sent, order, pmax_sent)
+
+        pool: "object | None" = None
 
         fetch_round = 0
         while remaining:
@@ -760,117 +909,29 @@ class KafkaWireSource(RecordSource):
                 by_leader.setdefault(self._leaders[p], []).append(p)
             progressed = False
             fetch_round += 1
-            for leader, lparts in by_leader.items():
-                conn = own_conn(lparts[0])
-                # KIP-74: brokers fill the response budget in request
-                # order, so rotate the partition list each round — without
-                # this, partitions at the tail of a large sorted list can
-                # be starved of response bytes indefinitely.
-                lp = sorted(lparts)
-                k = fetch_round % len(lp)
-                order = lp[k:] + lp[:k]
-                # Pipelining: if last round sent ahead for this leader,
-                # its response is already in flight.  A stale in-flight
-                # (connection changed, or it no longer covers this
-                # round's partitions) is drained and discarded — the
-                # stream stays ordered either way.
-                fl = inflight.pop(leader, None)
-                if fl is not None and (
-                    fl[0] is not conn or not set(lp) <= set(fl[3])
-                ):
-                    try:
-                        fl[0].read_response(fl[1])
-                    except Exception:
-                        fl[0].close()
-                        own_conns.pop((fl[0].host, fl[0].port), None)
-                        conn = own_conn(lparts[0])
-                    fl = None
-                if fl is None:
-                    pmax_sent = self.partition_max_bytes
-                    corr = conn.send_request(
-                        kc.API_FETCH,
-                        self._version(conn, kc.API_FETCH),
-                        kc.encode_fetch_request(
-                            self.topic,
-                            [(p, next_offset[p]) for p in order],
-                            self.max_wait_ms,
-                            self.min_bytes,
-                            self.max_bytes,
-                            pmax_sent,
-                        ),
+            if len(by_leader) > 1 and pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                # One pool per stream; sharded scans run one stream per
+                # shard, so size by actual leader count, not a constant.
+                pool = ThreadPoolExecutor(
+                    max_workers=min(8, len(by_leader)),
+                    thread_name_prefix="kta-fetch",
+                )
+                pools.append(pool)
+            if pool is not None and len(by_leader) > 1:
+                results = list(
+                    pool.map(
+                        lambda kv: fetch_leader(kv[0], kv[1], fetch_round),
+                        by_leader.items(),
                     )
-                    fl = (
-                        conn,
-                        corr,
-                        {p: next_offset[p] for p in order},
-                        order,
-                        pmax_sent,
-                    )
-                conn, corr, sent_offsets, order, pmax_sent = fl
-                r = conn.read_response(corr)
-                fps = kc.decode_fetch_response(r)
-                # Send-ahead: while this response's records decode below,
-                # let the broker build the NEXT one.  A cheap native
-                # header scan of each partition's record set yields the
-                # exact offsets processing will arrive at (covered_end,
-                # compaction-aware); only clean all-native responses
-                # qualify, and a post-processing mismatch discards the
-                # speculative response (correctness never depends on the
-                # speculation being right).
-                spec_sent = False
-                #: Clean full-prefix scan results, reused by the decode
-                #: below so the header (and CRC) walk isn't paid twice.
-                scans: "Dict[int, tuple[int, int, int]]" = {}
-                if use_native_decode and remaining:
-                    clean = True
-                    spec: Dict[int, int] = {}
-                    for fp in fps:
-                        p = fp.partition
-                        if p not in remaining:
-                            continue
-                        if fp.error or len(fp.records) == 0:
-                            clean = False
-                            break
-                        nrec, used, covered = scan_record_set_native(
-                            fp.records, self.verify_crc
-                        )
-                        if used != len(fp.records) or nrec <= 0:
-                            clean = False
-                            break
-                        scans[p] = (nrec, used, covered)
-                        if covered <= next_offset[p]:
-                            clean = False
-                            break
-                        spec[p] = min(covered, end[p])
-                    if clean and spec:
-                        lp2 = sorted(
-                            p for p in order
-                            if p in spec and spec[p] < end[p]
-                        )
-                        if lp2:
-                            k2 = (fetch_round + 1) % len(lp2)
-                            order2 = lp2[k2:] + lp2[:k2]
-                            pmax2 = self.partition_max_bytes
-                            corr2 = conn.send_request(
-                                kc.API_FETCH,
-                                self._version(conn, kc.API_FETCH),
-                                kc.encode_fetch_request(
-                                    self.topic,
-                                    [(p, spec[p]) for p in order2],
-                                    self.max_wait_ms,
-                                    self.min_bytes,
-                                    self.max_bytes,
-                                    pmax2,
-                                ),
-                            )
-                            inflight[leader] = (
-                                conn,
-                                corr2,
-                                {p: spec[p] for p in order2},
-                                order2,
-                                pmax2,
-                            )
-                            spec_sent = True
+                )
+            else:
+                results = [
+                    fetch_leader(leader, lparts, fetch_round)
+                    for leader, lparts in by_leader.items()
+                ]
+            for leader, fps, scans, soas, spec_sent, order, pmax_sent in results:
                 for fp in fps:
                     p = fp.partition
                     if p not in remaining:
@@ -903,14 +964,19 @@ class KafkaWireSource(RecordSource):
                     # compaction, so this advances past removed ranges).
                     max_frame_end = -1
                     data = fp.records
-                    if use_native_decode and data:
+                    pre = soas.get(p)
+                    if pre is not None or (use_native_decode and data):
                         # Whole-response fast path: every leading complete
-                        # uncompressed v2 frame decodes in ONE native call
-                        # (io/native.py::decode_record_set_native); only
-                        # the remainder (compressed/legacy/truncated)
+                        # uncompressed v2 frame decoded in ONE native call
+                        # (already done in phase 1 for clean prefixes);
+                        # only the remainder (compressed/legacy/truncated)
                         # takes the per-frame loop below.
-                        soa, used, covered = decode_record_set_native(
-                            data, self.verify_crc, prescan=scans.get(p)
+                        soa, used, covered = (
+                            pre
+                            if pre is not None
+                            else decode_record_set_native(
+                                data, self.verify_crc, prescan=scans.get(p)
+                            )
                         )
                         if used:
                             max_frame_end = max(max_frame_end, covered)
@@ -1063,7 +1129,9 @@ class KafkaWireSource(RecordSource):
                             fl2[0].read_response(fl2[1])
                         except Exception:
                             fl2[0].close()
-                            own_conns.pop((fl2[0].host, fl2[0].port), None)
+                            with conn_lock:
+                                if own_conns.get(leader) is fl2[0]:
+                                    own_conns.pop(leader, None)
                 yield from flush(force=False)
             if not progressed and remaining:
                 # Nothing moved this round (e.g. leader churn): brief pause

@@ -98,6 +98,7 @@ class TemplateBroker:
         windows_per_partition: int,
         templates: List[bytes],
         records_per_batch: int,
+        brokers: int = 1,
     ):
         self.topic = topic
         self.partitions = list(range(partitions))
@@ -111,28 +112,40 @@ class TemplateBroker:
         self.templates = templates
         self.R = records_per_batch
         self.end_offset = windows_per_partition * records_per_batch
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("127.0.0.1", 0))
-        self._sock.listen(16)
-        self.port = self._sock.getsockname()[1]
+        #: N listener sockets = N advertised broker nodes (partition p is
+        #: led by node p % N) — exercises the wire client's
+        #: leader-parallel fetch the way a real multi-broker cluster does.
+        self._socks: List[socket.socket] = []
+        self.ports: List[int] = []
+        for _ in range(max(1, brokers)):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            s.listen(16)
+            self._socks.append(s)
+            self.ports.append(s.getsockname()[1])
+        self.port = self.ports[0]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "TemplateBroker":
-        t = threading.Thread(target=self._accept_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
+        for s in self._socks:
+            t = threading.Thread(
+                target=self._accept_loop, args=(s,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def __enter__(self) -> "TemplateBroker":
         return self.start()
@@ -140,10 +153,10 @@ class TemplateBroker:
     def __exit__(self, *exc) -> None:
         self.stop()
 
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, sock: socket.socket) -> None:
         while not self._stop.is_set():
             try:
-                conn, _ = self._sock.accept()
+                conn, _ = sock.accept()
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -259,11 +272,15 @@ class TemplateBroker:
             n = r.i32()
             for _ in range(max(n, 0)):
                 requested.append(r.string())
+            nb = len(self.ports)
             topics = [
                 kc.TopicMetadata(
                     0,
                     self.topic,
-                    [kc.PartitionMetadata(0, p, 0) for p in self.partitions],
+                    [
+                        kc.PartitionMetadata(0, p, p % nb)
+                        for p in self.partitions
+                    ],
                 )
                 if name == self.topic
                 else kc.TopicMetadata(
@@ -272,7 +289,11 @@ class TemplateBroker:
                 for name in (requested if requested else [self.topic])
             ]
             return kc.encode_metadata_response(
-                kc.MetadataResponse({0: ("127.0.0.1", self.port)}, 0, topics),
+                kc.MetadataResponse(
+                    {i: ("127.0.0.1", port) for i, port in enumerate(self.ports)},
+                    0,
+                    topics,
+                ),
                 version=api_version,
             )
         if api_key == kc.API_LIST_OFFSETS:
@@ -297,7 +318,8 @@ class TemplateBroker:
 
 
 def _broker_child(pipe, topic, partitions, windows, R, n_templates,
-                  vmin, vmax, compression, tombstone_every) -> None:
+                  vmin, vmax, compression, tombstone_every,
+                  brokers) -> None:
     """Subprocess entry: build templates, serve, report the port, block.
 
     The broker must live in its own process — in-process serving steals
@@ -307,7 +329,9 @@ def _broker_child(pipe, topic, partitions, windows, R, n_templates,
         R, n_templates, vmin, vmax,
         compression=compression, tombstone_every=tombstone_every,
     )
-    broker = TemplateBroker(topic, partitions, windows, templates, R)
+    broker = TemplateBroker(
+        topic, partitions, windows, templates, R, brokers=brokers
+    )
     broker.start()
     pipe.send(broker.port)
     pipe.recv()  # parent says stop (or EOFError on parent death)
@@ -332,6 +356,7 @@ class BrokerProcess:
                 self._kw["R"], self._kw["n_templates"], self._kw["vmin"],
                 self._kw["vmax"], self._kw["compression"],
                 self._kw.get("tombstone_every", 0),
+                self._kw.get("brokers", 1),
             ),
             daemon=True,
         )
@@ -373,6 +398,9 @@ def run(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--tombstone-every", type=int, default=0,
                     help="make every Nth template record a tombstone "
                          "(0 = none)")
+    ap.add_argument("--brokers", type=int, default=1,
+                    help="advertised broker nodes (partition p led by "
+                         "p %% N) — exercises leader-parallel fetching")
     ap.add_argument("--alive-bits", type=int, default=26)
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
@@ -416,6 +444,7 @@ def run(argv: "list[str] | None" = None) -> int:
         topic="bench-e2e", partitions=args.partitions, windows=windows,
         R=R, n_templates=args.templates, vmin=args.vmin, vmax=args.vmax,
         compression=comp, tombstone_every=args.tombstone_every,
+        brokers=args.brokers,
     ) as port:
         source = KafkaWireSource(f"127.0.0.1:{port}", "bench-e2e")
         t0 = time.perf_counter()
