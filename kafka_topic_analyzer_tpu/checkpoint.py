@@ -65,6 +65,18 @@ def _flatten(state: AnalyzerState) -> Dict[str, np.ndarray]:
     return flat
 
 
+def _snapshot_path(directory: str, scope) -> str:
+    """Single-controller snapshots are one file; multi-controller scans
+    write one file PER PROCESS (its own data rows + its own partitions'
+    offsets).  Data shards fold independently, so each process may resume
+    from its file with no cross-process coordination — a process without
+    a file simply rescans its shards from zero, which is still exact."""
+    if scope is None:
+        return os.path.join(directory, SNAPSHOT_NAME)
+    pid, nproc, _rows = scope
+    return os.path.join(directory, f"scan_snapshot.p{pid}of{nproc}.npz")
+
+
 def save_snapshot(
     directory: str,
     topic: str,
@@ -73,8 +85,13 @@ def save_snapshot(
     next_offsets: Dict[int, int],
     records_seen: int,
     init_now_s: int,
+    scope=None,
 ) -> str:
-    """Atomically write the snapshot; returns its path."""
+    """Atomically write the snapshot; returns its path.
+
+    ``scope``: None, or ``(process_index, process_count, local_rows)`` for
+    multi-controller runs — ``state`` is then the PROCESS-LOCAL rows
+    (ShardedTpuBackend.get_state_local)."""
     os.makedirs(directory, exist_ok=True)
     host_state = jax.tree.map(np.asarray, jax.device_get(state))
     flat = _flatten(host_state)
@@ -85,7 +102,10 @@ def save_snapshot(
         "records_seen": int(records_seen),
         "init_now_s": int(init_now_s),
     }
-    path = os.path.join(directory, SNAPSHOT_NAME)
+    if scope is not None:
+        meta["process"] = [int(scope[0]), int(scope[1])]
+        meta["local_rows"] = [int(r) for r in scope[2]]
+    path = _snapshot_path(directory, scope)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     os.close(fd)
     try:
@@ -103,6 +123,7 @@ def load_snapshot(
     topic: str,
     config: AnalyzerConfig,
     template: Optional[AnalyzerState] = None,
+    scope=None,
 ) -> Optional[Tuple[AnalyzerState, Dict[int, int], int, int]]:
     """Load (state, next_offsets, records_seen, init_now_s), or None if no
     compatible snapshot exists.  An incompatible snapshot (different config/
@@ -111,9 +132,10 @@ def load_snapshot(
     ``template`` supplies the expected state shapes; it defaults to the
     single-device layout.  Sharded backends pass their freshly-initialized
     (data-stacked) state — the engine uses ``backend.get_state()`` — since
-    their leaves carry a leading data-shard axis.
+    their leaves carry a leading data-shard axis.  With ``scope`` set (see
+    save_snapshot) the template and returned state are process-local.
     """
-    path = os.path.join(directory, SNAPSHOT_NAME)
+    path = _snapshot_path(directory, scope)
     if not os.path.exists(path):
         return None
     with np.load(path, allow_pickle=False) as z:
@@ -123,6 +145,16 @@ def load_snapshot(
                 f"snapshot at {path} was taken with a different topic/config "
                 "(fingerprint mismatch) — delete it or match the original flags"
             )
+        if scope is not None:
+            pid, nproc, rows = scope
+            if meta.get("process") != [pid, nproc] or meta.get(
+                "local_rows"
+            ) != [int(r) for r in rows]:
+                raise ValueError(
+                    f"snapshot at {path} belongs to a different process "
+                    "layout (process/data-row mismatch) — delete it or "
+                    "rerun with the original mesh and process count"
+                )
         if template is None:
             template = AnalyzerState.init(config)
         template = jax.tree.map(np.asarray, jax.device_get(template))

@@ -112,22 +112,18 @@ def run_scan(
     tracker = _ProgressTracker(start_offsets)
     if start_at:
         tracker.next_offsets.update(start_at)
-    can_snapshot = (
-        snapshot_dir is not None
-        and hasattr(backend, "get_state")
-        and getattr(backend, "snapshot_capable", True)
+    can_snapshot = snapshot_dir is not None and hasattr(backend, "get_state")
+    # Multi-controller runs snapshot per process (checkpoint._snapshot_path):
+    # the backend exposes its scope and process-local state accessors.
+    snap_scope = getattr(backend, "snapshot_scope", None)
+    snap_get = (
+        backend.get_state_local if snap_scope is not None else
+        (backend.get_state if hasattr(backend, "get_state") else None)
     )
-    if (
-        snapshot_dir is not None
-        and hasattr(backend, "get_state")
-        and not getattr(backend, "snapshot_capable", True)
-    ):
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "snapshots are not supported under multi-controller runs "
-            "(state shards are not process-addressable); continuing without"
-        )
+    snap_set = (
+        backend.set_state_local if snap_scope is not None else
+        (backend.set_state if hasattr(backend, "set_state") else None)
+    )
     if snapshot_dir is not None and not hasattr(backend, "get_state"):
         import logging
 
@@ -139,11 +135,15 @@ def run_scan(
         from kafka_topic_analyzer_tpu.checkpoint import load_snapshot
 
         snap = load_snapshot(
-            snapshot_dir, topic, backend.config, template=backend.get_state()
+            snapshot_dir,
+            topic,
+            backend.config,
+            template=snap_get(),
+            scope=snap_scope,
         )
         if snap is not None:
             state, offsets, records_seen, init_now_s = snap
-            backend.set_state(state)
+            snap_set(state)
             backend.init_now_s = init_now_s
             tracker.next_offsets.update(offsets)
             start_at = offsets
@@ -164,10 +164,11 @@ def run_scan(
                 snapshot_dir,
                 topic,
                 backend.config,
-                backend.get_state(),
+                snap_get(),
                 tracker.next_offsets,
                 seq,
                 backend.init_now_s,
+                scope=snap_scope,
             )
         last_snap = time.monotonic()
 

@@ -760,17 +760,26 @@ class KafkaWireSource(RecordSource):
                     c.close()
                     own_conns.pop(leader, None)
                     c = None
-                if c is None:
-                    c = BrokerConnection(
-                        host,
-                        port,
-                        self.timeout_s,
-                        ssl_context=self._ssl_context,
-                        sasl=self._sasl,
-                        sock_opts=self._sock_opts,
-                    )
-                    own_conns[leader] = c
-                return c
+                if c is not None:
+                    return c
+            # Connect OUTSIDE the lock: TCP+TLS+SASL setup can block up to
+            # the socket timeout, and one slow broker must not serialize
+            # every other leader thread's first round.
+            c = BrokerConnection(
+                host,
+                port,
+                self.timeout_s,
+                ssl_context=self._ssl_context,
+                sasl=self._sasl,
+                sock_opts=self._sock_opts,
+            )
+            with conn_lock:
+                winner = own_conns.get(leader)
+                if winner is not None:  # lost a (same-leader) race
+                    c.close()
+                    return winner
+                own_conns[leader] = c
+            return c
 
         def fetch_leader(leader: int, lparts: List[int], fetch_round: int):
             """Phase 1 of a round, one leader: (re)send, read, decode —
@@ -912,11 +921,12 @@ class KafkaWireSource(RecordSource):
             if len(by_leader) > 1 and pool is None:
                 from concurrent.futures import ThreadPoolExecutor
 
-                # One pool per stream; sharded scans run one stream per
-                # shard, so size by actual leader count, not a constant.
+                # max_workers is a CAP, not a pre-spawn: the executor
+                # creates threads lazily up to the concurrent task count,
+                # so leaders discovered later (metadata reload) still get
+                # full parallelism without resizing.
                 pool = ThreadPoolExecutor(
-                    max_workers=min(8, len(by_leader)),
-                    thread_name_prefix="kta-fetch",
+                    max_workers=8, thread_name_prefix="kta-fetch"
                 )
                 pools.append(pool)
             if pool is not None and len(by_leader) > 1:

@@ -154,9 +154,6 @@ class ShardedTpuBackend(MetricBackend):
 
         self.local_rows = local_data_rows(self.mesh)
         self._multiprocess = jax.process_count() > 1
-        #: Snapshots np.asarray the full state; non-addressable shards make
-        #: that impossible per-process — engine skips snapshots when False.
-        self.snapshot_capable = not self._multiprocess
 
         config_ = config
 
@@ -326,6 +323,51 @@ class ShardedTpuBackend(MetricBackend):
             host_state,
             self._specs,
         )
+
+    @property
+    def snapshot_scope(self):
+        """None single-controller; (pid, nproc, local_rows) under
+        jax.distributed — the engine then snapshots per process via
+        get_state_local/set_state_local (data shards fold independently,
+        so per-process files need no coordination)."""
+        if not self._multiprocess:
+            return None
+        return (jax.process_index(), jax.process_count(), self.local_rows)
+
+    def get_state_local(self) -> AnalyzerState:
+        """Host copy of THIS process's data rows of every state leaf."""
+        rows = self.local_rows
+        row0 = rows[0]
+        if rows != list(range(row0, row0 + len(rows))):
+            raise NotImplementedError(
+                "snapshots need contiguous local data rows"
+            )
+
+        def to_local(arr):
+            local_shape = (len(rows),) + arr.shape[1:]
+            buf = np.empty(local_shape, dtype=arr.dtype)
+            for sh in arr.addressable_shards:
+                idx = sh.index
+                r = idx[0]
+                lo = (r.start or 0) - row0
+                hi = (r.stop if r.stop is not None else arr.shape[0]) - row0
+                buf[(slice(lo, hi),) + tuple(idx[1:])] = np.asarray(sh.data)
+            return buf
+
+        return jax.tree.map(to_local, self.state)
+
+    def set_state_local(self, local_state: AnalyzerState) -> None:
+        """Rebuild the global state from THIS process's rows (the other
+        processes supply theirs in their own call)."""
+        d = self.config.data_shards
+
+        def put(x, s):
+            x = np.asarray(x)
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, s), x, global_shape=(d,) + x.shape[1:]
+            )
+
+        self.state = jax.tree.map(put, local_state, self._specs)
 
     # -- finalize ------------------------------------------------------------
 

@@ -25,10 +25,36 @@ force_platform("cpu")
 import jax  # noqa: E402
 
 
+class _Interrupt(RuntimeError):
+    pass
+
+
+class _StepBomb:
+    """Raise after N collective steps — N is the SAME on every process
+    (update_shards runs in lockstep), so the interrupt is synchronized
+    and no process is left waiting in a collective."""
+
+    def __init__(self, inner, limit: int):
+        self._inner = inner
+        self._limit = limit
+        self._n = 0
+
+    def update_shards(self, batches):
+        self._n += 1
+        if self._n > self._limit:
+            raise _Interrupt()
+        return self._inner.update_shards(batches)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def main() -> int:
     pid, nprocs, port, out_path = (
         int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
     )
+    mode = sys.argv[5] if len(sys.argv) > 5 else "plain"
+    snap_dir = sys.argv[6] if len(sys.argv) > 6 else None
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=nprocs,
@@ -62,11 +88,61 @@ def main() -> int:
         enable_quantiles=True,
         mesh_shape=(8, 1),
     )
-    backend = ShardedTpuBackend(config)
+    backend = ShardedTpuBackend(config, init_now_s=10**10)
     # The turnkey contract under test: this process feeds only its rows.
     assert len(backend.local_rows) == 4, backend.local_rows
-    source = SyntheticSource(spec)
-    result = run_scan("mh-topic", source, backend, batch_size=2048)
+
+    if mode == "resume":
+        # Interrupted scan with per-step per-process snapshots, then a
+        # resumed scan with a FRESH backend — the multi-host
+        # checkpoint/resume contract (checkpoint._snapshot_path).
+        try:
+            run_scan(
+                "mh-topic",
+                SyntheticSource(spec),
+                _StepBomb(backend, 1),
+                batch_size=2048,
+                snapshot_dir=snap_dir,
+                snapshot_every_s=0.0,
+            )
+            raise AssertionError("interrupt did not fire")
+        except _Interrupt:
+            pass
+        assert os.path.exists(
+            os.path.join(snap_dir, f"scan_snapshot.p{pid}of{nprocs}.npz")
+        ), "per-process snapshot file missing"
+
+        captured: "list" = []
+
+        class CaptureStart:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def batches(self, batch_size, partitions=None, start_at=None):
+                captured.append(start_at)
+                return self._inner.batches(batch_size, partitions, start_at)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        backend = ShardedTpuBackend(config, init_now_s=10**10)
+        result = run_scan(
+            "mh-topic",
+            CaptureStart(SyntheticSource(spec)),
+            backend,
+            batch_size=2048,
+            snapshot_dir=snap_dir,
+            resume=True,
+        )
+        # Resume must actually have engaged: the engine fed this process's
+        # shard streams from the snapshot's offsets, not from zero.
+        assert any(
+            s and any(v > 0 for v in s.values()) for s in captured
+        ), f"resume did not advance start offsets: {captured}"
+    else:
+        result = run_scan(
+            "mh-topic", SyntheticSource(spec), backend, batch_size=2048
+        )
 
     if jax.process_index() == 0:
         doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)

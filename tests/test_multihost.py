@@ -64,15 +64,15 @@ def _single_process_reference() -> dict:
     return result.metrics.to_dict(result.start_offsets, result.end_offsets)
 
 
-def test_two_process_scan_matches_single_process(tmp_path):
-    out = tmp_path / "mh_metrics.json"
+def _run_children(out, extra_args):
     port = _free_port()
     env = dict(os.environ)
     # The child pins its own platform/device-count env before importing jax.
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, _CHILD, str(pid), "2", str(port), str(out)],
+            [sys.executable, _CHILD, str(pid), "2", str(port), str(out)]
+            + extra_args,
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
@@ -92,8 +92,26 @@ def test_two_process_scan_matches_single_process(tmp_path):
     for rc, stdout, stderr in outs:
         assert rc == 0, f"child failed rc={rc}\nstdout:{stdout}\nstderr:{stderr}"
 
+
+def test_two_process_scan_matches_single_process(tmp_path):
+    out = tmp_path / "mh_metrics.json"
+    _run_children(out, [])
     got = json.loads(out.read_text())
     # Round-trip the reference through JSON too: quantile dict keys are
     # floats in-memory and strings on the wire.
+    want = json.loads(json.dumps(_single_process_reference()))
+    assert got == want
+
+
+def test_two_process_interrupt_resume(tmp_path):
+    """Per-process snapshots + resume under jax.distributed: an
+    interrupted 2-process scan resumed with fresh backends produces
+    exactly the single-process metrics (multi-host checkpoint/resume —
+    SURVEY.md §5.4 under §5.8's multi-controller design)."""
+    out = tmp_path / "mh_resume_metrics.json"
+    snap = tmp_path / "snaps"
+    snap.mkdir()
+    _run_children(out, ["resume", str(snap)])
+    got = json.loads(out.read_text())
     want = json.loads(json.dumps(_single_process_reference()))
     assert got == want
