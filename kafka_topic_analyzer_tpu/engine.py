@@ -112,7 +112,22 @@ def run_scan(
     tracker = _ProgressTracker(start_offsets)
     if start_at:
         tracker.next_offsets.update(start_at)
-    can_snapshot = snapshot_dir is not None and hasattr(backend, "get_state")
+    can_snapshot = (
+        snapshot_dir is not None
+        and hasattr(backend, "get_state")
+        and getattr(backend, "snapshot_capable", True)
+    )
+    if (
+        snapshot_dir is not None
+        and hasattr(backend, "get_state")
+        and not getattr(backend, "snapshot_capable", True)
+    ):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "this backend/mesh cannot snapshot (non-contiguous per-process "
+            "data rows); continuing without snapshots"
+        )
     # Multi-controller runs snapshot per process (checkpoint._snapshot_path):
     # the backend exposes its scope and process-local state accessors.
     snap_scope = getattr(backend, "snapshot_scope", None)

@@ -826,6 +826,40 @@ def encode_record_batch(
     return head.done() + crc_part
 
 
+def encode_control_batch(offset: int, ts_ms: int, commit: bool = True) -> bytes:
+    """A transaction control batch (attributes bits: 0x20 control, 0x10
+    transactional) holding one COMMIT/ABORT marker record.  Consumers
+    never surface these as messages; offsets still advance past them."""
+    key = struct.pack(">hh", 0, 1 if commit else 0)  # version, type
+    value = struct.pack(">hi", 0, 0)  # version, coordinator epoch
+    rec = ByteWriter()
+    rec.i8(0)
+    rec.varint(0)  # ts delta
+    rec.varint(0)  # offset delta
+    rec.varbytes(key)
+    rec.varbytes(value)
+    rec.varint(0)  # headers
+    rb = rec.done()
+    body = ByteWriter()
+    body.varint(len(rb)).raw(rb)
+    payload = body.done()
+
+    crcw = ByteWriter()
+    crcw.i16(0x30)  # attributes: control | transactional
+    crcw.i32(0)  # last_offset_delta
+    crcw.i64(ts_ms).i64(ts_ms)
+    crcw.i64(-1).i16(-1).i32(-1)
+    crcw.i32(1)
+    crc_part = crcw.done() + payload
+    head = ByteWriter()
+    head.i64(offset)
+    head.i32(4 + 1 + 4 + len(crc_part))
+    head.i32(-1)
+    head.i8(2)
+    head.u32(_crc32c(crc_part))
+    return head.done() + crc_part
+
+
 def _encode_legacy_message(
     offset: int,
     ts_ms: int,
@@ -1113,6 +1147,20 @@ def iter_batch_frames(buf: bytes, verify_crc: bool = False) -> Iterator[BatchFra
         payload = buf[r.pos : end]
         if verify_crc and _crc32c(buf[crc_start:end]) != crc:
             raise KafkaProtocolError(f"record batch CRC mismatch at offset {base_offset}")
+        if attributes & 0x20:
+            # Control batch (transaction commit/abort markers): consumers
+            # never see these as messages — librdkafka filters them at any
+            # isolation level — but their offsets ARE part of the log, so
+            # the frame still advances the covered range.
+            yield BatchFrame(
+                base_offset,
+                first_ts,
+                0,
+                b"",
+                end_offset=base_offset + max(last_offset_delta, 0) + 1,
+            )
+            pos = end
+            continue
         codec = attributes & 0x07
         if codec != COMPRESSION_NONE:
             from kafka_topic_analyzer_tpu.io.compression import decompress

@@ -861,7 +861,10 @@ class KafkaWireSource(RecordSource):
                     nrec, used, covered = scan_record_set_native(
                         fp.records, self.verify_crc
                     )
-                    if used != len(fp.records) or nrec <= 0:
+                    # nrec may be 0 with the whole set consumed — a
+                    # marker-only (transaction control) stretch still
+                    # speculates: covered advances past it.
+                    if used != len(fp.records):
                         clean = False
                         break
                     scans[p] = (nrec, used, covered)

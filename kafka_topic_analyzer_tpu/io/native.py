@@ -239,6 +239,24 @@ def dedupe_slots_native(
     return slot_out[:count], alive_out[:count]
 
 
+#: The decoder's SoA layout — ONE spec for every allocation site (per-frame
+#: decode, record-set decode, the marker-only empty result).
+_SOA_COLUMNS = (
+    ("offsets", np.int64),
+    ("ts_ms", np.int64),
+    ("key_len", np.int32),
+    ("value_len", np.int32),
+    ("key_null", np.uint8),
+    ("value_null", np.uint8),
+    ("key_hash32", np.uint32),
+    ("key_hash64", np.uint64),
+)
+
+
+def _soa_columns(n: int) -> "dict[str, np.ndarray]":
+    return {k: np.empty(n, dtype=d) for k, d in _SOA_COLUMNS}
+
+
 def decode_records_native(frame) -> "dict[str, np.ndarray] | None":
     """Decode one RecordBatch v2 frame (kafka_codec.BatchFrame) into SoA
     columns with key hashes computed inline — the wire client's hot half
@@ -256,16 +274,7 @@ def decode_records_native(frame) -> "dict[str, np.ndarray] | None":
     if n > max(len(frame.payload) // 7, 0):
         return None
     payload = np.frombuffer(frame.payload, dtype=np.uint8)
-    out = {
-        "offsets": np.empty(n, dtype=np.int64),
-        "ts_ms": np.empty(n, dtype=np.int64),
-        "key_len": np.empty(n, dtype=np.int32),
-        "value_len": np.empty(n, dtype=np.int32),
-        "key_null": np.empty(n, dtype=np.uint8),
-        "value_null": np.empty(n, dtype=np.uint8),
-        "key_hash32": np.empty(n, dtype=np.uint32),
-        "key_hash64": np.empty(n, dtype=np.uint64),
-    }
+    out = _soa_columns(n)
     rc = lib.kta_decode_records(
         _as_ptr(payload, ctypes.c_uint8),
         ctypes.c_int64(len(payload)),
@@ -330,29 +339,28 @@ def decode_record_set_native(
     lib = load_library()
     data = np.frombuffer(buf, dtype=np.uint8)
     consumed = ctypes.c_int64(0)
+    scan_covered = ctypes.c_int64(-1)
     if prescan is not None:
         n = prescan[0]
         verify_crc = False  # the prescan already checksummed the prefix
+        consumed.value, scan_covered.value = prescan[1], prescan[2]
     else:
         n = lib.kta_scan_record_set(
             _as_ptr(data, ctypes.c_uint8),
             ctypes.c_int64(len(data)),
             ctypes.c_int32(1 if verify_crc else 0),
             ctypes.byref(consumed),
-            None,
+            ctypes.byref(scan_covered),
         )
-    if n <= 0:
+    if n < 0:
         return {}, 0, -1
-    out = {
-        "offsets": np.empty(n, dtype=np.int64),
-        "ts_ms": np.empty(n, dtype=np.int64),
-        "key_len": np.empty(n, dtype=np.int32),
-        "value_len": np.empty(n, dtype=np.int32),
-        "key_null": np.empty(n, dtype=np.uint8),
-        "value_null": np.empty(n, dtype=np.uint8),
-        "key_hash32": np.empty(n, dtype=np.uint32),
-        "key_hash64": np.empty(n, dtype=np.uint64),
-    }
+    if n == 0:
+        # No messages in the decodable prefix, but it may still cover
+        # offsets (a transaction-marker-only stretch): the caller must
+        # advance past it, so consumed/covered ride along with empty
+        # columns.
+        return _soa_columns(0), int(consumed.value), int(scan_covered.value)
+    out = _soa_columns(n)
     covered = ctypes.c_int64(-1)
     rc = lib.kta_decode_record_set(
         _as_ptr(data, ctypes.c_uint8),

@@ -381,6 +381,7 @@ struct FrameHeader {
   int64_t payload_pos;   // first record byte
   int64_t covered_end;   // base_offset + max(last_offset_delta, 0) + 1
   int32_t num_records;
+  bool control;          // txn commit/abort markers: skip, offsets count
 };
 
 inline bool native_frame_at(const uint8_t* buf, int64_t len, int64_t pos,
@@ -393,6 +394,7 @@ inline bool native_frame_at(const uint8_t* buf, int64_t len, int64_t pos,
   if (buf[pos + 16] != 2) return false;      // legacy MessageSet v0/v1
   const int16_t attributes = be16(buf + pos + 21);
   if ((attributes & 0x07) != 0) return false;  // compressed
+  fh->control = (attributes & 0x20) != 0;
   const int32_t num_records = be32(buf + pos + 57);
   const int64_t payload_pos = pos + 61;
   // Untrusted count: a valid record needs >= 7 payload bytes.
@@ -427,7 +429,7 @@ extern "C" int64_t kta_scan_record_set(const uint8_t* buf, int64_t len,
   int64_t pos = 0, total = 0, covered = -1;
   FrameHeader fh;
   while (native_frame_at(buf, len, pos, verify_crc, &fh)) {
-    total += fh.num_records;
+    if (!fh.control) total += fh.num_records;  // markers aren't messages
     if (fh.covered_end > covered) covered = fh.covered_end;
     pos = fh.end;
   }
@@ -458,6 +460,11 @@ extern "C" int64_t kta_decode_record_set(
   int64_t pos = 0, n = 0, covered = -1;
   FrameHeader fh;
   while (native_frame_at(buf, len, pos, verify_crc, &fh)) {
+    if (fh.control) {  // txn markers: no records, offsets still covered
+      if (fh.covered_end > covered) covered = fh.covered_end;
+      pos = fh.end;
+      continue;
+    }
     if (n + fh.num_records > capacity) return -1;
     const int64_t got = kta_decode_records(
         buf + fh.payload_pos, fh.end - fh.payload_pos, fh.num_records,

@@ -154,6 +154,15 @@ class ShardedTpuBackend(MetricBackend):
 
         self.local_rows = local_data_rows(self.mesh)
         self._multiprocess = jax.process_count() > 1
+        rows = self.local_rows
+        self._rows_contiguous = rows == list(
+            range(rows[0], rows[0] + len(rows))
+        ) if rows else True
+        #: Per-process snapshots assemble contiguous local row blocks; a
+        #: mesh that interleaves process ownership along the data axis
+        #: can't snapshot — the engine degrades with a warning instead of
+        #: crashing at the first snapshot interval.
+        self.snapshot_capable = not self._multiprocess or self._rows_contiguous
 
         config_ = config
 

@@ -37,7 +37,11 @@ class FakeBroker:
         honor_max_bytes: bool = False,
         coverage_overrides: "Optional[Dict[int, Dict[int, int]]]" = None,
         message_magic: int = 2,
+        control_offsets: "Optional[Dict[int, set]]" = None,
     ):
+        #: partition → offsets rendered as transaction control batches
+        #: (commit markers) instead of data records.
+        self.control_offsets = control_offsets or {}
         #: 2 = RecordBatch v2 (default); 0/1 = legacy MessageSet entries,
         #: emulating pre-0.11 segments retained on upgraded clusters.
         self.message_magic = message_magic
@@ -94,12 +98,40 @@ class FakeBroker:
         # slower than the client it exists to test.
         self._chunks: Dict[int, "list[tuple[int, int, bytes]]"] = {}
         self._chunk_last_offsets: Dict[int, "list[int]"] = {}
+        control = self.control_offsets
         for p, rs in self.records.items():
             chunks = []
             for ci, lo in enumerate(range(0, len(rs), max_records_per_fetch)):
                 part = rs[lo : lo + max_records_per_fetch]
                 last = self.coverage_overrides.get(p, {}).get(ci, part[-1][0])
-                if message_magic == 2:
+                ctrl = control.get(p, set())
+                if message_magic == 2 and any(r[0] in ctrl for r in part):
+                    assert ci not in self.coverage_overrides.get(p, {}), (
+                        "control_offsets and coverage_overrides cannot "
+                        "target the same chunk (coverage would be dropped)"
+                    )
+                    # Transactional log shape: marker offsets become
+                    # single-record control batches between data batches.
+                    pieces, run = [], []
+
+                    def flush_run():
+                        if run:
+                            pieces.append(
+                                kc.encode_record_batch(list(run), compression)
+                            )
+                            run.clear()
+
+                    for rec in part:
+                        if rec[0] in ctrl:
+                            flush_run()
+                            pieces.append(
+                                kc.encode_control_batch(rec[0], rec[1])
+                            )
+                        else:
+                            run.append(rec)
+                    flush_run()
+                    encoded = b"".join(pieces)
+                elif message_magic == 2:
                     encoded = kc.encode_record_batch(
                         part, compression, last_offset=last
                     )

@@ -1,0 +1,96 @@
+"""Transaction control batches (commit/abort markers, batch attribute bit
+5): consumers never see them as messages — librdkafka filters them at any
+isolation level, so the reference's counters exclude them — but their
+offsets are part of the log and the scan must advance past them.
+
+Covers all three decode paths (Python iter_batch_frames, native
+scan/decode of whole record sets) and the full wire scan."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.native import (
+    decode_record_set_native,
+    native_available,
+    scan_record_set_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native shim unavailable"
+)
+
+
+def _record_set():
+    """[2 data records][commit marker][abort marker][2 data records]."""
+    return b"".join(
+        [
+            kc.encode_record_batch(
+                [(0, 1000, b"k0", b"v0"), (1, 1001, b"k1", b"v1")]
+            ),
+            kc.encode_control_batch(2, 1002, commit=True),
+            kc.encode_control_batch(3, 1003, commit=False),
+            kc.encode_record_batch(
+                [(4, 1004, b"k4", b"v4"), (5, 1005, b"k5", None)]
+            ),
+        ]
+    )
+
+
+def test_iter_batch_frames_skips_control_records():
+    frames = list(kc.iter_batch_frames(_record_set(), verify_crc=True))
+    assert [f.num_records for f in frames] == [2, 0, 0, 2]
+    # Control frames still cover their offsets.
+    assert [f.end_offset for f in frames] == [2, 3, 4, 6]
+    recs = [
+        off for f in frames for off, _ in kc.decode_frame_records(f)
+    ]
+    assert recs == [0, 1, 4, 5]
+
+
+def test_native_scan_and_decode_skip_control_records():
+    buf = _record_set()
+    n, consumed, covered = scan_record_set_native(buf, verify_crc=True)
+    assert (n, consumed, covered) == (4, len(buf), 6)
+    soa, used, covered2 = decode_record_set_native(buf, verify_crc=True)
+    assert used == len(buf) and covered2 == 6
+    assert soa["offsets"].tolist() == [0, 1, 4, 5]
+    assert soa["value_null"].tolist() == [0, 0, 0, 1]
+
+
+def test_control_only_record_set_still_advances():
+    buf = kc.encode_control_batch(7, 1000) + kc.encode_control_batch(8, 1001)
+    n, consumed, covered = scan_record_set_native(buf)
+    assert (n, consumed, covered) == (0, len(buf), 9)
+    soa, used, covered2 = decode_record_set_native(buf)
+    assert used == len(buf) and covered2 == 9
+    assert len(soa["offsets"]) == 0
+
+
+def test_wire_scan_excludes_markers_from_metrics(tmp_path):
+    """End-to-end: a transactional topic's markers don't count as
+    messages (reference parity: librdkafka's consumer hides them,
+    src/kafka.rs:92-135 only ever sees real messages)."""
+    from tests.fake_broker import FakeBroker
+    from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+    records = {
+        0: [(i, 1000 + i, b"k%d" % i, b"v%d" % i) for i in range(6)],
+    }
+    broker = FakeBroker("txn-topic", records, control_offsets={0: {2, 5}})
+    with broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "txn-topic")
+        cfg = AnalyzerConfig(num_partitions=1, batch_size=64)
+        result = run_scan(
+            "txn-topic", src, CpuExactBackend(cfg, init_now_s=0), 64
+        )
+        src.close()
+    m = result.metrics
+    # 6 log slots, 2 are markers → 4 messages.
+    assert m.overall_count == 4
+    assert int(m.per_partition[0, 0]) == 4
