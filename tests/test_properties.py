@@ -224,3 +224,61 @@ def test_dedupe_matches_sequential_replay(updates):
     assert dict(zip(slots.tolist(), flags.tolist())) == {
         k: int(v) for k, v in replay.items()
     }
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.lists(kafka_record, min_size=0, max_size=4),
+    st.integers(0, 200),  # mutation position
+    st.integers(0, 255),  # xor mask (0 = no mutation)
+    st.integers(0, 80),   # tail truncation
+    st.booleans(),        # insert a control batch
+)
+def test_native_record_set_walk_total_and_prefix_consistent(
+    recs, mpos, mask, cut, with_control
+):
+    """The native record-set walker (kta_scan/kta_decode_record_set) is new
+    untrusted-input surface: arbitrary mutations/truncations must never
+    crash, over-read, or disagree between scan and decode — and whatever
+    prefix it accepts must match the reference Python frame iterator."""
+    from kafka_topic_analyzer_tpu.io.native import (
+        decode_record_set_native,
+        native_available,
+        scan_record_set_native,
+    )
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native shim unavailable")
+    rows = [(i, ts, k, v) for i, (ts, k, v) in enumerate(recs)]
+    buf = bytearray()
+    if rows:
+        buf += kc.encode_record_batch(rows)
+    if with_control:
+        base = len(rows)
+        buf += kc.encode_control_batch(base, 1000)
+    if mask and buf:
+        buf[mpos % len(buf)] ^= mask
+    if cut:
+        buf = buf[: max(0, len(buf) - cut)]
+    data = bytes(buf)
+
+    n, consumed, covered = scan_record_set_native(data)
+    soa, used, covered2 = decode_record_set_native(data)
+    # scan and decode must agree on the accepted prefix...
+    assert 0 <= consumed <= len(data)
+    if used:  # decode returning used=0 means "malformed inside prefix"
+        assert (used, covered2) == (consumed, covered)
+        assert len(soa["offsets"]) == n
+        # ...and the accepted-and-decoded prefix must decode identically
+        # via the Python reference path (same record count and offsets).
+        # Only under `used`: the header-only scan can accept a prefix
+        # whose record BODIES are mutated — the record-level decoders
+        # (native and Python alike) are the ones that reject those.
+        py_offsets = [
+            off
+            for f in kc.iter_batch_frames(data[:consumed])
+            for off, _ in kc.decode_frame_records(f)
+        ]
+        assert soa["offsets"].tolist() == py_offsets
