@@ -5,10 +5,14 @@ benchmarked separately — the native shim's generator runs at memory
 bandwidth), then stream them through the jitted TPU backend with donated
 state, and report end-to-end messages/second over the timed window.
 
-Prints ONE JSON line:
-  {"metric": "msgs_per_sec", "value": N, "unit": "msgs/s", "vs_baseline": R}
+Output contract: the LAST JSON line on stdout is the result —
+  {"metric": "msgs_per_sec", "value": N, "unit": "msgs/s", "vs_baseline": R, ...}
 vs_baseline is the ratio to the reference's only published number,
-590,221 msgs/s (BASELINE.md, demo_output.png).
+590,221 msgs/s (BASELINE.md, demo_output.png).  A non-degraded run prints
+an earlier salvage-checkpoint line (same headline fields, no breakdown)
+that the supervisor reuses if the optional breakdown section wedges the
+accelerator tunnel; consumers must take the last line (tools/bench_all.py
+does).
 """
 
 from __future__ import annotations
@@ -57,17 +61,74 @@ def supervised_main() -> int:
     # watchdog, and back-to-back client inits have been observed to hang
     # the tunnel (see BENCH_NOTES.md round 2).
     env.setdefault("KTA_ACCEL_OK", "1")
-    for attempt, extra in ((1, {}), (2, {"KTA_JAX_PLATFORMS": "cpu",
-                                         "KTA_DEGRADED": "1"})):
+
+    # Cheap liveness probe before committing to the accelerator attempt:
+    # when the tunnel relay process is dead (observed 2026-07-29 after a
+    # SIGKILLed hung client), EVERY client init blocks forever in a
+    # connect-retry loop — skip straight to the CPU attempt instead of
+    # burning the whole deadline discovering that.
+    attempts = [(1, {}), (2, {"KTA_JAX_PLATFORMS": "cpu",
+                              "KTA_DEGRADED": "1"})]
+    try:
+        probe_s = float(os.environ.get("KTA_PROBE_TIMEOUT") or 150)
+    except ValueError:
+        probe_s = 150.0  # malformed override: keep the default
+    if (
+        probe_s > 0
+        and not os.environ.get("KTA_JAX_PLATFORMS")
+        # An orchestrator that already probed (tools/bench_all.py) passes
+        # its verdict via KTA_ACCEL_OK; re-probing per child would stack
+        # client inits against the relay — the documented wedge mechanism.
+        and not os.environ.get("KTA_ACCEL_OK")
+    ):
+        # The one shared liveness verdict (real device op + non-cpu
+        # platform) — see jax_support.probe_accelerator_alive.
+        from kafka_topic_analyzer_tpu.jax_support import probe_accelerator_alive
+
+        if not probe_accelerator_alive(probe_s):
+            print(
+                f"bench: accelerator init probe failed within {probe_s:.0f}s "
+                "(tunnel relay down?) — skipping to host CPU, degraded",
+                file=sys.stderr, flush=True,
+            )
+            attempts = attempts[1:]
+    def salvage(stdout: "str | None") -> bool:
+        """A killed accelerator child may have printed its headline JSON
+        line before hanging in the optional breakdown section — losing a
+        successful chip measurement to a CPU rerun would be strictly worse
+        than reporting it.  Re-print the last JSON line, flagged."""
+        for line in reversed((stdout or "").strip().splitlines()):
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                doc["breakdown_truncated"] = True
+                print(json.dumps(doc), flush=True)
+                return True
+        return False
+
+    for attempt, extra in attempts:
         env.update(extra)
         try:
+            # Child stdout is captured (and forwarded) so a kill mid-run
+            # can salvage an already-printed result line; stderr is NOT
+            # captured, so progress/diagnostics stream through live.
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
                 env=env, timeout=deadline if attempt == 1 else None,
+                stdout=None if attempt == 2 else subprocess.PIPE, text=True,
             )
             rc = proc.returncode
-        except subprocess.TimeoutExpired:
+            stdout = proc.stdout
+        except subprocess.TimeoutExpired as te:
             rc = None
+            stdout = te.stdout
+            if isinstance(stdout, bytes):
+                stdout = stdout.decode(errors="replace")
+        if rc is None:
+            if salvage(stdout):
+                return 0
             print(
                 f"bench: accelerator attempt exceeded {deadline:.0f}s "
                 "(tunnel hang) — rerunning on host CPU, degraded",
@@ -77,10 +138,15 @@ def supervised_main() -> int:
             # Normal exit (success or a deterministic failure like a
             # usage error): report it faithfully — degrading would just
             # rerun the same failure and misattribute it to the chip.
+            if stdout:
+                sys.stdout.write(stdout)
+                sys.stdout.flush()
             return rc
         if attempt == 2:
             return 1  # fallback child killed by a signal: genuine failure
         if rc is not None:
+            if salvage(stdout):
+                return 0
             print(
                 f"bench: accelerator attempt died on signal {-rc} — "
                 "rerunning on host CPU, degraded",
@@ -95,7 +161,10 @@ def main() -> int:
                     help="BASELINE.json workload preset (overrides "
                          "--partitions/--features)")
     ap.add_argument("--partitions", type=int, default=16)
-    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="records per device step (default: 2^20; 2^16 on "
+                         "the axon tunnel platform, where a 2^20 warmup has "
+                         "been observed to wedge the relay — BENCH_NOTES.md)")
     ap.add_argument("--batches", type=int, default=8,
                     help="distinct pre-materialized batches")
     ap.add_argument("--steps", type=int, default=64,
@@ -133,6 +202,18 @@ def main() -> int:
     )
 
     import jax
+
+    platform = jax.devices()[0].platform
+    # A fast-FAILING accelerator plugin leaves jax on host CPU without
+    # tripping the watchdog (e.g. under an orchestrator's KTA_ACCEL_OK=1
+    # verdict that predates the failure): flag it rather than report an
+    # unflagged CPU number.  An explicit KTA_JAX_PLATFORMS=cpu is a
+    # deliberate choice, not degradation.
+    if platform == "cpu" and not os.environ.get("KTA_JAX_PLATFORMS"):
+        degraded = True
+
+    if args.batch_size is None:
+        args.batch_size = 1 << 16 if platform == "axon" else 1 << 20
 
     from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
     from kafka_topic_analyzer_tpu.config import AnalyzerConfig
@@ -204,9 +285,44 @@ def main() -> int:
         "value": round(msgs_per_sec, 1),
         "unit": "msgs/s",
         "vs_baseline": round(msgs_per_sec / BASELINE_MSGS_PER_SEC, 2),
+        "batch_size": args.batch_size,
+        "platform": platform,
     }
     if degraded:
         result["degraded_cpu_fallback"] = True
+
+    # Measured breakdown (VERDICT r1 items 1/5): where does the streamed
+    # number bind?  (a) host->device bandwidth — on this rig an SSH-tunneled
+    # relay, on a production host PCIe; (b) the device-resident step rate —
+    # what the same chip sustains once transfer is off the critical path.
+    # Accelerator runs only: on the degraded CPU fallback there is no
+    # device for these numbers to describe.  The headline line has already
+    # been printed above, so even if a breakdown op wedges the tunnel and
+    # this child is killed, the supervisor salvages the measurement.
+    if not degraded:
+        # Salvage checkpoint: the supervisor reuses this line if a
+        # breakdown op hangs and the child must be killed.
+        print(json.dumps(result), flush=True)
+        try:
+            from kafka_topic_analyzer_tpu.packing import pack_batch
+            from kafka_topic_analyzer_tpu.tools.hwmeasure import (
+                headline_transfer_gbps,
+                timed_step_loop,
+            )
+
+            result["transfer_gbps"] = headline_transfer_gbps()
+            dev_bufs = [
+                jax.device_put(pack_batch(b, config))
+                for b in host_batches[: min(2, len(host_batches))]
+            ]
+            jax.block_until_ready(dev_bufs)
+            resident = timed_step_loop(
+                config, dev_bufs, steps=min(32, args.steps),
+                device_resident=True,
+            )
+            result["device_resident_msgs_per_sec"] = resident["msgs_per_sec"]
+        except Exception as e:  # breakdown is informative, never fatal
+            result["breakdown_error"] = repr(e)
 
     if args.accuracy and (config.enable_hll or config.enable_quantiles):
         # Sketch error vs the CPU-exact oracle — fed EXACTLY the sequence the

@@ -35,6 +35,29 @@ def force_platform(platforms: str) -> None:
         pass
 
 
+def probe_accelerator_alive(timeout_s: float) -> bool:
+    """One shared verdict on "is there a live accelerator?": run a real
+    device op (not just client init — a half-up tunnel can pass init and
+    block on the first op) in a killable subprocess and require a
+    non-cpu platform.  "ok cpu" means the accelerator plugin failed FAST
+    and jax fell back to host CPU: that is not a healthy accelerator, and
+    treating it as one would let callers report unflagged host-CPU numbers
+    as chip measurements."""
+    import subprocess
+    import sys
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.numpy.arange(4).sum().block_until_ready(); "
+             "print('ok', jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s, check=False,
+        )
+        return "ok" in probe.stdout and "ok cpu" not in probe.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def ensure_responsive_accelerator(timeout_s: float = 240.0) -> bool:
     """Probe the default accelerator in a killable subprocess; on timeout or
     failure, force the host CPU platform so the caller cannot hang on a
@@ -45,7 +68,6 @@ def ensure_responsive_accelerator(timeout_s: float = 240.0) -> bool:
     (cli.py::_make_cli_backend); KTA_ACCEL_OK=1 short-circuits so
     orchestrators (tools/bench_all.py) probe once for many children.
     """
-    import subprocess
     import sys
 
     if os.environ.get("KTA_JAX_PLATFORMS") or os.environ.get("KTA_ACCEL_OK"):
@@ -54,17 +76,8 @@ def ensure_responsive_accelerator(timeout_s: float = 240.0) -> bool:
         timeout_s = float(os.environ.get("KTA_ACCEL_TIMEOUT") or timeout_s)
     except ValueError:
         pass  # malformed override: keep the default, like the other knobs
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.numpy.arange(4).sum().block_until_ready(); "
-             "print('ok')"],
-            capture_output=True, text=True, timeout=timeout_s, check=False,
-        )
-        if "ok" in probe.stdout:
-            return True
-    except subprocess.TimeoutExpired:
-        pass
+    if probe_accelerator_alive(timeout_s):
+        return True
     print(
         "WARNING: accelerator unresponsive — forcing the cpu platform; "
         "results will NOT reflect TPU performance",

@@ -19,7 +19,10 @@ import sys
 
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="records per device step; default lets bench.py "
+                         "pick per platform (2^20, or the proven-good 2^16 "
+                         "on the axon tunnel)")
     ap.add_argument("--batches", type=int, default=8)
     ap.add_argument("--steps", type=int, default=32)
     ap.add_argument("--out", default="-")
@@ -44,11 +47,12 @@ def main(argv: "list[str] | None" = None) -> int:
         cmd = [
             sys.executable, os.path.join(repo, "bench.py"),
             "--config", str(cfg),
-            "--batch-size", str(args.batch_size),
             "--batches", str(args.batches),
             "--steps", str(args.steps),
             "--accuracy",  # the BASELINE metric includes sketch error
         ]
+        if args.batch_size:
+            cmd += ["--batch-size", str(args.batch_size)]
         print(f"bench_all: running config {cfg}...", file=sys.stderr)
         proc = subprocess.run(cmd, capture_output=True, text=True, env=child_env)
         if proc.returncode != 0:

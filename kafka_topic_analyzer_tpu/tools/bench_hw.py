@@ -1,0 +1,274 @@
+"""One-process real-TPU measurement campaign.
+
+The axon tunnel to the chip degrades with repeated client inits (see
+BENCH_NOTES.md round 2), so every hardware number we need is measured by
+THIS single process, stage by stage, each stage printing a flushed line
+before moving on — a hang names the last stage that made it out, and the
+JSON at the end carries whatever completed.
+
+Stages:
+  init            jax.devices() + platform
+  transfer        host->device bandwidth, single stream (1/8/32 MiB)
+  transfer-conc   4 concurrent 8 MiB puts (does the tunnel scale with
+                  parallel streams?)
+  pack            native host pack throughput (no device)
+  stream          full-feature analyzer step, host batches crossing the
+                  wire each step — bench.py's protocol at --batch-pow
+  resident        same step with the packed buffers pre-staged on device:
+                  the device-compute rate a PCIe host would see
+  counters        resident, counters-only config (the reference's exact
+                  workload, src/metric.rs:12-26)
+  pallas          resident, counters-only via the Pallas MXU kernel
+                  (ops/pallas_counters.py) — the promote-or-demote number
+
+  big             LAST (hang risk): the stream protocol again at
+                  --big-pow (default 2^20 — the batch size whose warmup
+                  wedged the tunnel on 2026-07-29; everything above has
+                  already been captured if this one dies)
+
+Usage: python -m kafka_topic_analyzer_tpu.tools.bench_hw
+         [--batch-pow 16] [--steps 64] [--stop-after STAGE] [--json PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+RESULTS: dict = {}
+
+
+def _stage(name):
+    print(f"bench_hw: [{name}] start", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+
+    def done(extra: str = ""):
+        dt = time.perf_counter() - t0
+        print(
+            f"bench_hw: [{name}] ok in {dt:.2f}s {extra}",
+            file=sys.stderr, flush=True,
+        )
+        return dt
+
+    return done
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-pow", type=int, default=16,
+                    help="log2 batch size (16 -> 65536: the shape already "
+                         "in the compile cache from probe runs)")
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--partitions", type=int, default=16)
+    ap.add_argument("--stop-after", default=None,
+                    choices=["init", "transfer", "transfer-conc", "pack",
+                             "stream", "resident", "counters", "pallas"])
+    ap.add_argument("--big-pow", type=int, default=20,
+                    help="log2 batch size for the final 'big' stage; "
+                         "0 disables it")
+    ap.add_argument("--big-steps", type=int, default=8)
+    ap.add_argument("--json", default=None, help="also write results here")
+    args = ap.parse_args()
+    B = 1 << args.batch_pow
+    S = args.steps
+
+    def flush_json() -> None:
+        # Incremental: a later-stage hang must not lose earlier numbers.
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(RESULTS, f, indent=1)
+
+    def emit() -> int:
+        print(json.dumps(RESULTS), flush=True)
+        flush_json()
+        return 0
+
+    def stop(stage: str) -> bool:
+        return args.stop_after == stage
+
+    # -- init ---------------------------------------------------------------
+    done = _stage("init")
+    # Through jax_support: honors KTA_JAX_PLATFORMS and drops the axon
+    # tunnel's backend factory when excluded — a plain `import jax` +
+    # `jax.devices()` initializes every discovered plugin, and a wedged
+    # tunnel blocks that init even under JAX_PLATFORMS=cpu.
+    from kafka_topic_analyzer_tpu.jax_support import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    RESULTS["device"] = str(dev)
+    RESULTS["platform"] = dev.platform
+    done(str(dev))
+    if stop("init"):
+        return emit()
+
+    # -- transfer bandwidth -------------------------------------------------
+    done = _stage("transfer")
+    from kafka_topic_analyzer_tpu.tools.hwmeasure import (
+        measure_transfer_gbps,
+        timed_step_loop,
+    )
+
+    from kafka_topic_analyzer_tpu.tools.hwmeasure import HEADLINE_TRANSFER_MIB
+
+    bws = measure_transfer_gbps(dev, mib_sizes=(1, HEADLINE_TRANSFER_MIB, 32))
+    # Same key, same policy as bench.py's JSON line (hwmeasure): the
+    # headline-size single put; the per-size detail keeps its own key.
+    RESULTS["transfer_gbps"] = bws[HEADLINE_TRANSFER_MIB]
+    RESULTS["transfer_gbps_by_mib"] = bws
+    flush_json()
+    done(" ".join(f"{m}MiB={v:.3f}GB/s" for m, v in bws.items()))
+    if stop("transfer"):
+        return emit()
+
+    done = _stage("transfer-conc")
+    hosts = [np.full((8 << 20,), i, np.uint8) for i in range(4)]
+    t0 = time.perf_counter()
+    ds = [jax.device_put(h, dev) for h in hosts]
+    jax.block_until_ready(ds)
+    dt = time.perf_counter() - t0
+    RESULTS["transfer_gbps_concurrent"] = round(4 * 8 / 1024 / dt, 4)
+    flush_json()
+    done(f"4x8MiB={RESULTS['transfer_gbps_concurrent']:.3f}GB/s")
+    del ds
+    if stop("transfer-conc"):
+        return emit()
+
+    # -- shared workload ----------------------------------------------------
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.packing import pack_batch
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSpec
+
+    full_cfg = AnalyzerConfig(
+        num_partitions=args.partitions, batch_size=B,
+        count_alive_keys=True, alive_bitmap_bits=26,
+        enable_hll=True, enable_quantiles=True,
+    )
+    cnt_cfg = AnalyzerConfig(num_partitions=args.partitions, batch_size=B)
+    pal_cfg = AnalyzerConfig(
+        num_partitions=args.partitions, batch_size=B, use_pallas_counters=True
+    )
+    spec = SyntheticSpec(
+        num_partitions=args.partitions,
+        messages_per_partition=(4 * B) // args.partitions,
+        keys_per_partition=200_000,
+        key_null_permille=50,
+        tombstone_permille=100,
+        seed=0xBEEF,
+    )
+
+    done = _stage("pack")
+    try:
+        from kafka_topic_analyzer_tpu.io.native import NativeSyntheticSource
+
+        src = NativeSyntheticSource(spec)
+    except Exception:
+        from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource
+
+        src = SyntheticSource(spec)
+    batches = [b.pad_to(B) for b in src.batches(B)]
+    t0 = time.perf_counter()
+    bufs = {}
+    for name, cfg in (("full", full_cfg), ("cnt", cnt_cfg)):
+        bufs[name] = [pack_batch(b, cfg) for b in batches]
+    pack_dt = time.perf_counter() - t0
+    n_packed = 2 * len(batches) * B
+    RESULTS["host_pack_msgs_per_sec"] = round(n_packed / pack_dt, 1)
+    RESULTS["packed_bytes_per_record"] = round(
+        bufs["full"][0].nbytes / B, 1
+    )
+    flush_json()
+    done(f"{n_packed / pack_dt / 1e6:.1f}M rec/s, "
+         f"{RESULTS['packed_bytes_per_record']}B/rec full")
+    if stop("pack"):
+        return emit()
+
+    def timed_loop(name, cfg, device_bufs, host_bufs=None, steps=S):
+        """One timed_step_loop (tools/hwmeasure.py) recorded under `name`;
+        either streams host buffers (device_put per step) or cycles
+        pre-staged device buffers (resident)."""
+        done = _stage(name)
+        resident = device_bufs is not None
+        r = timed_step_loop(
+            cfg,
+            device_bufs if resident else host_bufs,
+            steps=steps,
+            device_resident=resident,
+            dev=dev,
+        )
+        RESULTS[name + "_msgs_per_sec"] = r["msgs_per_sec"]
+        RESULTS[name + "_compile_s"] = r["compile_s"]
+        flush_json()
+        done(f"{r['msgs_per_sec'] / 1e6:.2f}M msgs/s "
+             f"(compile+first {r['compile_s']:.1f}s)")
+
+    # -- stream: host batches cross the tunnel every step --------------------
+    timed_loop("stream", full_cfg, None, host_bufs=bufs["full"])
+    if stop("stream"):
+        return emit()
+
+    # -- resident: buffers pre-staged on device ------------------------------
+    done = _stage("stage-bufs")
+    dev_full = [jax.device_put(b, dev) for b in bufs["full"]]
+    jax.block_until_ready(dev_full)
+    done(f"{len(dev_full)} bufs")
+    timed_loop("resident", full_cfg, dev_full)
+    del dev_full
+    if stop("resident"):
+        return emit()
+
+    done = _stage("stage-cnt-bufs")
+    dev_cnt = [jax.device_put(b, dev) for b in bufs["cnt"]]
+    jax.block_until_ready(dev_cnt)
+    done()
+    timed_loop("counters", cnt_cfg, dev_cnt)
+    if stop("counters"):
+        return emit()
+
+    timed_loop("pallas", pal_cfg, dev_cnt)
+
+    if RESULTS.get("pallas_msgs_per_sec") and RESULTS.get("counters_msgs_per_sec"):
+        RESULTS["pallas_vs_scatter"] = round(
+            RESULTS["pallas_msgs_per_sec"] / RESULTS["counters_msgs_per_sec"], 3
+        )
+        flush_json()
+    del dev_cnt
+    if stop("pallas") or not args.big_pow:
+        return emit()
+
+    # -- big: the wedge-prone shape, LAST -------------------------------------
+    BIG = 1 << args.big_pow
+    big_cfg = AnalyzerConfig(
+        num_partitions=args.partitions, batch_size=BIG,
+        count_alive_keys=True, alive_bitmap_bits=26,
+        enable_hll=True, enable_quantiles=True,
+    )
+    done = _stage("big-pack")
+    big_spec = SyntheticSpec(
+        num_partitions=args.partitions,
+        messages_per_partition=(2 * BIG) // args.partitions,
+        keys_per_partition=200_000,
+        key_null_permille=50,
+        tombstone_permille=100,
+        seed=0xBEEF,
+    )
+    try:
+        bsrc = NativeSyntheticSource(big_spec)
+    except Exception:
+        from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource
+
+        bsrc = SyntheticSource(big_spec)
+    big_bufs = [
+        pack_batch(b.pad_to(BIG), big_cfg) for b in bsrc.batches(BIG)
+    ]
+    done(f"{len(big_bufs)} bufs of {big_bufs[0].nbytes >> 20}MiB")
+    timed_loop("big", big_cfg, None, host_bufs=big_bufs,
+               steps=args.big_steps)
+    return emit()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

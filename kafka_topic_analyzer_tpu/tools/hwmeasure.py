@@ -1,0 +1,86 @@
+"""Shared hardware measurement protocol.
+
+One implementation of the two measurements both bench.py (the driver's
+headline JSON line) and tools/bench_hw.py (the staged campaign) report, so
+the protocols cannot drift: host->device transfer bandwidth, and the
+analyzer-step rate with donated state (streamed or device-resident).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+
+def measure_transfer_gbps(dev=None, mib_sizes: Sequence[int] = (8,)) -> dict:
+    """Time one synchronous device_put per size; returns {MiB: GB/s}."""
+    import jax
+    import numpy as np
+
+    out = {}
+    for mib in mib_sizes:
+        host = np.ones((mib << 20,), np.uint8)
+        t0 = time.perf_counter()
+        d = jax.device_put(host, dev)
+        d.block_until_ready()
+        out[mib] = round(mib / 1024 / (time.perf_counter() - t0), 4)
+        del d
+    return out
+
+
+#: The size every reporter uses for its comparable `transfer_gbps` figure.
+HEADLINE_TRANSFER_MIB = 8
+
+
+def headline_transfer_gbps(dev=None) -> float:
+    """The single-put bandwidth figure reported as `transfer_gbps` by both
+    bench.py and tools/bench_hw.py — one policy, one key, comparable
+    across reports."""
+    return measure_transfer_gbps(dev, (HEADLINE_TRANSFER_MIB,))[
+        HEADLINE_TRANSFER_MIB
+    ]
+
+
+def timed_step_loop(
+    config,
+    feed,
+    *,
+    steps: int,
+    device_resident: bool,
+    dev=None,
+    state=None,
+) -> dict:
+    """Warmup-compile the packed analyzer step, then time `steps` steps
+    with donated state, cycling `feed` (packed uint8 buffers — device
+    arrays when ``device_resident`` else host arrays put each step).
+
+    Returns {"msgs_per_sec", "compile_s", "state"} — rate uses
+    config.batch_size records per step.
+    """
+    import jax
+
+    from kafka_topic_analyzer_tpu.backends.tpu import make_packed_step
+    from kafka_topic_analyzer_tpu.models.state import AnalyzerState
+
+    if state is None:
+        state = AnalyzerState.init(config)
+    step = jax.jit(make_packed_step(config), donate_argnums=(0,))
+
+    def put(buf):
+        return buf if device_resident else jax.device_put(buf, dev)
+
+    t0 = time.perf_counter()
+    state = step(state, put(feed[0]))
+    jax.block_until_ready(state)
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state = step(state, put(feed[i % len(feed)]))
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return {
+        "msgs_per_sec": round(steps * config.batch_size / dt, 1),
+        "compile_s": round(compile_s, 2),
+        "state": state,
+    }
