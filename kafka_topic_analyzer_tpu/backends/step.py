@@ -30,7 +30,19 @@ def analyzer_step(
     arrays: Dict[str, "jnp.ndarray"],
     config: AnalyzerConfig,
     space_index=0,
+    space_axis: "str | None" = None,
 ) -> AnalyzerState:
+    """Fold one batch (or, under a space-sharded mesh, one contiguous CHUNK
+    of a data row's batch) into the analyzer state.
+
+    ``space_axis`` names the mesh axis the record stream is chunked over
+    (parallel/sharded.py).  When given, bitmap updates are redistributed
+    on-device: every space shard all_gathers the (slot, aliveness) pair
+    chunks over ICI and applies them in source-chunk order, which preserves
+    exact last-writer-wins semantics even when one key's updates straddle
+    chunk boundaries (host dedupe is per chunk, so cross-chunk duplicates
+    are resolved here by application order).  All other reductions stay
+    chunk-local; the space axis is reduced once at finalize."""
     valid = arrays["valid"]
     key_null = arrays["key_null"]
     value_null = arrays["value_null"]
@@ -84,15 +96,37 @@ def analyzer_step(
 
     alive_state = state.alive
     if alive_state is not None:
-        words = bitmap_apply_pairs(
-            alive_state.words,
-            arrays["alive_slot"],
-            arrays["alive_flag"],
-            arrays["n_pairs"],
-            bits=config.alive_bitmap_bits,
-            space_index=space_index,
-            space_shards=config.space_shards,
-        )
+        if space_axis is not None and config.space_shards > 1:
+            from kafka_topic_analyzer_tpu.jax_support import lax
+
+            # Route over ICI: gather every space shard's pair chunk, then
+            # apply them in source order (chunk s holds records
+            # [s*C, (s+1)*C) of the data row's batch, and all_gather
+            # stacks by axis index, so gathered order == record order).
+            slots = lax.all_gather(arrays["alive_slot"], space_axis)
+            flags = lax.all_gather(arrays["alive_flag"], space_axis)
+            counts = lax.all_gather(arrays["n_pairs"], space_axis)
+            words = alive_state.words
+            for s in range(config.space_shards):
+                words = bitmap_apply_pairs(
+                    words,
+                    slots[s],
+                    flags[s],
+                    counts[s],
+                    bits=config.alive_bitmap_bits,
+                    space_index=space_index,
+                    space_shards=config.space_shards,
+                )
+        else:
+            words = bitmap_apply_pairs(
+                alive_state.words,
+                arrays["alive_slot"],
+                arrays["alive_flag"],
+                arrays["n_pairs"],
+                bits=config.alive_bitmap_bits,
+                space_index=space_index,
+                space_shards=config.space_shards,
+            )
         alive_state = AliveBitmapState(words=words)
 
     hll_state = state.hll

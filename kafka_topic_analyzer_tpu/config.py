@@ -74,8 +74,14 @@ class AnalyzerConfig:
     #: sort kernel is exercised by its own unit tests).
     # --- parallelism --------------------------------------------------------
     #: Device mesh shape (data, space).  'data' shards record batches by
-    #: partition; 'space' shards the alive-bitmap slot space.  (1, 1) runs
-    #: single-device.  See kafka_topic_analyzer_tpu/parallel/.
+    #: partition; 'space' shards BOTH the alive-bitmap slot space and the
+    #: record stream: each data row's batch is split into space_shards
+    #: contiguous chunks (one per space shard, batch_size/space_shards
+    #: records each), so host→device bytes and per-device reduction work
+    #: scale down with the space axis; bitmap updates are redistributed
+    #: on-device over ICI (all_gather + in-source-order application —
+    #: backends/step.py).  (1, 1) runs single-device.
+    #: See kafka_topic_analyzer_tpu/parallel/.
     mesh_shape: Tuple[int, int] = (1, 1)
 
     def __post_init__(self) -> None:
@@ -116,3 +122,9 @@ class AnalyzerConfig:
     @property
     def space_shards(self) -> int:
         return self.mesh_shape[1]
+
+    @property
+    def chunk_size(self) -> int:
+        """Records per (data, space) device per step: each data row's batch
+        is split contiguously across the space axis."""
+        return self.batch_size // self.space_shards

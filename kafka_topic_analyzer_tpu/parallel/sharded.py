@@ -1,20 +1,35 @@
 """Sharded TPU backend: shard_map update steps + collective finalize.
 
-Per-step work is collective-free (each (data, space) device folds its own
-slice); all cross-device communication happens once, in the finalize step:
+The record stream is sharded over BOTH mesh axes: partitions are assigned
+to data rows (parallel/mesh.py::assign_partitions), and each data row's
+batch is split into `space_shards` contiguous chunks — one per space
+shard, `batch_size / space_shards` records each.  Host→device transfer
+and per-device reduction work therefore scale down with the full device
+count, not just the data axis (with the batch replicated over 'space',
+the old layout, every added space shard re-transferred and re-reduced
+the whole batch).
 
-- counters / byte sums / counts : ``psum``   over 'data'
-- timestamp & size extremes     : ``pmin`` / ``pmax`` over 'data'
-- HLL registers                 : ``pmax``  over 'data'
-- DDSketch bucket counts        : ``psum``  over 'data'
+Per-step communication is a single small ICI collective: the alive
+bitmap's host-deduped (slot, aliveness) pairs are all_gathered over
+'space' and applied in source-chunk order, because a slot's updates may
+straddle chunk boundaries and last-writer-wins is order-sensitive
+(backends/step.py).  Everything else is chunk-local per step; the
+remaining axes reduce once, in finalize:
+
+- counters / byte sums / counts : ``psum``   over ('data', 'space')
+- timestamp & size extremes     : ``pmin`` / ``pmax`` over ('data', 'space')
+- HLL registers                 : ``pmax``  over ('data', 'space')
+- DDSketch bucket counts        : ``psum``  over ('data', 'space')
 - alive bitmap                  : ``all_gather`` over 'data' + OR-reduce
                                   (bit-OR has no wired-in collective; the
                                   gather is one-shot), popcount, then
                                   ``psum`` over 'space'
 
-State layout: every `AnalyzerState` leaf gains a leading 'data' axis of size
-D; the bitmap's word axis is additionally sharded over 'space'.  The update
-step is jitted with the state donated, exactly like the single-device path.
+State layout: metrics / HLL / DDSketch leaves gain a leading device axis
+of size D·S sharded over ('data', 'space') jointly; the bitmap keeps a
+leading 'data' axis with its word axis sharded over 'space' (slot-range
+ownership).  The update step is jitted with the state donated, exactly
+like the single-device path.
 """
 
 from __future__ import annotations
@@ -42,16 +57,22 @@ from kafka_topic_analyzer_tpu.results import TopicMetrics
 from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
 
 
+#: Leading device axis of the record-parallel state leaves: sharded over
+#: data AND space jointly (D·S rows), since each (data, space) device folds
+#: its own record chunk.
+_DEV = (DATA_AXIS, SPACE_AXIS)
+
+
 def _state_specs(config: AnalyzerConfig) -> AnalyzerState:
     """PartitionSpec pytree matching the stacked AnalyzerState."""
     metrics = MessageMetricsState(
-        per_partition=P(DATA_AXIS),
-        earliest_s=P(DATA_AXIS),
-        latest_s=P(DATA_AXIS),
-        smallest=P(DATA_AXIS),
-        largest=P(DATA_AXIS),
-        overall_size=P(DATA_AXIS),
-        overall_count=P(DATA_AXIS),
+        per_partition=P(_DEV),
+        earliest_s=P(_DEV),
+        latest_s=P(_DEV),
+        smallest=P(_DEV),
+        largest=P(_DEV),
+        overall_size=P(_DEV),
+        overall_count=P(_DEV),
     )
     alive = (
         AliveBitmapState(words=P(DATA_AXIS, SPACE_AXIS))
@@ -61,8 +82,8 @@ def _state_specs(config: AnalyzerConfig) -> AnalyzerState:
     from kafka_topic_analyzer_tpu.models.compaction import HLLState
     from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState
 
-    hll = HLLState(regs=P(DATA_AXIS)) if config.enable_hll else None
-    quantiles = DDSketchState(counts=P(DATA_AXIS)) if config.enable_quantiles else None
+    hll = HLLState(regs=P(_DEV)) if config.enable_hll else None
+    quantiles = DDSketchState(counts=P(_DEV)) if config.enable_quantiles else None
     return AnalyzerState(metrics=metrics, alive=alive, hll=hll, quantiles=quantiles)
 
 
@@ -78,19 +99,20 @@ def _global_put(x: np.ndarray, mesh, spec) -> jax.Array:
 
 
 def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
-    """Host-built stacked state (leading 'data' axis), placed with shardings."""
+    """Host-built stacked state (leading device axis), placed with shardings."""
     d = config.data_shards
+    dev = d * config.space_shards  # record-parallel leaves: one row per device
     p = config.num_partitions
     i64max = np.iinfo(np.int64).max
     i64min = np.iinfo(np.int64).min
     metrics = MessageMetricsState(
-        per_partition=np.zeros((d, p, 7), np.int64),
-        earliest_s=np.full((d, p), i64max, np.int64),
-        latest_s=np.full((d, p), i64min, np.int64),
-        smallest=np.full((d, p), i64max, np.int64),
-        largest=np.zeros((d, p), np.int64),
-        overall_size=np.zeros((d,), np.int64),
-        overall_count=np.zeros((d,), np.int64),
+        per_partition=np.zeros((dev, p, 7), np.int64),
+        earliest_s=np.full((dev, p), i64max, np.int64),
+        latest_s=np.full((dev, p), i64min, np.int64),
+        smallest=np.full((dev, p), i64max, np.int64),
+        largest=np.zeros((dev, p), np.int64),
+        overall_size=np.zeros((dev,), np.int64),
+        overall_count=np.zeros((dev,), np.int64),
     )
     alive = None
     if config.count_alive_keys:
@@ -103,7 +125,7 @@ def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
         from kafka_topic_analyzer_tpu.models.compaction import HLLState
 
         rows = config.num_partitions if config.distinct_keys_per_partition else 1
-        hll = HLLState(regs=np.zeros((d, rows, config.hll_m), np.int32))
+        hll = HLLState(regs=np.zeros((dev, rows, config.hll_m), np.int32))
     quantiles = None
     if config.enable_quantiles:
         from kafka_topic_analyzer_tpu.models.quantiles import DDSketchState
@@ -112,7 +134,7 @@ def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
         rows = config.num_partitions if config.quantiles_per_partition else 1
         quantiles = DDSketchState(
             counts=np.zeros(
-                (d, rows, ddsketch_num_buckets(config.quantile_buckets)), np.int64
+                (dev, rows, ddsketch_num_buckets(config.quantile_buckets)), np.int64
             )
         )
     state = AnalyzerState(metrics=metrics, alive=alive, hll=hll, quantiles=quantiles)
@@ -146,7 +168,26 @@ class ShardedTpuBackend(MetricBackend):
             raise ValueError("mesh shape does not match config.mesh_shape")
         self.state = _stacked_init(config, self.mesh)
         self._specs = _state_specs(config)
-        self._buf_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        # Packed buffers: one CHUNK (batch_size / space_shards records) per
+        # (data, space) device — shape (D, S, chunk_nbytes).
+        self._buf_sharding = NamedSharding(self.mesh, P(DATA_AXIS, SPACE_AXIS))
+        self._row_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        import dataclasses as _dc
+
+        if config.batch_size % config.space_shards:
+            raise ValueError(
+                "batch_size must divide evenly into space_shards chunks"
+            )
+        if config.use_pallas_counters and config.chunk_size % 1024:
+            raise ValueError(
+                "use_pallas_counters requires a per-space-shard chunk "
+                "(batch_size / space_shards) that is a multiple of 1024"
+            )
+        self._chunk_config = (
+            _dc.replace(config, batch_size=config.chunk_size)
+            if config.space_shards > 1
+            else config
+        )
         self.use_native = use_native
         # Multi-controller support: the data rows THIS process feeds, and
         # whether device transfers must go through the process-local API.
@@ -164,13 +205,19 @@ class ShardedTpuBackend(MetricBackend):
         #: crashing at the first snapshot interval.
         self.snapshot_capable = not self._multiprocess or self._rows_contiguous
 
-        config_ = config
+        chunk_config = self._chunk_config
 
         def _step_body(state, bufs):
             local = jax.tree.map(lambda x: x[0], state)
-            arrays = unpack_device(bufs[0], config_)
+            arrays = unpack_device(bufs[0, 0], chunk_config)
             space_idx = lax.axis_index(SPACE_AXIS)
-            new = analyzer_step(local, arrays, config_, space_index=space_idx)
+            new = analyzer_step(
+                local,
+                arrays,
+                chunk_config,
+                space_index=space_idx,
+                space_axis=SPACE_AXIS,
+            )
             return jax.tree.map(lambda x: x[None], new)
 
         # The Pallas counter kernel declares its varying axes (vma) so the
@@ -185,7 +232,7 @@ class ShardedTpuBackend(MetricBackend):
         step = jax.shard_map(
             _step_body,
             mesh=self.mesh,
-            in_specs=(self._specs, P(DATA_AXIS)),
+            in_specs=(self._specs, P(DATA_AXIS, SPACE_AXIS)),
             out_specs=self._specs,
             check_vma=not relax_vma,
         )
@@ -201,14 +248,17 @@ class ShardedTpuBackend(MetricBackend):
         def merge_body(state):
             local = jax.tree.map(lambda x: x[0], state)
             m = local.metrics
+            # Record-parallel leaves fold per (data, space) device, so their
+            # reductions span both mesh axes.
+            dev_axes = (DATA_AXIS, SPACE_AXIS)
             merged = MessageMetricsState(
-                per_partition=lax.psum(m.per_partition, DATA_AXIS),
-                earliest_s=lax.pmin(m.earliest_s, DATA_AXIS),
-                latest_s=lax.pmax(m.latest_s, DATA_AXIS),
-                smallest=lax.pmin(m.smallest, DATA_AXIS),
-                largest=lax.pmax(m.largest, DATA_AXIS),
-                overall_size=lax.psum(m.overall_size, DATA_AXIS),
-                overall_count=lax.psum(m.overall_count, DATA_AXIS),
+                per_partition=lax.psum(m.per_partition, dev_axes),
+                earliest_s=lax.pmin(m.earliest_s, dev_axes),
+                latest_s=lax.pmax(m.latest_s, dev_axes),
+                smallest=lax.pmin(m.smallest, dev_axes),
+                largest=lax.pmax(m.largest, dev_axes),
+                overall_size=lax.psum(m.overall_size, dev_axes),
+                overall_count=lax.psum(m.overall_count, dev_axes),
             )
             alive_count = jnp.int64(-1)
             if local.alive is not None:
@@ -222,10 +272,10 @@ class ShardedTpuBackend(MetricBackend):
                 # the replication explicit (and is a no-op numerically).
                 alive_count = lax.pmax(lax.psum(pops, SPACE_AXIS), DATA_AXIS)
             hll_regs = (
-                lax.pmax(local.hll.regs, DATA_AXIS) if local.hll is not None else None
+                lax.pmax(local.hll.regs, dev_axes) if local.hll is not None else None
             )
             dd_counts = (
-                lax.psum(local.quantiles.counts, DATA_AXIS)
+                lax.psum(local.quantiles.counts, dev_axes)
                 if local.quantiles is not None
                 else None
             )
@@ -254,18 +304,36 @@ class ShardedTpuBackend(MetricBackend):
         passes None for them.  Every process must call this in lockstep:
         the compiled step is a global program."""
         d = self.config.data_shards
+        s = self.config.space_shards
+        c = self.config.chunk_size
         if len(batches) != d:
             raise ValueError(f"expected {d} shard batches, got {len(batches)}")
-        per_shard = np.stack(
-            [
+
+        def chunks(batch: "Optional[RecordBatch]") -> List[np.ndarray]:
+            """Contiguous 1/S record chunks of one data row's batch, packed.
+
+            Contiguity is what makes the device-side ordered application
+            exact: chunk s holds records [s·C, (s+1)·C), so source-chunk
+            order equals record order (backends/step.py)."""
+            if batch is None:
+                batch = RecordBatch.empty(0)
+            n = len(batch)
+            if n > c * s:
+                raise ValueError(
+                    f"batch of {n} exceeds batch_size {self.config.batch_size}"
+                )
+            return [
                 pack_batch(
-                    batches[r] if batches[r] is not None else RecordBatch.empty(0),
-                    self.config,
+                    batch.take(np.arange(lo, min(lo + c, n))),
+                    self._chunk_config,
                     use_native=self.use_native,
                 )
-                for r in self.local_rows
+                for lo in range(0, c * s, c)
             ]
-        )
+
+        per_shard = np.stack(
+            [np.stack(chunks(batches[r])) for r in self.local_rows]
+        )  # [local_rows, S, chunk_nbytes]
         if self._multiprocess:
             bufs = jax.make_array_from_process_local_data(
                 self._buf_sharding,
@@ -300,12 +368,12 @@ class ShardedTpuBackend(MetricBackend):
         local = np.full((len(self.local_rows),), int(flag), np.int32)
         if self._multiprocess:
             arr = jax.make_array_from_process_local_data(
-                self._buf_sharding,
+                self._row_sharding,
                 local,
                 global_shape=(self.config.data_shards,),
             )
         else:
-            arr = jax.device_put(local, self._buf_sharding)
+            arr = jax.device_put(local, self._row_sharding)
         return bool(np.asarray(self._any_fn(arr)).sum() > 0)
 
     def update(self, batch: RecordBatch) -> None:
@@ -352,14 +420,21 @@ class ShardedTpuBackend(MetricBackend):
                 "snapshots need contiguous local data rows"
             )
 
+        d = self.config.data_shards
+
         def to_local(arr):
-            local_shape = (len(rows),) + arr.shape[1:]
+            # Record-parallel leaves carry D·S leading rows (one per
+            # device), the bitmap D; either way each data row owns a
+            # contiguous `scale`-row block of the leading axis.
+            scale = arr.shape[0] // d
+            base = row0 * scale
+            local_shape = (len(rows) * scale,) + arr.shape[1:]
             buf = np.empty(local_shape, dtype=arr.dtype)
             for sh in arr.addressable_shards:
                 idx = sh.index
                 r = idx[0]
-                lo = (r.start or 0) - row0
-                hi = (r.stop if r.stop is not None else arr.shape[0]) - row0
+                lo = (r.start or 0) - base
+                hi = (r.stop if r.stop is not None else arr.shape[0]) - base
                 buf[(slice(lo, hi),) + tuple(idx[1:])] = np.asarray(sh.data)
             return buf
 
@@ -369,11 +444,15 @@ class ShardedTpuBackend(MetricBackend):
         """Rebuild the global state from THIS process's rows (the other
         processes supply theirs in their own call)."""
         d = self.config.data_shards
+        n_local = len(self.local_rows)
 
         def put(x, s):
             x = np.asarray(x)
+            scale = x.shape[0] // n_local
             return jax.make_array_from_process_local_data(
-                NamedSharding(self.mesh, s), x, global_shape=(d,) + x.shape[1:]
+                NamedSharding(self.mesh, s),
+                x,
+                global_shape=(d * scale,) + x.shape[1:],
             )
 
         self.state = jax.tree.map(put, local_state, self._specs)

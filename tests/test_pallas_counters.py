@@ -107,13 +107,16 @@ def test_pallas_under_sharded_mesh_matches_lax():
     def scan(use_pallas: bool):
         cfg = AnalyzerConfig(
             num_partitions=5,
-            batch_size=1024,
+            # Chunked input sharding: each space shard folds
+            # batch_size / space_shards records, and the kernel needs
+            # 1024-record chunks — so 2048 over a (4, 2) mesh.
+            batch_size=2048,
             mesh_shape=(4, 2),
             use_pallas_counters=use_pallas,
         )
         backend = ShardedTpuBackend(cfg)
         return run_scan(
-            "t", SyntheticSource(spec), backend, batch_size=1024
+            "t", SyntheticSource(spec), backend, batch_size=2048
         ).metrics
 
     a, b = scan(False), scan(True)

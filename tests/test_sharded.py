@@ -76,3 +76,55 @@ def test_mixed_batch_update_splits_by_partition():
         be.update(batch)  # mixed-partition path
     m = be.finalize()
     assert int(m.overall_count) == 7 * 3_000
+
+
+def test_cross_chunk_last_writer_wins():
+    """A key whose alive-bitmap updates straddle the space-chunk boundary
+    must resolve by RECORD order, not by which space shard saw it: the
+    device-side ordered application (backends/step.py) is what makes the
+    chunked input sharding exact."""
+    from kafka_topic_analyzer_tpu.records import RecordBatch
+
+    def batch_of(rows):
+        b = RecordBatch.empty(len(rows))
+        for i, (h32, value_len) in enumerate(rows):
+            b.partition[i] = 0
+            b.key_len[i] = 4
+            b.value_null[i] = value_len is None
+            b.value_len[i] = 0 if value_len is None else value_len
+            b.ts_s[i] = 100 + i
+            b.key_hash32[i] = h32
+            b.key_hash64[i] = h32
+            b.valid[i] = True
+        return b
+
+    # batch_size 8 over (1, 2) → chunks of 4.  Key A: alive in chunk 0,
+    # tombstoned in chunk 1 → dead.  Key B: tombstoned in chunk 0, alive
+    # in chunk 1 → alive.  Key C alive twice in chunk 0 → alive.  Key D
+    # only in chunk 1, alive → alive.
+    rows = [
+        (0xA, 10), (0xB, None), (0xC, 5), (0xC, 6),   # chunk 0
+        (0xA, None), (0xB, 7), (0xD, 8), (0xD, 9),    # chunk 1
+    ]
+    cfg = AnalyzerConfig(
+        num_partitions=1,
+        batch_size=8,
+        mesh_shape=(1, 2),
+        count_alive_keys=True,
+        alive_bitmap_bits=8,
+    )
+    be = ShardedTpuBackend(cfg, init_now_s=10**10)
+    be.update_shards([batch_of(rows)])
+    m = be.finalize()
+    assert int(m.alive_keys) == 3  # B, C, D alive; A dead
+
+    # Same records through the CPU oracle (sequential replay).
+    oracle = CpuExactBackend(
+        AnalyzerConfig(
+            num_partitions=1, batch_size=8,
+            count_alive_keys=True, alive_bitmap_bits=8,
+        ),
+        init_now_s=10**10,
+    )
+    oracle.update(batch_of(rows))
+    assert int(oracle.finalize().alive_keys) == 3
