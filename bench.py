@@ -295,11 +295,13 @@ def main() -> int:
     # number bind?  (a) host->device bandwidth — on this rig an SSH-tunneled
     # relay, on a production host PCIe; (b) the device-resident step rate —
     # what the same chip sustains once transfer is off the critical path.
-    # Accelerator runs only: on the degraded CPU fallback there is no
-    # device for these numbers to describe.  The headline line has already
-    # been printed above, so even if a breakdown op wedges the tunnel and
+    # Accelerator platforms only: on host CPU (degraded fallback OR an
+    # explicit KTA_JAX_PLATFORMS=cpu run) there is no device for these
+    # numbers to describe — a host-to-host memcpy reported as
+    # `transfer_gbps` would poison cross-report comparisons.  The headline
+    # line prints first, so even if a breakdown op wedges the tunnel and
     # this child is killed, the supervisor salvages the measurement.
-    if not degraded:
+    if platform != "cpu":
         # Salvage checkpoint: the supervisor reuses this line if a
         # breakdown op hangs and the child must be killed.
         print(json.dumps(result), flush=True)

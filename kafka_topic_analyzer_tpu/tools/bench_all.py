@@ -42,6 +42,9 @@ def main(argv: "list[str] | None" = None) -> int:
         child_env.setdefault("KTA_ACCEL_OK", "1")
     else:
         child_env["KTA_JAX_PLATFORMS"] = "cpu"
+        # Children must self-describe too: an explicit platform override
+        # alone reads as a deliberate CPU run, but this one is a fallback.
+        child_env["KTA_DEGRADED"] = "1"
         report["degraded_cpu_fallback"] = True
     for cfg in [int(c) for c in args.configs.split(",") if c]:
         cmd = [

@@ -17,6 +17,10 @@ def measure_transfer_gbps(dev=None, mib_sizes: Sequence[int] = (8,)) -> dict:
     import jax
     import numpy as np
 
+    # Untimed warmup put: the process's first transfer pays one-time
+    # allocator/stream setup, which would otherwise deflate the first
+    # size's figure.
+    jax.device_put(np.ones((1 << 16,), np.uint8), dev).block_until_ready()
     out = {}
     for mib in mib_sizes:
         host = np.ones((mib << 20,), np.uint8)
