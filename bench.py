@@ -81,14 +81,25 @@ def supervised_main() -> int:
         # client inits against the relay — the documented wedge mechanism.
         and not os.environ.get("KTA_ACCEL_OK")
     ):
-        # The one shared liveness verdict (real device op + non-cpu
-        # platform) — see jax_support.probe_accelerator_alive.
-        from kafka_topic_analyzer_tpu.jax_support import probe_accelerator_alive
+        # The one shared probe (real device op; see jax_support): None =
+        # wedged tunnel, "cpu" = working CPU-only machine — different
+        # diagnoses, same consequence (skip the accelerator attempt; the
+        # CPU run is flagged either way, since neither case yields chip
+        # numbers).
+        from kafka_topic_analyzer_tpu.jax_support import probe_device_platform
 
-        if not probe_accelerator_alive(probe_s):
+        platform = probe_device_platform(probe_s)
+        if platform is None:
             print(
                 f"bench: accelerator init probe failed within {probe_s:.0f}s "
                 "(tunnel relay down?) — skipping to host CPU, degraded",
+                file=sys.stderr, flush=True,
+            )
+            attempts = attempts[1:]
+        elif platform == "cpu":
+            print(
+                "bench: no accelerator present — running on host CPU, "
+                "flagged degraded",
                 file=sys.stderr, flush=True,
             )
             attempts = attempts[1:]

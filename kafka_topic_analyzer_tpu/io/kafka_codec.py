@@ -47,6 +47,14 @@ class KafkaProtocolError(RuntimeError):
     pass
 
 
+class UnsupportedVersionError(KafkaProtocolError):
+    """Error 35: the broker rejected the request's api version — the
+    caller may retry at a lower version (KIP-511 ApiVersions dance)."""
+
+
+ERR_UNSUPPORTED_VERSION = 35
+
+
 # ---------------------------------------------------------------------------
 # primitives
 
@@ -104,6 +112,44 @@ class ByteWriter:
         if b is None:
             return self.varint(-1)
         return self.varint(len(b)).raw(b)
+
+    # -- flexible-version (KIP-482) primitives ------------------------------
+
+    def uvarint(self, v: int) -> "ByteWriter":
+        """UNSIGNED varint — compact lengths and tag ids (flexible
+        encodings use these, unlike record fields' zigzag varints)."""
+        if v < 0:
+            raise ValueError("uvarint requires v >= 0")
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        return self.raw(bytes(out))
+
+    def compact_string(self, s: Optional[str]) -> "ByteWriter":
+        """COMPACT_NULLABLE_STRING: uvarint(len + 1), 0 = null."""
+        if s is None:
+            return self.uvarint(0)
+        b = s.encode()
+        return self.uvarint(len(b) + 1).raw(b)
+
+    def compact_bytes(self, b: Optional[bytes]) -> "ByteWriter":
+        if b is None:
+            return self.uvarint(0)
+        return self.uvarint(len(b) + 1).raw(b)
+
+    def compact_array_len(self, n: Optional[int]) -> "ByteWriter":
+        """COMPACT_ARRAY header: uvarint(count + 1), 0 = null array."""
+        return self.uvarint(0 if n is None else n + 1)
+
+    def tags(self) -> "ByteWriter":
+        """Empty tagged-field buffer (this client sends no tagged fields)."""
+        return self.uvarint(0)
 
     def done(self) -> bytes:
         return b"".join(self._parts)
@@ -192,6 +238,63 @@ class ByteReader:
             return None
         return self._take(n)
 
+    # -- flexible-version (KIP-482) primitives ------------------------------
+
+    def uvarint(self) -> int:
+        shift = 0
+        v = 0
+        while True:
+            b = self._take(1)[0]
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v
+            shift += 7
+            if shift > 63:
+                raise KafkaProtocolError("uvarint too long")
+
+    def compact_string(self) -> Optional[str]:
+        n = self.uvarint()
+        if n == 0:
+            return None
+        try:
+            return bytes(self._take(n - 1)).decode()
+        except UnicodeDecodeError as e:
+            raise KafkaProtocolError(f"invalid UTF-8 string on the wire: {e}") from e
+
+    def compact_bytes(self) -> Optional[bytes]:
+        n = self.uvarint()
+        if n == 0:
+            return None
+        return self._take(n - 1)
+
+    def compact_bytes_view(self) -> "Optional[memoryview]":
+        """Zero-copy compact bytes — the flexible twin of bytes_view, for
+        fetch record sets."""
+        n = self.uvarint()
+        if n == 0:
+            return None
+        n -= 1
+        if n > len(self.buf) - self.pos:
+            raise KafkaProtocolError(
+                f"truncated message: need {n} bytes at {self.pos}, "
+                f"have {len(self.buf)}"
+            )
+        v = memoryview(self.buf)[self.pos : self.pos + n]
+        self.pos += n
+        return v
+
+    def compact_array_len(self) -> int:
+        """COMPACT_ARRAY count; null arrays read as empty."""
+        n = self.uvarint()
+        return 0 if n == 0 else n - 1
+
+    def skip_tags(self) -> None:
+        """Skip a tagged-field buffer (forward compatibility: unknown
+        tagged fields are ignorable by contract)."""
+        for _ in range(self.uvarint()):
+            self.uvarint()  # tag id
+            self._take(self.uvarint())
+
     def remaining(self) -> int:
         return len(self.buf) - self.pos
 
@@ -200,13 +303,35 @@ class ByteReader:
 # request framing
 
 
+#: First flexible (KIP-482 tagged/compact encoding) version per API this
+#: client speaks.  Flexible requests use header v2 (a tag buffer after
+#: client_id) and flexible responses header v1 (a tag buffer after the
+#: correlation id) — EXCEPT ApiVersions responses, which stay header v0 at
+#: every version so that brokers can answer clients whose flexible support
+#: is still unknown.
+_FLEXIBLE_FROM = {
+    API_METADATA: 9,
+    API_FETCH: 12,
+    API_LIST_OFFSETS: 6,
+    API_VERSIONS: 3,
+}
+
+
+def is_flexible(api_key: int, api_version: int) -> bool:
+    v = _FLEXIBLE_FROM.get(api_key)
+    return v is not None and api_version >= v
+
+
 def encode_request(
     api_key: int, api_version: int, correlation_id: int, client_id: str, body: bytes
 ) -> bytes:
-    """Length-prefixed request with header v1 (src client.id analog:
-    the reference sets client.id=topic-analyzer, src/kafka.rs:36)."""
+    """Length-prefixed request with header v1 — or v2 (trailing tag
+    buffer) for flexible api versions (src client.id analog: the
+    reference sets client.id=topic-analyzer, src/kafka.rs:36)."""
     w = ByteWriter()
     w.i16(api_key).i16(api_version).i32(correlation_id).string(client_id)
+    if is_flexible(api_key, api_version):
+        w.tags()
     payload = w.done() + body
     return struct.pack(">i", len(payload)) + payload
 
@@ -216,19 +341,38 @@ def decode_request_header(buf: bytes) -> Tuple[int, int, int, Optional[str], Byt
     api_key = r.i16()
     api_version = r.i16()
     corr = r.i32()
-    client_id = r.string()
+    client_id = r.string()  # header v2 keeps the classic NULLABLE_STRING
+    if is_flexible(api_key, api_version):
+        r.skip_tags()
     return api_key, api_version, corr, client_id, r
 
 
 # ---------------------------------------------------------------------------
 # Metadata v1 / v5 (classic encoding; v5 is the floor on Kafka 4.0 brokers
-# after KIP-896 removed pre-2.1 protocol versions)
+# after KIP-896 removed pre-2.1 protocol versions) / v12 (flexible,
+# KIP-482 compact encoding + KIP-516 topic ids)
+
+#: All-zero UUID = "name lookup" in topic-id-aware requests (KIP-516).
+_NULL_UUID = b"\x00" * 16
 
 
 def encode_metadata_request(
     topics: Optional[List[str]], version: int = 1
 ) -> bytes:
     w = ByteWriter()
+    if version >= 9:
+        w.compact_array_len(None if topics is None else len(topics))
+        for t in topics or []:
+            if version >= 10:
+                w.raw(_NULL_UUID)  # topic_id: lookup by name
+            w.compact_string(t)
+            w.tags()
+        w.i8(0)  # allow_auto_topic_creation = false (read-only tool)
+        if version <= 10:
+            w.i8(0)  # include_cluster_authorized_operations
+        w.i8(0)  # include_topic_authorized_operations
+        w.tags()
+        return w.done()
     if topics is None:
         w.i32(-1)
     else:
@@ -238,6 +382,36 @@ def encode_metadata_request(
     if version >= 4:
         w.i8(0)  # allow_auto_topic_creation = false (read-only tool)
     return w.done()
+
+
+def decode_metadata_request(
+    r: ByteReader, version: int = 1
+) -> Optional[List[str]]:
+    """Topic names of a Metadata request (fake-broker side)."""
+    if version >= 9:
+        n = r.uvarint()
+        if n == 0:
+            topics = None
+        else:
+            topics = []
+            for _ in range(n - 1):
+                if version >= 10:
+                    r._take(16)  # topic_id
+                topics.append(r.compact_string() or "")
+                r.skip_tags()
+        r.i8()  # allow_auto_topic_creation
+        if version <= 10:
+            r.i8()
+        r.i8()
+        r.skip_tags()
+        return topics
+    n = r.i32()
+    if n < 0:
+        return None
+    topics = [r.string() or "" for _ in range(n)]
+    if version >= 4:
+        r.i8()
+    return topics
 
 
 @dataclasses.dataclass
@@ -263,6 +437,35 @@ class MetadataResponse:
 
 def encode_metadata_response(resp: MetadataResponse, version: int = 1) -> bytes:
     w = ByteWriter()
+    if version >= 9:
+        w.i32(0)  # throttle_time_ms
+        w.compact_array_len(len(resp.brokers))
+        for node_id, (host, port) in resp.brokers.items():
+            w.i32(node_id).compact_string(host).i32(port)
+            w.compact_string(None)  # rack
+            w.tags()
+        w.compact_string(None)  # cluster_id
+        w.i32(resp.controller_id)
+        w.compact_array_len(len(resp.topics))
+        for t in resp.topics:
+            w.i16(t.error).compact_string(t.name)
+            if version >= 10:
+                w.raw(_NULL_UUID)  # topic_id
+            w.i8(0)  # is_internal
+            w.compact_array_len(len(t.partitions))
+            for p in t.partitions:
+                w.i16(p.error).i32(p.partition).i32(p.leader)
+                w.i32(0)  # leader_epoch (v7+)
+                w.compact_array_len(1).i32(p.leader)  # replicas
+                w.compact_array_len(1).i32(p.leader)  # isr
+                w.compact_array_len(0)  # offline_replicas
+                w.tags()
+            w.i32(-2147483648)  # topic_authorized_operations (v8+)
+            w.tags()
+        if 8 <= version <= 10:
+            w.i32(-2147483648)  # cluster_authorized_operations
+        w.tags()
+        return w.done()
     if version >= 3:
         w.i32(0)  # throttle_time_ms
     w.i32(len(resp.brokers))
@@ -285,6 +488,46 @@ def encode_metadata_response(resp: MetadataResponse, version: int = 1) -> bytes:
 
 
 def decode_metadata_response(r: ByteReader, version: int = 1) -> MetadataResponse:
+    if version >= 9:
+        r.i32()  # throttle_time_ms
+        brokers = {}
+        for _ in range(r.compact_array_len()):
+            node_id = r.i32()
+            host = r.compact_string() or ""
+            port = r.i32()
+            r.compact_string()  # rack
+            r.skip_tags()
+            brokers[node_id] = (host, port)
+        r.compact_string()  # cluster_id
+        controller = r.i32()
+        topics = []
+        for _ in range(r.compact_array_len()):
+            err = r.i16()
+            name = r.compact_string() or ""
+            if version >= 10:
+                r._take(16)  # topic_id
+            r.i8()  # is_internal
+            parts = []
+            for _ in range(r.compact_array_len()):
+                perr = r.i16()
+                pid = r.i32()
+                leader = r.i32()
+                r.i32()  # leader_epoch
+                for _ in range(r.compact_array_len()):
+                    r.i32()  # replicas
+                for _ in range(r.compact_array_len()):
+                    r.i32()  # isr
+                for _ in range(r.compact_array_len()):
+                    r.i32()  # offline_replicas
+                r.skip_tags()
+                parts.append(PartitionMetadata(perr, pid, leader))
+            r.i32()  # topic_authorized_operations
+            r.skip_tags()
+            topics.append(TopicMetadata(err, name, parts))
+        if 8 <= version <= 10:
+            r.i32()  # cluster_authorized_operations
+        r.skip_tags()
+        return MetadataResponse(brokers, controller, topics)
     if version >= 3:
         r.i32()  # throttle_time_ms
     brokers = {}
@@ -320,14 +563,26 @@ def decode_metadata_response(r: ByteReader, version: int = 1) -> MetadataRespons
 
 
 # ---------------------------------------------------------------------------
-# ListOffsets v1
+# ListOffsets v1 (classic) / v7 (flexible)
 
 
 def encode_list_offsets_request(
-    topic: str, partition_timestamps: List[Tuple[int, int]]
+    topic: str, partition_timestamps: List[Tuple[int, int]], version: int = 1
 ) -> bytes:
     w = ByteWriter()
     w.i32(-1)  # replica_id
+    if version >= 6:
+        w.i8(0)  # isolation_level: read_uncommitted (v2+)
+        w.compact_array_len(1).compact_string(topic)
+        w.compact_array_len(len(partition_timestamps))
+        for pid, ts in partition_timestamps:
+            w.i32(pid)
+            w.i32(-1)  # current_leader_epoch (v4+): unknown
+            w.i64(ts)
+            w.tags()
+        w.tags()  # topic
+        w.tags()  # request
+        return w.done()
     w.i32(1).string(topic)
     w.i32(len(partition_timestamps))
     for pid, ts in partition_timestamps:
@@ -335,8 +590,24 @@ def encode_list_offsets_request(
     return w.done()
 
 
-def decode_list_offsets_request(r: ByteReader) -> Tuple[str, List[Tuple[int, int]]]:
+def decode_list_offsets_request(
+    r: ByteReader, version: int = 1
+) -> Tuple[str, List[Tuple[int, int]]]:
     r.i32()  # replica_id
+    if version >= 6:
+        r.i8()  # isolation_level
+        ntopics = r.compact_array_len()
+        assert ntopics == 1
+        topic = r.compact_string() or ""
+        out = []
+        for _ in range(r.compact_array_len()):
+            pid = r.i32()
+            r.i32()  # current_leader_epoch
+            out.append((pid, r.i64()))
+            r.skip_tags()
+        r.skip_tags()
+        r.skip_tags()
+        return topic, out
     ntopics = r.i32()
     assert ntopics == 1
     topic = r.string() or ""
@@ -347,10 +618,21 @@ def decode_list_offsets_request(r: ByteReader) -> Tuple[str, List[Tuple[int, int
 
 
 def encode_list_offsets_response(
-    topic: str, results: List[Tuple[int, int, int, int]]
+    topic: str, results: List[Tuple[int, int, int, int]], version: int = 1
 ) -> bytes:
     """results: (partition, error, timestamp, offset)."""
     w = ByteWriter()
+    if version >= 6:
+        w.i32(0)  # throttle_time_ms (v2+)
+        w.compact_array_len(1).compact_string(topic)
+        w.compact_array_len(len(results))
+        for pid, err, ts, off in results:
+            w.i32(pid).i16(err).i64(ts).i64(off)
+            w.i32(-1)  # leader_epoch (v4+)
+            w.tags()
+        w.tags()
+        w.tags()
+        return w.done()
     w.i32(1).string(topic)
     w.i32(len(results))
     for pid, err, ts, off in results:
@@ -358,8 +640,25 @@ def encode_list_offsets_response(
     return w.done()
 
 
-def decode_list_offsets_response(r: ByteReader) -> "dict[int, tuple[int, int]]":
+def decode_list_offsets_response(
+    r: ByteReader, version: int = 1
+) -> "dict[int, tuple[int, int]]":
     out = {}
+    if version >= 6:
+        r.i32()  # throttle_time_ms
+        for _ in range(r.compact_array_len()):
+            r.compact_string()  # topic
+            for _ in range(r.compact_array_len()):
+                pid = r.i32()
+                err = r.i16()
+                r.i64()  # timestamp
+                off = r.i64()
+                r.i32()  # leader_epoch
+                r.skip_tags()
+                out[pid] = (err, off)
+            r.skip_tags()
+        r.skip_tags()
+        return out
     for _ in range(r.i32()):
         r.string()  # topic
         for _ in range(r.i32()):
@@ -372,7 +671,7 @@ def decode_list_offsets_response(r: ByteReader) -> "dict[int, tuple[int, int]]":
 
 
 # ---------------------------------------------------------------------------
-# Fetch v4
+# Fetch v4 (classic) / v12 (flexible; sessionless — session_id 0, epoch -1)
 
 
 def encode_fetch_request(
@@ -382,10 +681,28 @@ def encode_fetch_request(
     min_bytes: int,
     max_bytes: int,
     partition_max_bytes: int,
+    version: int = 4,
 ) -> bytes:
     w = ByteWriter()
     w.i32(-1)  # replica_id
     w.i32(max_wait_ms).i32(min_bytes).i32(max_bytes).i8(0)  # isolation: read_uncommitted
+    if version >= 12:
+        w.i32(0).i32(-1)  # session_id / session_epoch: sessionless (KIP-227)
+        w.compact_array_len(1).compact_string(topic)
+        w.compact_array_len(len(partition_offsets))
+        for pid, off in partition_offsets:
+            w.i32(pid)
+            w.i32(-1)       # current_leader_epoch (v9+): unknown
+            w.i64(off)
+            w.i32(-1)       # last_fetched_epoch (v12+): none
+            w.i64(-1)       # log_start_offset (v5+): consumer
+            w.i32(partition_max_bytes)
+            w.tags()
+        w.tags()  # topic
+        w.compact_array_len(0)  # forgotten_topics_data (v7+)
+        w.compact_string("")    # rack_id (v11+)
+        w.tags()
+        return w.done()
     w.i32(1).string(topic)
     w.i32(len(partition_offsets))
     for pid, off in partition_offsets:
@@ -393,12 +710,37 @@ def encode_fetch_request(
     return w.done()
 
 
-def decode_fetch_request(r: ByteReader):
+def decode_fetch_request(r: ByteReader, version: int = 4):
     r.i32()  # replica
     max_wait = r.i32()
     min_bytes = r.i32()
     max_bytes = r.i32()
     r.i8()  # isolation
+    if version >= 12:
+        r.i32()  # session_id
+        r.i32()  # session_epoch
+        ntopics = r.compact_array_len()
+        assert ntopics == 1
+        topic = r.compact_string() or ""
+        parts = []
+        for _ in range(r.compact_array_len()):
+            pid = r.i32()
+            r.i32()  # current_leader_epoch
+            off = r.i64()
+            r.i32()  # last_fetched_epoch
+            r.i64()  # log_start_offset
+            pmax = r.i32()
+            r.skip_tags()
+            parts.append((pid, off, pmax))
+        r.skip_tags()  # topic
+        for _ in range(r.compact_array_len()):  # forgotten topics
+            r.compact_string()
+            for _ in range(r.compact_array_len()):
+                r.i32()
+            r.skip_tags()
+        r.compact_string()  # rack_id
+        r.skip_tags()
+        return topic, parts, max_wait, min_bytes, max_bytes
     ntopics = r.i32()
     assert ntopics == 1
     topic = r.string() or ""
@@ -412,11 +754,27 @@ def decode_fetch_request(r: ByteReader):
 
 
 def encode_fetch_response(
-    topic: str, partitions: List[Tuple[int, int, int, bytes]]
+    topic: str, partitions: List[Tuple[int, int, int, bytes]], version: int = 4
 ) -> bytes:
     """partitions: (partition, error, high_watermark, record_set_bytes)."""
     w = ByteWriter()
     w.i32(0)  # throttle_time_ms
+    if version >= 12:
+        w.i16(0)  # top-level error_code (v7+)
+        w.i32(0)  # session_id (v7+)
+        w.compact_array_len(1).compact_string(topic)
+        w.compact_array_len(len(partitions))
+        for pid, err, hw, records in partitions:
+            w.i32(pid).i16(err).i64(hw)
+            w.i64(hw)   # last_stable_offset (v4+)
+            w.i64(0)    # log_start_offset (v5+)
+            w.compact_array_len(0)  # aborted_transactions
+            w.i32(-1)   # preferred_read_replica (v11+)
+            w.compact_bytes(records)
+            w.tags()
+        w.tags()
+        w.tags()
+        return w.done()
     w.i32(1).string(topic)
     w.i32(len(partitions))
     for pid, err, hw, records in partitions:
@@ -435,9 +793,37 @@ class FetchedPartition:
     records: bytes
 
 
-def decode_fetch_response(r: ByteReader) -> List[FetchedPartition]:
+def decode_fetch_response(r: ByteReader, version: int = 4) -> List[FetchedPartition]:
     r.i32()  # throttle
     out = []
+    if version >= 12:
+        err_top = r.i16()
+        if err_top:
+            raise KafkaProtocolError(f"Fetch error {err_top}")
+        r.i32()  # session_id
+        for _ in range(r.compact_array_len()):
+            r.compact_string()  # topic
+            for _ in range(r.compact_array_len()):
+                pid = r.i32()
+                err = r.i16()
+                hw = r.i64()
+                r.i64()  # last_stable_offset
+                r.i64()  # log_start_offset
+                for _ in range(r.compact_array_len()):  # aborted txns
+                    r.i64()
+                    r.i64()
+                    r.skip_tags()
+                r.i32()  # preferred_read_replica
+                records = r.compact_bytes_view()
+                r.skip_tags()
+                out.append(
+                    FetchedPartition(
+                        pid, err, hw, records if records is not None else b""
+                    )
+                )
+            r.skip_tags()
+        r.skip_tags()
+        return out
     for _ in range(r.i32()):
         r.string()  # topic
         for _ in range(r.i32()):
@@ -458,23 +844,61 @@ def decode_fetch_response(r: ByteReader) -> List[FetchedPartition]:
 
 
 # ---------------------------------------------------------------------------
-# ApiVersions v0
+# ApiVersions v0 (classic) / v3 (flexible request; response header stays v0
+# at EVERY version — the broker answers before knowing the client's
+# flexible support)
 
 
-def encode_api_versions_response(apis: List[Tuple[int, int, int]]) -> bytes:
+def encode_api_versions_request(version: int = 0) -> bytes:
+    if version < 3:
+        return b""
+    w = ByteWriter()
+    w.compact_string("kafka-topic-analyzer-tpu")
+    w.compact_string("2")
+    w.tags()
+    return w.done()
+
+
+def encode_api_versions_response(
+    apis: List[Tuple[int, int, int]], version: int = 0
+) -> bytes:
     w = ByteWriter()
     w.i16(0)  # error
+    if version >= 3:
+        w.compact_array_len(len(apis))
+        for key, vmin, vmax in apis:
+            w.i16(key).i16(vmin).i16(vmax)
+            w.tags()
+        w.i32(0)  # throttle_time_ms (v1+)
+        w.tags()
+        return w.done()
     w.i32(len(apis))
     for key, vmin, vmax in apis:
         w.i16(key).i16(vmin).i16(vmax)
     return w.done()
 
 
-def decode_api_versions_response(r: ByteReader) -> "dict[int, tuple[int, int]]":
+def decode_api_versions_response(
+    r: ByteReader, version: int = 0
+) -> "dict[int, tuple[int, int]]":
     err = r.i16()
+    if err == ERR_UNSUPPORTED_VERSION:
+        # Answered in v0 format regardless of the requested version
+        # (KIP-511): the caller downgrades and retries.
+        raise UnsupportedVersionError("ApiVersions error 35")
     if err:
         raise KafkaProtocolError(f"ApiVersions error {err}")
     out = {}
+    if version >= 3:
+        for _ in range(r.compact_array_len()):
+            api_key = r.i16()
+            vmin = r.i16()
+            vmax = r.i16()
+            r.skip_tags()
+            out[api_key] = (vmin, vmax)
+        r.i32()  # throttle_time_ms
+        r.skip_tags()
+        return out
     for _ in range(r.i32()):
         # Read fields in explicit order: `out[r.i16()] = (r.i16(), r.i16())`
         # evaluates the RHS before the key and scrambles the triples.

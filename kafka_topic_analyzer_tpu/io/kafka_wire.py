@@ -168,6 +168,7 @@ class BrokerConnection:
             sock = ssl_context.wrap_socket(sock, server_hostname=host)
         self.sock = sock
         self._corr = 0
+        self._inflight: "Dict[int, Tuple[int, int]]" = {}
         self._lock = threading.Lock()
         #: ApiVersions handshake result, filled lazily ({} = legacy broker).
         self.api_versions: "Optional[Dict[int, tuple[int, int]]]" = None
@@ -256,22 +257,37 @@ class BrokerConnection:
         with self._lock:
             self._corr += 1
             corr = self._corr
+            # Response-header shape depends on the REQUEST's api version
+            # (flexible responses carry a tag buffer after the correlation
+            # id); remember it so read_response can strip it even when
+            # requests are pipelined.
+            self._inflight[corr] = (api_key, api_version)
             self.sock.sendall(
                 kc.encode_request(api_key, api_version, corr, CLIENT_ID, body)
             )
             return corr
+
+    @staticmethod
+    def _strip_header_tags(r: kc.ByteReader, api_key: int, api_version: int) -> None:
+        # ApiVersions responses keep header v0 forever (the broker answers
+        # before knowing the client's flexible support).
+        if api_key != kc.API_VERSIONS and kc.is_flexible(api_key, api_version):
+            r.skip_tags()
 
     def read_response(self, corr: int) -> kc.ByteReader:
         """Pipelining half 2: read the next response; must match ``corr``."""
         with self._lock:
             (length,) = struct.unpack(">i", self._recv_exact(4))
             payload = self._recv_exact(length)
+            meta = self._inflight.pop(corr, None)
         r = kc.ByteReader(payload)
         got_corr = r.i32()
         if got_corr != corr:
             raise kc.KafkaProtocolError(
                 f"correlation id mismatch: sent {corr}, got {got_corr}"
             )
+        if meta is not None:
+            self._strip_header_tags(r, *meta)
         return r
 
     def request(self, api_key: int, api_version: int, body: bytes) -> kc.ByteReader:
@@ -289,6 +305,7 @@ class BrokerConnection:
             raise kc.KafkaProtocolError(
                 f"correlation id mismatch: sent {corr}, got {got_corr}"
             )
+        self._strip_header_tags(r, api_key, api_version)
         return r
 
 
@@ -481,11 +498,14 @@ class KafkaWireSource(RecordSource):
     #: Preferred-first version candidates per API.  Metadata v5 is the floor
     #: on Kafka 4.0 brokers (KIP-896 removed pre-2.1 versions); v1 keeps
     #: very old brokers working.  The last entry doubles as the legacy
-    #: default when the broker predates ApiVersions.
+    #: default when the broker predates ApiVersions.  The leading entries
+    #: are the flexible (KIP-482 tagged/compact) versions — preferred when
+    #: the broker's advertised range covers them, and required once a
+    #: future KIP-896-style floor raise drops the classic encodings.
     _CANDIDATES = {
-        kc.API_METADATA: ("Metadata", (5, 1)),
-        kc.API_LIST_OFFSETS: ("ListOffsets", (1,)),
-        kc.API_FETCH: ("Fetch", (4,)),
+        kc.API_METADATA: ("Metadata", (12, 5, 1)),
+        kc.API_LIST_OFFSETS: ("ListOffsets", (7, 1)),
+        kc.API_FETCH: ("Fetch", (12, 4)),
     }
 
     def _evict(self, conn: BrokerConnection) -> None:
@@ -501,33 +521,56 @@ class KafkaWireSource(RecordSource):
             if (conn.host, conn.port) in self._assume_legacy:
                 conn.api_versions = {}
             else:
-                try:
-                    r = conn.request(kc.API_VERSIONS, 0, b"")
-                except kc.KafkaProtocolError as e:
-                    # Pre-0.10 brokers slam the connection on the unknown
-                    # request: remember the host as legacy (so the caller's
-                    # retry skips the handshake) and surface the failure —
-                    # the stream is dead either way.
-                    self._evict(conn)
-                    if "closed the connection" in str(e):
-                        self._assume_legacy.add((conn.host, conn.port))
-                    raise
-                except OSError as e:
-                    # Transient socket failure: evict (dead/desynced stream)
-                    # but do NOT guess legacy — the retry re-handshakes.
-                    self._evict(conn)
-                    raise kc.KafkaProtocolError(
-                        f"ApiVersions handshake failed: {e}"
-                    ) from e
-                try:
-                    conn.api_versions = kc.decode_api_versions_response(r)
-                except kc.KafkaProtocolError as e:
-                    # A cleanly-decoded error response (e.g. 35
-                    # UNSUPPORTED_VERSION): genuinely old broker.
-                    log.warning(
-                        "ApiVersions rejected (%s); assuming legacy broker", e
-                    )
-                    conn.api_versions = {}
+                # KIP-511 downgrade dance: offer the flexible v3 first; a
+                # broker that doesn't speak it answers UNSUPPORTED_VERSION
+                # in v0 format (brokers parse only the first two header
+                # fields of an unknown-version ApiVersions request), and
+                # the client retries at v0.  This is what survives a
+                # future floor raise that drops ApiVersions v0.
+                for av in (3, 0):
+                    try:
+                        r = conn.request(
+                            kc.API_VERSIONS, av,
+                            kc.encode_api_versions_request(av),
+                        )
+                    except kc.KafkaProtocolError as e:
+                        # Pre-0.10 brokers slam the connection on the
+                        # unknown request: remember the host as legacy (so
+                        # the caller's retry skips the handshake) and
+                        # surface the failure — the stream is dead either
+                        # way.
+                        self._evict(conn)
+                        if "closed the connection" in str(e):
+                            self._assume_legacy.add((conn.host, conn.port))
+                        raise
+                    except OSError as e:
+                        # Transient socket failure: evict (dead/desynced
+                        # stream) but do NOT guess legacy — the retry
+                        # re-handshakes.
+                        self._evict(conn)
+                        raise kc.KafkaProtocolError(
+                            f"ApiVersions handshake failed: {e}"
+                        ) from e
+                    try:
+                        conn.api_versions = kc.decode_api_versions_response(
+                            r, av
+                        )
+                        break
+                    except kc.UnsupportedVersionError:
+                        if av == 0:
+                            # v0 itself rejected: genuinely ancient broker.
+                            log.warning(
+                                "ApiVersions rejected; assuming legacy broker"
+                            )
+                            conn.api_versions = {}
+                        continue  # downgrade v3 -> v0
+                    except kc.KafkaProtocolError as e:
+                        # A cleanly-decoded non-version error: old broker.
+                        log.warning(
+                            "ApiVersions rejected (%s); assuming legacy broker", e
+                        )
+                        conn.api_versions = {}
+                        break
         name, candidates = self._CANDIDATES[api_key]
         ranges = conn.api_versions
         if not ranges or api_key not in ranges:
@@ -603,14 +646,15 @@ class KafkaWireSource(RecordSource):
         for leader, parts in by_leader.items():
             host, port = self._brokers[leader]
             conn = self._connect(host, port)
+            lo_v = self._version(conn, kc.API_LIST_OFFSETS)
             r = conn.request(
                 kc.API_LIST_OFFSETS,
-                self._version(conn, kc.API_LIST_OFFSETS),
+                lo_v,
                 kc.encode_list_offsets_request(
-                    self.topic, [(p, ts) for p in parts]
+                    self.topic, [(p, ts) for p in parts], lo_v
                 ),
             )
-            for pid, (err, off) in kc.decode_list_offsets_response(r).items():
+            for pid, (err, off) in kc.decode_list_offsets_response(r, lo_v).items():
                 if err:
                     raise kc.KafkaProtocolError(
                         f"ListOffsets error {err} for partition {pid}"
@@ -815,9 +859,10 @@ class KafkaWireSource(RecordSource):
                 fl = None
             if fl is None:
                 pmax_sent = self.partition_max_bytes
+                fetch_v = self._version(conn, kc.API_FETCH)
                 corr = conn.send_request(
                     kc.API_FETCH,
-                    self._version(conn, kc.API_FETCH),
+                    fetch_v,
                     kc.encode_fetch_request(
                         self.topic,
                         [(p, next_offset[p]) for p in order],
@@ -825,6 +870,7 @@ class KafkaWireSource(RecordSource):
                         self.min_bytes,
                         self.max_bytes,
                         pmax_sent,
+                        fetch_v,
                     ),
                 )
                 fl = (
@@ -836,7 +882,7 @@ class KafkaWireSource(RecordSource):
                 )
             conn, corr, sent_offsets, order, pmax_sent = fl
             r = conn.read_response(corr)
-            fps = kc.decode_fetch_response(r)
+            fps = kc.decode_fetch_response(r, self._version(conn, kc.API_FETCH))
             # Send-ahead: while this response's records decode, let the
             # broker build the NEXT one.  A cheap native header scan of
             # each partition's record set yields the exact offsets
@@ -880,9 +926,10 @@ class KafkaWireSource(RecordSource):
                         k2 = (fetch_round + 1) % len(lp2)
                         order2 = lp2[k2:] + lp2[:k2]
                         pmax2 = self.partition_max_bytes
+                        fetch_v2 = self._version(conn, kc.API_FETCH)
                         corr2 = conn.send_request(
                             kc.API_FETCH,
-                            self._version(conn, kc.API_FETCH),
+                            fetch_v2,
                             kc.encode_fetch_request(
                                 self.topic,
                                 [(p, spec[p]) for p in order2],
@@ -890,6 +937,7 @@ class KafkaWireSource(RecordSource):
                                 self.min_bytes,
                                 self.max_bytes,
                                 pmax2,
+                                fetch_v2,
                             ),
                         )
                         inflight[leader] = (

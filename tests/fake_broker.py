@@ -31,6 +31,7 @@ class FakeBroker:
         cluster: "Optional[FakeCluster]" = None,
         api_ranges: "Optional[Dict[int, Tuple[int, int]]]" = None,
         no_api_versions: bool = False,
+        modern: bool = False,
         sasl_plain: "Optional[Tuple[str, str]]" = None,
         sasl_scram: "Optional[Tuple[str, str, str]]" = None,
         honor_partition_max_bytes: bool = False,
@@ -69,8 +70,18 @@ class FakeBroker:
         self.tls_context = tls_context
         self.node_id = node_id
         self.cluster = cluster
-        #: Advertised ApiVersions ranges; default mirrors a modern broker
-        #: (Metadata up to v5) so tests exercise the negotiated v5 path.
+        #: Advertised ApiVersions ranges; the default mirrors a classic
+        #: broker (Metadata up to v5) so tests exercise the negotiated v5
+        #: path; ``modern=True`` advertises the flexible (KIP-482) ranges
+        #: a current broker offers, driving the client onto Metadata v12 /
+        #: ListOffsets v7 / Fetch v12.
+        if modern and api_ranges is None:
+            api_ranges = {
+                kc.API_FETCH: (4, 12),
+                kc.API_LIST_OFFSETS: (1, 7),
+                kc.API_METADATA: (1, 12),
+                kc.API_VERSIONS: (0, 3),
+            }
         self.api_ranges = api_ranges or {
             kc.API_FETCH: (0, 4),
             kc.API_LIST_OFFSETS: (0, 1),
@@ -281,7 +292,21 @@ class FakeBroker:
                         )
                 else:
                     body = self._dispatch(api_key, api_version, r)
-                resp = struct.pack(">i", 4 + len(body)) + struct.pack(">i", corr) + body
+                # Flexible responses use header v1 (a tag buffer after the
+                # correlation id) — except ApiVersions, which stays header
+                # v0 at every version.
+                head_tags = (
+                    b"\x00"
+                    if api_key != kc.API_VERSIONS
+                    and kc.is_flexible(api_key, api_version)
+                    else b""
+                )
+                resp = (
+                    struct.pack(">i", 4 + len(head_tags) + len(body))
+                    + struct.pack(">i", corr)
+                    + head_tags
+                    + body
+                )
                 conn.sendall(resp)
 
     def _dispatch(self, api_key: int, api_version: int, r: kc.ByteReader) -> bytes:
@@ -291,14 +316,19 @@ class FakeBroker:
                 w = kc.ByteWriter()
                 w.i16(35).i32(0)
                 return w.done()
+            av_max = self.api_ranges.get(kc.API_VERSIONS, (0, 0))[1]
+            if api_version > av_max:
+                # KIP-511: an unknown ApiVersions version gets error 35 in
+                # v0 format; the client downgrades and retries.
+                w = kc.ByteWriter()
+                w.i16(35).i32(0)
+                return w.done()
             return kc.encode_api_versions_response(
-                [(k, lo, hi) for k, (lo, hi) in sorted(self.api_ranges.items())]
+                [(k, lo, hi) for k, (lo, hi) in sorted(self.api_ranges.items())],
+                api_version,
             )
         if api_key == kc.API_METADATA:
-            requested = []
-            n = r.i32()
-            for _ in range(max(n, 0)):
-                requested.append(r.string())
+            requested = kc.decode_metadata_request(r, api_version) or []
             brokers = (
                 self.cluster.broker_addrs()
                 if self.cluster is not None
@@ -332,7 +362,7 @@ class FakeBroker:
                 kc.MetadataResponse(brokers, 0, topics), version=api_version
             )
         if api_key == kc.API_LIST_OFFSETS:
-            _topic, parts = kc.decode_list_offsets_request(r)
+            _topic, parts = kc.decode_list_offsets_request(r, api_version)
             results = []
             for pid, ts in parts:
                 if pid not in self.records:
@@ -349,10 +379,12 @@ class FakeBroker:
                         -1,
                     )
                     results.append((pid, 0, ts, hit))
-            return kc.encode_list_offsets_response(self.topic, results)
+            return kc.encode_list_offsets_response(
+                self.topic, results, api_version
+            )
         if api_key == kc.API_FETCH:
             self.fetch_count += 1
-            _topic, parts, _mw, _mb, _xb = kc.decode_fetch_request(r)
+            _topic, parts, _mw, _mb, _xb = kc.decode_fetch_request(r, api_version)
             out = []
             budget = _xb if self.honor_max_bytes else None
             served_any = False
@@ -394,7 +426,7 @@ class FakeBroker:
                 if record_set:
                     served_any = True
                 out.append((pid, 0, hw, record_set))
-            return kc.encode_fetch_response(self.topic, out)
+            return kc.encode_fetch_response(self.topic, out, api_version)
         raise AssertionError(f"fake broker: unsupported api {api_key}")
 
     def _leader(self, partition: int) -> int:

@@ -284,7 +284,9 @@ def test_version_negotiation_legacy_and_ancient_brokers():
 
 def test_version_negotiation_incompatible_broker():
     ranges = {
-        kc.API_FETCH: (11, 17),  # too new: our Fetch v4 removed
+        # Too new: both our Fetch encodings (v12 flexible, v4 classic)
+        # removed by a hypothetical future KIP-896-style floor raise.
+        kc.API_FETCH: (13, 17),
         kc.API_LIST_OFFSETS: (0, 9),
         kc.API_METADATA: (0, 13),
     }
@@ -477,4 +479,148 @@ def test_empty_topic_is_empty():
     with FakeBroker("wire.topic", {0: [], 1: []}) as broker:
         src = KafkaWireSource(f"127.0.0.1:{broker.port}", "wire.topic")
         assert src.is_empty()
+        src.close()
+
+
+# ---------------------------------------------------------------------------
+# flexible (KIP-482) protocol versions: Metadata v12 / ListOffsets v7 /
+# Fetch v12 / ApiVersions v3
+
+
+def test_flexible_primitives_roundtrip():
+    w = kc.ByteWriter()
+    for v in (0, 1, 127, 128, 300, 1 << 31):
+        w.uvarint(v)
+    w.compact_string(None).compact_string("").compact_string("héllo")
+    w.compact_bytes(None).compact_bytes(b"").compact_bytes(b"\x00\xff")
+    w.compact_array_len(None).compact_array_len(0).compact_array_len(3)
+    w.tags()
+    r = kc.ByteReader(w.done())
+    assert [r.uvarint() for _ in range(6)] == [0, 1, 127, 128, 300, 1 << 31]
+    assert [r.compact_string() for _ in range(3)] == [None, "", "héllo"]
+    assert [r.compact_bytes() for _ in range(3)] == [None, b"", b"\x00\xff"]
+    assert [r.compact_array_len() for _ in range(3)] == [0, 0, 3]
+    r.skip_tags()
+    assert r.remaining() == 0
+
+
+def test_skip_tags_skips_unknown_tagged_fields():
+    # Forward compatibility: a response carrying tagged fields this client
+    # does not know must decode as if they were absent.
+    w = kc.ByteWriter()
+    w.uvarint(2)  # two tagged fields
+    w.uvarint(0).uvarint(3).raw(b"abc")
+    w.uvarint(7).uvarint(1).raw(b"z")
+    w.i32(42)
+    r = kc.ByteReader(w.done())
+    r.skip_tags()
+    assert r.i32() == 42
+
+
+@pytest.mark.parametrize("version", [9, 12])
+def test_metadata_flexible_roundtrip(version):
+    topics = [
+        kc.TopicMetadata(
+            0, "t", [kc.PartitionMetadata(0, 0, 1), kc.PartitionMetadata(0, 1, 2)]
+        )
+    ]
+    resp = kc.MetadataResponse({1: ("h1", 9092), 2: ("h2", 9093)}, 1, topics)
+    out = kc.decode_metadata_response(
+        kc.ByteReader(kc.encode_metadata_response(resp, version)), version
+    )
+    assert out.brokers == resp.brokers
+    assert out.controller_id == resp.controller_id
+    assert [(t.error, t.name) for t in out.topics] == [(0, "t")]
+    assert [(p.partition, p.leader) for p in out.topics[0].partitions] == [
+        (0, 1), (1, 2),
+    ]
+    req = kc.encode_metadata_request(["a", "b"], version)
+    assert kc.decode_metadata_request(kc.ByteReader(req), version) == ["a", "b"]
+
+
+def test_list_offsets_v7_roundtrip():
+    req = kc.encode_list_offsets_request("t", [(0, -2), (3, -1)], 7)
+    topic, parts = kc.decode_list_offsets_request(kc.ByteReader(req), 7)
+    assert (topic, parts) == ("t", [(0, -2), (3, -1)])
+    resp = kc.encode_list_offsets_response(
+        "t", [(0, 0, -1, 17), (3, 0, -1, 99)], 7
+    )
+    out = kc.decode_list_offsets_response(kc.ByteReader(resp), 7)
+    assert out == {0: (0, 17), 3: (0, 99)}
+
+
+def test_fetch_v12_roundtrip():
+    req = kc.encode_fetch_request("t", [(0, 5), (2, 11)], 100, 1, 1 << 20,
+                                  1 << 16, 12)
+    topic, parts, mw, mb, xb = kc.decode_fetch_request(kc.ByteReader(req), 12)
+    assert (topic, mw, mb, xb) == ("t", 100, 1, 1 << 20)
+    assert parts == [(0, 5, 1 << 16), (2, 11, 1 << 16)]
+    records = kc.encode_record_batch([(5, 1000, b"k", b"v")])
+    resp = kc.encode_fetch_response("t", [(0, 0, 6, records)], 12)
+    fps = kc.decode_fetch_response(kc.ByteReader(resp), 12)
+    assert len(fps) == 1
+    assert (fps[0].partition, fps[0].error, fps[0].high_watermark) == (0, 0, 6)
+    assert bytes(fps[0].records) == records
+
+
+def test_api_versions_v3_roundtrip():
+    apis = [(1, 4, 12), (3, 1, 12), (18, 0, 3)]
+    out = kc.decode_api_versions_response(
+        kc.ByteReader(kc.encode_api_versions_response(apis, 3)), 3
+    )
+    assert out == {1: (4, 12), 3: (1, 12), 18: (0, 3)}
+    # The v3 request body is compact strings + tags; decodable as written.
+    r = kc.ByteReader(kc.encode_api_versions_request(3))
+    assert r.compact_string() == "kafka-topic-analyzer-tpu"
+    assert r.compact_string() == "2"
+    r.skip_tags()
+    assert r.remaining() == 0
+
+
+def test_version_negotiation_flexible_broker():
+    """A broker advertising current ranges drives the client onto the
+    flexible versions (and the whole request/response cycle survives the
+    tagged headers)."""
+    with FakeBroker("wire.topic", {0: _mk_records(0, 20)}, modern=True) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "wire.topic")
+        conn = src._any_conn()
+        assert src._version(conn, kc.API_METADATA) == 12
+        assert src._version(conn, kc.API_LIST_OFFSETS) == 7
+        assert src._version(conn, kc.API_FETCH) == 12
+        assert src.partitions() == [0]
+        src.close()
+
+
+def test_wire_scan_flexible_broker_matches_direct():
+    records = {0: _mk_records(0, 400), 1: _mk_records(1, 250), 2: []}
+    with FakeBroker(
+        "wire.topic", records, max_records_per_fetch=97, modern=True
+    ) as broker:
+        result = _scan_via_wire(broker)
+    direct = _scan_direct(records, [0, 1, 2])
+    m = result.metrics
+    assert np.array_equal(m.per_partition, direct.per_partition)
+    assert m.alive_keys == direct.alive_keys
+    assert m.overall_count == 650
+
+
+def test_wire_scan_flexible_broker_compressed_and_paginated():
+    rows = [r for r in _mk_records(0, 300, start=50) if r[0] % 3 == 0]
+    with FakeBroker(
+        "wire.topic", {0: rows}, compression=kc.COMPRESSION_LZ4, modern=True
+    ) as broker:
+        result = _scan_via_wire(broker, overrides={"check.crcs": "true"})
+    assert result.metrics.overall_count == len(rows)
+    assert result.end_offsets == {0: 349}
+
+
+def test_api_versions_downgrade_dance():
+    """The client offers ApiVersions v3 first (KIP-511); a classic broker
+    rejects it with error 35 in v0 format and the client retries at v0 —
+    same negotiation result, no eviction, same connection."""
+    with FakeBroker("wire.topic", {0: _mk_records(0, 20)}) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", "wire.topic")
+        conn = src._any_conn()
+        assert src._version(conn, kc.API_METADATA) == 5  # classic fallback
+        assert conn.api_versions  # handshake completed despite the 35
         src.close()
