@@ -180,12 +180,60 @@ def test_response_decoders_total_on_garbage(buf):
         kc.decode_fetch_response,
         kc.decode_api_versions_response,
     ):
-        try:
-            decoder(kc.ByteReader(buf))
-        except kc.KafkaProtocolError:
-            pass
-        except MemoryError:
-            raise AssertionError("decoder allocated unbounded memory")
+        # Classic AND flexible wire formats: both read untrusted bytes.
+        for version in (1, 4, 7, 12):
+            try:
+                decoder(kc.ByteReader(buf), version)
+            except (kc.KafkaProtocolError, AssertionError):
+                # AssertionError: single-topic invariants (ntopics == 1)
+                # in the fake-broker-side request decoders' twins.
+                pass
+            except MemoryError:
+                raise AssertionError("decoder allocated unbounded memory")
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.lists(st.integers(0, 2**63 - 1), max_size=8),
+    st.lists(st.one_of(st.none(), st.text(max_size=40)), max_size=5),
+    st.lists(st.one_of(st.none(), st.binary(max_size=64)), max_size=5),
+)
+def test_flexible_primitives_roundtrip_property(uints, strings, blobs):
+    """KIP-482 compact primitives: write→read is identity for arbitrary
+    values (uvarint boundaries, empty vs null strings/bytes)."""
+    w = kc.ByteWriter()
+    for v in uints:
+        w.uvarint(v)
+    for s in strings:
+        w.compact_string(s)
+    for b in blobs:
+        w.compact_bytes(b)
+    r = kc.ByteReader(w.done())
+    assert [r.uvarint() for _ in uints] == uints
+    assert [r.compact_string() for _ in strings] == strings
+    assert [r.compact_bytes() for _ in blobs] == blobs
+    assert r.remaining() == 0
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    st.lists(
+        st.tuples(st.integers(0, 2**31 - 1), st.binary(max_size=32)),
+        max_size=6,
+    ),
+    st.binary(max_size=32),
+)
+def test_skip_tags_skips_arbitrary_tag_buffers(tag_fields, tail):
+    """Unknown tagged fields of any shape are skipped exactly (forward
+    compatibility contract), leaving the reader at the following field."""
+    w = kc.ByteWriter()
+    w.uvarint(len(tag_fields))
+    for tag, data in tag_fields:
+        w.uvarint(tag).uvarint(len(data)).raw(data)
+    w.raw(tail)
+    r = kc.ByteReader(w.done())
+    r.skip_tags()
+    assert bytes(r._take(r.remaining())) == tail
 
 
 def test_invalid_utf8_string_is_protocol_error():
