@@ -214,13 +214,14 @@ def main() -> int:
 
     import jax
 
+    from kafka_topic_analyzer_tpu.jax_support import detect_cpu_fallback
+
     platform = jax.devices()[0].platform
     # A fast-FAILING accelerator plugin leaves jax on host CPU without
     # tripping the watchdog (e.g. under an orchestrator's KTA_ACCEL_OK=1
     # verdict that predates the failure): flag it rather than report an
-    # unflagged CPU number.  An explicit KTA_JAX_PLATFORMS=cpu is a
-    # deliberate choice, not degradation.
-    if platform == "cpu" and not os.environ.get("KTA_JAX_PLATFORMS"):
+    # unflagged CPU number.
+    if detect_cpu_fallback():
         degraded = True
 
     if args.batch_size is None:
@@ -300,7 +301,9 @@ def main() -> int:
         "platform": platform,
     }
     if degraded:
-        result["degraded_cpu_fallback"] = True
+        from kafka_topic_analyzer_tpu.jax_support import mark_degraded
+
+        mark_degraded(result)
 
     # Measured breakdown (VERDICT r1 items 1/5): where does the streamed
     # number bind?  (a) host->device bandwidth — on this rig an SSH-tunneled

@@ -103,6 +103,15 @@ def analyzer_step(
             # apply them in source order (chunk s holds records
             # [s*C, (s+1)*C) of the data row's batch, and all_gather
             # stacks by axis index, so gathered order == record order).
+            #
+            # Documented trade-off (ADVICE r2): the unrolled loop applies
+            # all S chunks on EVERY space shard, so per-step bitmap work
+            # (and trace size) is replicated S-fold instead of scaling
+            # down with the space axis.  Acceptable at the small S this
+            # targets (2-4 on one slice); if large space meshes become a
+            # target, switch to a fori_loop over a stacked pair array or
+            # pre-route pairs by slot range so each shard applies only
+            # its own slots.
             slots = lax.all_gather(arrays["alive_slot"], space_axis)
             flags = lax.all_gather(arrays["alive_flag"], space_axis)
             counts = lax.all_gather(arrays["n_pairs"], space_axis)

@@ -50,7 +50,9 @@ def config_fingerprint(config: AnalyzerConfig, topic: str) -> str:
     payload = json.dumps(
         # state_version: bump whenever the AnalyzerState layout changes so
         # stale snapshots are rejected instead of shape-erroring.
-        {"topic": topic, "state_version": 2, **fields},
+        # v3: space_shards>1 meshes changed record-parallel leaves from D
+        # to D*S leading rows (parallel/sharded.py, r2 commit 9409a31).
+        {"topic": topic, "state_version": 3, **fields},
         sort_keys=True,
     )
     return hashlib.sha256(payload.encode()).hexdigest()[:16]

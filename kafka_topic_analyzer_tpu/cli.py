@@ -168,12 +168,23 @@ def parse_timestamp_ms(text: str) -> int:
 
 
 def parse_mesh(text: str) -> "tuple[int, int]":
-    parts = [int(x) for x in text.split(",") if x]
-    if len(parts) == 1:
-        return (parts[0], 1)
-    if len(parts) == 2:
-        return (parts[0], parts[1])
-    raise ValueError(f"bad --mesh {text!r}")
+    try:
+        parts = [int(x) for x in text.split(",") if x]
+    except ValueError:
+        raise ValueError(
+            f"bad --mesh {text!r}: expected DATA or DATA,SPACE device "
+            "counts (integers, e.g. '4' or '4,2')"
+        ) from None
+    if len(parts) not in (1, 2):
+        raise ValueError(
+            f"bad --mesh {text!r}: expected 1 or 2 comma-separated device "
+            f"counts, got {len(parts)}"
+        )
+    if any(p < 1 for p in parts):
+        raise ValueError(
+            f"bad --mesh {text!r}: device counts must be positive"
+        )
+    return (parts[0], parts[1] if len(parts) == 2 else 1)
 
 
 def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object":

