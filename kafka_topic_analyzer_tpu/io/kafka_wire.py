@@ -742,15 +742,16 @@ class KafkaWireSource(RecordSource):
             nonlocal pend, pend_count
             if not (pend_count >= batch_size or (force and pend_count)):
                 return
-            # Concat ONCE, yield consecutive slices, keep one remainder —
-            # re-concatenating per yielded batch would be O(R^2) copying.
+            # Concat ONCE, yield consecutive zero-copy slice views, keep one
+            # remainder — re-concatenating per yielded batch would be O(R^2)
+            # copying, and take(arange) would re-copy every column per yield.
             full = RecordBatch.concat(pend)
             lo = 0
             while len(full) - lo >= batch_size or (force and lo < len(full)):
                 hi = min(lo + batch_size, len(full))
-                yield full.take(np.arange(lo, hi))
+                yield full.slice(lo, hi)
                 lo = hi
-            rest = full.take(np.arange(lo, len(full)))
+            rest = full.slice(lo, len(full))
             pend = [rest] if len(rest) else []
             pend_count = len(rest)
 
@@ -759,6 +760,36 @@ class KafkaWireSource(RecordSource):
             if len(chunk):
                 pend.append(chunk)
                 pend_count += len(chunk)
+
+        def accept_records(soa: "dict[str, np.ndarray]", p: int) -> int:
+            """Push the records of a decoded SoA chunk that fall in
+            [next_offset[p], end[p]) and advance next_offset; returns the
+            accepted count.  Offsets increase within a Kafka record set, so
+            the in-range run is a contiguous slice found by searchsorted
+            (columns become views, no per-column mask copies); a broker
+            violating the ordering contract falls back to a boolean mask."""
+            offs = soa["offsets"]
+            if len(offs) == 0:
+                return 0
+            a, b = next_offset[p], end[p]
+            if bool((offs[1:] > offs[:-1]).all()):
+                lo = int(np.searchsorted(offs, a, "left"))
+                hi = int(np.searchsorted(offs, b, "left"))
+                if hi <= lo:
+                    return 0
+                sel: "slice | np.ndarray" = slice(lo, hi)
+                cnt = hi - lo
+                last = int(offs[hi - 1])
+            else:
+                idx = np.flatnonzero((offs >= a) & (offs < b))
+                if len(idx) == 0:
+                    return 0
+                sel = idx
+                cnt = len(idx)
+                last = int(offs[idx[-1]])
+            push_chunk(_chunk_to_batch(soa, sel, p))
+            next_offset[p] = last + 1
+            return cnt
 
         use_native_decode = self.use_native_hashing
         if use_native_decode:
@@ -1041,12 +1072,8 @@ class KafkaWireSource(RecordSource):
                         )
                         if used:
                             max_frame_end = max(max_frame_end, covered)
-                            offs = soa["offsets"]
-                            mask = (offs >= next_offset[p]) & (offs < end[p])
-                            cnt = int(np.count_nonzero(mask))
+                            cnt = accept_records(soa, p)
                             if cnt:
-                                push_chunk(_chunk_to_batch(soa, mask, p))
-                                next_offset[p] = int(offs[mask][-1]) + 1
                                 consumed += cnt
                                 progressed = True
                             data = data[used:] if used < len(data) else b""
@@ -1065,15 +1092,11 @@ class KafkaWireSource(RecordSource):
                             else None
                         )
                         if chunk is not None:
-                            offs = chunk["offsets"]
                             # Keep records in [next_offset, end): compressed
                             # batches can start earlier; records past the
                             # snapshot watermark are out of scope.
-                            mask = (offs >= next_offset[p]) & (offs < end[p])
-                            cnt = int(np.count_nonzero(mask))
+                            cnt = accept_records(chunk, p)
                             if cnt:
-                                push_chunk(_chunk_to_batch(chunk, mask, p))
-                                next_offset[p] = int(offs[mask][-1]) + 1
                                 consumed += cnt
                                 progressed = True
                             continue
@@ -1206,27 +1229,38 @@ class KafkaWireSource(RecordSource):
         return records_to_batch(rows, use_native=self.use_native_hashing)
 
 
-def _chunk_to_batch(chunk: "dict[str, np.ndarray]", mask: np.ndarray, partition: int) -> RecordBatch:
+def _chunk_to_batch(
+    chunk: "dict[str, np.ndarray]", sel, partition: int
+) -> RecordBatch:
     """Native-decoded SoA frame (io/native.py::decode_records_native) →
-    RecordBatch for the masked records."""
-    idx = np.nonzero(mask)[0]
-    n = len(idx)
-    ts_ms = chunk["ts_ms"][idx]
+    RecordBatch for the selected records.
+
+    ``sel`` is a slice (the hot path: in-range records are a contiguous run
+    because offsets increase within a record set — columns become zero-copy
+    VIEWS of the freshly-allocated SoA buffers) or an index array (the
+    fallback when a broker violates the ordering contract).  Bool columns
+    are reinterpreted with ``.view``, not ``astype`` — the decoder writes
+    0/1 uint8."""
+    offs = chunk["offsets"][sel]
+    n = len(offs)
+    ts_ms = chunk["ts_ms"][sel]
+    if isinstance(sel, slice):
+        ts_ms = ts_ms.copy()  # about to clamp in place; don't mutate the SoA
     # Missing timestamps (-1) report as 0 ms (``to_millis().unwrap_or(0)``,
     # src/metric.rs:209) — matching records_to_batch.
-    ts_ms = np.where(ts_ms < 0, 0, ts_ms)
+    np.maximum(ts_ms, 0, out=ts_ms)
     batch = RecordBatch(
         partition=np.full(n, partition, dtype=np.int32),
-        key_len=chunk["key_len"][idx],
-        value_len=chunk["value_len"][idx],
-        key_null=chunk["key_null"][idx].astype(np.bool_),
-        value_null=chunk["value_null"][idx].astype(np.bool_),
+        key_len=chunk["key_len"][sel],
+        value_len=chunk["value_len"][sel],
+        key_null=chunk["key_null"][sel].view(np.bool_),
+        value_null=chunk["value_null"][sel].view(np.bool_),
         ts_s=ts_ms // 1000,
-        key_hash32=chunk["key_hash32"][idx],
-        key_hash64=chunk["key_hash64"][idx],
+        key_hash32=chunk["key_hash32"][sel],
+        key_hash64=chunk["key_hash64"][sel],
         valid=np.ones(n, dtype=np.bool_),
     )
-    batch.offsets = chunk["offsets"][idx].copy()
+    batch.offsets = offs
     return batch
 
 

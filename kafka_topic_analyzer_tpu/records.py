@@ -117,6 +117,8 @@ class RecordBatch:
     def concat(cls, batches: "list[RecordBatch]") -> "RecordBatch":
         if not batches:
             return cls.empty()
+        if len(batches) == 1:
+            return batches[0]  # treated immutably everywhere: safe to share
         out = cls(
             **{
                 name: np.concatenate([getattr(b, name) for b in batches])
@@ -133,6 +135,18 @@ class RecordBatch:
         )
         if self.offsets is not None:
             out.offsets = self.offsets[idx]
+        return out
+
+    def slice(self, lo: int, hi: int) -> "RecordBatch":
+        """Zero-copy view of rows [lo, hi) — the hot path's re-batching uses
+        this instead of ``take(arange(lo, hi))`` (which fancy-index-copies
+        every column).  Views alias this batch's buffers; downstream
+        consumers copy at pack/pad time and never mutate in place."""
+        out = RecordBatch(
+            **{name: getattr(self, name)[lo:hi] for name, _ in self.FIELDS}
+        )
+        if self.offsets is not None:
+            out.offsets = self.offsets[lo:hi]
         return out
 
     def as_dict(self) -> "dict[str, np.ndarray]":

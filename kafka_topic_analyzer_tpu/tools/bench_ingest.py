@@ -1,0 +1,181 @@
+"""Host-ingest capacity benchmark: where does the wire drain bind, and what
+is the per-core ceiling?  (VERDICT r2 next #3.)
+
+Three measurements, one JSON line:
+
+1. ``drain_msgs_per_sec`` — wall-clock drain through a real loopback TCP
+   broker (the honest single-stream number; on a 1-core container the
+   serving process shares the core, so this UNDERSTATES a dedicated core).
+2. ``drain_cpu_msgs_per_sec`` — records / client-process CPU seconds
+   (``os.times``; the spawned broker is excluded): the rate one dedicated
+   core sustains INCLUDING its share of kernel TCP receive work.
+3. ``pipeline_msgs_per_sec`` — the socket-free client pipeline (native
+   record-set scan + decode + range-accept + re-batching) over pre-built
+   wire buffers: the per-core capacity when bytes arrive for free (in
+   production, NIC/softirq work lands on other cores and the remote
+   broker's send cost is not ours).
+
+The per-core ceiling analysis derived from these lives in BENCH_NOTES.md.
+This replaces profiling the reference's consume loop (src/kafka.rs:92-135,
+whose published figure is 590,221 msgs/s end to end).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import sys
+import time
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+
+
+def _patched_record_sets(templates: "list[bytes]", windows: int,
+                         records_per_batch: int,
+                         frames_per_set: int = 8) -> "list[bytes]":
+    """Record sets of ``frames_per_set`` consecutive base_offset-patched
+    template frames (the first 8 bytes of a v2 frame are not CRC-covered) —
+    the same multi-frame-per-response shape real fetch responses have, so
+    per-decode-call fixed costs are amortized like the wire client's."""
+    out = []
+    group = bytearray()
+    for w in range(windows):
+        t = bytearray(templates[w % len(templates)])
+        struct.pack_into(">q", t, 0, w * records_per_batch)
+        group += t
+        if (w + 1) % frames_per_set == 0:
+            out.append(bytes(group))
+            group = bytearray()
+    if group:
+        out.append(bytes(group))
+    return out
+
+
+def measure_pipeline(record_sets: "list[bytes]", total_records: int,
+                     batch_size: int, verify_crc: bool) -> "tuple[int, float]":
+    """Drive scan → decode → accept → re-batch over in-memory buffers,
+    mirroring the wire client's per-response hot path (kafka_wire.py
+    fetch_leader phase 1 + accept_records + flush)."""
+    from kafka_topic_analyzer_tpu.io.kafka_wire import _chunk_to_batch
+    from kafka_topic_analyzer_tpu.io.native import (
+        decode_record_set_native,
+        scan_record_set_native,
+    )
+    from kafka_topic_analyzer_tpu.records import RecordBatch
+
+    total = total_records
+    pend: "list[RecordBatch]" = []
+    pend_count = 0
+    n_out = 0
+    t0 = time.perf_counter()
+    for rs in record_sets:
+        prescan = scan_record_set_native(rs, verify_crc)
+        soa, used, covered = decode_record_set_native(
+            rs, verify_crc, prescan=prescan
+        )
+        offs = soa["offsets"]
+        hi = int(np.searchsorted(offs, total, "left"))
+        pend.append(_chunk_to_batch(soa, slice(0, hi), 0))
+        pend_count += hi
+        if pend_count >= batch_size:
+            full = RecordBatch.concat(pend)
+            lo = 0
+            while len(full) - lo >= batch_size:
+                n_out += len(full.slice(lo, lo + batch_size))
+                lo += batch_size
+            rest = full.slice(lo, len(full))
+            pend = [rest] if len(rest) else []
+            pend_count = len(rest)
+    n_out += pend_count
+    return n_out, time.perf_counter() - t0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--records", type=int, default=20_000_000)
+    ap.add_argument("--partitions", type=int, default=16)
+    ap.add_argument("--records-per-batch", type=int, default=4096)
+    ap.add_argument("--templates", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--vmin", type=int, default=100)
+    ap.add_argument("--vmax", type=int, default=420)
+    ap.add_argument("--check-crcs", action="store_true")
+    ap.add_argument("--repeat", type=int, default=3,
+                    help="pipeline passes; best is reported (capacity is a "
+                         "max — interference on a shared box only subtracts)")
+    ap.add_argument("--skip-drain", action="store_true",
+                    help="only the socket-free pipeline measurement")
+    args = ap.parse_args(argv)
+
+    from kafka_topic_analyzer_tpu.tools.bench_e2e import (
+        BrokerProcess,
+        build_templates,
+    )
+
+    doc: "dict[str, object]" = {"metric": "ingest", "nproc": os.cpu_count()}
+
+    # --- 3: socket-free pipeline capacity --------------------------------
+    templates = build_templates(
+        args.records_per_batch, args.templates, args.vmin, args.vmax
+    )
+    windows = max(args.records // args.records_per_batch, 1)
+    record_sets = _patched_record_sets(
+        templates, windows, args.records_per_batch
+    )
+    rates = []
+    for _ in range(max(args.repeat, 1)):
+        n, dt = measure_pipeline(
+            record_sets, windows * args.records_per_batch, args.batch_size,
+            args.check_crcs,
+        )
+        rates.append(n / dt)
+    doc["pipeline_msgs_per_sec"] = round(max(rates))
+    doc["pipeline_runs"] = [round(r) for r in rates]
+    print(
+        f"bench_ingest: pipeline {n} records, best of {len(rates)}: "
+        f"{max(rates):,.0f}/s (socket-free)", file=sys.stderr,
+    )
+
+    # --- 1+2: loopback TCP drain + client-CPU rate -----------------------
+    del record_sets, templates  # ~6 GB at default size; the drain phase
+    #                             must not run (or swap) under dead RSS
+    if not args.skip_drain:
+        from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+        pwindows = max(args.records // (args.partitions *
+                                        args.records_per_batch), 1)
+        with BrokerProcess(
+            topic="bench-ingest", partitions=args.partitions,
+            windows=pwindows, R=args.records_per_batch,
+            n_templates=args.templates, vmin=args.vmin, vmax=args.vmax,
+            compression=kc.COMPRESSION_NONE, tombstone_every=0, brokers=1,
+        ) as port:
+            src = KafkaWireSource(f"127.0.0.1:{port}", "bench-ingest")
+            got = 0
+            c0 = os.times()
+            t0 = time.perf_counter()
+            for batch in src.batches(args.batch_size):
+                got += len(batch)
+            wall = time.perf_counter() - t0
+            c1 = os.times()
+            src.close()
+        cpu = (c1.user - c0.user) + (c1.system - c0.system)
+        doc["drain_msgs_per_sec"] = round(got / wall)
+        doc["drain_cpu_msgs_per_sec"] = round(got / cpu) if cpu else None
+        doc["drain_user_cpu_s"] = round(c1.user - c0.user, 2)
+        doc["drain_sys_cpu_s"] = round(c1.system - c0.system, 2)
+        print(
+            f"bench_ingest: drain {got} records wall={wall:.2f}s "
+            f"cpu={cpu:.2f}s", file=sys.stderr,
+        )
+
+    print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,13 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--native", choices=["auto", "on", "off"], default="auto")
     args = ap.parse_args(argv)
 
-    spec = spec_from_kv(args.synthetic)
+    try:
+        spec = spec_from_kv(args.synthetic)
+    except ValueError as e:
+        # Same clean one-line reporting as the analyzer CLI's
+        # user_input_phase (the messages name the offending key).
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     src: SyntheticSource
     if args.native in ("auto", "on"):
         try:
