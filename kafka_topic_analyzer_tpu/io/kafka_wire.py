@@ -742,18 +742,10 @@ class KafkaWireSource(RecordSource):
             nonlocal pend, pend_count
             if not (pend_count >= batch_size or (force and pend_count)):
                 return
-            # Concat ONCE, yield consecutive zero-copy slice views, keep one
-            # remainder — re-concatenating per yielded batch would be O(R^2)
-            # copying, and take(arange) would re-copy every column per yield.
-            full = RecordBatch.concat(pend)
-            lo = 0
-            while len(full) - lo >= batch_size or (force and lo < len(full)):
-                hi = min(lo + batch_size, len(full))
-                yield full.slice(lo, hi)
-                lo = hi
-            rest = full.slice(lo, len(full))
-            pend = [rest] if len(rest) else []
-            pend_count = len(rest)
+            out, pend, pend_count = RecordBatch.resplit(
+                pend, batch_size, force
+            )
+            yield from out
 
         def push_chunk(chunk: RecordBatch) -> None:
             nonlocal pend_count

@@ -149,6 +149,25 @@ class RecordBatch:
             out.offsets = self.offsets[lo:hi]
         return out
 
+    @classmethod
+    def resplit(
+        cls, pend: "list[RecordBatch]", batch_size: int, force: bool
+    ) -> "tuple[list[RecordBatch], list[RecordBatch], int]":
+        """Re-batch accumulated chunks to ``batch_size``: concat ONCE, cut
+        zero-copy slice views, keep one remainder.  Returns
+        (full_batches, remainder_list, remainder_count).  Shared by the
+        wire client's flush and bench_ingest so the benchmark times the
+        exact hot-path algorithm."""
+        full = cls.concat(pend)
+        out = []
+        lo = 0
+        while len(full) - lo >= batch_size or (force and lo < len(full)):
+            hi = min(lo + batch_size, len(full))
+            out.append(full.slice(lo, hi))
+            lo = hi
+        rest = full.slice(lo, len(full))
+        return out, ([rest] if len(rest) else []), len(rest)
+
     def as_dict(self) -> "dict[str, np.ndarray]":
         return {name: getattr(self, name) for name, _ in self.FIELDS}
 

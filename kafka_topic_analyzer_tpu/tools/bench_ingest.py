@@ -82,14 +82,10 @@ def measure_pipeline(record_sets: "list[bytes]", total_records: int,
         pend.append(_chunk_to_batch(soa, slice(0, hi), 0))
         pend_count += hi
         if pend_count >= batch_size:
-            full = RecordBatch.concat(pend)
-            lo = 0
-            while len(full) - lo >= batch_size:
-                n_out += len(full.slice(lo, lo + batch_size))
-                lo += batch_size
-            rest = full.slice(lo, len(full))
-            pend = [rest] if len(rest) else []
-            pend_count = len(rest)
+            out, pend, pend_count = RecordBatch.resplit(
+                pend, batch_size, force=False
+            )
+            n_out += sum(len(b) for b in out)
     n_out += pend_count
     return n_out, time.perf_counter() - t0
 
