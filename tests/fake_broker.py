@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import bisect
 import socket
+import time
 import struct
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -39,7 +40,13 @@ class FakeBroker:
         coverage_overrides: "Optional[Dict[int, Dict[int, int]]]" = None,
         message_magic: int = 2,
         control_offsets: "Optional[Dict[int, set]]" = None,
+        response_delay=None,
     ):
+        #: Optional callable (api_key, node_id) -> seconds, slept before
+        #: each response send: induces cross-leader timing skew so the
+        #: client's concurrent fetch threads interleave differently every
+        #: run (tests/test_race_stress.py).
+        self.response_delay = response_delay
         #: partition → offsets rendered as transaction control batches
         #: (commit markers) instead of data records.
         self.control_offsets = control_offsets or {}
@@ -292,6 +299,8 @@ class FakeBroker:
                         )
                 else:
                     body = self._dispatch(api_key, api_version, r)
+                if self.response_delay is not None:
+                    time.sleep(self.response_delay(api_key, self.node_id))
                 # Flexible responses use header v1 (a tag buffer after the
                 # correlation id) — except ApiVersions, which stays header
                 # v0 at every version.

@@ -1,0 +1,121 @@
+"""Default-on bounded race stress for the concurrent ingest machinery
+(SURVEY.md §5.2 — the reference is single-threaded by construction; this
+build's leader-parallel fetch pool, pipelined send-ahead, and prefetch
+threads are its concurrency surface).
+
+Strategy: the same topic served by a 4-node FakeCluster whose per-node
+response latency is randomized per run (seeded jitter), so fetch threads
+interleave differently every pass — then every pass's metrics must be
+byte-identical to the jitter-free single-broker oracle.  A race in chunk
+ordering, offset tracking, send-ahead reconciliation, or state folding
+shows up as a metrics diff; a deadlock shows up as the suite timeout.
+
+The heavyweight soak stays behind KTA_STRESS (test_utils.py); this tier is
+sized to run in every suite pass.
+"""
+
+import random
+
+import pytest
+
+from fake_broker import FakeBroker, FakeCluster
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+TOPIC = "race.topic"
+P = 8
+N_PER_P = 1500
+
+
+def _records():
+    rng = random.Random(0xACE)
+    out = {}
+    for p in range(P):
+        rows = []
+        for i in range(N_PER_P):
+            key = None if rng.random() < 0.06 else b"k%d-%d" % (p, i % 120)
+            value = (
+                None
+                if key is not None and rng.random() < 0.12
+                else bytes(rng.randrange(5, 60))
+            )
+            rows.append((i, 1_600_000_000_000 + i, key, value))
+        out[p] = rows
+    return out
+
+
+RECORDS = _records()
+
+
+def _scan(bootstrap: str) -> "tuple":
+    cfg = AnalyzerConfig(
+        num_partitions=P, batch_size=2048, count_alive_keys=True,
+        alive_bitmap_bits=20, enable_hll=True, enable_quantiles=True,
+    )
+    src = KafkaWireSource(bootstrap, TOPIC)
+    try:
+        m = run_scan(TOPIC, src, CpuExactBackend(cfg), 2048).metrics
+    finally:
+        src.close()
+    return (
+        m.overall_count, m.overall_size,
+        tuple(m.partitions), m.per_partition.tobytes(),
+        m.earliest_ts_s, m.latest_ts_s,
+        m.smallest_message, m.largest_message,
+        m.alive_keys, round(float(m.distinct_keys_hll or 0), 6),
+    )
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=200) as broker:
+        yield _scan(f"127.0.0.1:{broker.port}")
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_jittered_cluster_matches_oracle(oracle, seed):
+    rng = random.Random(seed)
+    # Per-node base skew + per-response jitter: leaders answer in a
+    # different order every round, so pipelined send-aheads and the fetch
+    # pool's phase-2 bookkeeping interleave differently each pass.
+    base = {node: rng.uniform(0, 0.004) for node in range(4)}
+
+    def delay(api_key, node_id):
+        return base[node_id] + rng.uniform(0, 0.004)
+
+    with FakeCluster(
+        TOPIC, RECORDS, n_nodes=4, max_records_per_fetch=90,
+        response_delay=delay,
+    ) as cluster:
+        got = _scan(cluster.bootstrap)
+    assert got == oracle
+
+
+def test_jittered_cluster_matches_oracle_native_off(oracle):
+    """Same interleave stress through the pure-Python decode path (the
+    native fast path short-circuits parts of the per-frame loop)."""
+    rng = random.Random(99)
+
+    def delay(api_key, node_id):
+        return rng.uniform(0, 0.003)
+
+    with FakeCluster(
+        TOPIC, RECORDS, n_nodes=4, max_records_per_fetch=90,
+        response_delay=delay,
+    ) as cluster:
+        cfg = AnalyzerConfig(
+            num_partitions=P, batch_size=2048, count_alive_keys=True,
+            alive_bitmap_bits=20, enable_hll=True, enable_quantiles=True,
+        )
+        src = KafkaWireSource(
+            cluster.bootstrap, TOPIC, use_native_hashing=False
+        )
+        try:
+            m = run_scan(TOPIC, src, CpuExactBackend(cfg), 2048).metrics
+        finally:
+            src.close()
+    assert (m.overall_count, m.alive_keys) == (oracle[0], oracle[8])
+    assert m.per_partition.tobytes() == oracle[3]
