@@ -41,21 +41,43 @@ SNAPSHOT_NAME = "scan_snapshot.npz"
 _EXECUTION_ONLY_FIELDS = ("use_pallas_counters",)
 
 
-def config_fingerprint(config: AnalyzerConfig, topic: str) -> str:
-    """Snapshot compatibility key: anything that changes state shapes or
-    fold semantics participates."""
+def _fingerprint_at(config: AnalyzerConfig, topic: str, version: int) -> str:
     fields = dataclasses.asdict(config)
     for k in _EXECUTION_ONLY_FIELDS:
         fields.pop(k, None)
     payload = json.dumps(
-        # state_version: bump whenever the AnalyzerState layout changes so
-        # stale snapshots are rejected instead of shape-erroring.
-        # v3: space_shards>1 meshes changed record-parallel leaves from D
-        # to D*S leading rows (parallel/sharded.py, r2 commit 9409a31).
-        {"topic": topic, "state_version": 3, **fields},
+        {"topic": topic, "state_version": version, **fields},
         sort_keys=True,
     )
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def config_fingerprint(config: AnalyzerConfig, topic: str) -> str:
+    """Snapshot compatibility key (the one new snapshots are stamped with):
+    anything that changes state shapes or fold semantics participates.
+
+    state_version: bump whenever the AnalyzerState layout changes so stale
+    snapshots are rejected instead of shape-erroring.  v3: space_shards>1
+    meshes changed record-parallel leaves from D to D*S leading rows
+    (parallel/sharded.py, r2 commit 9409a31).  S=1 layouts were untouched
+    by that change, so they stamp version 2 — and loaders additionally
+    accept the v3-stamped fingerprint for S=1 configs
+    (`acceptable_fingerprints`), keeping both pre-r2 AND r2/r3-era
+    single-space-shard snapshots resumable (the r2/r3 code stamped every
+    config v3)."""
+    version = 2 if config.space_shards == 1 else 3
+    return _fingerprint_at(config, topic, version)
+
+
+def acceptable_fingerprints(config: AnalyzerConfig, topic: str) -> "set[str]":
+    """All fingerprints a loader should accept for this config: the
+    canonical one, plus the v3-stamped variant for S=1 configs whose state
+    layout is identical under both version labels (see
+    config_fingerprint)."""
+    out = {config_fingerprint(config, topic)}
+    if config.space_shards == 1:
+        out.add(_fingerprint_at(config, topic, 3))
+    return out
 
 
 def _flatten(state: AnalyzerState) -> Dict[str, np.ndarray]:
@@ -142,7 +164,7 @@ def load_snapshot(
         return None
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
-        if meta["fingerprint"] != config_fingerprint(config, topic):
+        if meta["fingerprint"] not in acceptable_fingerprints(config, topic):
             raise ValueError(
                 f"snapshot at {path} was taken with a different topic/config "
                 "(fingerprint mismatch) — delete it or match the original flags"

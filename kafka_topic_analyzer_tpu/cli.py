@@ -103,7 +103,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pallas", action="store_true",
                    help="Use the Pallas MXU counter kernel for the "
                         "per-partition counters (tpu backend; requires "
-                        "batch-size % 1024 == 0)")
+                        "batch-size %% 1024 == 0)")
     p.add_argument("--distributed", metavar="COORD:PORT,PID,NPROCS",
                    help="Multi-host mode: initialize jax.distributed with the "
                         "given coordinator address, process id and process "

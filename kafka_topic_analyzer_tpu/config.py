@@ -40,9 +40,13 @@ class AnalyzerConfig:
     #: HyperLogLog distinct-key sketch (new capability; replaces the bitmap's
     #: O(2^bits) memory with O(2^hll_p) at ~1.04/sqrt(2^hll_p) rel. error).
     enable_hll: bool = False
-    #: HLL precision p (m = 2^p registers). p=14 → 0.81% standard error.
-    #: Capped at 15 so bucket indices fit the packed transfer's u16 section.
-    hll_p: int = 14
+    #: HLL precision p (m = 2^p registers). p=16 → 0.41% standard error,
+    #: holding BASELINE.md's ≤1% budget at >2σ (p=14's 0.81% rode the edge:
+    #: r3 recorded a 1.6% draw on config 3).  Capped at 16, the widest p
+    #: whose bucket indices (0..2^p-1) fit the packed transfer's u16
+    #: section; inactive records ship idx 0 with rho 0 (a scatter-max
+    #: no-op), so no out-of-range sentinel index is needed.
+    hll_p: int = 16
     #: One register file per partition instead of a single global one
     #: (implies enable_hll).  The global estimate stays exact HLL semantics:
     #: rows union by elementwise max.
@@ -97,8 +101,8 @@ class AnalyzerConfig:
             raise ValueError("batch_size must be >= 1")
         if not (0 < self.alive_bitmap_bits <= 32):
             raise ValueError("alive_bitmap_bits must be in (0, 32]")
-        if not (4 <= self.hll_p <= 15):
-            raise ValueError("hll_p must be in [4, 15]")
+        if not (4 <= self.hll_p <= 16):
+            raise ValueError("hll_p must be in [4, 16]")
         if self.quantile_buckets < 8:
             raise ValueError("quantile_buckets must be >= 8")
         if self.use_pallas_counters and self.batch_size % 1024:

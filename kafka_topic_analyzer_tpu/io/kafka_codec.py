@@ -8,13 +8,19 @@ covers the whole surface.  Both the client (`kafka_wire.py`) and the test
 fake broker use these encoders/decoders, mirroring SURVEY.md §4's
 backend-contract strategy.
 
-Implemented versions (classic encoding, no flexible/tagged fields):
-- Metadata v1–v5 (v5 is the Kafka 4.0 floor after KIP-896; the client
-  negotiates via ApiVersions v0), ListOffsets v1, Fetch v4
+Implemented versions — each API in both the classic and the KIP-482
+flexible (compact/tagged-field) encodings, negotiated per broker via
+ApiVersions (`_FLEXIBLE_FROM` below; version choice in kafka_wire.py's
+`_CANDIDATES`):
+- Metadata v1–v5 classic / v12 flexible (v5 is the Kafka 4.0 floor after
+  KIP-896), ListOffsets v1 classic / v7 flexible, Fetch v4 classic /
+  v12 flexible (sessionless: session_id 0, epoch -1), ApiVersions
+  v0 classic / v3 flexible-request (response header stays v0 per KIP-511)
+- SaslHandshake v1 + SaslAuthenticate v0 for PLAIN/SCRAM (`kafka_wire.py`)
 - RecordBatch v2 ("magic 2", Kafka >= 0.11) with zigzag-varint records;
-  compression: none, gzip (zlib), snappy (xerial framing) and LZ4 frames
-  via io/compression.py; zstd is rejected with a clear error.  v0/v1
-  MessageSets are rejected with a clear error.
+  all four codecs decode via io/compression.py: gzip (zlib), snappy
+  (xerial framing), LZ4 frames, and zstd (from-scratch RFC 8878 decoder,
+  io/zstd_py.py).  v0/v1 MessageSets are rejected with a clear error.
 """
 
 from __future__ import annotations

@@ -34,16 +34,37 @@ def force_platform(platforms: str) -> None:
         # (e.g. "tpu") also removes its platform from MLIR's known set and
         # breaks unrelated lowering registration (pallas import), while
         # builtins are never init-eager for non-selected platforms anyway.
-        builtin = {"cpu", "tpu", "cuda", "gpu", "rocm", "metal"}
         from jax._src import xla_bridge as _xb
 
         for name in [
             n for n in _xb._backend_factories
-            if n not in selected and n.lower() not in builtin
+            if n not in selected and n.lower() not in _BUILTIN_PLATFORMS
         ]:
             _xb._backend_factories.pop(name, None)
     except Exception:
         pass
+
+
+#: Builtin jax platforms — anything else registered in the backend-factory
+#: table is an out-of-tree plugin (e.g. a device tunnel) whose client init
+#: may block; force_platform and the KTA_ACCEL_OK short-circuit both key
+#: off this distinction.
+_BUILTIN_PLATFORMS = {"cpu", "tpu", "cuda", "gpu", "rocm", "metal"}
+
+
+def _plugin_platforms() -> "set[str]":
+    """Names of registered NON-builtin backend factories (lowercased).
+    Best-effort: empty on any failure, which callers treat as 'no tunnel
+    plugin present'."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        return {
+            n.lower() for n in _xb._backend_factories
+            if n.lower() not in _BUILTIN_PLATFORMS
+        }
+    except Exception:
+        return set()
 
 
 def probe_device_platform(timeout_s: float) -> "str | None":
@@ -109,11 +130,14 @@ def ensure_responsive_accelerator(timeout_s: float = 240.0) -> "bool | str":
         # via force_platform — a no-op when the override includes the
         # tunnel platform — and a platform-carrying verdict of "cpu".
         ambient = os.environ.get("JAX_PLATFORMS")
-        if ambient and "axon" not in {p.strip() for p in ambient.split(",")}:
-            # Only force when the ambient override steers AWAY from the
-            # tunnel: when it includes the tunnel platform, the
-            # sitecustomize's own config ("axon,cpu") is the working
-            # arrangement and must not be clobbered.
+        ambient_set = (
+            {p.strip().lower() for p in ambient.split(",")} if ambient else set()
+        )
+        if ambient and not (ambient_set & _plugin_platforms()):
+            # Only force when the ambient override steers AWAY from every
+            # registered tunnel plugin (not just axon's): when it includes
+            # a tunnel platform, the sitecustomize's own config (e.g.
+            # "axon,cpu") is the working arrangement — don't clobber it.
             force_platform(ambient)
         elif verdict.strip().lower() == "cpu":
             force_platform("cpu")

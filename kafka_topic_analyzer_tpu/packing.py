@@ -100,8 +100,9 @@ def dedupe_slots_numpy(
 def hll_idx_rho_numpy(
     h64: np.ndarray, active: np.ndarray, p: int
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Pre-split HLL updates: (bucket index, rho).  Inactive records get the
-    scratch bucket 2^p with rho 0."""
+    """Pre-split HLL updates: (bucket index, rho).  Inactive records get
+    bucket 0 with rho 0 — a no-op under scatter-max, so indices use the
+    full u16 range (p up to 16 inclusive) with no sentinel bucket."""
     from kafka_topic_analyzer_tpu.ops.fnv import splitmix64_np
 
     h = splitmix64_np(h64.astype(np.uint64))

@@ -157,7 +157,13 @@ class RecordBatch:
         zero-copy slice views, keep one remainder.  Returns
         (full_batches, remainder_list, remainder_count).  Shared by the
         wire client's flush and bench_ingest so the benchmark times the
-        exact hot-path algorithm."""
+        exact hot-path algorithm.
+
+        The yielded batches are views that pin the concat buffer until the
+        downstream pack/pad copies them (bounded: one in-flight batch).
+        The *remainder* would pin it across flushes — potentially for the
+        rest of the scan — so it alone is copied out (< batch_size rows,
+        amortized cost ~0; ADVICE r3)."""
         full = cls.concat(pend)
         out = []
         lo = 0
@@ -165,8 +171,17 @@ class RecordBatch:
             hi = min(lo + batch_size, len(full))
             out.append(full.slice(lo, hi))
             lo = hi
-        rest = full.slice(lo, len(full))
+        rest = full.slice(lo, len(full)).copy()
         return out, ([rest] if len(rest) else []), len(rest)
+
+    def copy(self) -> "RecordBatch":
+        """Deep-copy the columns (detach a view from its parent buffer)."""
+        out = RecordBatch(
+            **{name: getattr(self, name).copy() for name, _ in self.FIELDS}
+        )
+        if self.offsets is not None:
+            out.offsets = self.offsets.copy()
+        return out
 
     def as_dict(self) -> "dict[str, np.ndarray]":
         return {name: getattr(self, name) for name, _ in self.FIELDS}

@@ -203,3 +203,29 @@ def test_incompatible_snapshot_rejected(tmp_path):
         load_snapshot(str(tmp_path), "t", other)
     with pytest.raises(ValueError, match="fingerprint"):
         load_snapshot(str(tmp_path), "other-topic", CFG)
+
+
+def test_v3_stamped_single_shard_snapshot_still_loads(tmp_path):
+    """r2/r3 stamped EVERY config's fingerprint with state_version=3; S=1
+    layouts were identical under v2 and v3, so a v3-stamped S=1 snapshot
+    must keep loading after the v2 re-labeling (code-review r4)."""
+    import json
+
+    from kafka_topic_analyzer_tpu.checkpoint import (
+        SNAPSHOT_NAME,
+        _fingerprint_at,
+    )
+
+    be = TpuBackend(CFG, init_now_s=5)
+    save_snapshot(str(tmp_path), "t", CFG, be.get_state(), {0: 1}, 1, 5)
+    # Rewrite the stamp to what the r2/r3 code would have produced.
+    path = str(tmp_path / SNAPSHOT_NAME)
+    with np.load(path, allow_pickle=False) as z:
+        data = {k: z[k] for k in z.files}
+    meta = json.loads(str(data["__meta__"]))
+    assert CFG.space_shards == 1
+    meta["fingerprint"] = _fingerprint_at(CFG, "t", 3)
+    data["__meta__"] = np.array(json.dumps(meta))
+    np.savez(path.removesuffix(".npz"), **data)
+    loaded = load_snapshot(str(tmp_path), "t", CFG)
+    assert loaded is not None

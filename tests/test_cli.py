@@ -15,6 +15,41 @@ def test_parse_mesh():
     assert parse_mesh("4,2") == (4, 2)
 
 
+def test_help_and_version_surface(capsys):
+    """-h/--help/--version must exit 0 and render, like clap's
+    (src/main.rs:32-67 — the reference's help cannot crash).
+
+    Regression: a bare ``%`` in an argparse help string makes
+    ``format_help()`` raise ValueError at print time (r2-r3 shipped one in
+    the --pallas help), so every registered action's help is formatted
+    here, not just spot-checked flags.
+    """
+    from kafka_topic_analyzer_tpu.cli import build_parser
+
+    parser = build_parser()
+    # Every action's help string must survive argparse's %-interpolation.
+    formatter = parser._get_formatter()
+    for action in parser._actions:
+        if action.help:
+            # Same interpolation argparse applies inside format_help().
+            formatter._expand_help(action)
+    full = parser.format_help()
+    assert "--pallas" in full and "--topic" in full
+
+    for flag in ("-h", "--help"):
+        with pytest.raises(SystemExit) as e:
+            main([flag])
+        assert e.value.code == 0
+        out = capsys.readouterr().out
+        assert "kafka-topic-analyzer" in out and "--backend" in out
+
+    with pytest.raises(SystemExit) as e:
+        main(["--version"])
+    assert e.value.code == 0
+    out = capsys.readouterr().out
+    assert "kafka-topic-analyzer-tpu" in out
+
+
 def _run(capsys, extra):
     argv = [
         "-t", "unit.topic",

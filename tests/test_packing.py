@@ -71,14 +71,18 @@ def test_dedupe_numpy_last_writer_wins():
     assert result == {5: 0, 6: 1, 7: 1}  # inactive slot 9 ignored
 
 
-def test_native_pack_semantics_match_numpy():
+@pytest.mark.parametrize("hll_p", [10, 16])
+def test_native_pack_semantics_match_numpy(hll_p):
+    import dataclasses
+
     native = pytest.importorskip("kafka_topic_analyzer_tpu.io.native")
     if not native.native_available():
         pytest.skip("native shim unavailable")
+    cfg = dataclasses.replace(CFG, hll_p=hll_p)
     batch = _batch()
-    a = pack_batch(batch, CFG, use_native=False)
-    b = pack_batch(batch, CFG, use_native=True)
-    ua, ub = unpack_numpy(a, CFG), unpack_numpy(b, CFG)
+    a = pack_batch(batch, cfg, use_native=False)
+    b = pack_batch(batch, cfg, use_native=True)
+    ua, ub = unpack_numpy(a, cfg), unpack_numpy(b, cfg)
     nv = int(ua["n_valid"])
     assert nv == int(ub["n_valid"])
     for name in ("partition", "key_len", "value_len", "key_null",
@@ -141,7 +145,11 @@ def test_dedupe_native_matches_numpy():
         ), bits
 
 
-def test_hll_idx_rho_matches_reference():
+@pytest.mark.parametrize("p", [10, 16])
+def test_hll_idx_rho_matches_reference(p):
+    """p=16 is the default AND the u16 edge: max idx 65535 must survive the
+    packed section round trip (the old sentinel-bucket design would have
+    overflowed here)."""
     from kafka_topic_analyzer_tpu.ops.fnv import splitmix64
 
     rng = np.random.default_rng(4)
@@ -149,7 +157,6 @@ def test_hll_idx_rho_matches_reference():
     # make some values produce long rho runs
     h64[:4] = [0, 1, 1 << 50, (1 << 64) - 1]
     active = np.ones(1000, dtype=bool)
-    p = 10
     idx, rho = hll_idx_rho_numpy(h64, active, p)
     for i in range(64):
         h = splitmix64(int(h64[i]))
