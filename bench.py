@@ -197,6 +197,11 @@ def main() -> int:
                          "error distribution (mean/max reported alongside "
                          "the main run's draw, so a single ±2σ draw can't "
                          "masquerade as the sketch's accuracy — r3 weak #2)")
+    ap.add_argument("--accuracy-seed-batches", type=int, default=None,
+                    help="batches per accuracy seed (default: same as "
+                         "--batches, so the seed distribution is measured at "
+                         "the SAME cardinality as the main draw — HLL error "
+                         "depends on cardinality, r4 weak #5)")
     args = ap.parse_args()
     if args.config:
         preset = CONFIGS[args.config]
@@ -374,11 +379,21 @@ def main() -> int:
 
         # Error DISTRIBUTION over independent seeds: one draw cannot tell a
         # within-budget sketch from a lucky one (r3's config-3 record was a
-        # ~2σ draw read as the truth).  Each seed gets its own dataset;
-        # shapes are identical so the jitted step is compile-cache warm.
+        # ~2σ draw read as the truth).  Each seed gets its own dataset at
+        # the SAME batch count as the main run — HLL error is a function of
+        # cardinality, so a smaller per-seed dataset would measure a
+        # different distribution than the headline draw's (r4 weak #5).
+        # Shapes are identical so the jitted step is compile-cache warm.
         seed_errs_hll: "list[float]" = []
         seed_errs_q: "list[float]" = []
-        acc_batches = min(args.batches, 4)
+        acc_batches = (args.accuracy_seed_batches
+                       if args.accuracy_seed_batches is not None
+                       else args.batches)
+        if acc_batches < 1:
+            ap.error("--accuracy-seed-batches must be >= 1")
+        if args.accuracy_seeds > 0:
+            result["accuracy_seed_batches"] = acc_batches
+            result["accuracy_seed_records"] = acc_batches * args.batch_size
         for s in range(max(0, args.accuracy_seeds)):
             import dataclasses as _dc
 
