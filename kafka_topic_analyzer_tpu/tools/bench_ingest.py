@@ -27,11 +27,66 @@ import json
 import os
 import struct
 import sys
+import threading
 import time
 
 import numpy as np
 
 from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+
+
+class _StallSampler(threading.Thread):
+    """Measures the client process's longest GIL-held stretches during a
+    drain: a thread asking for a 1 ms sleep can only resume once it can
+    re-acquire the GIL, so (observed - requested) bounds the serialized
+    GIL-held share that would block a second drain thread (VERDICT r3 #5 /
+    r4 #3 — is the 3.1M rec/s/core x N-core extrapolation killed by the
+    GIL?).  On a 1-core box, OS timeslices granted to the broker child
+    land in the same delay, so this is an UPPER bound on GIL stalls."""
+
+    def __init__(self) -> None:
+        super().__init__(daemon=True)
+        self.delays: "list[float]" = []
+        # NB: not named _stop — threading.Thread uses a _stop() method
+        # internally; shadowing it with an Event breaks join().
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            t0 = time.perf_counter()
+            time.sleep(0.001)
+            self.delays.append(time.perf_counter() - t0 - 0.001)
+
+    def finish(self) -> "dict[str, float]":
+        self._halt.set()
+        self.join(2)
+        if not self.delays:
+            return {}
+        d = np.sort(np.asarray(self.delays))
+        return {
+            "gil_stall_p50_ms": round(float(d[len(d) // 2]) * 1e3, 2),
+            "gil_stall_p99_ms": round(float(d[int(len(d) * 0.99)]) * 1e3, 2),
+            "gil_stall_max_ms": round(float(d[-1]) * 1e3, 2),
+        }
+
+
+def _drain_stream(port: int, topic: str, batch_size: int, barrier,
+                  out: "list", idx: int) -> None:
+    """One stream's drain: own wire client, own loopback broker.  All
+    streams rendezvous after connection setup so the timed window measures
+    concurrent drains, not staggered ones."""
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+    src = KafkaWireSource(f"127.0.0.1:{port}", topic)
+    try:
+        barrier.wait(timeout=120)
+        got = 0
+        t0 = time.perf_counter()
+        for batch in src.batches(batch_size):
+            got += len(batch)
+        out[idx] = (got, time.perf_counter() - t0)
+    finally:
+        src.close()
 
 
 def _patched_record_sets(templates: "list[bytes]", windows: int,
@@ -105,7 +160,18 @@ def main(argv: "list[str] | None" = None) -> int:
                          "max — interference on a shared box only subtracts)")
     ap.add_argument("--skip-drain", action="store_true",
                     help="only the socket-free pipeline measurement")
+    ap.add_argument("--streams", type=int, default=1,
+                    help="concurrent loopback drains in ONE process (each "
+                         "stream gets its own broker child + wire client + "
+                         "thread).  Tests whether the leader-parallel pool's "
+                         "N-core scaling claim survives the GIL: the native "
+                         "decode releases the GIL (ctypes.CDLL), so N "
+                         "streams should aggregate close to the 1-stream "
+                         "CPU rate x available cores, and the reported "
+                         "gil_stall_* percentiles bound the serialized share")
     args = ap.parse_args(argv)
+    if args.streams < 1:
+        ap.error("--streams must be >= 1")
 
     from kafka_topic_analyzer_tpu.tools.bench_e2e import (
         BrokerProcess,
@@ -140,33 +206,63 @@ def main(argv: "list[str] | None" = None) -> int:
     del record_sets, templates  # ~6 GB at default size; the drain phase
     #                             must not run (or swap) under dead RSS
     if not args.skip_drain:
-        from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+        from contextlib import ExitStack
 
-        pwindows = max(args.records // (args.partitions *
+        n_streams = args.streams
+        pwindows = max(args.records // (n_streams * args.partitions *
                                         args.records_per_batch), 1)
-        with BrokerProcess(
-            topic="bench-ingest", partitions=args.partitions,
-            windows=pwindows, R=args.records_per_batch,
-            n_templates=args.templates, vmin=args.vmin, vmax=args.vmax,
-            compression=kc.COMPRESSION_NONE, tombstone_every=0, brokers=1,
-        ) as port:
-            src = KafkaWireSource(f"127.0.0.1:{port}", "bench-ingest")
-            got = 0
+        with ExitStack() as stack:
+            ports = [
+                stack.enter_context(BrokerProcess(
+                    topic=f"bench-ingest-{i}", partitions=args.partitions,
+                    windows=pwindows, R=args.records_per_batch,
+                    n_templates=args.templates, vmin=args.vmin,
+                    vmax=args.vmax, compression=kc.COMPRESSION_NONE,
+                    tombstone_every=0, brokers=1,
+                ))
+                for i in range(n_streams)
+            ]
+            results: "list" = [None] * n_streams
+            barrier = threading.Barrier(n_streams + 1)
+            threads = [
+                threading.Thread(
+                    target=_drain_stream,
+                    args=(ports[i], f"bench-ingest-{i}", args.batch_size,
+                          barrier, results, i),
+                    daemon=True,
+                )
+                for i in range(n_streams)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait(timeout=120)  # all clients connected; start clock
+            sampler = _StallSampler()
+            sampler.start()
             c0 = os.times()
             t0 = time.perf_counter()
-            for batch in src.batches(args.batch_size):
-                got += len(batch)
+            for t in threads:
+                t.join()
             wall = time.perf_counter() - t0
             c1 = os.times()
-            src.close()
+            doc.update(sampler.finish())
+        if any(r is None for r in results):
+            raise RuntimeError("a drain stream died; see stderr")
+        got = sum(r[0] for r in results)
         cpu = (c1.user - c0.user) + (c1.system - c0.system)
+        # Aggregate rate over the CONCURRENT window (all streams started
+        # together; wall is until the last finishes).
         doc["drain_msgs_per_sec"] = round(got / wall)
         doc["drain_cpu_msgs_per_sec"] = round(got / cpu) if cpu else None
         doc["drain_user_cpu_s"] = round(c1.user - c0.user, 2)
         doc["drain_sys_cpu_s"] = round(c1.system - c0.system, 2)
+        if n_streams > 1:
+            doc["streams"] = n_streams
+            doc["stream_msgs_per_sec"] = [
+                round(r[0] / r[1]) for r in results
+            ]
         print(
-            f"bench_ingest: drain {got} records wall={wall:.2f}s "
-            f"cpu={cpu:.2f}s", file=sys.stderr,
+            f"bench_ingest: drain {got} records x{n_streams} streams "
+            f"wall={wall:.2f}s cpu={cpu:.2f}s", file=sys.stderr,
         )
 
     print(json.dumps(doc))
