@@ -90,6 +90,46 @@ def test_produce_request_body_shape():
     assert r.remaining() == 0
 
 
+def test_producer_version_negotiation_against_fake_broker():
+    """_negotiated() clamps into the advertised range via a real
+    ApiVersions round-trip, and refuses with a clear error when the
+    broker's floor is above what this producer speaks."""
+    from fake_broker import FakeBroker
+
+    from kafka_topic_analyzer_tpu.io.kafka_produce import (
+        API_PRODUCE,
+        _negotiated,
+    )
+    from kafka_topic_analyzer_tpu.io.kafka_wire import BrokerConnection
+
+    with FakeBroker(
+        "t", {0: []},
+        api_ranges={kc.API_VERSIONS: (0, 3), API_PRODUCE: (3, 9)},
+    ) as b:
+        conn = BrokerConnection("127.0.0.1", b.port)
+        try:
+            # Clamped to this module's non-flexible ceiling, not the
+            # broker's flexible max.
+            assert _negotiated(conn, API_PRODUCE, 3, 8) == 8
+            # Cached: a second call must not re-handshake.  Poison the
+            # request method so any round-trip attempt blows up.
+            conn.request = None
+            assert _negotiated(conn, API_PRODUCE, 3, 8) == 8
+        finally:
+            conn.close()
+    with FakeBroker(
+        "t", {0: []},
+        api_ranges={kc.API_VERSIONS: (0, 3), API_PRODUCE: (9, 12)},
+    ) as b:
+        conn = BrokerConnection("127.0.0.1", b.port)
+        try:
+            with pytest.raises(kc.KafkaProtocolError,
+                               match=r"v9-12.*speaks v3-8"):
+                _negotiated(conn, API_PRODUCE, 3, 8)
+        finally:
+            conn.close()
+
+
 @pytest.mark.skipif(
     not BOOT,
     reason="set KTA_KAFKA_BOOTSTRAP=host:port to run against a live broker",

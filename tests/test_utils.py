@@ -75,3 +75,58 @@ def test_soak_pipeline(monkeypatch):
         "soak", SyntheticSource(spec), TpuBackend(cfg, init_now_s=0), 1 << 17
     ).metrics
     assert m.overall_count == 4_000_000
+
+
+def test_soak_memory_is_o1(monkeypatch):
+    """The analyzer's whole point at scale is O(1) state over an unbounded
+    stream (SURVEY.md §5.7: the reference holds fixed-size counters,
+    src/metric.rs:12-26; this build adds fixed-size sketches).  Drive many
+    batches through the device backend and assert the client process RSS
+    stays flat after warmup — a per-batch leak (device buffers, packed
+    host buffers, jit cache growth) would compound over a 1B-message scan
+    long before correctness tests noticed.  Gated: soak tier."""
+    import os
+
+    if not os.environ.get("KTA_STRESS"):
+        pytest.skip("set KTA_STRESS=1 for the soak run")
+
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+    def rss_mb() -> float:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+        raise RuntimeError("no VmRSS")
+
+    cfg = AnalyzerConfig(
+        num_partitions=8, batch_size=1 << 17, count_alive_keys=True,
+        alive_bitmap_bits=24, enable_hll=True, enable_quantiles=True,
+    )
+    spec = SyntheticSpec(
+        num_partitions=8, messages_per_partition=1 << 16,
+        keys_per_partition=50_000,
+    )
+    batches = [
+        b.pad_to(cfg.batch_size)
+        for b in SyntheticSource(spec).batches(cfg.batch_size)
+    ]
+    backend = TpuBackend(cfg, init_now_s=0)
+    warmup_rounds, soak_rounds = 8, 64
+    for _ in range(warmup_rounds):
+        for b in batches:
+            backend.update(b)
+    backend.block_until_ready()
+    base = rss_mb()
+    for _ in range(soak_rounds):
+        for b in batches:
+            backend.update(b)
+    backend.block_until_ready()
+    grown = rss_mb() - base
+    n = (warmup_rounds + soak_rounds) * sum(b.num_valid for b in batches)
+    assert backend.finalize().overall_count == n
+    # Allocator jitter allowance only: 64 rounds of a real per-batch leak
+    # (one retained 2.3 MB packed buffer, say) would blow far past this.
+    assert grown < 160, f"RSS grew {grown:.0f} MB over {soak_rounds} rounds"
