@@ -43,6 +43,25 @@ def make_packed_step(config: AnalyzerConfig):
     return step
 
 
+class StagedBatch:
+    """A batch already packed and launched host→device.
+
+    Produced by ``TpuBackend.prepare`` — designed to run on a prefetch
+    worker thread (engine.run_scan stages there), so the pack (native,
+    GIL-released) and the async ``device_put`` transfer both overlap the
+    device's current step instead of serializing in front of the next
+    dispatch.  The explicit double-buffered host→device pipeline
+    SURVEY.md §7 M5 calls for; prefetch depth bounds in-flight buffers.
+    Deliberately just a typed buffer: all bookkeeping (counts, bytes,
+    offsets) stays with the decoded batch the engine already holds.
+    """
+
+    __slots__ = ("buf",)
+
+    def __init__(self, buf):
+        self.buf = buf
+
+
 def self_check_unpack(device=None) -> None:
     """One-time guard: pack a known batch on the host, unpack it on the
     device, and compare — catches any bitcast/byte-order mismatch before it
@@ -97,7 +116,17 @@ class TpuBackend(MetricBackend):
             self.state = AnalyzerState.init(config)
         self._step = jax.jit(make_packed_step(config), donate_argnums=(0,))
 
-    def update(self, batch: RecordBatch) -> None:
+    def prepare(self, batch: RecordBatch) -> StagedBatch:
+        """Pack + start the host→device transfer for a batch that will be
+        fed to ``update`` later.  Safe to call from a worker thread (jax
+        dispatch is thread-safe; the packers are pure numpy/C++)."""
+        buf = pack_batch(batch, self.config, use_native=self.use_native)
+        return StagedBatch(jax.device_put(buf, self.device))
+
+    def update(self, batch: "RecordBatch | StagedBatch") -> None:
+        if isinstance(batch, StagedBatch):
+            self.state = self._step(self.state, batch.buf)
+            return
         buf = pack_batch(batch, self.config, use_native=self.use_native)
         self.state = self._step(self.state, jax.device_put(buf, self.device))
 

@@ -254,16 +254,45 @@ def run_scan(
                 maybe_snapshot()
                 spinner.set_message(f"[Sq: {seq} | T: {topic} | shards: {d}]")
         else:
+            # Backends with a `prepare` method (the packed single-device
+            # path) stage INSIDE the prefetch worker: remap + pack (native,
+            # GIL-released) + the async host→device transfer all overlap
+            # the device's current step, so the main thread only does
+            # bookkeeping and step dispatch.  The decoded batch travels
+            # alongside for progress/snapshot bookkeeping and MUST keep its
+            # true partition ids (remap_batch mutates in place; the tracker
+            # keys snapshots by true id), so the worker packs a shallow
+            # copy carrying the dense ids instead.  Prefetch depth bounds
+            # the in-flight device buffers.
+            prepare = getattr(backend, "prepare", None)
+
+            def _with_staging(it):
+                if prepare is None:
+                    return ((b, None) for b in it)
+
+                def _dense_view(b):
+                    if pindex.ids == list(range(len(pindex.ids))):
+                        return b  # already dense; nothing to rewrite
+                    return dataclasses.replace(
+                        b, partition=pindex.to_dense(b.partition)
+                    )
+
+                return ((b, prepare(_dense_view(b))) for b in it)
+
             batches = _closing(
                 prefetch(
-                    source.batches(batch_size, start_at=start_at), prefetch_depth
+                    _with_staging(
+                        source.batches(batch_size, start_at=start_at)
+                    ),
+                    prefetch_depth,
                 )
             )
             while True:
                 with profile.stage("ingest"):
-                    batch = next(batches, None)
-                if batch is None:
+                    item = next(batches, None)
+                if item is None:
                     break
+                batch, staged = item
                 nvalid = batch.num_valid
                 last = len(batch) - 1
                 last_partition = int(batch.partition[last])  # true id, pre-remap
@@ -273,9 +302,14 @@ def run_scan(
                     else "~"  # gapless sources don't carry offsets
                 )
                 tracker.observe(batch, batch.partition)
-                batch = pindex.remap_batch(batch)
-                with profile.stage("dispatch", items=nvalid, nbytes=batch.nbytes):
-                    backend.update(batch)
+                if staged is None:
+                    staged = pindex.remap_batch(batch)
+                # nbytes is always the DECODED batch size (remap doesn't
+                # change it) so the stat stays comparable across backends.
+                with profile.stage(
+                    "dispatch", items=nvalid, nbytes=batch.nbytes,
+                ):
+                    backend.update(staged)
                 seq += nvalid
                 maybe_snapshot()
                 # indicatif-template message like src/kafka.rs:111-113.

@@ -131,3 +131,79 @@ def test_full_stack_interrupt_resume(tmp_path):
     assert result.end_offsets == {
         p: rows[-1][0] + 1 for p, rows in _records().items()
     }
+
+
+def test_non_dense_partitions_staged_scan_snapshots_true_ids(tmp_path):
+    """Engine staging (pack on the prefetch worker) must not disturb the
+    true-partition-id bookkeeping: remap_batch mutates in place, so the
+    worker packs a dense COPY.  A topic with ids {3,4,5} is scanned with
+    snapshots on; the snapshot must key next_offsets by TRUE ids and a
+    resume must not double-count (the exact regression a staged in-place
+    remap would cause)."""
+    from fake_broker import FakeBroker
+
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.checkpoint import load_snapshot
+    from kafka_topic_analyzer_tpu.io.kafka_wire import records_to_batch
+
+    records = {
+        p: [
+            (off, 1_600_000_000_000 + off * 500,
+             f"p{p}-k{off % 37}".encode() if off % 7 else None,
+             None if off % 13 == 5 else bytes(10 + (off * 3 + p) % 60))
+            for off in range(600)
+        ]
+        for p in (3, 4, 5)
+    }
+    cfg = AnalyzerConfig(
+        num_partitions=3, batch_size=256, count_alive_keys=True,
+        alive_bitmap_bits=16,
+    )
+    with FakeBroker("gap.topic", records) as b:
+        src = KafkaWireSource(f"127.0.0.1:{b.port}", "gap.topic")
+        try:
+            result = run_scan(
+                "gap.topic", src, TpuBackend(cfg, init_now_s=0), 256,
+                snapshot_dir=str(tmp_path), snapshot_every_s=0.0,
+            )
+        finally:
+            src.close()
+        snap = load_snapshot(str(tmp_path), "gap.topic", cfg)
+        assert snap is not None
+        _, next_offsets, records_seen, _ = snap
+        # Keys are TRUE partition ids at their end offsets, not dense rows.
+        assert next_offsets == {3: 600, 4: 600, 5: 600}
+        assert records_seen == 1800
+
+        # Resume from the completed snapshot: nothing left to scan, and
+        # metrics must come back identical (no double counting).
+        src2 = KafkaWireSource(f"127.0.0.1:{b.port}", "gap.topic")
+        try:
+            resumed = run_scan(
+                "gap.topic", src2, TpuBackend(cfg, init_now_s=0), 256,
+                snapshot_dir=str(tmp_path), resume=True,
+            )
+        finally:
+            src2.close()
+
+    m = result.metrics
+    assert m.partitions == [3, 4, 5]
+    assert m.overall_count == 1800
+    oracle = CpuExactBackend(cfg, init_now_s=0)
+    rows = [
+        (p, ts, k, v)
+        for p in (3, 4, 5)
+        for (_off, ts, k, v) in records[p]
+    ]
+    # Oracle needs dense rows; feed with remapped partition ids.
+    for lo in range(0, len(rows), 256):
+        chunk = rows[lo:lo + 256]
+        oracle.update(records_to_batch([(p - 3, ts, k, v) for p, ts, k, v in chunk]))
+    want = oracle.finalize()
+    assert np.array_equal(m.per_partition, want.per_partition)
+    assert m.overall_size == want.overall_size
+    assert m.alive_keys == want.alive_keys
+    rm = resumed.metrics
+    assert rm.overall_count == m.overall_count
+    assert np.array_equal(rm.per_partition, m.per_partition)
+    assert rm.alive_keys == m.alive_keys

@@ -159,3 +159,41 @@ def test_batch_padding_is_inert():
     # 15000 records into 4096-sized padded steps exercises padding heavily.
     m_cpu, m_tpu = run_both(cfg)
     assert m_tpu.overall_count == 15_000
+
+
+def test_prepare_staged_updates_match_direct_updates():
+    """prepare()+update(StagedBatch) must be byte-identical to direct
+    update(RecordBatch) — the engine stages on prefetch workers, so any
+    divergence would corrupt scans only in the threaded path."""
+    import numpy as np
+
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+    cfg = AnalyzerConfig(
+        num_partitions=5, batch_size=512, count_alive_keys=True,
+        alive_bitmap_bits=16, enable_hll=True, hll_p=10,
+        enable_quantiles=True,
+    )
+    spec = SyntheticSpec(
+        num_partitions=5, messages_per_partition=700,
+        keys_per_partition=90, tombstone_permille=120, seed=77,
+    )
+    batches = [
+        b.pad_to(cfg.batch_size)
+        for b in SyntheticSource(spec).batches(cfg.batch_size)
+    ]
+    direct = TpuBackend(cfg, init_now_s=0)
+    staged = TpuBackend(cfg, init_now_s=0)
+    for b in batches:
+        direct.update(b)
+        staged.update(staged.prepare(b))
+    md, ms = direct.finalize(), staged.finalize()
+    assert np.array_equal(md.per_partition, ms.per_partition)
+    assert np.array_equal(md.per_partition_extremes, ms.per_partition_extremes)
+    assert md.overall_count == ms.overall_count
+    assert md.overall_size == ms.overall_size
+    assert md.alive_keys == ms.alive_keys
+    assert md.distinct_keys_hll == ms.distinct_keys_hll
+    assert list(md.quantiles.values) == list(ms.quantiles.values)
