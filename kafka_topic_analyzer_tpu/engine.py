@@ -32,6 +32,8 @@ class PartitionIndex:
     def __init__(self, partition_ids: "list[int]"):
         self.ids = sorted(partition_ids)
         self._sorted = np.array(self.ids, dtype=np.int64)
+        #: Fixed at construction: dense ids make remapping a no-op.
+        self.is_dense = self.ids == list(range(len(self.ids)))
 
     def __len__(self) -> int:
         return len(self.ids)
@@ -40,8 +42,8 @@ class PartitionIndex:
         return np.searchsorted(self._sorted, partition).astype(np.int32)
 
     def remap_batch(self, batch: RecordBatch) -> RecordBatch:
-        if self.ids == list(range(len(self.ids))):
-            return batch  # already dense
+        if self.is_dense:
+            return batch
         batch.partition = self.to_dense(batch.partition)
         return batch
 
@@ -197,6 +199,14 @@ def run_scan(
 
     from kafka_topic_analyzer_tpu.utils.prefetch import prefetch
 
+    def _dense_copy(b: RecordBatch) -> RecordBatch:
+        """Dense-partition view for packing on a prefetch worker.  A COPY
+        when ids are non-dense: remap_batch mutates in place, and the main
+        loop must keep true partition ids for progress/snapshot keys."""
+        if pindex.is_dense:
+            return b  # nothing to rewrite; safe to alias
+        return dataclasses.replace(b, partition=pindex.to_dense(b.partition))
+
     try:
         if hasattr(backend, "update_shards"):
             # Sharded scan: one batch stream per data shard, each restricted
@@ -214,13 +224,25 @@ def run_scan(
             # per-round continuation is a global agreement, not a local one.
             lockstep = getattr(backend, "global_any", None)
             multiproc = lockstep is not None and len(feed_rows) < d
+            # Stage the S-way chunk packing on each row's prefetch worker
+            # (same contract as the single-device path below: pack a dense
+            # COPY, keep the decoded batch for true-id bookkeeping).
+            prepare_shard = getattr(backend, "prepare_shard", None)
+
+            def _stage_row(it):
+                if prepare_shard is None:
+                    return ((b, None) for b in it)
+                return ((b, prepare_shard(_dense_copy(b))) for b in it)
+
             iters = {
                 r: _closing(
                     prefetch(
-                        source.batches(
-                            batch_size,
-                            partitions=shard_parts[r],
-                            start_at=start_at,
+                        _stage_row(
+                            source.batches(
+                                batch_size,
+                                partitions=shard_parts[r],
+                                start_at=start_at,
+                            )
                         ),
                         prefetch_depth,
                     )
@@ -231,18 +253,21 @@ def run_scan(
             }
             alive = {r: True for r in feed_rows}
             while True:
-                shard_batches: "list[RecordBatch | None]" = [None] * d
+                shard_batches: "list" = [None] * d
                 step_valid = 0
                 with profile.stage("ingest"):
                     for r in feed_rows:
-                        b = next(iters[r], None) if alive[r] else None
-                        if b is None:
+                        item = next(iters[r], None) if alive[r] else None
+                        if item is None:
                             alive[r] = False
-                        else:
-                            step_valid += b.num_valid
-                            tracker.observe(b, b.partition)
-                            b = pindex.remap_batch(b)
-                        shard_batches[r] = b
+                            continue
+                        b, staged = item
+                        step_valid += b.num_valid
+                        tracker.observe(b, b.partition)
+                        shard_batches[r] = (
+                            staged if staged is not None
+                            else pindex.remap_batch(b)
+                        )
                 have_data = step_valid > 0
                 if multiproc:
                     have_data = lockstep(have_data)
@@ -269,15 +294,7 @@ def run_scan(
             def _with_staging(it):
                 if prepare is None:
                     return ((b, None) for b in it)
-
-                def _dense_view(b):
-                    if pindex.ids == list(range(len(pindex.ids))):
-                        return b  # already dense; nothing to rewrite
-                    return dataclasses.replace(
-                        b, partition=pindex.to_dense(b.partition)
-                    )
-
-                return ((b, prepare(_dense_view(b))) for b in it)
+                return ((b, prepare(_dense_copy(b))) for b in it)
 
             batches = _closing(
                 prefetch(

@@ -142,6 +142,18 @@ def _stacked_init(config: AnalyzerConfig, mesh) -> AnalyzerState:
     return jax.tree.map(lambda x, s: _global_put(x, mesh, s), state, specs)
 
 
+class PackedShard:
+    """One data row's batch already packed into its space chunks
+    ``[S, chunk_nbytes]`` by ``ShardedTpuBackend.prepare_shard`` — the
+    sharded counterpart of ``backends.tpu.StagedBatch``.  Just a typed
+    array: all bookkeeping stays with the decoded batch the engine holds."""
+
+    __slots__ = ("chunks",)
+
+    def __init__(self, chunks: np.ndarray):
+        self.chunks = chunks
+
+
 class ShardedTpuBackend(MetricBackend):
     """Multi-device backend over a (data, space) mesh.
 
@@ -296,44 +308,56 @@ class ShardedTpuBackend(MetricBackend):
 
     # -- update --------------------------------------------------------------
 
-    def update_shards(self, batches: List[Optional[RecordBatch]]) -> None:
-        """One collective step; ``batches[d]`` feeds data row ``d``.
+    def _pack_chunks(self, batch: "Optional[RecordBatch]") -> np.ndarray:
+        """Contiguous 1/S record chunks of one data row's batch, packed
+        into ``[S, chunk_nbytes]``.
+
+        Contiguity is what makes the device-side ordered application
+        exact: chunk s holds records [s·C, (s+1)·C), so source-chunk
+        order equals record order (backends/step.py)."""
+        s = self.config.space_shards
+        c = self.config.chunk_size
+        if batch is None:
+            batch = RecordBatch.empty(0)
+        n = len(batch)
+        if n > c * s:
+            raise ValueError(
+                f"batch of {n} exceeds batch_size {self.config.batch_size}"
+            )
+        return np.stack([
+            pack_batch(
+                batch.take(np.arange(lo, min(lo + c, n))),
+                self._chunk_config,
+                use_native=self.use_native,
+            )
+            for lo in range(0, c * s, c)
+        ])
+
+    def prepare_shard(self, batch: RecordBatch) -> "PackedShard":
+        """Pack one data row's batch ahead of its collective step — safe on
+        a prefetch worker thread (pure numpy/C++), so the S-way chunk
+        packing of every row overlaps the device's current step instead of
+        serializing in front of update_shards (engine staging)."""
+        return PackedShard(self._pack_chunks(batch))
+
+    def update_shards(
+        self, batches: "List[RecordBatch | PackedShard | None]"
+    ) -> None:
+        """One collective step; ``batches[d]`` feeds data row ``d`` — a
+        decoded batch, or a ``PackedShard`` staged via ``prepare_shard``.
 
         Under multi-controller, entries for rows another process hosts are
         ignored here (that process supplies them in ITS call) — the engine
         passes None for them.  Every process must call this in lockstep:
         the compiled step is a global program."""
         d = self.config.data_shards
-        s = self.config.space_shards
-        c = self.config.chunk_size
         if len(batches) != d:
             raise ValueError(f"expected {d} shard batches, got {len(batches)}")
 
-        def chunks(batch: "Optional[RecordBatch]") -> List[np.ndarray]:
-            """Contiguous 1/S record chunks of one data row's batch, packed.
-
-            Contiguity is what makes the device-side ordered application
-            exact: chunk s holds records [s·C, (s+1)·C), so source-chunk
-            order equals record order (backends/step.py)."""
-            if batch is None:
-                batch = RecordBatch.empty(0)
-            n = len(batch)
-            if n > c * s:
-                raise ValueError(
-                    f"batch of {n} exceeds batch_size {self.config.batch_size}"
-                )
-            return [
-                pack_batch(
-                    batch.take(np.arange(lo, min(lo + c, n))),
-                    self._chunk_config,
-                    use_native=self.use_native,
-                )
-                for lo in range(0, c * s, c)
-            ]
-
-        per_shard = np.stack(
-            [np.stack(chunks(batches[r])) for r in self.local_rows]
-        )  # [local_rows, S, chunk_nbytes]
+        per_shard = np.stack([
+            b.chunks if isinstance(b, PackedShard) else self._pack_chunks(b)
+            for b in (batches[r] for r in self.local_rows)
+        ])  # [local_rows, S, chunk_nbytes]
         if self._multiprocess:
             bufs = jax.make_array_from_process_local_data(
                 self._buf_sharding,

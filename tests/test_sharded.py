@@ -128,3 +128,107 @@ def test_cross_chunk_last_writer_wins():
     )
     oracle.update(batch_of(rows))
     assert int(oracle.finalize().alive_keys) == 3
+
+
+def test_prepare_shard_staged_step_matches_direct():
+    """update_shards fed PackedShards (the engine's prefetch-worker
+    staging) must be byte-identical to feeding decoded batches."""
+    import numpy as np
+
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    cfg = AnalyzerConfig(
+        num_partitions=4, batch_size=512, count_alive_keys=True,
+        alive_bitmap_bits=16, enable_hll=True, hll_p=10,
+        enable_quantiles=True, mesh_shape=(2, 2),
+    )
+    spec = SyntheticSpec(
+        num_partitions=4, messages_per_partition=900,
+        keys_per_partition=70, tombstone_permille=90, seed=31,
+    )
+    batches = list(SyntheticSource(spec).batches(cfg.batch_size))
+    halves = [batches[i::2] for i in range(2)]  # row r gets every 2nd batch
+    direct = ShardedTpuBackend(cfg, init_now_s=0)
+    staged = ShardedTpuBackend(cfg, init_now_s=0)
+    rounds = max(len(h) for h in halves)
+    for i in range(rounds):
+        row = [h[i] if i < len(h) else None for h in halves]
+        direct.update_shards(list(row))
+        staged.update_shards([
+            staged.prepare_shard(b) if b is not None else None for b in row
+        ])
+    md, ms = direct.finalize(), staged.finalize()
+    assert np.array_equal(md.per_partition, ms.per_partition)
+    assert np.array_equal(md.per_partition_extremes, ms.per_partition_extremes)
+    assert md.overall_count == ms.overall_count
+    assert md.alive_keys == ms.alive_keys
+    assert md.distinct_keys_hll == ms.distinct_keys_hll
+    assert list(md.quantiles.values) == list(ms.quantiles.values)
+
+
+def test_non_dense_partitions_sharded_engine_scan(tmp_path):
+    """Sharded engine scan over true partition ids {5,7,9}: the staged
+    packing must use dense rows while snapshots keep true ids (same
+    regression class as the single-device staging)."""
+    import numpy as np
+
+    from fake_broker import FakeBroker
+
+    from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+    from kafka_topic_analyzer_tpu.checkpoint import load_snapshot
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+    from kafka_topic_analyzer_tpu.engine import run_scan
+    from kafka_topic_analyzer_tpu.io.kafka_wire import (
+        KafkaWireSource,
+        records_to_batch,
+    )
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    ids = (5, 7, 9)
+    records = {
+        p: [
+            (off, 1_600_000_000_000 + off * 400,
+             f"p{p}-k{off % 23}".encode() if off % 6 else None,
+             None if off % 11 == 4 else bytes(8 + (off * 5 + p) % 50))
+            for off in range(500)
+        ]
+        for p in ids
+    }
+    cfg = AnalyzerConfig(
+        num_partitions=3, batch_size=256, count_alive_keys=True,
+        alive_bitmap_bits=16, mesh_shape=(2, 2),
+    )
+    with FakeBroker("gap.sharded", records) as b:
+        src = KafkaWireSource(f"127.0.0.1:{b.port}", "gap.sharded")
+        try:
+            result = run_scan(
+                "gap.sharded", src, ShardedTpuBackend(cfg, init_now_s=0),
+                256, snapshot_dir=str(tmp_path), snapshot_every_s=0.0,
+            )
+        finally:
+            src.close()
+    snap = load_snapshot(
+        str(tmp_path), "gap.sharded", cfg,
+        template=ShardedTpuBackend(cfg, init_now_s=0).get_state(),
+    )
+    assert snap is not None
+    _, next_offsets, records_seen, _ = snap
+    assert next_offsets == {5: 500, 7: 500, 9: 500}
+    assert records_seen == 1500
+
+    m = result.metrics
+    assert m.partitions == [5, 7, 9]
+    oracle = CpuExactBackend(cfg, init_now_s=0)
+    rows = [
+        (dense, ts, k, v)
+        for dense, p in enumerate(ids)
+        for (_off, ts, k, v) in records[p]
+    ]
+    for lo in range(0, len(rows), 256):
+        oracle.update(records_to_batch(rows[lo:lo + 256]))
+    want = oracle.finalize()
+    assert np.array_equal(m.per_partition, want.per_partition)
+    assert m.overall_count == want.overall_count
+    assert m.alive_keys == want.alive_keys
