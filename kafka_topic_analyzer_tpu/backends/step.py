@@ -76,7 +76,8 @@ def analyzer_step(
         value_len,
         key_null,
         value_null,
-        arrays["ts_s"],
+        arrays["ts_min"],
+        arrays["ts_max"],
         valid,
         config.num_partitions,
     )

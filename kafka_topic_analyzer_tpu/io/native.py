@@ -27,7 +27,7 @@ _NATIVE_DIR = os.path.join(
 )
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 7
+_ABI = 8
 _SO_NAME = f"libkta_ingest.v{_ABI}.so"
 
 
@@ -384,7 +384,7 @@ def decode_record_set_native(
 
 
 def pack_batch_native(batch, config) -> "np.ndarray | None":
-    """Fused SoA→wire-format-v1 packing in C++ (see packing.py for the
+    """Fused SoA→wire-format-v2 packing in C++ (see packing.py for the
     layout contract).  Returns None when the shim rejects the batch (out of
     range values) so the numpy path can raise its descriptive error."""
     from kafka_topic_analyzer_tpu.packing import MAX_VALUE_LEN, packed_nbytes
@@ -407,6 +407,7 @@ def pack_batch_native(batch, config) -> "np.ndarray | None":
         _as_ptr(c(batch.key_hash64), ctypes.c_uint64),
         ctypes.c_int64(batch.num_valid),
         ctypes.c_int64(b),
+        ctypes.c_int32(config.num_partitions),
         ctypes.c_int32(1 if config.count_alive_keys else 0),
         ctypes.c_int32(config.alive_bitmap_bits),
         ctypes.c_int32(1 if config.enable_hll else 0),

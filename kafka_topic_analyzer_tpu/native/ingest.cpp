@@ -86,7 +86,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 7; }
+int32_t kta_version() { return 8; }
 
 // CRC32-C (Castagnoli) over a byte buffer — Kafka's record-batch checksum.
 // Table-driven; the Python fallback (kafka_codec._crc32c) is a per-byte
@@ -481,7 +481,7 @@ extern "C" int64_t kta_decode_record_set(
   return n;
 }
 
-// Fused batch packing: RecordBatch SoA columns -> wire-format-v1 buffer
+// Fused batch packing: RecordBatch SoA columns -> wire-format-v2 buffer
 // (kafka_topic_analyzer_tpu/packing.py), including the host pre-reductions
 // (last-writer-wins bitmap dedupe via kta_dedupe_slots' table, HLL
 // (bucket, rho) split).  One C++ pass replaces several numpy conversions on
@@ -494,13 +494,17 @@ extern "C" int64_t kta_pack_batch(
     const int32_t* partition, const int32_t* key_len, const int32_t* value_len,
     const uint8_t* key_null, const uint8_t* value_null, const int64_t* ts_s,
     const uint32_t* h32, const uint64_t* h64,
-    int64_t n_valid, int64_t batch_size,
+    int64_t n_valid, int64_t batch_size, int32_t num_partitions,
     int32_t with_alive, int32_t alive_bits, int32_t with_hll, int32_t hll_p,
     int32_t value_len_cap,
     uint8_t* out, int64_t out_cap) {
   if (n_valid < 0 || n_valid > batch_size) return -1;
+  if (num_partitions <= 0) return -1;
   const int64_t b = batch_size;
-  int64_t need = 16 + b * (2 + 2 + 4 + 1 + 8);
+  const int64_t P = num_partitions;
+  // Wire format v2: the per-record i64 ts column is replaced by a [2P]
+  // per-partition min/max table (packing.py::_sections rationale).
+  int64_t need = 16 + b * (2 + 2 + 4 + 1) + 2 * P * 8;
   if (with_alive) need += b * 5;
   if (with_hll) need += b * 3;
   if (need > out_cap) return -1;
@@ -518,8 +522,8 @@ extern "C" int64_t kta_pack_batch(
   pos += b * 4;
   uint8_t* fl8 = out + pos;
   pos += b;
-  uint8_t* ts64 = out + pos;
-  pos += b * 8;
+  uint8_t* tsmm64 = out + pos;
+  pos += 2 * P * 8;
 
   auto store = [](uint8_t* base, int64_t idx, auto v) {
     std::memcpy(base + idx * static_cast<int64_t>(sizeof(v)), &v, sizeof(v));
@@ -531,6 +535,7 @@ extern "C" int64_t kta_pack_batch(
   parallel_for(n_valid, 8, [&](int64_t a, int64_t e) {
     for (int64_t i = a; i < e; ++i) {
       if (partition[i] < 0 || partition[i] > 0x7fff ||
+          partition[i] >= num_partitions ||
           key_len[i] < 0 || key_len[i] > 0xffff ||
           value_len[i] < 0 || value_len[i] > vcap) {
         bad.store(true);
@@ -540,10 +545,26 @@ extern "C" int64_t kta_pack_batch(
       store(kl16, i, static_cast<uint16_t>(key_len[i]));
       store(vl32, i, static_cast<uint32_t>(value_len[i]));
       fl8[i] = (key_null[i] ? 1 : 0) | (value_null[i] ? 2 : 0);
-      store(ts64, i, ts_s[i]);
     }
   });
   if (bad.load()) return -1;
+
+  {
+    // Per-partition ts min/max over the valid prefix: identity-filled,
+    // single sequential pass (~1 ns/record; not worth the thread fan-out).
+    std::vector<int64_t> mm(2 * P);
+    for (int64_t r = 0; r < P; ++r) {
+      mm[r] = INT64_MAX;
+      mm[P + r] = INT64_MIN;
+    }
+    for (int64_t i = 0; i < n_valid; ++i) {
+      const int64_t r = partition[i];
+      const int64_t t = ts_s[i];
+      if (t < mm[r]) mm[r] = t;
+      if (t > mm[P + r]) mm[P + r] = t;
+    }
+    std::memcpy(tsmm64, mm.data(), 2 * P * 8);
+  }
 
   int64_t n_pairs = 0;
   if (with_alive) {

@@ -76,12 +76,19 @@ def extremes_update(
     value_len,
     key_null,
     value_null,
-    ts_s,           # int64[B]
+    ts_min,         # int64[P], host-pre-reduced (packing.ts_minmax_table)
+    ts_max,         # int64[P]
     valid,
     num_partitions: int,
 ):
-    """Update per-partition min/max timestamp and message size via masked
-    scatter-min/max (padded records route to a scratch row)."""
+    """Update per-partition min/max timestamp and message size.
+
+    Timestamps arrive already reduced per partition by the host (wire
+    format v2 dropped the 8 B/record ts column; min/max is associative,
+    so elementwise-merging the batch table is exact).  Message-size
+    extremes still scatter from the per-record sizes that the counter
+    sums need on device anyway (padded records route to a scratch row).
+    """
     kn = valid & ~key_null
     vn = valid & ~value_null
     msg_size = (
@@ -89,11 +96,8 @@ def extremes_update(
         + jnp.where(vn, value_len, 0).astype(jnp.int64)
     )
     p = num_partitions
-    idx = jnp.where(valid, partition, p)
     # Size extremes exclude tombstones (src/metric.rs:249-251).
     idx_sized = jnp.where(vn, partition, p)
-    ts_min = jnp.full((p + 1,), I64_MAX, jnp.int64).at[idx].min(ts_s)[:p]
-    ts_max = jnp.full((p + 1,), I64_MIN, jnp.int64).at[idx].max(ts_s)[:p]
     sz_min = jnp.full((p + 1,), I64_MAX, jnp.int64).at[idx_sized].min(msg_size)[:p]
     sz_max = jnp.zeros((p + 1,), jnp.int64).at[idx_sized].max(msg_size)[:p]
     return (

@@ -1,4 +1,4 @@
-"""Packed host→device batch transfer (wire format v1).
+"""Packed host→device batch transfer (wire format v2).
 
 The profiled bottleneck of the streaming path is host→device bandwidth
 (SURVEY.md §7 hard part (a) — on this environment's tunneled TPU it measures
@@ -6,24 +6,27 @@ The profiled bottleneck of the streaming path is host→device bandwidth
 
 1. **One buffer, one transfer** — all per-record columns packed into a single
    contiguous ``uint8[N]`` section layout instead of nine separate arrays.
-2. **Minimal bytes per record** — 17 B for the exact counters (vs 37 B naive):
-   partition i16, key_len u16, value_len u32, flags u8, ts_s i64; padding is
-   expressed as a single ``n_valid`` prefix length in the header instead of a
-   bool per record.
-3. **Host pre-reduction** — the alive bitmap's last-writer-wins dedupe
+2. **Minimal bytes per record** — 9 B for the exact counters (vs 37 B naive;
+   17 B in v1): partition i16, key_len u16, value_len u32, flags u8;
+   padding is expressed as a single ``n_valid`` prefix length in the header
+   instead of a bool per record.
+3. **Host pre-reduction** — anything the device would only reduce anyway is
+   reduced on the host: v2 replaced v1's per-record ``ts_s i64[B]`` column
+   with a per-partition min/max table ``i64[2P]`` (the device only ever
+   min/maxes timestamps); the alive bitmap's last-writer-wins dedupe
    happens on the host (C++ shim / numpy): the device receives at most one
    (slot, aliveness) pair per touched slot (+5 B) and applies two scatter-ORs
    instead of sorting a million int64 keys; HLL updates ship as pre-split
    (bucket index u16, rho u8) (+3 B) instead of a full 64-bit hash.
 
-Layout (sections in order; B = static batch size):
+Layout (sections in order; B = static batch size, P = num_partitions):
 
     header   u8[16]   n_valid i32 | n_pairs i32 | reserved
     partition i16[B]
     key_len   u16[B]  (keys > 64 KiB are rejected at pack time)
     value_len u32[B]
     flags     u8[B]   bit0 = key_null, bit1 = value_null
-    ts_s      i64[B]
+    ts_minmax i64[2P] per-partition ts min then max, identity-filled
     [alive]  slot u32[B] + alive u8[B]          iff count_alive_keys
     [hll]    idx u16[B] + rho u8[B]             iff enable_hll
 
@@ -52,14 +55,31 @@ MAX_VALUE_LEN = (1 << 24) - 1
 
 
 def _sections(config: AnalyzerConfig, batch_size: int):
-    """(name, dtype, count) section list, in buffer order."""
+    """(name, dtype, count) section list, in buffer order (wire format v2).
+
+    v2 removed the 8 B/record ``ts_s`` column: the device only ever
+    reduces timestamps to per-partition min/max (ops/counters.py
+    ``extremes_update``), so the HOST pre-reduces each batch to a
+    ``[2P]`` int64 table (mins then maxes; I64_MAX/I64_MIN where the
+    batch has no record for that partition — the identity elements, so
+    merging on device is exact).  That is 8 B/record off the wire — the
+    dominant column at 17-25 B/record — lifting the transfer-bound
+    msgs/s ceiling ~1.5-1.9x (BENCH_NOTES.md round-1 ceiling table).
+    Min/max associativity keeps the sharded chunk path exact too.
+
+    Known trade-off: sharded scans pack each of the S space chunks with
+    its own [2P] table, so per-step ts bytes are S*2P*8 instead of B*8 —
+    a net INCREASE only when 2*P*S > B, i.e. partition counts within ~2x
+    of MAX_PARTITIONS combined with small chunked batches; every realistic
+    config (P ≤ thousands, B ≥ 2^17) is a large net win.
+    """
     b = batch_size
     sec = [
         ("partition", np.int16, b),
         ("key_len", np.uint16, b),
         ("value_len", np.uint32, b),
         ("flags", np.uint8, b),
-        ("ts_s", np.int64, b),
+        ("ts_minmax", np.int64, 2 * config.num_partitions),
     ]
     if config.count_alive_keys:
         sec.append(("alive_slot", np.uint32, b))
@@ -144,12 +164,30 @@ def _dedupe_slots(h32, active, alive, bits, use_native=True):
 # pack (host)
 
 
+I64_MAX = np.iinfo(np.int64).max
+I64_MIN = np.iinfo(np.int64).min
+
+
+def ts_minmax_table(partition: np.ndarray, ts_s: np.ndarray,
+                    num_partitions: int) -> np.ndarray:
+    """Host-side per-partition ts reduction: ``[2P]`` int64, mins then
+    maxes, identity-filled for partitions absent from this batch.  Inputs
+    are the VALID prefix only (callers slice by n_valid)."""
+    table = np.empty(2 * num_partitions, dtype=np.int64)
+    table[:num_partitions] = I64_MAX
+    table[num_partitions:] = I64_MIN
+    if len(partition):
+        np.minimum.at(table[:num_partitions], partition, ts_s)
+        np.maximum.at(table[num_partitions:], partition, ts_s)
+    return table
+
+
 def pack_batch(
     batch: RecordBatch,
     config: AnalyzerConfig,
     use_native: bool = True,
 ) -> np.ndarray:
-    """RecordBatch → one contiguous uint8 buffer (wire format v1).
+    """RecordBatch → one contiguous uint8 buffer (wire format v2).
 
     The batch's valid records must be a prefix (all sources produce
     prefix-valid batches; padding lives at the tail).
@@ -171,6 +209,13 @@ def pack_batch(
     ):
         raise ValueError(
             f"partition index out of packed-transfer range [0, {MAX_PARTITIONS}]"
+        )
+    if n_valid and batch.partition[:n_valid].max() >= config.num_partitions:
+        # The v2 ts table is [2P]; a stray dense index past P would scatter
+        # out of bounds (the reducers would mis-bucket it anyway).
+        raise ValueError(
+            f"partition index {int(batch.partition[:n_valid].max())} >= "
+            f"num_partitions {config.num_partitions}"
         )
     if n and (batch.value_len.min() < 0 or batch.key_len.min() < 0):
         # astype(uint) would silently wrap a negative length into gigabytes.
@@ -216,7 +261,10 @@ def pack_batch(
         "flags": (
             batch.key_null.astype(np.uint8) | (batch.value_null.astype(np.uint8) << 1)
         ),
-        "ts_s": batch.ts_s,
+        "ts_minmax": ts_minmax_table(
+            batch.partition[:n_valid], batch.ts_s[:n_valid],
+            config.num_partitions,
+        ),
     }
     if config.count_alive_keys:
         active = batch.valid & ~batch.key_null
@@ -271,6 +319,9 @@ def unpack_numpy(buf: np.ndarray, config: AnalyzerConfig) -> Dict[str, np.ndarra
     out["partition"] = out["partition"].astype(np.int32)
     out["key_len"] = out["key_len"].astype(np.int32)
     out["value_len"] = out["value_len"].astype(np.int32)
+    tm = out.pop("ts_minmax")
+    out["ts_min"] = tm[: config.num_partitions]
+    out["ts_max"] = tm[config.num_partitions :]
     return out
 
 
@@ -309,4 +360,7 @@ def unpack_device(buf, config: AnalyzerConfig):
     out["partition"] = out["partition"].astype(jnp.int32)
     out["key_len"] = out["key_len"].astype(jnp.int32)
     out["value_len"] = out["value_len"].astype(jnp.int32)
+    tm = out.pop("ts_minmax")
+    out["ts_min"] = tm[: config.num_partitions]
+    out["ts_max"] = tm[config.num_partitions :]
     return out

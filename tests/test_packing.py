@@ -1,4 +1,4 @@
-"""Packed transfer (wire format v1): layout roundtrip, host pre-reductions."""
+"""Packed transfer (wire format v2): layout roundtrip, host pre-reductions."""
 
 import jax
 import numpy as np
@@ -47,10 +47,18 @@ def test_pack_unpack_numpy_roundtrip():
     assert np.array_equal(got["partition"][:400], batch.partition[:400])
     assert np.array_equal(got["key_len"][:400], batch.key_len[:400])
     assert np.array_equal(got["value_len"][:400], batch.value_len[:400])
-    assert np.array_equal(got["ts_s"][:400], batch.ts_s[:400])
     assert np.array_equal(got["key_null"][:400], batch.key_null[:400])
     assert np.array_equal(got["value_null"][:400], batch.value_null[:400])
     assert np.array_equal(got["valid"], batch.valid)
+    # v2: ts ships as the host-reduced per-partition min/max table.
+    for p in range(CFG.num_partitions):
+        sel = batch.partition[:400] == p
+        if sel.any():
+            assert got["ts_min"][p] == batch.ts_s[:400][sel].min()
+            assert got["ts_max"][p] == batch.ts_s[:400][sel].max()
+        else:
+            assert got["ts_min"][p] == np.iinfo(np.int64).max
+            assert got["ts_max"][p] == np.iinfo(np.int64).min
 
 
 def test_device_unpack_matches_numpy_unpack():
@@ -86,7 +94,7 @@ def test_native_pack_semantics_match_numpy(hll_p):
     nv = int(ua["n_valid"])
     assert nv == int(ub["n_valid"])
     for name in ("partition", "key_len", "value_len", "key_null",
-                 "value_null", "ts_s", "hll_idx", "hll_rho"):
+                 "value_null", "ts_min", "ts_max", "hll_idx", "hll_rho"):
         assert np.array_equal(ua[name][:nv], ub[name][:nv]), name
     # Dedupe pair ORDER differs (sorted vs first-touch); counts must match
     # exactly (dict comparison alone would mask duplicate emissions), then
@@ -111,8 +119,10 @@ def test_native_pack_odd_batch_size_and_empty():
     b = native.pack_batch_native(batch, odd_cfg)
     assert b is not None
     ua, ub = unpack_numpy(a, odd_cfg), unpack_numpy(b, odd_cfg)
-    for name in ("partition", "key_len", "value_len", "ts_s"):
+    for name in ("partition", "key_len", "value_len"):
         assert np.array_equal(ua[name][:400], ub[name][:400]), name
+    for name in ("ts_min", "ts_max"):  # [P] tables, not per-record
+        assert np.array_equal(ua[name], ub[name]), name
     from kafka_topic_analyzer_tpu.records import RecordBatch
 
     empty = native.pack_batch_native(RecordBatch.empty(0), odd_cfg)
