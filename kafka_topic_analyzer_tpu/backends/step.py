@@ -141,16 +141,25 @@ def analyzer_step(
 
     hll_state = state.hll
     if hll_state is not None:
-        regs = hll_apply(
-            hll_state.regs,
-            arrays["hll_idx"],
-            arrays["hll_rho"],
-            partition=(
-                arrays["partition"]
-                if config.distinct_keys_per_partition
-                else None
-            ),
-        )
+        if "hll_regs" in arrays:
+            # Table mode (wire v3, global row): the host already reduced
+            # the batch to a register table — merge elementwise, no
+            # scatter on the device hot path.
+            regs = jnp.maximum(
+                hll_state.regs,
+                arrays["hll_regs"].astype(jnp.int32)[None, :],
+            )
+        else:
+            regs = hll_apply(
+                hll_state.regs,
+                arrays["hll_idx"],
+                arrays["hll_rho"],
+                partition=(
+                    arrays["partition"]
+                    if config.distinct_keys_per_partition
+                    else None
+                ),
+            )
         hll_state = HLLState(regs=regs)
 
     q_state = state.quantiles

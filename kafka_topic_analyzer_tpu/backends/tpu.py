@@ -5,7 +5,7 @@ Design (SURVEY.md §7 M3 + the transfer work in packing.py):
   dispatches one jitted step with the state buffers *donated*, so XLA updates
   them in place and the host never round-trips the state (hard part (e));
 - each batch crosses the host→device boundary as ONE packed uint8 buffer in
-  wire format v2 (packing.py) — minimal bytes per record, host-side
+  wire format v3 (packing.py) — minimal bytes per record, host-side
   pre-reduction for the bitmap/HLL updates;
 - dispatch is asynchronous — the host thread returns immediately and keeps
   packing the next batch while the device works; `finalize` synchronizes;

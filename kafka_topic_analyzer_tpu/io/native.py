@@ -27,7 +27,7 @@ _NATIVE_DIR = os.path.join(
 )
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 8
+_ABI = 9
 _SO_NAME = f"libkta_ingest.v{_ABI}.so"
 
 
@@ -410,7 +410,12 @@ def pack_batch_native(batch, config) -> "np.ndarray | None":
         ctypes.c_int32(config.num_partitions),
         ctypes.c_int32(1 if config.count_alive_keys else 0),
         ctypes.c_int32(config.alive_bitmap_bits),
-        ctypes.c_int32(1 if config.enable_hll else 0),
+        # 0 = off, 1 = per-record pairs (per-partition register rows),
+        # 2 = host-reduced global register table (wire v3).
+        ctypes.c_int32(
+            0 if not config.enable_hll
+            else (1 if config.distinct_keys_per_partition else 2)
+        ),
         ctypes.c_int32(config.hll_p),
         ctypes.c_int32(MAX_VALUE_LEN if config.use_pallas_counters else 0),
         _as_ptr(out, ctypes.c_uint8),

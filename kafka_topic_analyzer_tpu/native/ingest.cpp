@@ -86,7 +86,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 8; }
+int32_t kta_version() { return 9; }
 
 // CRC32-C (Castagnoli) over a byte buffer — Kafka's record-batch checksum.
 // Table-driven; the Python fallback (kafka_codec._crc32c) is a per-byte
@@ -481,15 +481,19 @@ extern "C" int64_t kta_decode_record_set(
   return n;
 }
 
-// Fused batch packing: RecordBatch SoA columns -> wire-format-v2 buffer
+// Fused batch packing: RecordBatch SoA columns -> wire-format-v3 buffer
 // (kafka_topic_analyzer_tpu/packing.py), including the host pre-reductions
-// (last-writer-wins bitmap dedupe via kta_dedupe_slots' table, HLL
-// (bucket, rho) split).  One C++ pass replaces several numpy conversions on
-// the per-batch hot path.  Layout contract lives in packing.py; keep in
-// sync (HEADER 16B; sections p i16 | klen u16 | vlen u32 | flags u8 |
-// ts i64 | [slot u32 | alive u8] | [idx u16 | rho u8]).
+// (per-partition ts min/max table, last-writer-wins bitmap dedupe via
+// kta_dedupe_slots' table, and the HLL reduction — global register table
+// in mode 2, per-record (bucket, rho) pairs in mode 1).  One C++ pass
+// replaces several numpy conversions on the per-batch hot path.  Layout
+// contract lives in packing.py; keep in sync (HEADER 16B; sections
+// p i16[B] | klen u16[B] | vlen u32[B] | flags u8[B] | ts_minmax i64[2P] |
+// [slot u32[B] | alive u8[B]] | [hll: regs u8[2^p] (mode 2) OR
+// idx u16[B] | rho u8[B] (mode 1)]).
 // Returns total bytes written, or -1 on error (including key_len > u16 /
-// partition out of i16 range — mirrors pack_batch's validation).
+// partition out of i16/num_partitions range — mirrors pack_batch's
+// validation).
 extern "C" int64_t kta_pack_batch(
     const int32_t* partition, const int32_t* key_len, const int32_t* value_len,
     const uint8_t* key_null, const uint8_t* value_null, const int64_t* ts_s,
@@ -506,7 +510,10 @@ extern "C" int64_t kta_pack_batch(
   // per-partition min/max table (packing.py::_sections rationale).
   int64_t need = 16 + b * (2 + 2 + 4 + 1) + 2 * P * 8;
   if (with_alive) need += b * 5;
-  if (with_hll) need += b * 3;
+  // with_hll: 0 = off, 1 = per-record pairs (per-partition registers),
+  // 2 = host-reduced global register table of 2^hll_p bytes (wire v3).
+  if (with_hll == 1) need += b * 3;
+  if (with_hll == 2) need += int64_t(1) << hll_p;
   if (need > out_cap) return -1;
 
   std::memset(out, 0, need);
@@ -591,7 +598,7 @@ extern "C" int64_t kta_pack_batch(
       std::memcpy(alive8, flags.data(), n_pairs);
     }
   }
-  if (with_hll) {
+  if (with_hll == 1) {
     uint8_t* idx16 = out + pos;
     pos += b * 2;
     uint8_t* rho8 = out + pos;
@@ -612,6 +619,23 @@ extern "C" int64_t kta_pack_batch(
                       : static_cast<uint8_t>(__builtin_clzll(rest) + 1);
       }
     });
+  } else if (with_hll == 2) {
+    // Global register table: scatter-max on the host's cache-resident
+    // u8[2^p] (64 KB at p=16), sequential single pass — the device then
+    // merges it elementwise.  (The memset above already zeroed it.)
+    uint8_t* tbl = out + pos;
+    const int p = hll_p;
+    pos += int64_t(1) << p;
+    for (int64_t i = 0; i < n_valid; ++i) {
+      if (key_null[i]) continue;
+      const uint64_t h = splitmix64(h64[i]);
+      const uint64_t idx = h >> (64 - p);
+      const uint64_t rest = h << p;
+      const uint8_t rho =
+          rest == 0 ? static_cast<uint8_t>(64 - p + 1)
+                    : static_cast<uint8_t>(__builtin_clzll(rest) + 1);
+      if (rho > tbl[idx]) tbl[idx] = rho;
+    }
   }
 
   // Header: n_valid i32 | n_pairs i32 | reserved.

@@ -1,4 +1,4 @@
-"""Packed host→device batch transfer (wire format v2).
+"""Packed host→device batch transfer (wire format v3).
 
 The profiled bottleneck of the streaming path is host→device bandwidth
 (SURVEY.md §7 hard part (a) — on this environment's tunneled TPU it measures
@@ -16,8 +16,11 @@ The profiled bottleneck of the streaming path is host→device bandwidth
    min/maxes timestamps); the alive bitmap's last-writer-wins dedupe
    happens on the host (C++ shim / numpy): the device receives at most one
    (slot, aliveness) pair per touched slot (+5 B) and applies two scatter-ORs
-   instead of sorting a million int64 keys; HLL updates ship as pre-split
-   (bucket index u16, rho u8) (+3 B) instead of a full 64-bit hash.
+   instead of sorting a million int64 keys; global HLL ships as ONE
+   host-reduced u8[2^p] register table per batch (v3 — register max is
+   commutative, so the device merges elementwise, no scatter), while
+   per-partition HLL ships pre-split (bucket index u16, rho u8) pairs
+   (+3 B) instead of a full 64-bit hash.
 
 Layout (sections in order; B = static batch size, P = num_partitions):
 
@@ -28,7 +31,8 @@ Layout (sections in order; B = static batch size, P = num_partitions):
     flags     u8[B]   bit0 = key_null, bit1 = value_null
     ts_minmax i64[2P] per-partition ts min then max, identity-filled
     [alive]  slot u32[B] + alive u8[B]          iff count_alive_keys
-    [hll]    idx u16[B] + rho u8[B]             iff enable_hll
+    [hll]    regs u8[2^p] host-reduced table    iff enable_hll (global; v3)
+             idx u16[B] + rho u8[B]             iff distinct_keys_per_partition
 
 Device-side unpacking is pure ``lax.bitcast_convert_type`` on reshaped slices
 (both host and TPU are little-endian; the TPU backend runs a one-time
@@ -55,7 +59,8 @@ MAX_VALUE_LEN = (1 << 24) - 1
 
 
 def _sections(config: AnalyzerConfig, batch_size: int):
-    """(name, dtype, count) section list, in buffer order (wire format v2).
+    """(name, dtype, count) section list, in buffer order (wire format v3;
+    v3 = v2 plus the global-HLL register-table section below).
 
     v2 removed the 8 B/record ``ts_s`` column: the device only ever
     reduces timestamps to per-partition min/max (ops/counters.py
@@ -85,8 +90,18 @@ def _sections(config: AnalyzerConfig, batch_size: int):
         sec.append(("alive_slot", np.uint32, b))
         sec.append(("alive_flag", np.uint8, b))
     if config.enable_hll:
-        sec.append(("hll_idx", np.uint16, b))
-        sec.append(("hll_rho", np.uint8, b))
+        if config.distinct_keys_per_partition:
+            # Pair mode: per-record (register index, rho) — each record
+            # must land in its own partition's register row.
+            sec.append(("hll_idx", np.uint16, b))
+            sec.append(("hll_rho", np.uint8, b))
+        else:
+            # Table mode (v3): register max is fully commutative, so for
+            # the single global row the host pre-reduces the whole batch
+            # to one u8[2^p] register table (64 KB at p=16 vs 3 B/record
+            # of pairs), and the device merges it ELEMENTWISE — no
+            # scatter at all on the hot path.
+            sec.append(("hll_regs", np.uint8, 1 << config.hll_p))
     return sec
 
 
@@ -187,7 +202,7 @@ def pack_batch(
     config: AnalyzerConfig,
     use_native: bool = True,
 ) -> np.ndarray:
-    """RecordBatch → one contiguous uint8 buffer (wire format v2).
+    """RecordBatch → one contiguous uint8 buffer (wire format v3).
 
     The batch's valid records must be a prefix (all sources produce
     prefix-valid batches; padding lives at the tail).
@@ -285,8 +300,15 @@ def pack_batch(
     if config.enable_hll:
         active = batch.valid & ~batch.key_null
         idx, rho = hll_idx_rho_numpy(batch.key_hash64, active, config.hll_p)
-        fields["hll_idx"] = idx
-        fields["hll_rho"] = rho
+        if config.distinct_keys_per_partition:
+            fields["hll_idx"] = idx
+            fields["hll_rho"] = rho
+        else:
+            table = np.zeros(1 << config.hll_p, dtype=np.uint8)
+            if n_valid:
+                # rho is 0 for masked/null-key records — a no-op under max.
+                np.maximum.at(table, idx[:n_valid], rho[:n_valid])
+            fields["hll_regs"] = table
 
     out[:HEADER_BYTES] = header.view(np.uint8)
     for name, dtype, count in _sections(config, b):
@@ -327,6 +349,10 @@ def unpack_numpy(buf: np.ndarray, config: AnalyzerConfig) -> Dict[str, np.ndarra
 
 # ---------------------------------------------------------------------------
 # unpack (device, inside jit)
+#
+# "hll_regs" (table mode) flows through the generic section loop in both
+# unpackers untouched — it is already u8[2^p] and the step consumes it
+# elementwise.
 
 
 def unpack_device(buf, config: AnalyzerConfig):

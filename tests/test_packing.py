@@ -80,22 +80,33 @@ def test_dedupe_numpy_last_writer_wins():
 
 
 @pytest.mark.parametrize("hll_p", [10, 16])
-def test_native_pack_semantics_match_numpy(hll_p):
+@pytest.mark.parametrize("per_partition", [False, True])
+def test_native_pack_semantics_match_numpy(hll_p, per_partition):
     import dataclasses
 
     native = pytest.importorskip("kafka_topic_analyzer_tpu.io.native")
     if not native.native_available():
         pytest.skip("native shim unavailable")
-    cfg = dataclasses.replace(CFG, hll_p=hll_p)
+    cfg = dataclasses.replace(
+        CFG, hll_p=hll_p, distinct_keys_per_partition=per_partition
+    )
     batch = _batch()
     a = pack_batch(batch, cfg, use_native=False)
     b = pack_batch(batch, cfg, use_native=True)
     ua, ub = unpack_numpy(a, cfg), unpack_numpy(b, cfg)
     nv = int(ua["n_valid"])
     assert nv == int(ub["n_valid"])
+    # Per-partition HLL ships per-record pairs; the global default ships
+    # the host-reduced register table (wire v3).
+    hll_names = (
+        ("hll_idx", "hll_rho") if per_partition else ("hll_regs",)
+    )
+    per_record = ("partition", "key_len", "value_len", "key_null",
+                  "value_null", "hll_idx", "hll_rho")
     for name in ("partition", "key_len", "value_len", "key_null",
-                 "value_null", "ts_min", "ts_max", "hll_idx", "hll_rho"):
-        assert np.array_equal(ua[name][:nv], ub[name][:nv]), name
+                 "value_null", "ts_min", "ts_max") + hll_names:
+        cut = nv if name in per_record else len(ua[name])
+        assert np.array_equal(ua[name][:cut], ub[name][:cut]), name
     # Dedupe pair ORDER differs (sorted vs first-touch); counts must match
     # exactly (dict comparison alone would mask duplicate emissions), then
     # compare as dicts.
