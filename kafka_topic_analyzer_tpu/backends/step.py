@@ -142,12 +142,14 @@ def analyzer_step(
     hll_state = state.hll
     if hll_state is not None:
         if "hll_regs" in arrays:
-            # Table mode (wire v3, global row): the host already reduced
-            # the batch to a register table — merge elementwise, no
-            # scatter on the device hot path.
+            # Table mode (wire v3): the host already reduced the batch to
+            # a register table (R rows — 1 global or P per-partition) —
+            # merge elementwise, no scatter on the device hot path.
             regs = jnp.maximum(
                 hll_state.regs,
-                arrays["hll_regs"].astype(jnp.int32)[None, :],
+                arrays["hll_regs"].astype(jnp.int32).reshape(
+                    -1, hll_state.regs.shape[1]
+                ),
             )
         else:
             regs = hll_apply(

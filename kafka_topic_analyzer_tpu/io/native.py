@@ -27,7 +27,7 @@ _NATIVE_DIR = os.path.join(
 )
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 9
+_ABI = 10
 _SO_NAME = f"libkta_ingest.v{_ABI}.so"
 
 
@@ -384,16 +384,21 @@ def decode_record_set_native(
 
 
 def pack_batch_native(batch, config) -> "np.ndarray | None":
-    """Fused SoA→wire-format-v2 packing in C++ (see packing.py for the
+    """Fused SoA→wire-format-v3 packing in C++ (see packing.py for the
     layout contract).  Returns None when the shim rejects the batch (out of
     range values) so the numpy path can raise its descriptive error."""
-    from kafka_topic_analyzer_tpu.packing import MAX_VALUE_LEN, packed_nbytes
+    from kafka_topic_analyzer_tpu.packing import (
+        MAX_VALUE_LEN,
+        hll_table_rows,
+        packed_nbytes,
+    )
 
     lib = load_library()
     b = config.batch_size
     n = len(batch)
     if n > b:
         raise ValueError(f"batch of {n} exceeds batch_size {b}")
+    hll_rows = hll_table_rows(config, b)
     out = np.empty(packed_nbytes(config, b), dtype=np.uint8)
     c = np.ascontiguousarray  # strided views would be read with wrong strides
     nbytes = lib.kta_pack_batch(
@@ -410,13 +415,14 @@ def pack_batch_native(batch, config) -> "np.ndarray | None":
         ctypes.c_int32(config.num_partitions),
         ctypes.c_int32(1 if config.count_alive_keys else 0),
         ctypes.c_int32(config.alive_bitmap_bits),
-        # 0 = off, 1 = per-record pairs (per-partition register rows),
-        # 2 = host-reduced global register table (wire v3).
+        # 0 = off, 1 = per-record pairs, 2 = host-reduced register table
+        # (wire v3); the mode/rows decision is packing.hll_table_rows so
+        # the numpy path, this call, and the layout can never disagree.
         ctypes.c_int32(
-            0 if not config.enable_hll
-            else (1 if config.distinct_keys_per_partition else 2)
+            0 if not config.enable_hll else (2 if hll_rows else 1)
         ),
         ctypes.c_int32(config.hll_p),
+        ctypes.c_int32(hll_rows),
         ctypes.c_int32(MAX_VALUE_LEN if config.use_pallas_counters else 0),
         _as_ptr(out, ctypes.c_uint8),
         ctypes.c_int64(out.nbytes),

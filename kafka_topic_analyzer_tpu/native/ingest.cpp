@@ -86,7 +86,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 9; }
+int32_t kta_version() { return 10; }
 
 // CRC32-C (Castagnoli) over a byte buffer — Kafka's record-batch checksum.
 // Table-driven; the Python fallback (kafka_codec._crc32c) is a per-byte
@@ -500,6 +500,7 @@ extern "C" int64_t kta_pack_batch(
     const uint32_t* h32, const uint64_t* h64,
     int64_t n_valid, int64_t batch_size, int32_t num_partitions,
     int32_t with_alive, int32_t alive_bits, int32_t with_hll, int32_t hll_p,
+    int32_t hll_rows,
     int32_t value_len_cap,
     uint8_t* out, int64_t out_cap) {
   if (n_valid < 0 || n_valid > batch_size) return -1;
@@ -510,10 +511,18 @@ extern "C" int64_t kta_pack_batch(
   // per-partition min/max table (packing.py::_sections rationale).
   int64_t need = 16 + b * (2 + 2 + 4 + 1) + 2 * P * 8;
   if (with_alive) need += b * 5;
-  // with_hll: 0 = off, 1 = per-record pairs (per-partition registers),
-  // 2 = host-reduced global register table of 2^hll_p bytes (wire v3).
+  // with_hll: 0 = off, 1 = per-record pairs, 2 = host-reduced register
+  // table of hll_rows << hll_p bytes (wire v3; rows = 1 global or P
+  // per-partition — python's packing.hll_table_rows decides).
   if (with_hll == 1) need += b * 3;
-  if (with_hll == 2) need += int64_t(1) << hll_p;
+  if (with_hll == 2) {
+    // Per-row tables index by partition id: rows must cover every id the
+    // (validated) partition column can carry, or tbl[row << p | idx]
+    // writes past the section.
+    if (hll_rows < 1 || (hll_rows > 1 && hll_rows < num_partitions))
+      return -1;
+    need += int64_t(hll_rows) << hll_p;
+  }
   if (need > out_cap) return -1;
 
   std::memset(out, 0, need);
@@ -620,16 +629,20 @@ extern "C" int64_t kta_pack_batch(
       }
     });
   } else if (with_hll == 2) {
-    // Global register table: scatter-max on the host's cache-resident
-    // u8[2^p] (64 KB at p=16), sequential single pass — the device then
-    // merges it elementwise.  (The memset above already zeroed it.)
+    // Register table: scatter-max on the host's cache-resident
+    // u8[rows << p] (64 KB at p=16 global), sequential single pass — the
+    // device then merges it elementwise.  Row 0 for the global sketch;
+    // the record's partition row when per-partition registers fit the
+    // table budget.  (The memset above already zeroed it.)
     uint8_t* tbl = out + pos;
     const int p = hll_p;
-    pos += int64_t(1) << p;
+    const bool per_row = hll_rows > 1;
+    pos += int64_t(hll_rows) << p;
     for (int64_t i = 0; i < n_valid; ++i) {
       if (key_null[i]) continue;
       const uint64_t h = splitmix64(h64[i]);
-      const uint64_t idx = h >> (64 - p);
+      const int64_t row = per_row ? partition[i] : 0;
+      const int64_t idx = (row << p) | static_cast<int64_t>(h >> (64 - p));
       const uint64_t rest = h << p;
       const uint8_t rho =
           rest == 0 ? static_cast<uint8_t>(64 - p + 1)

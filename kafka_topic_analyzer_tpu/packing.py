@@ -16,11 +16,11 @@ The profiled bottleneck of the streaming path is host→device bandwidth
    min/maxes timestamps); the alive bitmap's last-writer-wins dedupe
    happens on the host (C++ shim / numpy): the device receives at most one
    (slot, aliveness) pair per touched slot (+5 B) and applies two scatter-ORs
-   instead of sorting a million int64 keys; global HLL ships as ONE
-   host-reduced u8[2^p] register table per batch (v3 — register max is
-   commutative, so the device merges elementwise, no scatter), while
-   per-partition HLL ships pre-split (bucket index u16, rho u8) pairs
-   (+3 B) instead of a full 64-bit hash.
+   instead of sorting a million int64 keys; HLL ships as ONE host-reduced
+   u8[R << p] register table per batch whenever that is smaller than the
+   per-record pairs (v3 — register max is commutative, so the device
+   merges elementwise, no scatter), else as pre-split (bucket index u16,
+   rho u8) pairs (+3 B) instead of a full 64-bit hash.
 
 Layout (sections in order; B = static batch size, P = num_partitions):
 
@@ -31,8 +31,10 @@ Layout (sections in order; B = static batch size, P = num_partitions):
     flags     u8[B]   bit0 = key_null, bit1 = value_null
     ts_minmax i64[2P] per-partition ts min then max, identity-filled
     [alive]  slot u32[B] + alive u8[B]          iff count_alive_keys
-    [hll]    regs u8[2^p] host-reduced table    iff enable_hll (global; v3)
-             idx u16[B] + rho u8[B]             iff distinct_keys_per_partition
+    [hll]    regs u8[R << p] host-reduced table (R = 1 global, P per-
+             partition) WHEN R·2^p ≤ 3·B, else idx u16[B] + rho u8[B]
+             pairs — one size rule, ``hll_table_rows``, decides for the
+             packers and (via section presence) the device step
 
 Device-side unpacking is pure ``lax.bitcast_convert_type`` on reshaped slices
 (both host and TPU are little-endian; the TPU backend runs a one-time
@@ -90,19 +92,32 @@ def _sections(config: AnalyzerConfig, batch_size: int):
         sec.append(("alive_slot", np.uint32, b))
         sec.append(("alive_flag", np.uint8, b))
     if config.enable_hll:
-        if config.distinct_keys_per_partition:
-            # Pair mode: per-record (register index, rho) — each record
-            # must land in its own partition's register row.
+        rows = hll_table_rows(config, b)
+        if rows:
+            # Table mode (v3): register max is fully commutative, so the
+            # host pre-reduces the whole batch to a u8[R, 2^p] register
+            # table (R = 1 global, R = P per-partition) and the device
+            # merges it ELEMENTWISE — no scatter on the hot path.
+            sec.append(("hll_regs", np.uint8, rows << config.hll_p))
+        else:
+            # Pair mode: per-record (register index, rho) — cheaper on
+            # the wire than a table whenever R·2^p > 3·B.
             sec.append(("hll_idx", np.uint16, b))
             sec.append(("hll_rho", np.uint8, b))
-        else:
-            # Table mode (v3): register max is fully commutative, so for
-            # the single global row the host pre-reduces the whole batch
-            # to one u8[2^p] register table (64 KB at p=16 vs 3 B/record
-            # of pairs), and the device merges it ELEMENTWISE — no
-            # scatter at all on the hot path.
-            sec.append(("hll_regs", np.uint8, 1 << config.hll_p))
     return sec
+
+
+def hll_table_rows(config: AnalyzerConfig, batch_size: int) -> int:
+    """Rows of the host-reduced HLL register table, or 0 for pair mode.
+
+    The table costs ``R << hll_p`` bytes per batch vs 3 B/record of
+    pairs: ship whichever is smaller.  Pack, unpack, and the device step
+    all derive the mode from this one function (the step via the
+    presence of the ``hll_regs`` array), so the decision cannot skew."""
+    rows = (
+        config.num_partitions if config.distinct_keys_per_partition else 1
+    )
+    return rows if (rows << config.hll_p) <= 3 * batch_size else 0
 
 
 def packed_nbytes(config: AnalyzerConfig, batch_size: int) -> int:
@@ -300,15 +315,22 @@ def pack_batch(
     if config.enable_hll:
         active = batch.valid & ~batch.key_null
         idx, rho = hll_idx_rho_numpy(batch.key_hash64, active, config.hll_p)
-        if config.distinct_keys_per_partition:
-            fields["hll_idx"] = idx
-            fields["hll_rho"] = rho
-        else:
-            table = np.zeros(1 << config.hll_p, dtype=np.uint8)
+        rows = hll_table_rows(config, b)
+        if rows:
+            table = np.zeros(rows << config.hll_p, dtype=np.uint8)
             if n_valid:
                 # rho is 0 for masked/null-key records — a no-op under max.
-                np.maximum.at(table, idx[:n_valid], rho[:n_valid])
+                flat = idx[:n_valid].astype(np.int64)
+                if rows > 1:
+                    flat = flat + (
+                        batch.partition[:n_valid].astype(np.int64)
+                        << config.hll_p
+                    )
+                np.maximum.at(table, flat, rho[:n_valid])
             fields["hll_regs"] = table
+        else:
+            fields["hll_idx"] = idx
+            fields["hll_rho"] = rho
 
     out[:HEADER_BYTES] = header.view(np.uint8)
     for name, dtype, count in _sections(config, b):

@@ -79,7 +79,41 @@ def test_dedupe_numpy_last_writer_wins():
     assert result == {5: 0, 6: 1, 7: 1}  # inactive slot 9 ignored
 
 
-@pytest.mark.parametrize("hll_p", [10, 16])
+#: HARD-CODED expected HLL wire mode per (per_partition, hll_p) at
+#: b=512/P=5 — independent of hll_table_rows, so a threshold bug in the
+#: size rule fails here instead of shifting expectations silently.
+HLL_MODE = {
+    (False, 8): "table",   # 1*256  <= 1536
+    (False, 10): "table",  # 1*1024 <= 1536
+    (False, 16): "pairs",  # 1*65536 > 1536
+    (True, 8): "table",    # 5*256  <= 1536 — the R>1 row-indexed path
+    (True, 10): "pairs",   # 5*1024 > 1536
+    (True, 16): "pairs",
+}
+
+
+def test_hll_table_rows_size_rule():
+    """The one decision function every packer derives the mode from."""
+    import dataclasses
+
+    from kafka_topic_analyzer_tpu.packing import hll_table_rows
+
+    for (pp, p), mode in HLL_MODE.items():
+        cfg = dataclasses.replace(
+            CFG, hll_p=p, distinct_keys_per_partition=pp
+        )
+        rows = hll_table_rows(cfg, 512)
+        assert bool(rows) == (mode == "table"), (pp, p)
+        if rows:
+            assert rows == (5 if pp else 1)
+    # Boundary (global p=8, table = 256 B): 3*86 = 258 >= 256 -> table;
+    # 3*85 = 255 < 256 -> pairs.
+    cfg = dataclasses.replace(CFG, hll_p=8, distinct_keys_per_partition=False)
+    assert hll_table_rows(cfg, 86) == 1
+    assert hll_table_rows(cfg, 85) == 0
+
+
+@pytest.mark.parametrize("hll_p", [8, 10, 16])
 @pytest.mark.parametrize("per_partition", [False, True])
 def test_native_pack_semantics_match_numpy(hll_p, per_partition):
     import dataclasses
@@ -96,10 +130,10 @@ def test_native_pack_semantics_match_numpy(hll_p, per_partition):
     ua, ub = unpack_numpy(a, cfg), unpack_numpy(b, cfg)
     nv = int(ua["n_valid"])
     assert nv == int(ub["n_valid"])
-    # Per-partition HLL ships per-record pairs; the global default ships
-    # the host-reduced register table (wire v3).
     hll_names = (
-        ("hll_idx", "hll_rho") if per_partition else ("hll_regs",)
+        ("hll_regs",)
+        if HLL_MODE[(per_partition, hll_p)] == "table"
+        else ("hll_idx", "hll_rho")
     )
     per_record = ("partition", "key_len", "value_len", "key_null",
                   "value_null", "hll_idx", "hll_rho")
