@@ -71,15 +71,10 @@ def analyzer_step(
         m.latest_s,
         m.smallest,
         m.largest,
-        arrays["partition"],
-        key_len,
-        value_len,
-        key_null,
-        value_null,
         arrays["ts_min"],
         arrays["ts_max"],
-        valid,
-        config.num_partitions,
+        arrays["sz_min"],
+        arrays["sz_max"],
     )
     kn = valid & ~key_null
     vn = valid & ~value_null

@@ -27,7 +27,7 @@ _NATIVE_DIR = os.path.join(
 )
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 10
+_ABI = 11
 _SO_NAME = f"libkta_ingest.v{_ABI}.so"
 
 
@@ -384,7 +384,7 @@ def decode_record_set_native(
 
 
 def pack_batch_native(batch, config) -> "np.ndarray | None":
-    """Fused SoA→wire-format-v3 packing in C++ (see packing.py for the
+    """Fused SoA→wire-format-v4 packing in C++ (see packing.py for the
     layout contract).  Returns None when the shim rejects the batch (out of
     range values) so the numpy path can raise its descriptive error."""
     from kafka_topic_analyzer_tpu.packing import (

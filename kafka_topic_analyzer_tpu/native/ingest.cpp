@@ -86,7 +86,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 10; }
+int32_t kta_version() { return 11; }
 
 // CRC32-C (Castagnoli) over a byte buffer — Kafka's record-batch checksum.
 // Table-driven; the Python fallback (kafka_codec._crc32c) is a per-byte
@@ -481,7 +481,7 @@ extern "C" int64_t kta_decode_record_set(
   return n;
 }
 
-// Fused batch packing: RecordBatch SoA columns -> wire-format-v3 buffer
+// Fused batch packing: RecordBatch SoA columns -> wire-format-v4 buffer
 // (kafka_topic_analyzer_tpu/packing.py), including the host pre-reductions
 // (per-partition ts min/max table, last-writer-wins bitmap dedupe via
 // kta_dedupe_slots' table, and the HLL reduction — global register table
@@ -489,8 +489,8 @@ extern "C" int64_t kta_decode_record_set(
 // replaces several numpy conversions on the per-batch hot path.  Layout
 // contract lives in packing.py; keep in sync (HEADER 16B; sections
 // p i16[B] | klen u16[B] | vlen u32[B] | flags u8[B] | ts_minmax i64[2P] |
-// [slot u32[B] | alive u8[B]] | [hll: regs u8[2^p] (mode 2) OR
-// idx u16[B] | rho u8[B] (mode 1)]).
+// sz_minmax i64[2P] | [slot u32[B] | alive u8[B]] |
+// [hll: regs u8[rows << p] (mode 2) OR idx u16[B] | rho u8[B] (mode 1)]).
 // Returns total bytes written, or -1 on error (including key_len > u16 /
 // partition out of i16/num_partitions range — mirrors pack_batch's
 // validation).
@@ -507,9 +507,10 @@ extern "C" int64_t kta_pack_batch(
   if (num_partitions <= 0) return -1;
   const int64_t b = batch_size;
   const int64_t P = num_partitions;
-  // Wire format v2: the per-record i64 ts column is replaced by a [2P]
-  // per-partition min/max table (packing.py::_sections rationale).
-  int64_t need = 16 + b * (2 + 2 + 4 + 1) + 2 * P * 8;
+  // Wire format v4: the per-record i64 ts column is replaced by TWO [2P]
+  // per-partition min/max tables — timestamps and (tombstone-excluded)
+  // message sizes (packing.py::_sections rationale).
+  int64_t need = 16 + b * (2 + 2 + 4 + 1) + 2 * (2 * P * 8);
   if (with_alive) need += b * 5;
   // with_hll: 0 = off, 1 = per-record pairs, 2 = host-reduced register
   // table of hll_rows << hll_p bytes (wire v3; rows = 1 global or P
@@ -540,6 +541,8 @@ extern "C" int64_t kta_pack_batch(
   pos += b;
   uint8_t* tsmm64 = out + pos;
   pos += 2 * P * 8;
+  uint8_t* szmm64 = out + pos;
+  pos += 2 * P * 8;
 
   auto store = [](uint8_t* base, int64_t idx, auto v) {
     std::memcpy(base + idx * static_cast<int64_t>(sizeof(v)), &v, sizeof(v));
@@ -566,20 +569,33 @@ extern "C" int64_t kta_pack_batch(
   if (bad.load()) return -1;
 
   {
-    // Per-partition ts min/max over the valid prefix: identity-filled,
-    // single sequential pass (~1 ns/record; not worth the thread fan-out).
-    std::vector<int64_t> mm(2 * P);
+    // Per-partition ts min/max AND (tombstone-excluded) message-size
+    // min/max over the valid prefix: identity-filled, single sequential
+    // pass (~1-2 ns/record; not worth the thread fan-out).  Size
+    // identities are I64_MAX / 0, matching the reference's `largest`
+    // starting at 0 (src/metric.rs:34, :249-251).
+    std::vector<int64_t> mm(2 * P), sz(2 * P);
     for (int64_t r = 0; r < P; ++r) {
       mm[r] = INT64_MAX;
       mm[P + r] = INT64_MIN;
+      sz[r] = INT64_MAX;
+      sz[P + r] = 0;
     }
     for (int64_t i = 0; i < n_valid; ++i) {
       const int64_t r = partition[i];
       const int64_t t = ts_s[i];
       if (t < mm[r]) mm[r] = t;
       if (t > mm[P + r]) mm[P + r] = t;
+      if (!value_null[i]) {
+        const int64_t size =
+            (key_null[i] ? 0 : static_cast<int64_t>(key_len[i])) +
+            static_cast<int64_t>(value_len[i]);
+        if (size < sz[r]) sz[r] = size;
+        if (size > sz[P + r]) sz[P + r] = size;
+      }
     }
     std::memcpy(tsmm64, mm.data(), 2 * P * 8);
+    std::memcpy(szmm64, sz.data(), 2 * P * 8);
   }
 
   int64_t n_pairs = 0;

@@ -71,35 +71,19 @@ def extremes_update(
     latest_s,       # int64[P], I64_MIN sentinel
     smallest,       # int64[P], I64_MAX sentinel
     largest,        # int64[P]
-    partition,      # int32[B]
-    key_len,
-    value_len,
-    key_null,
-    value_null,
     ts_min,         # int64[P], host-pre-reduced (packing.ts_minmax_table)
     ts_max,         # int64[P]
-    valid,
-    num_partitions: int,
+    sz_min,         # int64[P], host-pre-reduced (packing.sz_minmax_table)
+    sz_max,         # int64[P]
 ):
-    """Update per-partition min/max timestamp and message size.
+    """Merge per-partition timestamp and message-size extremes.
 
-    Timestamps arrive already reduced per partition by the host (wire
-    format v2 dropped the 8 B/record ts column; min/max is associative,
-    so elementwise-merging the batch table is exact).  Message-size
-    extremes still scatter from the per-record sizes that the counter
-    sums need on device anyway (padded records route to a scratch row).
+    Both arrive already reduced per partition by the host (wire v2
+    dropped the per-record ts column, v4 the size-extremes scatter —
+    min/max is associative, so elementwise-merging batch tables is
+    exact; tombstone exclusion for sizes happens at table build,
+    src/metric.rs:249-251).  No per-record work remains here.
     """
-    kn = valid & ~key_null
-    vn = valid & ~value_null
-    msg_size = (
-        jnp.where(kn, key_len, 0).astype(jnp.int64)
-        + jnp.where(vn, value_len, 0).astype(jnp.int64)
-    )
-    p = num_partitions
-    # Size extremes exclude tombstones (src/metric.rs:249-251).
-    idx_sized = jnp.where(vn, partition, p)
-    sz_min = jnp.full((p + 1,), I64_MAX, jnp.int64).at[idx_sized].min(msg_size)[:p]
-    sz_max = jnp.zeros((p + 1,), jnp.int64).at[idx_sized].max(msg_size)[:p]
     return (
         jnp.minimum(earliest_s, ts_min),
         jnp.maximum(latest_s, ts_max),

@@ -1,4 +1,4 @@
-"""Packed host→device batch transfer (wire format v3).
+"""Packed host→device batch transfer (wire format v4).
 
 The profiled bottleneck of the streaming path is host→device bandwidth
 (SURVEY.md §7 hard part (a) — on this environment's tunneled TPU it measures
@@ -30,6 +30,8 @@ Layout (sections in order; B = static batch size, P = num_partitions):
     value_len u32[B]
     flags     u8[B]   bit0 = key_null, bit1 = value_null
     ts_minmax i64[2P] per-partition ts min then max, identity-filled
+    sz_minmax i64[2P] per-partition message-size min then max (v4;
+             tombstone-excluded, identities I64_MAX / 0)
     [alive]  slot u32[B] + alive u8[B]          iff count_alive_keys
     [hll]    regs u8[R << p] host-reduced table (R = 1 global, P per-
              partition) WHEN R·2^p ≤ 3·B, else idx u16[B] + rho u8[B]
@@ -87,6 +89,13 @@ def _sections(config: AnalyzerConfig, batch_size: int):
         ("value_len", np.uint32, b),
         ("flags", np.uint8, b),
         ("ts_minmax", np.int64, 2 * config.num_partitions),
+        # v4: per-partition message-size min/max (tombstone-excluded,
+        # src/metric.rs:249-251) — integer min/max is associative, so the
+        # host pre-reduces it exactly like the ts table and the device
+        # drops its last extremes scatter.  Sizes still ship per record
+        # (the counter sums need them), so this adds 16 B/partition and
+        # removes a B-record scatter-min + scatter-max from the step.
+        ("sz_minmax", np.int64, 2 * config.num_partitions),
     ]
     if config.count_alive_keys:
         sec.append(("alive_slot", np.uint32, b))
@@ -212,12 +221,35 @@ def ts_minmax_table(partition: np.ndarray, ts_s: np.ndarray,
     return table
 
 
+def sz_minmax_table(batch: RecordBatch, n_valid: int,
+                    num_partitions: int) -> np.ndarray:
+    """Host-side per-partition message-size extremes: ``[2P]`` int64, mins
+    then maxes.  Size = key bytes (when the key is non-null) + value
+    bytes; tombstones are EXCLUDED entirely (src/metric.rs:249-251).
+    Identities are I64_MAX / 0 — matching the reference's ``largest``
+    starting at 0 (src/metric.rs:34)."""
+    table = np.empty(2 * num_partitions, dtype=np.int64)
+    table[:num_partitions] = I64_MAX
+    table[num_partitions:] = 0
+    sized = ~batch.value_null[:n_valid]
+    if sized.any():
+        part = batch.partition[:n_valid][sized]
+        size = (
+            np.where(batch.key_null[:n_valid], 0,
+                     batch.key_len[:n_valid]).astype(np.int64)
+            + batch.value_len[:n_valid].astype(np.int64)
+        )[sized]
+        np.minimum.at(table[:num_partitions], part, size)
+        np.maximum.at(table[num_partitions:], part, size)
+    return table
+
+
 def pack_batch(
     batch: RecordBatch,
     config: AnalyzerConfig,
     use_native: bool = True,
 ) -> np.ndarray:
-    """RecordBatch → one contiguous uint8 buffer (wire format v3).
+    """RecordBatch → one contiguous uint8 buffer (wire format v4).
 
     The batch's valid records must be a prefix (all sources produce
     prefix-valid batches; padding lives at the tail).
@@ -295,6 +327,7 @@ def pack_batch(
             batch.partition[:n_valid], batch.ts_s[:n_valid],
             config.num_partitions,
         ),
+        "sz_minmax": sz_minmax_table(batch, n_valid, config.num_partitions),
     }
     if config.count_alive_keys:
         active = batch.valid & ~batch.key_null
@@ -366,6 +399,9 @@ def unpack_numpy(buf: np.ndarray, config: AnalyzerConfig) -> Dict[str, np.ndarra
     tm = out.pop("ts_minmax")
     out["ts_min"] = tm[: config.num_partitions]
     out["ts_max"] = tm[config.num_partitions :]
+    sm = out.pop("sz_minmax")
+    out["sz_min"] = sm[: config.num_partitions]
+    out["sz_max"] = sm[config.num_partitions :]
     return out
 
 
@@ -411,4 +447,7 @@ def unpack_device(buf, config: AnalyzerConfig):
     tm = out.pop("ts_minmax")
     out["ts_min"] = tm[: config.num_partitions]
     out["ts_max"] = tm[config.num_partitions :]
+    sm = out.pop("sz_minmax")
+    out["sz_min"] = sm[: config.num_partitions]
+    out["sz_max"] = sm[config.num_partitions :]
     return out

@@ -50,7 +50,13 @@ def test_pack_unpack_numpy_roundtrip():
     assert np.array_equal(got["key_null"][:400], batch.key_null[:400])
     assert np.array_equal(got["value_null"][:400], batch.value_null[:400])
     assert np.array_equal(got["valid"], batch.valid)
-    # v2: ts ships as the host-reduced per-partition min/max table.
+    # v2/v4: ts and size extremes ship as host-reduced per-partition
+    # min/max tables (sizes tombstone-excluded, key bytes only when the
+    # key is non-null; identities I64_MAX / I64_MIN and I64_MAX / 0).
+    sizes = (
+        np.where(batch.key_null[:400], 0, batch.key_len[:400]).astype(np.int64)
+        + batch.value_len[:400]
+    )
     for p in range(CFG.num_partitions):
         sel = batch.partition[:400] == p
         if sel.any():
@@ -59,6 +65,13 @@ def test_pack_unpack_numpy_roundtrip():
         else:
             assert got["ts_min"][p] == np.iinfo(np.int64).max
             assert got["ts_max"][p] == np.iinfo(np.int64).min
+        sized = sel & ~batch.value_null[:400]
+        if sized.any():
+            assert got["sz_min"][p] == sizes[sized].min()
+            assert got["sz_max"][p] == sizes[sized].max()
+        else:
+            assert got["sz_min"][p] == np.iinfo(np.int64).max
+            assert got["sz_max"][p] == 0
 
 
 def test_device_unpack_matches_numpy_unpack():
@@ -138,7 +151,7 @@ def test_native_pack_semantics_match_numpy(hll_p, per_partition):
     per_record = ("partition", "key_len", "value_len", "key_null",
                   "value_null", "hll_idx", "hll_rho")
     for name in ("partition", "key_len", "value_len", "key_null",
-                 "value_null", "ts_min", "ts_max") + hll_names:
+                 "value_null", "ts_min", "ts_max", "sz_min", "sz_max") + hll_names:
         cut = nv if name in per_record else len(ua[name])
         assert np.array_equal(ua[name][:cut], ub[name][:cut]), name
     # Dedupe pair ORDER differs (sorted vs first-touch); counts must match
@@ -166,7 +179,7 @@ def test_native_pack_odd_batch_size_and_empty():
     ua, ub = unpack_numpy(a, odd_cfg), unpack_numpy(b, odd_cfg)
     for name in ("partition", "key_len", "value_len"):
         assert np.array_equal(ua[name][:400], ub[name][:400]), name
-    for name in ("ts_min", "ts_max"):  # [P] tables, not per-record
+    for name in ("ts_min", "ts_max", "sz_min", "sz_max"):  # [P] tables
         assert np.array_equal(ua[name], ub[name]), name
     from kafka_topic_analyzer_tpu.records import RecordBatch
 
