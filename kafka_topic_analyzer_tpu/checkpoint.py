@@ -110,12 +110,19 @@ def save_snapshot(
     records_seen: int,
     init_now_s: int,
     scope=None,
+    degraded: "Optional[Dict[int, str]]" = None,
 ) -> str:
     """Atomically write the snapshot; returns its path.
 
     ``scope``: None, or ``(process_index, process_count, local_rows)`` for
     multi-controller runs — ``state`` is then the PROCESS-LOCAL rows
-    (ShardedTpuBackend.get_state_local)."""
+    (ShardedTpuBackend.get_state_local).
+
+    ``degraded``: partition -> reason for partitions the scan dropped
+    (transport-fault degradation).  Informational only — resume reads
+    ``next_offsets``, which already stop at each degraded partition's last
+    folded record — but it lets an operator see from the snapshot alone
+    why a rerun is needed."""
     os.makedirs(directory, exist_ok=True)
     host_state = jax.tree.map(np.asarray, jax.device_get(state))
     flat = _flatten(host_state)
@@ -126,6 +133,8 @@ def save_snapshot(
         "records_seen": int(records_seen),
         "init_now_s": int(init_now_s),
     }
+    if degraded:
+        meta["degraded"] = {str(k): str(v) for k, v in degraded.items()}
     if scope is not None:
         meta["process"] = [int(scope[0]), int(scope[1])]
         meta["local_rows"] = [int(r) for r in scope[2]]

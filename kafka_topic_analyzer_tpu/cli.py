@@ -18,6 +18,30 @@ from typing import Dict, Optional
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 
 
+#: Exit code when the scan finished but one or more partitions were dropped
+#: after exhausting their transport retry budget: the report renders (with
+#: the degraded block) yet the numbers undercount, so automation must see a
+#: failure.  Distinct from 1 (hard error) and -2 (empty topic).
+EXIT_DEGRADED = 3
+
+
+def _degraded_exit(result, doc=None, render=False) -> int:
+    """Shared tail of every report path: surface the degraded partitions —
+    into ``doc`` as a str-keyed map (``--json``) and/or as the post-table
+    warning block (``render``) — and pick the exit code."""
+    if not result.degraded_partitions:
+        return 0
+    if doc is not None:
+        doc["degraded_partitions"] = {
+            str(p): r for p, r in result.degraded_partitions.items()
+        }
+    if render:
+        from kafka_topic_analyzer_tpu.report import render_degraded_block
+
+        sys.stdout.write(render_degraded_block(result.degraded_partitions))
+    return EXIT_DEGRADED
+
+
 class UserInputError(ValueError):
     """A bad flag/spec value (setup phase) — reported as one clean line.
     Internal ValueErrors deliberately do NOT inherit this, so they keep
@@ -369,7 +393,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         print(result.profile.summary(), file=sys.stderr)
     multi.close()  # flush per-topic segment dumps, release connections
     if _not_report_process(args):
-        return 0  # multi-host: one report, from process 0
+        return _degraded_exit(result)  # multi-host: one report, from process 0
 
     union = result.metrics
     # Per-topic projections, computed once for both output formats.
@@ -403,8 +427,10 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         if union.quantiles is not None:
             union_doc["size_quantiles"] = union.quantiles.as_dict()
         doc["union"] = union_doc
+        # Degraded keys are dense fan-in rows; reasons carry topic/partition.
+        rc = _degraded_exit(result, doc=doc)
         print(json.dumps(doc))
-        return 0
+        return rc
     # Per-topic reports from the shared projections.
     for topic, sliced, start, end in slices:
         # Extensions render only the per-row lines a slice can carry (e.g.
@@ -443,7 +469,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         )
         print(f"Message size quantiles (union): {qs}")
     print(eq)
-    return 0
+    return _degraded_exit(result, render=True)
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -539,7 +565,10 @@ def _run(args) -> int:
     if hasattr(source, "close"):
         source.close()  # flush segment dumps, release broker connections
     if _not_report_process(args):
-        return 0  # multi-host: one report, from process 0
+        # Multi-host: one report, from process 0 — but every process must
+        # agree on the degraded exit code for orchestrators (run_scan
+        # reduces the degraded flag across processes).
+        return _degraded_exit(result)
 
     if args.json:
         import json
@@ -547,8 +576,9 @@ def _run(args) -> int:
         doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)
         doc["topic"] = args.topic
         doc["duration_secs"] = result.duration_secs
+        rc = _degraded_exit(result, doc=doc)
         print(json.dumps(doc))
-        return 0
+        return rc
     sys.stdout.write(
         render_report(
             args.topic,
@@ -563,7 +593,7 @@ def _run(args) -> int:
         from kafka_topic_analyzer_tpu.report import render_extremes_table
 
         sys.stdout.write(render_extremes_table(result.metrics))
-    return 0
+    return _degraded_exit(result, render=True)
 
 
 if __name__ == "__main__":

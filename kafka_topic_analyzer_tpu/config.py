@@ -14,6 +14,61 @@ from typing import Tuple
 
 
 @dataclasses.dataclass(frozen=True)
+class TransportRetryConfig:
+    """Transport-fault tolerance knobs for the live Kafka scan.
+
+    Deliberately NOT part of `AnalyzerConfig`: retry pacing changes neither
+    state shapes nor fold semantics, and folding it into the analyzer config
+    would churn the checkpoint fingerprint (checkpoint.py) for a setting
+    that has no effect on the numbers.  Mapped from the librdkafka-style
+    ``--librdkafka`` overrides table in io/kafka_wire.py.
+    """
+
+    #: First delay after a transport failure (librdkafka ``retry.backoff.ms``;
+    #: ``reconnect.backoff.ms`` raises it too when set higher).  Doubles per
+    #: consecutive failure.
+    backoff_ms: int = 100
+    #: Backoff ceiling (librdkafka ``reconnect.backoff.max.ms``).
+    backoff_max_ms: int = 10_000
+    #: Consecutive transport failures a partition survives before it is
+    #: marked *degraded* (scan continues without it) instead of retrying
+    #: forever.  Non-librdkafka knob: ``transport.retry.budget``.
+    retry_budget: int = 8
+    #: Fractional jitter applied to every delay (librdkafka applies ±20%):
+    #: a delay d is drawn uniformly from [d·(1-j), d·(1+j)].
+    jitter: float = 0.2
+
+    def __post_init__(self) -> None:
+        if self.backoff_ms < 1:
+            raise ValueError("retry.backoff.ms must be >= 1")
+        if self.backoff_max_ms < self.backoff_ms:
+            raise ValueError(
+                "reconnect.backoff.max.ms must be >= retry.backoff.ms"
+            )
+        if self.retry_budget < 1:
+            raise ValueError("transport.retry.budget must be >= 1")
+        if not (0.0 <= self.jitter < 1.0):
+            raise ValueError("retry jitter must be in [0, 1)")
+
+    @classmethod
+    def from_overrides(cls, overrides: dict) -> "TransportRetryConfig":
+        """Pop the retry-related librdkafka-style properties out of an
+        overrides dict (mutating it, like the other knob parsers in
+        io/kafka_wire.py) and build the config."""
+        base = int(overrides.pop("retry.backoff.ms", 100))
+        # librdkafka paces reconnect attempts separately; this client runs
+        # one schedule, so an explicitly higher reconnect floor wins.
+        base = max(base, int(overrides.pop("reconnect.backoff.ms", base)))
+        return cls(
+            backoff_ms=base,
+            backoff_max_ms=max(
+                base, int(overrides.pop("reconnect.backoff.max.ms", 10_000))
+            ),
+            retry_budget=int(overrides.pop("transport.retry.budget", 8)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class AnalyzerConfig:
     """Static configuration for one analysis run.
 

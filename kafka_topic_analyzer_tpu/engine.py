@@ -55,6 +55,13 @@ class ScanResult:
     profile: ScanProfile
     start_offsets: "dict[int, int]"
     end_offsets: "dict[int, int]"
+    #: partition -> reason, for partitions the source dropped after
+    #: exhausting their retry budget (graceful degradation).  Non-empty
+    #: means the metrics UNDERCOUNT those partitions' tails: the report
+    #: flags them and the CLI exits non-zero (cli.EXIT_DEGRADED).
+    degraded_partitions: "dict[int, str]" = dataclasses.field(
+        default_factory=dict
+    )
 
 
 class _ProgressTracker:
@@ -167,7 +174,19 @@ def run_scan(
             seq = records_seen
     last_snap = time.monotonic()
 
-    def maybe_snapshot(force: bool = False) -> None:
+    # Offsets/seq as of the last COMPLETED fold.  The tracker observes a
+    # batch during ingest, before backend.update folds it, so on a mid-round
+    # failure `tracker.next_offsets` can be ahead of the backend state; the
+    # failure-path snapshot must use these instead or a resume would skip
+    # the observed-but-never-folded records.
+    committed_offsets = dict(tracker.next_offsets)
+    committed_seq = seq
+
+    def maybe_snapshot(
+        force: bool = False,
+        offsets: "Optional[dict[int, int]]" = None,
+        records_seen: Optional[int] = None,
+    ) -> None:
         nonlocal last_snap
         if not can_snapshot:
             return
@@ -182,10 +201,15 @@ def run_scan(
                 topic,
                 backend.config,
                 snap_get(),
-                tracker.next_offsets,
-                seq,
+                tracker.next_offsets if offsets is None else offsets,
+                seq if records_seen is None else records_seen,
                 backend.init_now_s,
                 scope=snap_scope,
+                degraded=(
+                    source.degraded_partitions()
+                    if hasattr(source, "degraded_partitions")
+                    else None
+                ),
             )
         last_snap = time.monotonic()
 
@@ -276,6 +300,8 @@ def run_scan(
                 with profile.stage("dispatch", items=step_valid):
                     backend.update_shards(shard_batches)
                 seq += step_valid
+                committed_offsets = dict(tracker.next_offsets)
+                committed_seq = seq
                 maybe_snapshot()
                 spinner.set_message(f"[Sq: {seq} | T: {topic} | shards: {d}]")
         else:
@@ -328,16 +354,59 @@ def run_scan(
                 ):
                     backend.update(staged)
                 seq += nvalid
+                committed_offsets = dict(tracker.next_offsets)
+                committed_seq = seq
                 maybe_snapshot()
                 # indicatif-template message like src/kafka.rs:111-113.
                 spinner.set_message(
                     f"[Sq: {seq} | T: {topic} | P: {last_partition} | "
                     f"O: {last_offset} | Ts: {format_utc_seconds(int(batch.ts_s[last]))}]"
                 )
+    except BaseException:
+        # Irrecoverable mid-scan failure (or interrupt): persist the
+        # progress as a final snapshot so a rerun with --resume continues
+        # where this one died instead of rescanning from earliest.  Best
+        # effort — the original failure is what must surface.
+        try:
+            maybe_snapshot(
+                force=True,
+                offsets=committed_offsets,
+                records_seen=committed_seq,
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "final failure snapshot could not be written"
+            )
+        raise
     finally:
         for it in open_iters:
             if hasattr(it, "close"):
                 it.close()
+
+    degraded = (
+        dict(source.degraded_partitions())
+        if hasattr(source, "degraded_partitions")
+        else {}
+    )
+    # Multi-controller: each process feeds (and can only degrade) its own
+    # rows, but process 0 renders the report and orchestrators read every
+    # process's exit code — so "did the scan degrade" must be a global
+    # agreement, like the per-round continuation above.
+    lockstep = getattr(backend, "global_any", None)
+    if lockstep is not None:
+        d = backend.config.data_shards
+        feed_rows = list(getattr(backend, "local_rows", range(d)))
+        if len(feed_rows) < d and lockstep(bool(degraded)) and not degraded:
+            degraded = {
+                -1: "partition(s) degraded on another process (see its log)"
+            }
+    if degraded:
+        # Degraded partitions carry an unscanned tail; snapshot so a rerun
+        # resumes them once the cluster recovers (their next_offsets stop
+        # at the last successfully folded record).
+        maybe_snapshot(force=True)
 
     with profile.stage("finalize"):
         metrics = backend.finalize()
@@ -350,4 +419,5 @@ def run_scan(
         profile=profile,
         start_offsets=start_offsets,
         end_offsets=end_offsets,
+        degraded_partitions=degraded,
     )

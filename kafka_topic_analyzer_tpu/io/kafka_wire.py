@@ -18,7 +18,13 @@ reproduces the reference consumer's observable behavior (src/kafka.rs):
   receive.message.max.bytes), socket tuning (socket.timeout.ms,
   socket.connection.setup.timeout.ms, broker.address.family,
   socket.keepalive.enable, socket.nagle.disable,
-  socket.send/receive.buffer.bytes), TLS and SASL properties.  Properties
+  socket.send/receive.buffer.bytes), transport-fault recovery
+  (retry.backoff.ms, reconnect.backoff.ms, reconnect.backoff.max.ms, and
+  the non-librdkafka transport.retry.budget — see config.py
+  ``TransportRetryConfig`` and io/retry.py), TLS and SASL properties.
+  A partition that stays unreachable past its retry budget is marked
+  *degraded* (``self.degraded``) and dropped from the scan instead of
+  aborting it; the engine/CLI report it and exit non-zero.  Properties
   that are valid librdkafka consumer config but can have no effect here
   (KNOWN_NOOP_PROPERTIES — group/commit settings the reference disables
   anyway) are accepted silently; truly unknown keys warn, like librdkafka
@@ -42,7 +48,9 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from kafka_topic_analyzer_tpu.config import TransportRetryConfig
 from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.retry import Backoff, PartitionRetryBudget
 from kafka_topic_analyzer_tpu.io.source import RecordSource
 from kafka_topic_analyzer_tpu.records import RecordBatch
 
@@ -65,8 +73,7 @@ KNOWN_NOOP_PROPERTIES = frozenset({
     "max.poll.interval.ms", "enable.auto.commit", "auto.commit.interval.ms",
     "auto.offset.reset", "enable.partition.eof", "enable.auto.offset.store",
     "queue.buffering.max.ms", "queued.min.messages",
-    "queued.max.messages.kbytes", "client.id", "reconnect.backoff.ms",
-    "reconnect.backoff.max.ms", "statistics.interval.ms",
+    "queued.max.messages.kbytes", "client.id", "statistics.interval.ms",
     "api.version.request", "broker.version.fallback", "debug", "log_level",
     "allow.auto.create.topics", "client.rack", "metadata.max.age.ms",
     "topic.metadata.refresh.interval.ms",
@@ -330,6 +337,19 @@ def parse_bootstrap(bootstrap_servers: str) -> List[Tuple[str, int]]:
     return out
 
 
+class _TransportFailure:
+    """Phase-1 fetch result when a leader's transport died mid-round: the
+    serial phase books the failure against the leader's partitions instead
+    of letting the exception abort the scan."""
+
+    __slots__ = ("leader", "partitions", "error")
+
+    def __init__(self, leader: int, partitions: List[int], error: BaseException):
+        self.leader = leader
+        self.partitions = partitions
+        self.error = error
+
+
 class KafkaWireSource(RecordSource):
     def __init__(
         self,
@@ -364,6 +384,11 @@ class KafkaWireSource(RecordSource):
         self.error_backoff_ms = int(
             overrides.pop("fetch.error.backoff.ms", self.max_wait_ms)
         )
+        #: Transport-fault recovery: reconnect pacing (retry.backoff.ms,
+        #: reconnect.backoff.ms, reconnect.backoff.max.ms) and the
+        #: per-partition retry budget (transport.retry.budget) that gates
+        #: the degraded transition.
+        self.retry_config = TransportRetryConfig.from_overrides(overrides)
         family_name = overrides.pop("broker.address.family", "any").lower()
         try:
             family = {
@@ -452,7 +477,15 @@ class KafkaWireSource(RecordSource):
         self._brokers: Dict[int, Tuple[str, int]] = {}
         self._leaders: Dict[int, int] = {}
         self._watermarks: Optional[Tuple[Dict[int, int], Dict[int, int]]] = None
+        #: partition -> reason, for every partition dropped from a scan
+        #: after exhausting its transport/protocol retry budget.  Sharded
+        #: scans run several batches() streams against one source, so this
+        #: accumulates across streams; the engine snapshots it per scan.
+        self.degraded: Dict[int, str] = {}
         self._load_metadata()
+
+    def degraded_partitions(self) -> Dict[int, str]:
+        return dict(self.degraded)
 
     # -- connections ---------------------------------------------------------
 
@@ -602,11 +635,23 @@ class KafkaWireSource(RecordSource):
                     raise
                 log.warning("ApiVersions handshake failed (%s); retrying", e)
                 continue
-            r = conn.request(
-                kc.API_METADATA, v, kc.encode_metadata_request([self.topic], v)
-            )
-            md = kc.decode_metadata_response(r, v)
-            self._brokers = md.brokers
+            try:
+                r = conn.request(
+                    kc.API_METADATA, v,
+                    kc.encode_metadata_request([self.topic], v),
+                )
+                md = kc.decode_metadata_response(r, v)
+            except (OSError, kc.KafkaProtocolError) as e:
+                # A cached bootstrap connection died (broker restart) or
+                # the stream desynced: evict so the retry reconnects fresh
+                # instead of hitting the same dead socket forever.
+                self._evict(conn)
+                if attempt + 1 >= retries:
+                    raise kc.KafkaProtocolError(
+                        f"metadata request failed: {e}"
+                    ) from e
+                log.warning("metadata request failed (%s); retrying", e)
+                continue
             topic_md = next((t for t in md.topics if t.name == self.topic), None)
             if topic_md is None or topic_md.error == kc.ERR_UNKNOWN_TOPIC_OR_PARTITION:
                 raise SystemExit("Topic not found!")  # src/kafka.rs:62
@@ -621,6 +666,12 @@ class KafkaWireSource(RecordSource):
                 if p.error or p.leader < 0 or p.leader not in md.brokers
             ]
             if not bad:
+                # Commit brokers+leaders together, and only on full
+                # success: a recovery-path reload that fails partway (half
+                # -up broker, leaderless election) must leave the previous
+                # topology fully intact, not a half-new brokers table that
+                # routes still-healthy partitions into transport failures.
+                self._brokers = md.brokers
                 self._leaders = {p.partition: p.leader for p in topic_md.partitions}
                 return
             last_issue = ", ".join(
@@ -632,6 +683,31 @@ class KafkaWireSource(RecordSource):
         raise kc.KafkaProtocolError(
             f"no usable leader for topic {self.topic!r}: {last_issue}"
         )
+
+    def _reload_metadata(self) -> bool:
+        """Metadata refresh that tolerates an unreachable cluster: during
+        transport recovery a failed reload must not abort the scan — the
+        next round retries against the stale topology, and the per-partition
+        retry budget bounds how long that can go on."""
+        try:
+            self._load_metadata()
+            return True
+        except (OSError, kc.KafkaProtocolError) as e:
+            log.warning(
+                "metadata reload failed (%s); keeping stale topology", e
+            )
+            return False
+        except SystemExit:
+            # _load_metadata's "Topic not found!" exit is an init-time
+            # contract (src/kafka.rs:62).  Mid-scan it is a transient: a
+            # restarting broker can answer metadata with
+            # UNKNOWN_TOPIC_OR_PARTITION before it re-syncs topic state,
+            # and the scan already proved the topic exists.
+            log.warning(
+                "metadata reload says topic %r unknown (broker still "
+                "syncing?); keeping stale topology", self.topic,
+            )
+            return False
 
     def partitions(self) -> List[int]:
         return sorted(self._leaders)
@@ -647,14 +723,23 @@ class KafkaWireSource(RecordSource):
             host, port = self._brokers[leader]
             conn = self._connect(host, port)
             lo_v = self._version(conn, kc.API_LIST_OFFSETS)
-            r = conn.request(
-                kc.API_LIST_OFFSETS,
-                lo_v,
-                kc.encode_list_offsets_request(
-                    self.topic, [(p, ts) for p in parts], lo_v
-                ),
-            )
-            for pid, (err, off) in kc.decode_list_offsets_response(r, lo_v).items():
+            try:
+                r = conn.request(
+                    kc.API_LIST_OFFSETS,
+                    lo_v,
+                    kc.encode_list_offsets_request(
+                        self.topic, [(p, ts) for p in parts], lo_v
+                    ),
+                )
+                decoded = kc.decode_list_offsets_response(r, lo_v)
+            except (OSError, kc.KafkaProtocolError) as e:
+                # Evict the dead/desynced cached connection before
+                # surfacing the failure so a caller's retry reconnects.
+                self._evict(conn)
+                raise kc.KafkaProtocolError(
+                    f"ListOffsets on {host}:{port} failed: {e}"
+                ) from e
+            for pid, (err, off) in decoded.items():
                 if err:
                     raise kc.KafkaProtocolError(
                         f"ListOffsets error {err} for partition {pid}"
@@ -801,6 +886,24 @@ class KafkaWireSource(RecordSource):
 
         error_streak: Dict[int, int] = {p: 0 for p in parts}
         max_error_streak = 100
+        # Transport-fault recovery: reconnect pacing shared by the whole
+        # stream, budget per partition.  A partition whose budget runs out
+        # DEGRADES (dropped + reported via self.degraded) instead of
+        # aborting the scan and discarding every other partition's work.
+        backoff = Backoff(self.retry_config)
+        budget = PartitionRetryBudget(self.retry_config.retry_budget)
+        # Backoff is PER LEADER, not per round: one dead broker must not
+        # throttle the still-healthy leaders' throughput, so its partitions
+        # are deferred past a retry deadline while everyone else streams.
+        leader_fail_streak: Dict[int, int] = {}
+        leader_retry_at: Dict[int, float] = {}
+
+        def degrade(p: int, reason: str) -> None:
+            if p not in remaining:
+                return
+            log.error("partition %d degraded: %s", p, reason)
+            remaining.discard(p)
+            self.degraded[p] = reason
         # Consecutive fetches for a partition that neither consumed records
         # nor advanced the offset (possible under response-budget pressure
         # from sibling partitions) — bounded so a pathological broker can't
@@ -819,7 +922,16 @@ class KafkaWireSource(RecordSource):
             # (load balancer, port forward) must NOT share a socket — the
             # pipelined send/read halves from two threads would race for
             # each other's response bytes.
-            host, port = self._brokers[leader]
+            addr = self._brokers.get(leader)
+            if addr is None:
+                # A recovery-path metadata reload can drop a broker while
+                # its partitions still point at it (leaderless election
+                # window): a protocol error here books as a transport
+                # failure instead of a KeyError aborting the scan.
+                raise kc.KafkaProtocolError(
+                    f"leader {leader} missing from cluster metadata"
+                )
+            host, port = addr
             with conn_lock:
                 c = own_conns.get(leader)
                 if c is not None and (c.host, c.port) != (host, port):
@@ -983,13 +1095,47 @@ class KafkaWireSource(RecordSource):
                     )
             return (leader, fps, scans, soas, spec_sent, order, pmax_sent)
 
+        def fetch_leader_guarded(leader: int, lparts: List[int], fetch_round: int):
+            """fetch_leader with transport-failure capture: a reset, hang
+            (socket timeout), refused reconnect, or truncated/desynced
+            stream tears down this leader's connection — including any
+            speculative in-flight fetch riding on it — and returns a
+            `_TransportFailure` for phase 2 to book, rather than killing
+            the scan."""
+            try:
+                return fetch_leader(leader, lparts, fetch_round)
+            except (OSError, kc.KafkaProtocolError) as e:
+                inflight.pop(leader, None)
+                with conn_lock:
+                    c = own_conns.pop(leader, None)
+                if c is not None:
+                    c.close()
+                log.warning(
+                    "transport failure on leader %d (%s): %s",
+                    leader, type(e).__name__, e,
+                )
+                return _TransportFailure(leader, list(lparts), e)
+
         pool: "object | None" = None
 
         fetch_round = 0
         while remaining:
+            now = time.monotonic()
             by_leader: Dict[int, List[int]] = {}
+            deferred: "List[float]" = []
             for p in remaining:
-                by_leader.setdefault(self._leaders[p], []).append(p)
+                leader = self._leaders[p]
+                retry_at = leader_retry_at.get(leader)
+                if retry_at is not None and retry_at > now:
+                    deferred.append(retry_at)
+                    continue
+                by_leader.setdefault(leader, []).append(p)
+            if not by_leader:
+                # Every remaining partition's leader is inside its backoff
+                # window: sleep to the earliest retry deadline instead of
+                # spinning the loop.
+                time.sleep(max(0.0, min(deferred) - time.monotonic()))
+                continue
             progressed = False
             fetch_round += 1
             if len(by_leader) > 1 and pool is None:
@@ -1006,20 +1152,48 @@ class KafkaWireSource(RecordSource):
             if pool is not None and len(by_leader) > 1:
                 results = list(
                     pool.map(
-                        lambda kv: fetch_leader(kv[0], kv[1], fetch_round),
+                        lambda kv: fetch_leader_guarded(kv[0], kv[1], fetch_round),
                         by_leader.items(),
                     )
                 )
             else:
                 results = [
-                    fetch_leader(leader, lparts, fetch_round)
+                    fetch_leader_guarded(leader, lparts, fetch_round)
                     for leader, lparts in by_leader.items()
                 ]
-            for leader, fps, scans, soas, spec_sent, order, pmax_sent in results:
+            transport_failed = False
+            for result in results:
+                if isinstance(result, _TransportFailure):
+                    transport_failed = True
+                    streak = leader_fail_streak.get(result.leader, 0) + 1
+                    leader_fail_streak[result.leader] = streak
+                    # Capped exponential + jitter, paced per leader.  A
+                    # post-reload migration hands the partitions a new
+                    # leader id with no pending deadline, so they refetch
+                    # immediately.
+                    leader_retry_at[result.leader] = (
+                        time.monotonic() + backoff.delay_ms(streak) / 1000.0
+                    )
+                    reason = (
+                        f"{type(result.error).__name__}: {result.error}"
+                    )
+                    for p in result.partitions:
+                        if p not in remaining:
+                            continue
+                        if budget.record_failure(p, reason):
+                            degrade(p, budget.degraded[p])
+                    continue
+                leader, fps, scans, soas, spec_sent, order, pmax_sent = result
+                leader_fail_streak.pop(leader, None)
+                leader_retry_at.pop(leader, None)
                 for fp in fps:
                     p = fp.partition
                     if p not in remaining:
                         continue
+                    # A response arrived for this partition: its transport
+                    # is alive again (protocol errors are tracked by
+                    # error_streak separately).
+                    budget.record_success(p)
                     if fp.error:
                         # Warn and re-poll, like the reference's poll loop
                         # (src/kafka.rs:95-97) — but with recovery for the
@@ -1027,18 +1201,29 @@ class KafkaWireSource(RecordSource):
                         log.warning("fetch error %d on partition %d", fp.error, p)
                         error_streak[p] += 1
                         if fp.error == kc.ERR_NOT_LEADER_FOR_PARTITION:
-                            self._load_metadata()
+                            self._reload_metadata()
                         elif fp.error == kc.ERR_OFFSET_OUT_OF_RANGE:
                             # Retention advanced past our offset: resume at
                             # the new earliest (scan window stays [.., end)).
-                            new_start = self._earliest_offset(p)
+                            try:
+                                new_start = self._earliest_offset(p)
+                            except (OSError, kc.KafkaProtocolError) as e:
+                                # Leader unreachable for the re-anchor
+                                # lookup: stay put; the streak/budget
+                                # bounds the retries.
+                                log.warning(
+                                    "re-anchor lookup for partition %d "
+                                    "failed: %s", p, e,
+                                )
+                                new_start = next_offset[p]
                             if new_start > next_offset[p]:
                                 next_offset[p] = new_start
                                 progressed = True
                         if error_streak[p] >= max_error_streak:
-                            raise kc.KafkaProtocolError(
-                                f"partition {p}: {error_streak[p]} consecutive "
-                                f"fetch errors (last: {fp.error})"
+                            degrade(
+                                p,
+                                f"{error_streak[p]} consecutive fetch "
+                                f"errors (last: {fp.error})",
                             )
                         continue
                     error_streak[p] = 0
@@ -1151,9 +1336,10 @@ class KafkaWireSource(RecordSource):
                                 # answer.
                                 stall_streak[p] += 1
                                 if stall_streak[p] >= max_stall:
-                                    raise kc.KafkaProtocolError(
-                                        f"partition {p}: {stall_streak[p]} "
-                                        "consecutive empty fetches"
+                                    degrade(
+                                        p,
+                                        f"{stall_streak[p]} consecutive "
+                                        "empty fetches",
                                     )
                         else:
                             # Frames present but none complete at/past our
@@ -1164,11 +1350,13 @@ class KafkaWireSource(RecordSource):
                             # budget frees as other partitions drain.
                             if len(fp.records) >= pmax_sent:
                                 if pmax_sent >= MAX_PARTITION_FETCH_BYTES:
-                                    raise kc.KafkaProtocolError(
-                                        f"partition {p}: cannot decode fetch"
-                                        f" response even at max.partition."
-                                        f"fetch.bytes={pmax_sent}"
+                                    degrade(
+                                        p,
+                                        "cannot decode fetch response even "
+                                        "at max.partition.fetch.bytes="
+                                        f"{pmax_sent}",
                                     )
+                                    continue
                                 self.partition_max_bytes = min(
                                     max(self.partition_max_bytes, pmax_sent * 2),
                                     MAX_PARTITION_FETCH_BYTES,
@@ -1184,10 +1372,11 @@ class KafkaWireSource(RecordSource):
                             else:
                                 stall_streak[p] += 1
                                 if stall_streak[p] >= max_stall:
-                                    raise kc.KafkaProtocolError(
-                                        f"partition {p}: {stall_streak[p]} "
-                                        "consecutive fetches with no "
-                                        "progress (truncated responses)"
+                                    degrade(
+                                        p,
+                                        f"{stall_streak[p]} consecutive "
+                                        "fetches with no progress "
+                                        "(truncated responses)",
                                     )
                     if next_offset[p] >= end[p]:
                         remaining.discard(p)
@@ -1209,9 +1398,17 @@ class KafkaWireSource(RecordSource):
                                 if own_conns.get(leader) is fl2[0]:
                                     own_conns.pop(leader, None)
                 yield from flush(force=False)
-            if not progressed and remaining:
-                # Nothing moved this round (e.g. leader churn): brief pause
-                # so error responses don't busy-spin the broker.
+            if transport_failed and remaining:
+                # Dead/reset connections this round: refresh the topology
+                # (a restarted broker or migrated leader shows up in fresh
+                # metadata; partitions re-route via by_leader next round,
+                # reconnection happens lazily in own_conn).  Retry pacing
+                # is the failed leader's per-leader deadline above — the
+                # healthy leaders keep streaming unthrottled.
+                self._reload_metadata()
+            elif not progressed and remaining:
+                # Nothing moved this round (e.g. leader churn): brief
+                # pause so error responses don't busy-spin the broker.
                 time.sleep(self.error_backoff_ms / 1000.0)
         yield from flush(force=True)
 

@@ -91,6 +91,16 @@ class MultiTopicSource(RecordSource):
                 out[self._row_of[(topic, p)]] = off
         return out
 
+    def degraded_partitions(self) -> Dict[int, str]:
+        """Degraded rows across the fan-in, keyed by dense row id (the
+        partition-id space this source exposes), reasons prefixed with the
+        owning topic."""
+        out: Dict[int, str] = {}
+        for topic, src in self.topic_sources:
+            for p, reason in src.degraded_partitions().items():
+                out[self._row_of[(topic, p)]] = f"{topic}/{p}: {reason}"
+        return out
+
     def batches(
         self,
         batch_size: int,

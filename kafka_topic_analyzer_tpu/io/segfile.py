@@ -282,6 +282,9 @@ class TeeSource(RecordSource):
     def offsets_for_timestamp(self, ts_ms: int):
         return self.inner.offsets_for_timestamp(ts_ms)
 
+    def degraded_partitions(self):
+        return self.inner.degraded_partitions()
+
     def batches(self, batch_size, partitions=None, start_at=None):
         self.writer.set_base_offsets(self.inner.watermarks()[0])
         for batch in self.inner.batches(batch_size, partitions, start_at):

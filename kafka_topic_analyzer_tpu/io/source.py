@@ -40,6 +40,13 @@ class RecordSource(abc.ABC):
         offsets (snapshot resume, checkpoint.py); missing partitions start
         at their earliest offset."""
 
+    def degraded_partitions(self) -> Dict[int, str]:
+        """partition -> reason for partitions a scan dropped after
+        exhausting their transport/protocol retry budget (graceful
+        degradation; io/kafka_wire.py).  Empty for sources that cannot
+        degrade (synthetic, segment files)."""
+        return {}
+
     def total_records(self) -> int:
         start, end = self.watermarks()
         return sum(end[p] - start[p] for p in end)

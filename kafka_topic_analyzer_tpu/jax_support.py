@@ -15,6 +15,39 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-compat ``shard_map``: jax >= 0.5 exposes ``jax.shard_map``
+    (replication checking spelled ``check_vma``); on older releases (the
+    container ships 0.4.37) the same transform lives at
+    ``jax.experimental.shard_map.shard_map`` with the knob spelled
+    ``check_rep``.  All device-side callers route through here so the
+    sharded backend works on both."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _esm
+
+    return _esm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def varying_mesh_axes(x) -> frozenset:
+    """Mesh axes ``x`` varies over under a check_vma shard_map (its aval's
+    ``vma``), or an empty frozenset on jax versions that predate the vma
+    machinery (0.4.x checks replication via ``check_rep`` instead and has
+    no ``jax.typeof``) — callers then skip their pvary/vma plumbing."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", None) or frozenset()
+
+
 def force_platform(platforms: str) -> None:
     """Force the jax platform list even when a sitecustomize pinned
     JAX_PLATFORMS before we ran (e.g. axon's TPU tunnel).

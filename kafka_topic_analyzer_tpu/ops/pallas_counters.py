@@ -36,7 +36,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from kafka_topic_analyzer_tpu.jax_support import jnp
+from kafka_topic_analyzer_tpu.jax_support import jnp, varying_mesh_axes
 
 #: Records per grid step: an (8, 128) int32 tile.
 BLOCK = 1024
@@ -121,7 +121,7 @@ def _call(part, klen, vlen, kn, vn, valid, p_pad: int, interpret: bool):
     # Under a check_vma shard_map the output aval must declare its
     # varying mesh axes; the reduction preserves the inputs' variance
     # (per-device records → per-device counts), so propagate their vma.
-    vma = getattr(jax.typeof(part), "vma", None)
+    vma = varying_mesh_axes(part) or None
     out_aval = (
         jax.ShapeDtypeStruct((PLANES, p_pad), jnp.int32, vma=vma)
         if vma
@@ -176,7 +176,7 @@ def pallas_counters_update(
     # Under a check_vma shard_map the kernel output varies over the mesh
     # axes its inputs vary over; the zeros accumulator starts replicated
     # and must be explicitly cast to match before the add.
-    axes = tuple(sorted(getattr(jax.typeof(partition), "vma", frozenset())))
+    axes = tuple(sorted(varying_mesh_axes(partition)))
     if axes:
         total = jax.lax.pvary(total, axes)
     for lo in range(0, b, MAX_CALL):

@@ -46,7 +46,7 @@ from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
 from kafka_topic_analyzer_tpu.backends.step import analyzer_step
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 from kafka_topic_analyzer_tpu.packing import pack_batch, unpack_device
-from kafka_topic_analyzer_tpu.jax_support import jnp, lax
+from kafka_topic_analyzer_tpu.jax_support import jnp, lax, shard_map
 from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState
 from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState
 from kafka_topic_analyzer_tpu.models.state import AnalyzerState
@@ -241,7 +241,7 @@ class ShardedTpuBackend(MetricBackend):
         relax_vma = (
             config.use_pallas_counters and jax.default_backend() == "cpu"
         )
-        step = jax.shard_map(
+        step = shard_map(
             _step_body,
             mesh=self.mesh,
             in_specs=(self._specs, P(DATA_AXIS, SPACE_AXIS)),
@@ -299,7 +299,7 @@ class ShardedTpuBackend(MetricBackend):
             P() if config.enable_hll else None,
             P() if config.enable_quantiles else None,
         )
-        return jax.shard_map(
+        return shard_map(
             merge_body,
             mesh=self.mesh,
             in_specs=(specs,),
@@ -382,7 +382,7 @@ class ShardedTpuBackend(MetricBackend):
                 return lax.psum(x, DATA_AXIS)
 
             self._any_fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     body,
                     mesh=self.mesh,
                     in_specs=P(DATA_AXIS),

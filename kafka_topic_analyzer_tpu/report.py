@@ -89,6 +89,25 @@ def render_report(
     return body
 
 
+def render_degraded_block(degraded: "Dict[int, str]") -> str:
+    """Post-table warning block for partitions dropped mid-scan after
+    exhausting their transport retry budget.  Rendered OUTSIDE the
+    reference-compatible report (which stays byte-identical for clean
+    scans): their table rows undercount, so the reader must see why."""
+    if not degraded:
+        return ""
+    bang = "!" * 120
+    lines = [bang, f"WARNING: {len(degraded)} partition(s) DEGRADED — "
+                   "metrics below undercount their unscanned tails"]
+    for p in sorted(degraded):
+        lines.append(f"  partition {p}: {degraded[p]}")
+    lines.append(
+        "Rerun with --resume (snapshot written) once the cluster recovers."
+    )
+    lines.append(bang)
+    return "\n".join(lines) + "\n"
+
+
 def render_extremes_table(metrics: TopicMetrics) -> str:
     """Optional per-partition extremes table (new capability; the reference
     only has global lines).  Columns: first/last timestamp, min/max sized

@@ -18,6 +18,86 @@ from kafka_topic_analyzer_tpu.io import kafka_codec as kc
 Record = Tuple[int, int, Optional[bytes], Optional[bytes]]
 
 
+class FaultInjector:
+    """Transport-fault plan for a FakeBroker (or shared by a FakeCluster).
+
+    Every fault is armed with a bounded ``times`` count and consumed
+    atomically, so the broker misbehaves a deterministic number of times
+    and then heals — the client's recovery path must then complete the
+    scan with metrics identical to a fault-free run (tests/test_chaos.py).
+
+    Faults:
+    - ``drop_connection(after_bytes, times)``: the next ``times`` responses
+      send only their first ``after_bytes`` bytes and then hard-close the
+      connection (``after_bytes`` < 4 cuts mid-response-header);
+    - ``refuse_connections(times)``: the next ``times`` accepted
+      connections are closed before any bytes are served (a dead or
+      restarting broker's connection-refused window);
+    - ``stall_responses(seconds, times)``: the next ``times`` responses are
+      delayed by ``seconds`` (past the client's socket timeout this reads
+      as a hang);
+    - ``inject_fetch_errors(code, times)``: the next ``times`` fetched
+      partitions answer with the given transient Kafka error code instead
+      of records.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._drop: "list[int]" = []       # remaining drops, bytes each
+        self._refuse = 0
+        self._stall: "list[float]" = []    # remaining stalls, seconds each
+        self._fetch_errors: "list[int]" = []
+
+    # -- arming --------------------------------------------------------------
+
+    def drop_connection(self, after_bytes: int, times: int = 1) -> "FaultInjector":
+        with self._lock:
+            self._drop.extend([after_bytes] * times)
+        return self
+
+    def refuse_connections(self, times: int = 1) -> "FaultInjector":
+        with self._lock:
+            self._refuse += times
+        return self
+
+    def stall_responses(self, seconds: float, times: int = 1) -> "FaultInjector":
+        with self._lock:
+            self._stall.extend([seconds] * times)
+        return self
+
+    def inject_fetch_errors(self, code: int, times: int = 1) -> "FaultInjector":
+        with self._lock:
+            self._fetch_errors.extend([code] * times)
+        return self
+
+    # -- consumption (broker side) -------------------------------------------
+
+    def take_refusal(self) -> bool:
+        with self._lock:
+            if self._refuse > 0:
+                self._refuse -= 1
+                return True
+            return False
+
+    def take_drop(self) -> Optional[int]:
+        with self._lock:
+            return self._drop.pop(0) if self._drop else None
+
+    def take_stall(self) -> Optional[float]:
+        with self._lock:
+            return self._stall.pop(0) if self._stall else None
+
+    def take_fetch_error(self) -> Optional[int]:
+        with self._lock:
+            return self._fetch_errors.pop(0) if self._fetch_errors else None
+
+    def exhausted(self) -> bool:
+        with self._lock:
+            return not (
+                self._drop or self._refuse or self._stall or self._fetch_errors
+            )
+
+
 class FakeBroker:
     def __init__(
         self,
@@ -41,7 +121,13 @@ class FakeBroker:
         message_magic: int = 2,
         control_offsets: "Optional[Dict[int, set]]" = None,
         response_delay=None,
+        faults: "Optional[FaultInjector]" = None,
     ):
+        #: Transport-fault plan (connection drops/refusals, stalls,
+        #: transient fetch errors); None = behave.  Mutable attribute, so
+        #: tests can arm faults mid-scan or give FakeCluster nodes
+        #: distinct injectors after construction.
+        self.faults = faults
         #: Optional callable (api_key, node_id) -> seconds, slept before
         #: each response send: induces cross-leader timing skew so the
         #: client's concurrent fetch threads interleave differently every
@@ -168,6 +254,11 @@ class FakeBroker:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.fetch_count = 0
+        #: Open per-client sockets, so kill()/stop() can sever live
+        #: connections (a stopped listener alone lets in-flight scans
+        #: finish — not what "broker died" means).
+        self._conn_lock = threading.Lock()
+        self._open_conns: "set[socket.socket]" = set()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -183,6 +274,20 @@ class FakeBroker:
             self._server.close()
         except OSError:
             pass
+        with self._conn_lock:
+            conns = list(self._open_conns)
+            self._open_conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        """Broker death mid-scan: the listener AND every live connection go
+        away at once, like a SIGKILLed process — clients see resets, and
+        reconnect attempts get connection-refused."""
+        self.stop()
 
     def __enter__(self) -> "FakeBroker":
         return self.start()
@@ -208,13 +313,23 @@ class FakeBroker:
             self._threads.append(t)
 
     def _handshake_and_serve(self, conn: socket.socket) -> None:
+        if self.faults is not None and self.faults.take_refusal():
+            # Connection-refused window: close before serving a byte.
+            conn.close()
+            return
         if self.tls_context is not None:
             try:
                 conn = self.tls_context.wrap_socket(conn, server_side=True)
             except OSError:
                 conn.close()
                 return
-        self._serve(conn)
+        with self._conn_lock:
+            self._open_conns.add(conn)
+        try:
+            self._serve(conn)
+        finally:
+            with self._conn_lock:
+                self._open_conns.discard(conn)
 
     def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytes]:
         chunks = []
@@ -316,7 +431,31 @@ class FakeBroker:
                     + head_tags
                     + body
                 )
-                conn.sendall(resp)
+                if not self._send_response(conn, resp):
+                    return
+
+    def _send_response(self, conn: socket.socket, resp: bytes) -> bool:
+        """Send one framed response, applying stall/drop faults; returns
+        False when the connection must close (drop fired or peer gone)."""
+        f = self.faults
+        if f is not None:
+            stall = f.take_stall()
+            if stall:
+                time.sleep(stall)
+            cut = f.take_drop()
+            if cut is not None:
+                try:
+                    conn.sendall(resp[: max(0, cut)])
+                except OSError:
+                    pass
+                return False
+        try:
+            conn.sendall(resp)
+        except OSError:
+            # Peer vanished (e.g. it timed out during a stall): this
+            # connection is done, the broker itself stays up.
+            return False
+        return True
 
     def _dispatch(self, api_key: int, api_version: int, r: kc.ByteReader) -> bytes:
         if api_key == kc.API_VERSIONS:
@@ -398,6 +537,14 @@ class FakeBroker:
             budget = _xb if self.honor_max_bytes else None
             served_any = False
             for pid, fetch_offset, _pmax in parts:
+                if self.faults is not None:
+                    code = self.faults.take_fetch_error()
+                    if code is not None:
+                        # Transient per-partition fetch error (leader
+                        # election, coordinator churn): the client should
+                        # warn, back off, and re-poll.
+                        out.append((pid, code, -1, b""))
+                        continue
                 rs = self.records.get(pid)
                 if rs is None:
                     out.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, b""))
@@ -457,6 +604,11 @@ class FakeCluster:
         **broker_kwargs,
     ):
         self.n_nodes = n_nodes
+        #: partition -> node overrides (leader migration mid-scan); every
+        #: node serves every partition's records, so after migration the
+        #: new leader answers fetches and the old one NOT_LEADERs them —
+        #: like a real reassignment with full replication.
+        self._leader_overrides: Dict[int, int] = {}
         self.nodes = [
             FakeBroker(
                 topic, partition_records, node_id=i, cluster=self, **broker_kwargs
@@ -465,7 +617,17 @@ class FakeCluster:
         ]
 
     def leader(self, partition: int) -> int:
-        return partition % self.n_nodes
+        return self._leader_overrides.get(partition, partition % self.n_nodes)
+
+    def migrate_leader(self, partition: int, node_id: int) -> None:
+        """Move a partition's leadership; takes effect on the next
+        metadata/fetch the brokers serve."""
+        self._leader_overrides[partition] = node_id
+
+    def kill(self, node_id: int) -> None:
+        """SIGKILL one node: listener and live connections drop; leadership
+        of its partitions must be migrated for the scan to recover."""
+        self.nodes[node_id].kill()
 
     def broker_addrs(self) -> Dict[int, "tuple[str, int]"]:
         return {b.node_id: ("127.0.0.1", b.port) for b in self.nodes}

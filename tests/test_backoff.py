@@ -1,0 +1,127 @@
+"""Pure unit tests for the transport retry substrate (io/retry.py):
+deterministic backoff schedule, jitter bounds, and budget exhaustion →
+degraded transitions — injected rand/sleep, no sockets, tier-1 fast."""
+
+import pytest
+
+from kafka_topic_analyzer_tpu.config import TransportRetryConfig
+from kafka_topic_analyzer_tpu.io.retry import Backoff, PartitionRetryBudget
+
+
+def test_schedule_doubles_and_caps():
+    cfg = TransportRetryConfig(backoff_ms=100, backoff_max_ms=1000, jitter=0.0)
+    b = Backoff(cfg, rand=lambda: 0.5, sleep=lambda s: None)
+    assert [b.delay_ms(k) for k in range(1, 6)] == [100, 200, 400, 800, 1000]
+    assert b.delay_ms(0) == 0.0  # no failures yet -> no delay
+
+
+def test_jitter_bounds():
+    cfg = TransportRetryConfig(
+        backoff_ms=100, backoff_max_ms=10_000, jitter=0.2
+    )
+    assert Backoff(cfg, rand=lambda: 0.0).delay_ms(1) == pytest.approx(80.0)
+    assert Backoff(cfg, rand=lambda: 0.5).delay_ms(1) == pytest.approx(100.0)
+    hi = Backoff(cfg, rand=lambda: 1.0 - 1e-12).delay_ms(1)
+    assert hi <= 120.0 and hi == pytest.approx(120.0)
+
+
+def test_jittered_delay_never_exceeds_cap():
+    cfg = TransportRetryConfig(backoff_ms=100, backoff_max_ms=1000, jitter=0.2)
+    b = Backoff(cfg, rand=lambda: 0.999999)
+    for attempt in (4, 5, 50):
+        assert b.delay_ms(attempt) <= 1000.0
+
+
+def test_huge_attempt_counts_do_not_overflow():
+    cfg = TransportRetryConfig(backoff_ms=100, backoff_max_ms=500, jitter=0.0)
+    b = Backoff(cfg, rand=lambda: 0.5)
+    assert b.delay_ms(100_000) == 500.0
+
+
+def test_sleep_for_uses_injected_sleep():
+    slept = []
+    cfg = TransportRetryConfig(backoff_ms=100, backoff_max_ms=1000, jitter=0.0)
+    b = Backoff(cfg, rand=lambda: 0.5, sleep=slept.append)
+    assert b.sleep_for(2) == pytest.approx(0.2)
+    assert slept == [pytest.approx(0.2)]
+    assert b.sleep_for(0) == 0.0
+    assert len(slept) == 1  # zero delay never calls sleep
+
+
+def test_budget_exhaustion_degrades_exactly_once():
+    budget = PartitionRetryBudget(3)
+    assert not budget.record_failure(7, "ConnectionResetError: peer reset")
+    assert not budget.record_failure(7, "ConnectionResetError: peer reset")
+    assert budget.record_failure(7, "OSError: timed out")  # third strike
+    assert "3 consecutive transport failures" in budget.degraded[7]
+    assert "OSError: timed out" in budget.degraded[7]
+    # Already degraded: never re-triggers (the caller dropped it already).
+    assert not budget.record_failure(7, "whatever")
+
+
+def test_budget_resets_on_success():
+    budget = PartitionRetryBudget(2)
+    assert not budget.record_failure(0, "a")
+    budget.record_success(0)
+    assert not budget.record_failure(0, "b")  # count restarted after success
+    assert budget.record_failure(0, "c")
+    assert 0 in budget.degraded
+
+
+def test_budgets_are_per_partition():
+    budget = PartitionRetryBudget(2)
+    assert not budget.record_failure(0, "x")
+    assert not budget.record_failure(1, "x")
+    assert budget.record_failure(0, "x")
+    assert 1 not in budget.degraded
+
+
+def test_config_from_overrides_pops_retry_knobs():
+    ov = {
+        "retry.backoff.ms": "50",
+        "reconnect.backoff.ms": "80",
+        "reconnect.backoff.max.ms": "400",
+        "transport.retry.budget": "3",
+        "fetch.min.bytes": "1",
+    }
+    cfg = TransportRetryConfig.from_overrides(ov)
+    assert cfg.backoff_ms == 80  # the higher of the two configured floors
+    assert cfg.backoff_max_ms == 400
+    assert cfg.retry_budget == 3
+    assert set(ov) == {"fetch.min.bytes"}  # non-retry knobs untouched
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="retry.backoff.ms"):
+        TransportRetryConfig(backoff_ms=0)
+    with pytest.raises(ValueError, match="reconnect.backoff.max.ms"):
+        TransportRetryConfig(backoff_ms=100, backoff_max_ms=50)
+    with pytest.raises(ValueError, match="transport.retry.budget"):
+        TransportRetryConfig(retry_budget=0)
+    with pytest.raises(ValueError, match="jitter"):
+        TransportRetryConfig(jitter=1.0)
+
+
+def test_wire_source_threads_overrides_to_retry_config():
+    """The librdkafka overrides table reaches the scan's retry policy (and
+    the knobs are consumed, not warned about as unsupported)."""
+    from fake_broker import FakeBroker
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+    with FakeBroker("rt.topic", {0: [(0, 0, b"k", b"v")]}) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}",
+            "rt.topic",
+            overrides={
+                "retry.backoff.ms": "7",
+                "reconnect.backoff.max.ms": "70",
+                "transport.retry.budget": "2",
+            },
+        )
+        try:
+            assert src.retry_config.backoff_ms == 7
+            assert src.retry_config.backoff_max_ms == 70
+            assert src.retry_config.retry_budget == 2
+            assert src.degraded_partitions() == {}
+        finally:
+            src.close()

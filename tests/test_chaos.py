@@ -1,0 +1,357 @@
+"""Chaos suite: the live wire scan vs injected transport faults.
+
+Every fault below is armed deterministically (bounded fire counts, chaos
+triggered between engine steps) and the scan must complete with metrics
+BYTE-IDENTICAL to a fault-free run of the same synthetic topic — recovery
+may never drop, duplicate, or reorder a record's contribution.  The last
+tests cover the other contract: a partition that stays unreachable past
+its retry budget degrades (reported, non-zero exit, resumable snapshot)
+instead of aborting the scan.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+
+from fake_broker import FakeBroker, FakeCluster, FaultInjector
+
+pytestmark = pytest.mark.chaos
+
+TOPIC = "chaos.topic"
+
+#: Fast recovery pacing so faulted scans stay inside the tier-1 budget.
+FAST_RETRY = {
+    "retry.backoff.ms": "5",
+    "reconnect.backoff.max.ms": "40",
+}
+
+
+def _mk_records(partition: int, n: int):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 37}".encode() if i % 5 else None,
+            bytes(20 + (i % 13)) if i % 7 else None,
+        )
+        for i in range(n)
+    ]
+
+
+RECORDS = {p: _mk_records(p, 400) for p in range(3)}
+
+
+def _scan_result(bootstrap: str, overrides=None, source=None, batch_size=128):
+    src = source or KafkaWireSource(
+        bootstrap, TOPIC, overrides=dict(FAST_RETRY, **(overrides or {}))
+    )
+    cfg = AnalyzerConfig(
+        num_partitions=3, batch_size=batch_size,
+        count_alive_keys=True, alive_bitmap_bits=16,
+    )
+    backend = CpuExactBackend(cfg, init_now_s=10**10)
+    result = run_scan(TOPIC, src, backend, batch_size)
+    close = getattr(source, "inner", src)
+    close.close()
+    return result
+
+
+def _metrics_doc(result) -> dict:
+    return result.metrics.to_dict(result.start_offsets, result.end_offsets)
+
+
+class ChaosTrigger:
+    """Source proxy that fires ``action`` once, after the Nth yielded batch:
+    chaos strikes mid-scan, at a deterministic point between engine steps."""
+
+    def __init__(self, inner, after_batches: int, action):
+        self.inner = inner
+        self.after = after_batches
+        self.action = action
+        self._fired = False
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def batches(self, *args, **kwargs):
+        n = 0
+        for batch in self.inner.batches(*args, **kwargs):
+            yield batch
+            n += 1
+            if n == self.after and not self._fired:
+                self._fired = True
+                self.action()
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """Fault-free run of the synthetic topic — the byte-exact referee."""
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        result = _scan_result(f"127.0.0.1:{broker.port}")
+    assert not result.degraded_partitions
+    return _metrics_doc(result)
+
+
+# ---------------------------------------------------------------------------
+# faults the scan must absorb with identical metrics
+
+
+def test_connection_drop_mid_fetch_response(baseline):
+    """The leader connection dies after 100 bytes of a fetch response."""
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        trigger = ChaosTrigger(
+            src, 2,
+            lambda: setattr(
+                broker, "faults", FaultInjector().drop_connection(100, times=1)
+            ),
+        )
+        result = _scan_result(None, source=trigger)
+    assert not result.degraded_partitions
+    assert broker.faults.exhausted()
+    assert _metrics_doc(result) == baseline
+
+
+def test_connection_drop_mid_response_header(baseline):
+    """The cut lands inside the 4-byte response length prefix."""
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        trigger = ChaosTrigger(
+            src, 1,
+            lambda: setattr(
+                broker, "faults", FaultInjector().drop_connection(2, times=1)
+            ),
+        )
+        result = _scan_result(None, source=trigger)
+    assert not result.degraded_partitions
+    assert _metrics_doc(result) == baseline
+
+
+def test_reconnect_refused_window(baseline):
+    """After a drop, the broker refuses the next two reconnects before
+    accepting again — the client must back off through the window."""
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        trigger = ChaosTrigger(
+            src, 1,
+            lambda: setattr(
+                broker,
+                "faults",
+                FaultInjector()
+                .drop_connection(0, times=1)
+                .refuse_connections(times=2),
+            ),
+        )
+        result = _scan_result(None, source=trigger)
+    assert not result.degraded_partitions
+    assert broker.faults.exhausted()
+    assert _metrics_doc(result) == baseline
+
+
+def test_stalled_response_past_socket_timeout(baseline):
+    """A response hang longer than socket.timeout.ms reads as a dead
+    connection; the client reconnects and re-fetches."""
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}",
+            TOPIC,
+            overrides=dict(FAST_RETRY, **{"socket.timeout.ms": "250"}),
+        )
+        trigger = ChaosTrigger(
+            src, 1,
+            lambda: setattr(
+                broker, "faults", FaultInjector().stall_responses(0.7, times=1)
+            ),
+        )
+        result = _scan_result(None, source=trigger)
+    assert not result.degraded_partitions
+    assert _metrics_doc(result) == baseline
+
+
+def test_transient_fetch_error_codes(baseline):
+    """A few per-partition transient error codes (leader churn style) get
+    re-polled, not fatal and not double-counted."""
+    faults = FaultInjector().inject_fetch_errors(code=14, times=4)
+    with FakeBroker(
+        TOPIC, RECORDS, max_records_per_fetch=60, faults=faults
+    ) as broker:
+        result = _scan_result(f"127.0.0.1:{broker.port}")
+    assert not result.degraded_partitions
+    assert faults.exhausted()
+    assert _metrics_doc(result) == baseline
+
+
+def test_reload_metadata_swallows_transient_unknown_topic():
+    """A restarting broker can answer metadata with UNKNOWN_TOPIC_OR_PARTITION
+    before it re-syncs topic state.  At init that is the reference's fatal
+    "Topic not found!" exit — but the recovery-path reload already proved
+    the topic exists, so it must keep the stale topology instead of letting
+    the SystemExit abort the scan."""
+    with FakeBroker(TOPIC, RECORDS) as broker:
+        src = KafkaWireSource(f"127.0.0.1:{broker.port}", TOPIC)
+        leaders = dict(src._leaders)
+
+        def unknown_topic():
+            raise SystemExit("Topic not found!")
+
+        src._load_metadata = unknown_topic
+        assert src._reload_metadata() is False
+        assert src._leaders == leaders
+        src.close()
+
+
+def test_cluster_broker_death_leader_migration_and_drop(baseline):
+    """The acceptance scenario: mid-scan, one FakeCluster node is killed,
+    its partition's leadership migrates to the survivor, AND the survivor
+    drops a connection mid-response — the scan must still complete with
+    metrics byte-identical to the fault-free run."""
+    with FakeCluster(
+        TOPIC, RECORDS, n_nodes=2, max_records_per_fetch=40
+    ) as cluster:
+        src = KafkaWireSource(
+            cluster.bootstrap, TOPIC, overrides=dict(FAST_RETRY)
+        )
+
+        def havoc():
+            cluster.nodes[0].faults = FaultInjector().drop_connection(
+                7, times=1
+            )
+            # Node 1 leads partition 1 (p % 2); move it, then kill the node.
+            cluster.migrate_leader(1, 0)
+            cluster.kill(1)
+
+        result = _scan_result(None, source=ChaosTrigger(src, 2, havoc))
+    assert not result.degraded_partitions
+    assert _metrics_doc(result) == baseline
+
+
+def test_leader_migration_between_live_nodes(baseline):
+    """Pure leader migration (no death): the old leader NOT_LEADERs the
+    fetch, the client reloads metadata and re-routes."""
+    with FakeCluster(
+        TOPIC, RECORDS, n_nodes=2, max_records_per_fetch=40
+    ) as cluster:
+        src = KafkaWireSource(
+            cluster.bootstrap, TOPIC, overrides=dict(FAST_RETRY)
+        )
+        trigger = ChaosTrigger(src, 2, lambda: cluster.migrate_leader(1, 0))
+        result = _scan_result(None, source=trigger)
+    assert not result.degraded_partitions
+    assert _metrics_doc(result) == baseline
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: an unreachable partition must not abort the scan
+
+
+def test_unreachable_partition_degrades_scan_finishes(baseline):
+    """Node 1 dies and leadership never moves: partition 1 exhausts its
+    transport retry budget and degrades; partitions 0/2 still finish with
+    exact metrics, and the source reports the reason."""
+    with FakeCluster(
+        TOPIC, RECORDS, n_nodes=2, max_records_per_fetch=40
+    ) as cluster:
+        src = KafkaWireSource(
+            cluster.bootstrap,
+            TOPIC,
+            overrides=dict(FAST_RETRY, **{"transport.retry.budget": "3"}),
+        )
+        trigger = ChaosTrigger(src, 1, lambda: cluster.kill(1))
+        result = _scan_result(None, source=trigger)
+    assert set(result.degraded_partitions) == {1}
+    assert "transport failures" in result.degraded_partitions[1]
+    for p in ("0", "2"):
+        assert _metrics_doc(result)["partitions"][p] == baseline["partitions"][p]
+
+
+def test_degraded_cli_reports_exits_nonzero_writes_snapshot(tmp_path, capsys):
+    """End to end through the CLI: the report flags the degraded partition,
+    the process exits non-zero, and a resumable snapshot (stamped with the
+    degradation reasons) lands in --snapshot-dir."""
+    from kafka_topic_analyzer_tpu import cli
+
+    armed = []
+
+    def arm_on_first_fetch(api_key: int, node_id: int) -> float:
+        # The init handshake (metadata + watermarks) must succeed; node 1
+        # turns permanently dead only once fetching starts.
+        if api_key == kc.API_FETCH and node_id == 1 and not armed:
+            armed.append(True)
+            cluster.nodes[1].faults = (
+                FaultInjector()
+                .drop_connection(0, times=10**6)
+                .refuse_connections(times=10**6)
+            )
+        return 0.0
+
+    with FakeCluster(
+        TOPIC, RECORDS, n_nodes=2, max_records_per_fetch=100,
+        response_delay=arm_on_first_fetch,
+    ) as cluster:
+        rc = cli.main([
+            "-t", TOPIC, "-b", cluster.bootstrap,
+            "--backend", "tpu", "--quiet",
+            "--snapshot-dir", str(tmp_path),
+            "--librdkafka",
+            "retry.backoff.ms=5,reconnect.backoff.max.ms=20,"
+            "transport.retry.budget=3,socket.timeout.ms=500",
+        ])
+    assert rc == cli.EXIT_DEGRADED
+    out = capsys.readouterr().out
+    assert "DEGRADED" in out
+    assert "partition 1:" in out
+    snap = os.path.join(str(tmp_path), "scan_snapshot.npz")
+    assert os.path.exists(snap)
+    with np.load(snap, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+    assert "1" in meta["degraded"]
+    # Resume offsets for the healthy partitions cover their full range, so
+    # a rerun would only re-read the degraded partition's tail.
+    assert meta["next_offsets"]["0"] == 400
+    assert meta["next_offsets"]["2"] == 400
+
+
+def test_degraded_json_output(capsys):
+    """--json surfaces the degraded map for automation."""
+    from kafka_topic_analyzer_tpu import cli
+
+    armed = []
+
+    def arm_on_first_fetch(api_key: int, node_id: int) -> float:
+        if api_key == kc.API_FETCH and node_id == 1 and not armed:
+            armed.append(True)
+            cluster.nodes[1].faults = (
+                FaultInjector()
+                .drop_connection(0, times=10**6)
+                .refuse_connections(times=10**6)
+            )
+        return 0.0
+
+    with FakeCluster(
+        TOPIC, RECORDS, n_nodes=2, max_records_per_fetch=100,
+        response_delay=arm_on_first_fetch,
+    ) as cluster:
+        rc = cli.main([
+            "-t", TOPIC, "-b", cluster.bootstrap,
+            "--quiet", "--json",
+            "--librdkafka",
+            "retry.backoff.ms=5,reconnect.backoff.max.ms=20,"
+            "transport.retry.budget=3,socket.timeout.ms=500",
+        ])
+    assert rc == cli.EXIT_DEGRADED
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(doc["degraded_partitions"]) == {"1"}

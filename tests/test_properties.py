@@ -3,7 +3,10 @@ must hold for *arbitrary* record streams, not just the synthetic generator's
 distribution (SURVEY.md §4 backend-contract strategy, adversarial edition)."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip("hypothesis", reason="property tests need hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
 from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend

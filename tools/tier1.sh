@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Run the ROADMAP.md tier-1 verify line, verbatim.  This is the gate every
+# PR must keep green: the fast (`-m 'not slow'`) suite on the CPU backend,
+# with a hard wall-clock budget and a stable pass-count readout
+# (DOTS_PASSED) that survives pytest's output quirks.  Run from the repo
+# root: `bash tools/tier1.sh` (or `make tier1` if you add a Makefile).
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
