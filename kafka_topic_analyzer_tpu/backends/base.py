@@ -3,8 +3,10 @@
 from __future__ import annotations
 
 import abc
+import functools
 
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.records import RecordBatch
 from kafka_topic_analyzer_tpu.results import TopicMetrics
 
@@ -31,6 +33,30 @@ class MetricBackend(abc.ABC):
     @abc.abstractmethod
     def finalize(self) -> TopicMetrics:
         ...
+
+
+def _timed(fn, hist):
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        with hist.time():
+            return fn(self, *args, **kwargs)
+    return wrapped
+
+
+def instrument_steps(cls):
+    """Class decorator for concrete backends: record step-dispatch and
+    finalize latency into the obs histograms.  The engine's step entry
+    point is ``update_shards`` when the class defines one (the sharded
+    backend's ``update`` delegates to it — wrapping both would double
+    count), ``update`` otherwise.  Async backends therefore book dispatch
+    latency, not device time — the device side lives in the
+    ``--profile-dir`` XLA trace."""
+    step = "update_shards" if "update_shards" in cls.__dict__ else "update"
+    setattr(cls, step, _timed(
+        cls.__dict__[step], obs_metrics.BACKEND_STEP_SECONDS))
+    setattr(cls, "finalize", _timed(
+        cls.__dict__["finalize"], obs_metrics.BACKEND_FINALIZE_SECONDS))
+    return cls
 
 
 def make_backend(name: str, config: AnalyzerConfig) -> MetricBackend:

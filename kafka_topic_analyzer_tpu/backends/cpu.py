@@ -16,7 +16,7 @@ from typing import Dict
 
 import numpy as np
 
-from kafka_topic_analyzer_tpu.backends.base import MetricBackend
+from kafka_topic_analyzer_tpu.backends.base import MetricBackend, instrument_steps
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 from kafka_topic_analyzer_tpu.records import RecordBatch
 from kafka_topic_analyzer_tpu.results import (
@@ -47,6 +47,7 @@ def _exact_quantiles(sizes: np.ndarray, counts: np.ndarray) -> QuantileSummary:
     return QuantileSummary(list(QUANTILE_PROBS), vals)
 
 
+@instrument_steps
 class CpuExactBackend(MetricBackend):
     def __init__(self, config: AnalyzerConfig, init_now_s: "int | None" = None):
         super().__init__(config)

@@ -23,7 +23,7 @@ import os
 import jax
 import numpy as np
 
-from kafka_topic_analyzer_tpu.backends.base import MetricBackend
+from kafka_topic_analyzer_tpu.backends.base import MetricBackend, instrument_steps
 from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
 from kafka_topic_analyzer_tpu.backends.step import analyzer_step
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
@@ -96,6 +96,7 @@ def self_check_unpack(device=None) -> None:
 _checked_devices: "set[str]" = set()
 
 
+@instrument_steps
 class TpuBackend(MetricBackend):
     def __init__(
         self,

@@ -159,7 +159,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Also print a per-partition first/last-timestamp and "
                         "min/max-size table (new capability)")
     p.add_argument("--stats", action="store_true",
-                   help="Print per-stage throughput stats to stderr")
+                   help="Print per-stage throughput stats and the telemetry "
+                        "counter digest to stderr")
+    p.add_argument("--metrics-port", type=int, metavar="PORT",
+                   help="Serve Prometheus metrics on "
+                        "http://127.0.0.1:PORT/metrics while the scan runs "
+                        "(0 binds an ephemeral port)")
+    p.add_argument("--events-jsonl", metavar="FILE",
+                   help="Append structured scan lifecycle + transport-fault "
+                        "events to FILE as JSON lines")
+    p.add_argument("--trace-json", metavar="FILE",
+                   help="Write a Chrome trace-event JSON of host-side scan "
+                        "spans (fetch/decode/stages) to FILE; combine with "
+                        "--profile-dir for the XLA timeline")
     p.add_argument("--quiet", action="store_true", help="No progress spinner")
     return p
 
@@ -260,6 +272,18 @@ def wrap_with_dump(args, topic: str, source):
 
     return TeeSource(source, SegmentDumpWriter(args.dump_segments, topic))
 
+
+
+def _print_stats(args, result) -> None:
+    """--stats stderr dump: per-stage profile + the telemetry counter
+    digest (cluster-wide under multi-controller)."""
+    if not args.stats:
+        return
+    from kafka_topic_analyzer_tpu.report import render_telemetry_stats
+
+    print("scan stages:", file=sys.stderr)
+    print(result.profile.summary(), file=sys.stderr)
+    sys.stderr.write(render_telemetry_stats(result.telemetry))
 
 
 def _not_report_process(args) -> bool:
@@ -388,9 +412,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             resume=args.resume,
             start_at=start_at,
         )
-    if args.stats:
-        print("scan stages:", file=sys.stderr)
-        print(result.profile.summary(), file=sys.stderr)
+    _print_stats(args, result)
     multi.close()  # flush per-topic segment dumps, release connections
     if _not_report_process(args):
         return _degraded_exit(result)  # multi-host: one report, from process 0
@@ -427,6 +449,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         if union.quantiles is not None:
             union_doc["size_quantiles"] = union.quantiles.as_dict()
         doc["union"] = union_doc
+        doc["telemetry"] = result.telemetry
         # Degraded keys are dense fan-in rows; reasons carry topic/partition.
         rc = _degraded_exit(result, doc=doc)
         print(json.dumps(doc))
@@ -478,9 +501,15 @@ def main(argv: "list[str] | None" = None) -> int:
     init_logging()  # env_logger parity: RUST_LOG / KTA_LOG (src/main.rs:30)
     args = build_parser().parse_args(argv)
     from kafka_topic_analyzer_tpu.io.kafka_codec import KafkaProtocolError
+    from kafka_topic_analyzer_tpu.obs import telemetry_session
 
     try:
-        return _run(args)
+        with telemetry_session(
+            metrics_port=args.metrics_port,
+            events_jsonl=args.events_jsonl,
+            trace_json=args.trace_json,
+        ):
+            return _run(args)
     except (OSError, KafkaProtocolError) as e:
         # Environment/user-facing failures get one clean line, not a
         # traceback (the reference panics here; we can do better).  Other
@@ -559,9 +588,7 @@ def _run(args) -> int:
             resume=args.resume,
             start_at=start_at,
         )
-    if args.stats:
-        print("scan stages:", file=sys.stderr)
-        print(result.profile.summary(), file=sys.stderr)
+    _print_stats(args, result)
     if hasattr(source, "close"):
         source.close()  # flush segment dumps, release broker connections
     if _not_report_process(args):
@@ -576,6 +603,7 @@ def _run(args) -> int:
         doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)
         doc["topic"] = args.topic
         doc["duration_secs"] = result.duration_secs
+        doc["telemetry"] = result.telemetry
         rc = _degraded_exit(result, doc=doc)
         print(json.dumps(doc))
         return rc

@@ -19,6 +19,13 @@ import numpy as np
 
 from kafka_topic_analyzer_tpu.backends.base import MetricBackend
 from kafka_topic_analyzer_tpu.io.source import RecordSource
+from kafka_topic_analyzer_tpu.obs import events as obs_events
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.obs import trace as obs_trace
+from kafka_topic_analyzer_tpu.obs.registry import (
+    default_registry,
+    merge_snapshots,
+)
 from kafka_topic_analyzer_tpu.records import RecordBatch
 from kafka_topic_analyzer_tpu.results import TopicMetrics
 from kafka_topic_analyzer_tpu.utils.profiling import ScanProfile
@@ -62,6 +69,11 @@ class ScanResult:
     degraded_partitions: "dict[int, str]" = dataclasses.field(
         default_factory=dict
     )
+    #: Registry snapshot taken at scan end (obs.registry format) — under
+    #: multi-controller, the cluster-wide merge of every process's
+    #: registry, so the report process can render fleet totals
+    #: (``--stats``) and ``--json`` can embed them (``telemetry`` block).
+    telemetry: "Optional[dict]" = None
 
 
 class _ProgressTracker:
@@ -101,6 +113,8 @@ def run_scan(
     resume: bool = False,
     prefetch_depth: int = 2,
     start_at: "Optional[dict[int, int]]" = None,
+    tracer=None,
+    heartbeat_every_s: float = 10.0,
 ) -> ScanResult:
     """Full earliest→latest scan of the topic through the backend.
 
@@ -108,13 +122,62 @@ def run_scan(
     are saved atomically every ``snapshot_every_s`` seconds; with ``resume``
     a compatible snapshot restarts the scan where it left off
     (checkpoint.py; requires a backend with get_state/set_state, i.e. the
-    TPU backends)."""
+    TPU backends).
+
+    ``tracer`` (obs.trace.SpanTracer) mirrors every profile stage into a
+    Chrome trace; scan metrics/events flow to the default obs registry and
+    event bus unconditionally (both are no-ops until a sink/exporter
+    attaches), with per-partition lag/ETA gauges refreshed at the
+    ``heartbeat_every_s`` cadence."""
     pindex = PartitionIndex(source.partitions())
     start_offsets, end_offsets = source.watermarks()
-    profile = ScanProfile()
+    if tracer is None:
+        # CLI wiring: telemetry_session installs the --trace-json tracer
+        # as the process-wide active one instead of threading it here.
+        tracer = obs_trace.active()
+    profile = ScanProfile(tracer=tracer)
     spinner = spinner or Spinner(enabled=False)
     t0 = time.monotonic()
     seq = 0
+    obs_events.emit(
+        "scan_start",
+        topic=topic,
+        partitions=len(pindex),
+        batch_size=batch_size,
+    )
+    heartbeat = obs_events.Heartbeat(heartbeat_every_s)
+    # Partitions THIS process feeds — the sharded branch narrows this to
+    # its local rows' partitions, so that under multi-controller each
+    # process's lag/ETA gauges carry a disjoint label set (the merge
+    # algebra's gauge-union assumption; a process must not report full
+    # lag for partitions it never observes).
+    fed_partitions = list(end_offsets)
+
+    def maybe_heartbeat() -> None:
+        """Rate-limited telemetry refresh: per-partition lag/ETA gauges
+        from the tracker + one heartbeat event.  O(P) work at most once
+        per interval — never per batch."""
+        if not heartbeat.ready():
+            return
+        elapsed = time.monotonic() - t0
+        # Rate over THIS run only: a --resume restores seq to the
+        # snapshot's cumulative count, which elapsed knows nothing about.
+        rate = (seq - seq_base) / elapsed if elapsed > 0 else 0.0
+        lag_total = 0
+        for p in fed_partitions:
+            end = end_offsets[p]
+            lag = max(0, end - tracker.next_offsets.get(p, start_offsets[p]))
+            lag_total += lag
+            obs_metrics.PARTITION_LAG.labels(partition=p).set(lag)
+            obs_metrics.PARTITION_ETA_SECONDS.labels(partition=p).set(
+                lag / rate if rate > 0 else -1.0
+            )
+        obs_events.emit(
+            "heartbeat",
+            seq=seq,
+            records_per_sec=round(rate, 1),
+            lag_total=lag_total,
+        )
 
     # Caller-provided start offsets (e.g. --from-timestamp lookup); a
     # resumed snapshot's offsets take precedence below.
@@ -172,6 +235,7 @@ def run_scan(
             tracker.next_offsets.update(offsets)
             start_at = offsets
             seq = records_seen
+    seq_base = seq  # resumed records predate t0; rate math excludes them
     last_snap = time.monotonic()
 
     # Offsets/seq as of the last COMPLETED fold.  The tracker observes a
@@ -211,6 +275,11 @@ def run_scan(
                     else None
                 ),
             )
+        obs_metrics.SNAPSHOTS_SAVED.inc()
+        obs_events.emit(
+            "snapshot_saved",
+            records_seen=seq if records_seen is None else records_seen,
+        )
         last_snap = time.monotonic()
 
     # Prefetch iterators run worker threads; close them on ANY exit so an
@@ -244,6 +313,7 @@ def run_scan(
             d = backend.config.data_shards
             shard_parts = assign_partitions(pindex.ids, d)
             feed_rows = list(getattr(backend, "local_rows", range(d)))
+            fed_partitions = [p for r in feed_rows for p in shard_parts[r]]
             # Collective steps must stay in lockstep across processes, so
             # per-round continuation is a global agreement, not a local one.
             lockstep = getattr(backend, "global_any", None)
@@ -279,6 +349,7 @@ def run_scan(
             while True:
                 shard_batches: "list" = [None] * d
                 step_valid = 0
+                step_bytes = 0
                 with profile.stage("ingest"):
                     for r in feed_rows:
                         item = next(iters[r], None) if alive[r] else None
@@ -287,6 +358,7 @@ def run_scan(
                             continue
                         b, staged = item
                         step_valid += b.num_valid
+                        step_bytes += b.nbytes
                         tracker.observe(b, b.partition)
                         shard_batches[r] = (
                             staged if staged is not None
@@ -297,12 +369,19 @@ def run_scan(
                     have_data = lockstep(have_data)
                 if not have_data:
                     break
-                with profile.stage("dispatch", items=step_valid):
+                with profile.stage(
+                    "dispatch", items=step_valid, nbytes=step_bytes,
+                ):
                     backend.update_shards(shard_batches)
                 seq += step_valid
+                obs_metrics.SCAN_RECORDS.inc(step_valid)
+                obs_metrics.SCAN_BATCHES.inc()
+                obs_metrics.SCAN_BYTES.inc(step_bytes)
+                obs_metrics.BATCH_RECORDS.observe(step_valid)
                 committed_offsets = dict(tracker.next_offsets)
                 committed_seq = seq
                 maybe_snapshot()
+                maybe_heartbeat()
                 spinner.set_message(f"[Sq: {seq} | T: {topic} | shards: {d}]")
         else:
             # Backends with a `prepare` method (the packed single-device
@@ -354,9 +433,14 @@ def run_scan(
                 ):
                     backend.update(staged)
                 seq += nvalid
+                obs_metrics.SCAN_RECORDS.inc(nvalid)
+                obs_metrics.SCAN_BATCHES.inc()
+                obs_metrics.SCAN_BYTES.inc(batch.nbytes)
+                obs_metrics.BATCH_RECORDS.observe(nvalid)
                 committed_offsets = dict(tracker.next_offsets)
                 committed_seq = seq
                 maybe_snapshot()
+                maybe_heartbeat()
                 # indicatif-template message like src/kafka.rs:111-113.
                 spinner.set_message(
                     f"[Sq: {seq} | T: {topic} | P: {last_partition} | "
@@ -413,6 +497,30 @@ def run_scan(
     metrics.partitions = pindex.ids
     spinner.finish_with_message("done")
     duration_secs = int(time.monotonic() - t0)
+    # Final telemetry: drained partitions report zero lag, the stage
+    # profile folds into the registry, and the lifecycle closes.
+    heartbeat.force()  # the closing gauge refresh always lands
+    maybe_heartbeat()
+    # Locally-degraded partitions only: the -1 cross-process sentinel is
+    # another process's partition, and THAT process books it — counting
+    # it here would double it under the gauge's merge="sum" policy.
+    local_degraded = sum(1 for p in degraded if p >= 0)
+    obs_metrics.DEGRADED_PARTITIONS.set(local_degraded)
+    obs_metrics.record_profile(profile)
+    obs_events.emit(
+        "scan_end",
+        topic=topic,
+        records=seq,
+        duration_secs=duration_secs,
+        degraded=local_degraded,
+    )
+    # Cluster-wide registry view.  gather_telemetry is a lockstep
+    # collective, so it runs here — a point every process reaches — never
+    # from the report-only branch of the CLI.
+    gather = getattr(backend, "gather_telemetry", None)
+    telemetry = merge_snapshots(
+        gather() if gather is not None else [default_registry().snapshot()]
+    )
     return ScanResult(
         metrics=metrics,
         duration_secs=duration_secs,
@@ -420,4 +528,5 @@ def run_scan(
         start_offsets=start_offsets,
         end_offsets=end_offsets,
         degraded_partitions=degraded,
+        telemetry=telemetry,
     )

@@ -50,8 +50,15 @@ import numpy as np
 
 from kafka_topic_analyzer_tpu.config import TransportRetryConfig
 from kafka_topic_analyzer_tpu.io import kafka_codec as kc
-from kafka_topic_analyzer_tpu.io.retry import Backoff, PartitionRetryBudget
+from kafka_topic_analyzer_tpu.io.retry import (
+    Backoff,
+    PartitionRetryBudget,
+    note_backoff_sleep,
+)
 from kafka_topic_analyzer_tpu.io.source import RecordSource
+from kafka_topic_analyzer_tpu.obs import events as obs_events
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.obs import trace as obs_trace
 from kafka_topic_analyzer_tpu.records import RecordBatch
 
 log = logging.getLogger(__name__)
@@ -548,6 +555,10 @@ class KafkaWireSource(RecordSource):
         with self._conn_lock:
             if self._conns.get((conn.host, conn.port)) is conn:
                 del self._conns[(conn.host, conn.port)]
+        obs_metrics.CONNECTION_EVICTIONS.inc()
+        obs_events.emit(
+            "connection_evicted", host=conn.host, port=conn.port
+        )
 
     def _version(self, conn: BrokerConnection, api_key: int) -> int:
         if conn.api_versions is None:
@@ -689,13 +700,16 @@ class KafkaWireSource(RecordSource):
         transport recovery a failed reload must not abort the scan — the
         next round retries against the stale topology, and the per-partition
         retry budget bounds how long that can go on."""
+        obs_metrics.METADATA_RELOADS.inc()
         try:
             self._load_metadata()
+            obs_events.emit("metadata_reload", ok=True)
             return True
         except (OSError, kc.KafkaProtocolError) as e:
             log.warning(
                 "metadata reload failed (%s); keeping stale topology", e
             )
+            obs_events.emit("metadata_reload", ok=False, error=str(e))
             return False
         except SystemExit:
             # _load_metadata's "Topic not found!" exit is an init-time
@@ -904,6 +918,7 @@ class KafkaWireSource(RecordSource):
             log.error("partition %d degraded: %s", p, reason)
             remaining.discard(p)
             self.degraded[p] = reason
+            obs_events.emit("partition_degraded", partition=p, reason=reason)
         # Consecutive fetches for a partition that neither consumed records
         # nor advanced the offset (possible under response-budget pressure
         # from sibling partitions) — bounded so a pathological broker can't
@@ -1016,8 +1031,13 @@ class KafkaWireSource(RecordSource):
                     pmax_sent,
                 )
             conn, corr, sent_offsets, order, pmax_sent = fl
-            r = conn.read_response(corr)
+            with obs_trace.maybe_span("fetch", cat="io"):
+                r = conn.read_response(corr)
             fps = kc.decode_fetch_response(r, self._version(conn, kc.API_FETCH))
+            obs_metrics.FETCH_REQUESTS.inc()
+            obs_metrics.FETCH_BYTES.inc(
+                sum(len(fp.records) for fp in fps)
+            )
             # Send-ahead: while this response's records decode, let the
             # broker build the NEXT one.  A cheap native header scan of
             # each partition's record set yields the exact offsets
@@ -1087,12 +1107,14 @@ class KafkaWireSource(RecordSource):
             # expensive, GIL-releasing half); masking and state updates
             # stay in phase 2.
             soas: "Dict[int, tuple]" = {}
-            for fp in fps:
-                p = fp.partition
-                if p in scans:
-                    soas[p] = decode_record_set_native(
-                        fp.records, self.verify_crc, prescan=scans[p]
-                    )
+            if scans:
+                with obs_trace.maybe_span("decode", cat="io"):
+                    for fp in fps:
+                        p = fp.partition
+                        if p in scans:
+                            soas[p] = decode_record_set_native(
+                                fp.records, self.verify_crc, prescan=scans[p]
+                            )
             return (leader, fps, scans, soas, spec_sent, order, pmax_sent)
 
         def fetch_leader_guarded(leader: int, lparts: List[int], fetch_round: int):
@@ -1114,6 +1136,13 @@ class KafkaWireSource(RecordSource):
                     "transport failure on leader %d (%s): %s",
                     leader, type(e).__name__, e,
                 )
+                obs_metrics.TRANSPORT_FAILURES.inc()
+                obs_events.emit(
+                    "transport_failure",
+                    leader=leader,
+                    partitions=sorted(lparts),
+                    error=f"{type(e).__name__}: {e}",
+                )
                 return _TransportFailure(leader, list(lparts), e)
 
         pool: "object | None" = None
@@ -1134,7 +1163,10 @@ class KafkaWireSource(RecordSource):
                 # Every remaining partition's leader is inside its backoff
                 # window: sleep to the earliest retry deadline instead of
                 # spinning the loop.
-                time.sleep(max(0.0, min(deferred) - time.monotonic()))
+                sleep_s = min(deferred) - time.monotonic()
+                if sleep_s > 0:
+                    note_backoff_sleep(sleep_s)
+                    time.sleep(sleep_s)
                 continue
             progressed = False
             fetch_round += 1
@@ -1199,6 +1231,10 @@ class KafkaWireSource(RecordSource):
                         # (src/kafka.rs:95-97) — but with recovery for the
                         # known-persistent errors and a bounded retry budget.
                         log.warning("fetch error %d on partition %d", fp.error, p)
+                        obs_metrics.FETCH_ERRORS.inc()
+                        obs_events.emit(
+                            "fetch_error", partition=p, code=fp.error
+                        )
                         error_streak[p] += 1
                         if fp.error == kc.ERR_NOT_LEADER_FOR_PARTITION:
                             self._reload_metadata()

@@ -188,10 +188,9 @@ def ensure_responsive_accelerator(timeout_s: float = 240.0) -> "bool | str":
         return platform
     if platform is not None:
         return platform
-    print(
+    sys.stderr.write(
         "WARNING: accelerator unresponsive — forcing the cpu platform; "
-        "results will NOT reflect TPU performance",
-        file=sys.stderr,
+        "results will NOT reflect TPU performance\n"
     )
     force_platform("cpu")
     return False

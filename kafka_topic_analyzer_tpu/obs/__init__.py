@@ -1,0 +1,109 @@
+"""Telemetry subsystem: metrics registry, exporters, events, trace spans.
+
+Dependency-free observability for the scan pipeline:
+
+- ``obs.registry``  — counters / gauges / fixed-bucket histograms, with
+  snapshot + merge algebra for multi-controller aggregation;
+- ``obs.metrics``   — the instrument catalog every layer writes to;
+- ``obs.exporters`` — Prometheus text exposition over HTTP
+  (``--metrics-port``);
+- ``obs.events``    — structured JSONL event log (``--events-jsonl``) and
+  the rate-limited heartbeat;
+- ``obs.trace``     — host-side span tracer exporting Chrome trace-event
+  JSON (``--trace-json``), complementary to the ``--profile-dir`` XLA
+  trace.
+
+``telemetry_session`` is the CLI's one-stop wiring: it attaches exactly
+the sinks the flags ask for, yields the tracer for ``run_scan``, and
+tears everything down (flushing the trace file) on exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Iterator, Optional
+
+from kafka_topic_analyzer_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    merge_snapshots,
+    render_prometheus,
+)
+from kafka_topic_analyzer_tpu.obs.trace import SpanTracer  # noqa: F401
+
+
+@contextlib.contextmanager
+def telemetry_session(
+    metrics_port: "Optional[int]" = None,
+    events_jsonl: "Optional[str]" = None,
+    trace_json: "Optional[str]" = None,
+) -> "Iterator[Optional[SpanTracer]]":
+    """Wire up the flag-selected telemetry outputs around a scan.
+
+    Yields the span tracer (None unless ``trace_json`` is set) for
+    ``run_scan``'s profile to mirror stages into.  On exit the trace file
+    is written, the event log closed, and the scrape endpoint shut down —
+    the endpoint therefore serves while the scan runs.
+
+    Output paths are opened (and truncated, for the trace) at setup so a
+    bad ``--trace-json``/``--events-jsonl`` path fails before the scan,
+    not after it; and each teardown step is isolated, so a failing trace
+    write still closes the event log and the endpoint.
+    """
+    import sys
+
+    from kafka_topic_analyzer_tpu.obs import events as _events
+    from kafka_topic_analyzer_tpu.obs import trace as _trace
+
+    exporter = None
+    sink = None
+    tracer = None
+    try:
+        if metrics_port is not None:
+            from kafka_topic_analyzer_tpu.obs.exporters import (
+                PrometheusExporter,
+            )
+
+            exporter = PrometheusExporter(metrics_port)
+            if metrics_port == 0:
+                # The ephemeral port is useless unless announced; stderr,
+                # like the spinner, so --json stdout stays clean.
+                sys.stderr.write(
+                    "serving metrics on "
+                    f"http://{exporter.host}:{exporter.port}/metrics\n"
+                )
+        if events_jsonl:
+            sink = _events.JsonlEventLog(events_jsonl)
+            _events.add_sink(sink)
+        if trace_json:
+            with open(trace_json, "w", encoding="utf-8"):
+                pass  # fail fast on an unwritable path; write() re-opens
+            tracer = SpanTracer()
+            _trace.set_active(tracer)
+        yield tracer
+    finally:
+        if tracer is not None:
+            _trace.set_active(None)
+        try:
+            if tracer is not None:
+                try:
+                    tracer.write(trace_json)
+                except OSError:
+                    # Best-effort by contract: a trace-write failure (disk
+                    # filled mid-scan) must not mask the scan's own
+                    # exception or fail a finished scan.
+                    logging.getLogger(__name__).exception(
+                        "failed to write %s", trace_json
+                    )
+        finally:
+            try:
+                if sink is not None:
+                    _events.remove_sink(sink)
+                    sink.close()
+            finally:
+                if exporter is not None:
+                    exporter.close()

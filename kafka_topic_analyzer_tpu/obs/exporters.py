@@ -1,0 +1,77 @@
+"""Prometheus scrape endpoint for the metrics registry.
+
+A threaded stdlib HTTP server exposing ``/metrics`` (text exposition
+v0.0.4) while the scan runs — scrapes render a fresh registry snapshot
+per request, so a dashboard pointed at ``--metrics-port`` watches
+throughput, retries, and per-partition lag live.  Port 0 binds an
+ephemeral port (``.port`` reports the bound one — tests use this).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kafka_topic_analyzer_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+    render_prometheus,
+)
+
+log = logging.getLogger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
+        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+            self.send_error(404, "try /metrics")
+            return
+        body = render_prometheus(self.server.registry.snapshot()).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format: str, *args) -> None:
+        log.debug("metrics scrape: " + format, *args)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    registry: MetricsRegistry
+
+
+class PrometheusExporter:
+    """Serve ``registry`` on ``http://host:port/metrics`` from a daemon
+    thread until ``close()``."""
+
+    def __init__(
+        self,
+        port: int,
+        registry: "Optional[MetricsRegistry]" = None,
+        host: str = "127.0.0.1",
+    ):
+        self._server = _Server((host, port), _MetricsHandler)
+        self._server.registry = (
+            registry if registry is not None else default_registry()
+        )
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="kta-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("serving Prometheus metrics on http://%s:%d/metrics",
+                 host, self.port)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)

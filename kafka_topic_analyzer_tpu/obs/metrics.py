@@ -1,0 +1,106 @@
+"""Metric name catalog: every instrument the scan pipeline writes.
+
+One module so the full surface is auditable in one place (README
+"Observability" documents it verbatim).  All instruments live on the
+default registry; they update per batch / per fetch round — never per
+record — so instrumentation stays invisible next to decode costs
+(tools/bench_ingest.py holds telemetry-on within 2% of off).
+
+Naming follows Prometheus conventions: ``_total`` counters, ``_seconds``
+for durations, base units only.
+"""
+
+from __future__ import annotations
+
+from kafka_topic_analyzer_tpu.obs.registry import (
+    BATCH_SIZE_BUCKETS,
+    LATENCY_BUCKETS_S,
+    default_registry,
+)
+
+_REG = default_registry()
+
+# -- engine (run_scan) --------------------------------------------------------
+
+SCAN_RECORDS = _REG.counter(
+    "kta_scan_records_total", "Valid records folded by the scan engine")
+SCAN_BATCHES = _REG.counter(
+    "kta_scan_batches_total", "Engine steps dispatched (batches)")
+SCAN_BYTES = _REG.counter(
+    "kta_scan_bytes_total", "Decoded record-batch bytes through the engine")
+BATCH_RECORDS = _REG.histogram(
+    "kta_batch_records", "Valid records per engine step",
+    buckets=BATCH_SIZE_BUCKETS)
+STAGE_SECONDS = _REG.counter(
+    "kta_stage_seconds_total",
+    "Cumulative wall seconds per scan stage (ScanProfile)",
+    labelnames=("stage",))
+STAGE_RECORDS = _REG.counter(
+    "kta_stage_records_total",
+    "Records attributed per scan stage (ScanProfile)",
+    labelnames=("stage",))
+PARTITION_LAG = _REG.gauge(
+    "kta_partition_lag",
+    "Records between the scan position and the end watermark",
+    labelnames=("partition",))
+PARTITION_ETA_SECONDS = _REG.gauge(
+    "kta_partition_eta_seconds",
+    "Projected seconds to drain the partition at the current scan rate",
+    labelnames=("partition",))
+SNAPSHOTS_SAVED = _REG.counter(
+    "kta_snapshots_saved_total", "Resumable scan snapshots written")
+DEGRADED_PARTITIONS = _REG.gauge(
+    "kta_scan_degraded_partitions",
+    "Partitions dropped from the scan after exhausting their retry budget",
+    # Each process counts ITS locally-degraded partitions (the feeds are
+    # disjoint), so the cluster-wide figure is the sum, not the max.
+    merge="sum")
+
+# -- io/kafka_wire ------------------------------------------------------------
+
+FETCH_REQUESTS = _REG.counter(
+    "kta_fetch_requests_total", "Fetch responses read from brokers")
+FETCH_BYTES = _REG.counter(
+    "kta_fetch_bytes_total", "Record-set bytes carried by fetch responses")
+FETCH_ERRORS = _REG.counter(
+    "kta_fetch_errors_total",
+    "Per-partition Kafka protocol errors in fetch responses")
+TRANSPORT_FAILURES = _REG.counter(
+    "kta_transport_failures_total",
+    "Leader fetch rounds lost to resets/timeouts/truncated streams")
+CONNECTION_EVICTIONS = _REG.counter(
+    "kta_connection_evictions_total",
+    "Broker connections closed as dead or desynced")
+METADATA_RELOADS = _REG.counter(
+    "kta_metadata_reloads_total",
+    "Cluster metadata refreshes attempted during recovery")
+
+# -- io/retry -----------------------------------------------------------------
+
+BACKOFF_SLEEPS = _REG.counter(
+    "kta_backoff_sleeps_total", "Retry/backoff sleeps taken")
+BACKOFF_SLEEP_SECONDS = _REG.counter(
+    "kta_backoff_sleep_seconds_total", "Seconds spent in retry backoff")
+RETRY_BUDGET_EXHAUSTIONS = _REG.counter(
+    "kta_retry_budget_exhaustions_total",
+    "Partitions whose consecutive-transport-failure budget ran out")
+
+# -- backends -----------------------------------------------------------------
+
+BACKEND_STEP_SECONDS = _REG.histogram(
+    "kta_backend_step_seconds",
+    "Backend update dispatch latency (async backends: dispatch only)",
+    buckets=LATENCY_BUCKETS_S)
+BACKEND_FINALIZE_SECONDS = _REG.histogram(
+    "kta_backend_finalize_seconds",
+    "Backend finalize (device sync + collective merge) latency",
+    buckets=LATENCY_BUCKETS_S)
+
+
+def record_profile(profile) -> None:
+    """Fold a finished ScanProfile into the stage counters, so the
+    Prometheus/JSON view carries the same per-stage seconds as --stats."""
+    for name, st in profile.stages.items():
+        STAGE_SECONDS.labels(stage=name).inc(st.seconds)
+        if st.items:
+            STAGE_RECORDS.labels(stage=name).inc(st.items)

@@ -41,7 +41,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from kafka_topic_analyzer_tpu.backends.base import MetricBackend
+from kafka_topic_analyzer_tpu.backends.base import MetricBackend, instrument_steps
 from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
 from kafka_topic_analyzer_tpu.backends.step import analyzer_step
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
@@ -154,6 +154,7 @@ class PackedShard:
         self.chunks = chunks
 
 
+@instrument_steps
 class ShardedTpuBackend(MetricBackend):
     """Multi-device backend over a (data, space) mesh.
 
@@ -399,6 +400,78 @@ class ShardedTpuBackend(MetricBackend):
         else:
             arr = jax.device_put(local, self._row_sharding)
         return bool(np.asarray(self._any_fn(arr)).sum() > 0)
+
+    def gather_telemetry(self) -> "List[dict]":
+        """Per-process registry snapshots, one per controller.
+
+        Multi-controller aggregation over the same lockstep collective
+        machinery as ``global_any``: each process JSON-encodes its local
+        obs registry snapshot, the fleet agrees on the max payload size
+        (pmax over the data axis), and the length-prefixed padded byte
+        rows are all_gathered so every process can decode every
+        snapshot.  Rows are deduped by process id (a process hosting R
+        data rows contributes R identical copies).  Collective — every
+        process must call it at the same point (the engine does, in
+        ``run_scan``'s tail); the report process then folds the list with
+        ``obs.registry.merge_snapshots`` into the cluster-wide view."""
+        import json
+
+        from kafka_topic_analyzer_tpu.obs.registry import default_registry
+
+        snap = default_registry().snapshot()
+        if not self._multiprocess:
+            return [snap]
+        payload = json.dumps(
+            {"pid": jax.process_index(), "telemetry": snap}
+        ).encode()
+
+        def _row_array(local: np.ndarray, sharding, global_rows: int):
+            return jax.make_array_from_process_local_data(
+                sharding, local, global_shape=(global_rows,) + local.shape[1:]
+            )
+
+        d = self.config.data_shards
+        n_local = len(self.local_rows)
+        # Round 1: agree on the widest payload (pmax over 'data').
+        if not hasattr(self, "_pmax_fn"):
+            self._pmax_fn = jax.jit(
+                shard_map(
+                    lambda x: lax.pmax(x, DATA_AXIS),
+                    mesh=self.mesh,
+                    in_specs=P(DATA_AXIS),
+                    out_specs=P(),
+                )
+            )
+        lens = np.full((n_local,), len(payload), np.int32)
+        width = int(np.asarray(
+            self._pmax_fn(_row_array(lens, self._row_sharding, d))
+        ).max())
+        # Round 2: all_gather the length-prefixed, zero-padded rows.  Not
+        # cached/jitted: the width varies per call and this runs once per
+        # scan.
+        gather = shard_map(
+            lambda x: lax.all_gather(x, DATA_AXIS, tiled=True),
+            mesh=self.mesh,
+            in_specs=P(DATA_AXIS, None),
+            out_specs=P(None, None),
+        )
+        rows = np.zeros((n_local, 4 + width), np.uint8)
+        prefix = np.frombuffer(
+            len(payload).to_bytes(4, "big"), np.uint8
+        )
+        for r in range(n_local):
+            rows[r, :4] = prefix
+            rows[r, 4:4 + len(payload)] = np.frombuffer(payload, np.uint8)
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        gathered = np.asarray(
+            jax.jit(gather)(_row_array(rows, sharding, d))
+        )
+        out: "dict[int, dict]" = {}
+        for r in range(d):
+            n = int.from_bytes(gathered[r, :4].tobytes(), "big")
+            doc = json.loads(gathered[r, 4:4 + n].tobytes().decode())
+            out.setdefault(doc["pid"], doc["telemetry"])
+        return [out[pid] for pid in sorted(out)]
 
     def update(self, batch: RecordBatch) -> None:
         """Split a mixed batch by partition→shard (partition % D)."""

@@ -108,6 +108,53 @@ def render_degraded_block(degraded: "Dict[int, str]") -> str:
     return "\n".join(lines) + "\n"
 
 
+def _metric_total(snapshot: Dict, name: str) -> float:
+    """Sum of a metric's sample values across label sets (0 if absent)."""
+    metric = snapshot.get(name)
+    if metric is None:
+        return 0.0
+    return sum(s.get("value", 0.0) for s in metric["samples"])
+
+
+def render_telemetry_stats(snapshot: Optional[Dict]) -> str:
+    """``--stats`` telemetry section from a registry snapshot (cluster-wide
+    under multi-controller: the engine merges every process's registry
+    before this renders).  Counter-only digest — the full instrument set,
+    including histograms and per-partition gauges, is what ``--metrics-port``
+    serves and ``--json``'s ``telemetry`` block embeds."""
+    if not snapshot:
+        return ""
+    t = lambda name: _metric_total(snapshot, name)  # noqa: E731
+    lines = [
+        "telemetry:",
+        (
+            f"  scan: {t('kta_scan_records_total'):,.0f} records, "
+            f"{t('kta_scan_batches_total'):,.0f} batches, "
+            f"{t('kta_scan_bytes_total') / 1e6:,.1f} MB"
+        ),
+        (
+            f"  wire: {t('kta_fetch_requests_total'):,.0f} fetches "
+            f"({t('kta_fetch_bytes_total') / 1e6:,.1f} MB), "
+            f"{t('kta_fetch_errors_total'):,.0f} fetch errors, "
+            f"{t('kta_metadata_reloads_total'):,.0f} metadata reloads"
+        ),
+        (
+            f"  faults: {t('kta_transport_failures_total'):,.0f} transport "
+            f"failures, {t('kta_connection_evictions_total'):,.0f} "
+            f"evictions, {t('kta_backoff_sleeps_total'):,.0f} backoff "
+            f"sleeps ({t('kta_backoff_sleep_seconds_total'):.2f}s), "
+            f"{t('kta_retry_budget_exhaustions_total'):,.0f} budget "
+            f"exhaustions"
+        ),
+        (
+            f"  state: {t('kta_snapshots_saved_total'):,.0f} snapshots "
+            f"saved, {t('kta_scan_degraded_partitions'):,.0f} degraded "
+            f"partitions"
+        ),
+    ]
+    return "\n".join(lines) + "\n"
+
+
 def render_extremes_table(metrics: TopicMetrics) -> str:
     """Optional per-partition extremes table (new capability; the reference
     only has global lines).  Columns: first/last timestamp, min/max sized

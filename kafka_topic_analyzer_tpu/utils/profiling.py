@@ -5,6 +5,11 @@ The reference's only observability is wall-clock + a derived msgs/s
 metric here, the engine keeps per-stage (ingest / dispatch / finalize)
 wall-time and record counters, and can wrap the scan in a JAX profiler trace
 (``--profile-dir``) for XLA-level analysis on TPU.
+
+With a span tracer attached (``--trace-json``, obs/trace.py) every stage
+window is also mirrored into the Chrome trace with the *same* measured
+duration, so the host trace's per-stage totals agree with ``--stats``
+exactly.
 """
 
 from __future__ import annotations
@@ -12,7 +17,12 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import time
-from typing import Dict, Iterator
+from typing import Dict, Iterator, Optional
+
+#: Canonical stage order for summaries: pipeline position, not insertion
+#: order (insertion order varies with which stage fires first — e.g. a
+#: resumed scan snapshots before its first dispatch).
+_STAGE_ORDER = ("ingest", "dispatch", "snapshot", "finalize")
 
 
 @dataclasses.dataclass
@@ -25,11 +35,19 @@ class StageStats:
     def items_per_sec(self) -> float:
         return self.items / self.seconds if self.seconds > 0 else 0.0
 
+    @property
+    def mb_per_sec(self) -> float:
+        return (
+            self.bytes / self.seconds / 1e6 if self.seconds > 0 else 0.0
+        )
+
 
 class ScanProfile:
-    def __init__(self) -> None:
+    def __init__(self, tracer=None) -> None:
         self.stages: Dict[str, StageStats] = {}
         self.wall_start = time.monotonic()
+        #: Optional obs.trace.SpanTracer — stage windows mirror into it.
+        self.tracer = tracer
 
     @contextlib.contextmanager
     def stage(self, name: str, items: int = 0, nbytes: int = 0) -> Iterator[None]:
@@ -38,26 +56,44 @@ class ScanProfile:
         try:
             yield
         finally:
-            st.seconds += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            st.seconds += dt
             st.items += items
             st.bytes += nbytes
+            if self.tracer is not None:
+                # Same t0/dt as the stat above: the trace and --stats can
+                # never drift apart.
+                self.tracer.add_complete(name, t0, dt, cat="stage")
 
     @property
     def wall_seconds(self) -> float:
         return time.monotonic() - self.wall_start
 
+    def ordered_stages(self) -> "list[tuple[str, StageStats]]":
+        """Stages in canonical pipeline order, then alphabetical for any
+        stage outside the canon — deterministic across runs."""
+        rank = {name: i for i, name in enumerate(_STAGE_ORDER)}
+        return sorted(
+            self.stages.items(),
+            key=lambda kv: (rank.get(kv[0], len(_STAGE_ORDER)), kv[0]),
+        )
+
     def summary(self) -> str:
         lines = []
-        for name, st in self.stages.items():
-            lines.append(
-                f"  {name}: {st.seconds:.3f}s, {st.items} records"
-                + (f" ({st.items_per_sec:,.0f}/s)" if st.items else "")
-            )
+        for name, st in self.ordered_stages():
+            line = f"  {name}: {st.seconds:.3f}s, {st.items} records"
+            if st.items:
+                line += f" ({st.items_per_sec:,.0f}/s)"
+            if st.bytes:
+                line += (
+                    f", {st.bytes / 1e6:,.1f} MB ({st.mb_per_sec:,.1f} MB/s)"
+                )
+            lines.append(line)
         return "\n".join(lines)
 
 
 @contextlib.contextmanager
-def maybe_jax_trace(profile_dir: "str | None") -> Iterator[None]:
+def maybe_jax_trace(profile_dir: "Optional[str]") -> Iterator[None]:
     if not profile_dir:
         yield
         return

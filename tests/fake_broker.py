@@ -650,3 +650,27 @@ class FakeCluster:
     @property
     def bootstrap(self) -> str:
         return ",".join(f"127.0.0.1:{b.port}" for b in self.nodes)
+
+
+class ChaosTrigger:
+    """Source proxy that fires ``action`` once, after the Nth yielded batch:
+    chaos strikes mid-scan, at a deterministic point between engine steps
+    (after the init handshake — metadata/watermarks — has succeeded)."""
+
+    def __init__(self, inner, after_batches: int, action):
+        self.inner = inner
+        self.after = after_batches
+        self.action = action
+        self._fired = False
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def batches(self, *args, **kwargs):
+        n = 0
+        for batch in self.inner.batches(*args, **kwargs):
+            yield batch
+            n += 1
+            if n == self.after and not self._fired:
+                self._fired = True
+                self.action()

@@ -144,6 +144,24 @@ def main() -> int:
             "mh-topic", SyntheticSource(spec), backend, batch_size=2048
         )
 
+    # Cluster-wide telemetry merge: each process's lag/ETA gauges cover
+    # only the partitions ITS local rows feed, so the merged view is a
+    # disjoint union — every partition appears exactly once, drained to
+    # zero (a process must never report full lag for a partition another
+    # process scanned).
+    lag = result.telemetry["kta_partition_lag"]["samples"]
+    parts = sorted(s["labels"]["partition"] for s in lag)
+    assert parts == sorted(str(p) for p in range(6)), parts
+    assert all(s["value"] == 0 for s in lag), lag
+    if mode == "plain":
+        # The merged counter sums both processes' folds to the full topic.
+        # (Not asserted under "resume": the interrupted scan's partial
+        # counts share this process's registry with the resumed run's.)
+        assert (
+            result.telemetry["kta_scan_records_total"]["samples"][0]["value"]
+            == 6 * 5000
+        )
+
     if jax.process_index() == 0:
         doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)
         with open(out_path, "w") as f:

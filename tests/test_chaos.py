@@ -21,7 +21,7 @@ from kafka_topic_analyzer_tpu.engine import run_scan
 from kafka_topic_analyzer_tpu.io import kafka_codec as kc
 from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
 
-from fake_broker import FakeBroker, FakeCluster, FaultInjector
+from fake_broker import ChaosTrigger, FakeBroker, FakeCluster, FaultInjector
 
 pytestmark = pytest.mark.chaos
 
@@ -66,29 +66,6 @@ def _scan_result(bootstrap: str, overrides=None, source=None, batch_size=128):
 
 def _metrics_doc(result) -> dict:
     return result.metrics.to_dict(result.start_offsets, result.end_offsets)
-
-
-class ChaosTrigger:
-    """Source proxy that fires ``action`` once, after the Nth yielded batch:
-    chaos strikes mid-scan, at a deterministic point between engine steps."""
-
-    def __init__(self, inner, after_batches: int, action):
-        self.inner = inner
-        self.after = after_batches
-        self.action = action
-        self._fired = False
-
-    def __getattr__(self, name):
-        return getattr(self.inner, name)
-
-    def batches(self, *args, **kwargs):
-        n = 0
-        for batch in self.inner.batches(*args, **kwargs):
-            yield batch
-            n += 1
-            if n == self.after and not self._fired:
-                self._fired = True
-                self.action()
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,398 @@
+"""Unit + property coverage for the obs telemetry subsystem: registry
+instruments and snapshot/merge algebra, Prometheus text exposition, the
+event bus + JSONL sink, the span tracer, and the scrape endpoint.
+
+The histogram merge law — merging N shard snapshots equals observing the
+union of their samples — is the contract multi-controller aggregation
+(parallel/sharded.py::gather_telemetry) leans on; it gets a hypothesis
+property test (skipped cleanly when hypothesis is absent, like
+test_properties.py)."""
+
+import json
+import math
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kafka_topic_analyzer_tpu.obs import events, trace
+from kafka_topic_analyzer_tpu.obs.exporters import CONTENT_TYPE, PrometheusExporter
+from kafka_topic_analyzer_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    merge_snapshots,
+    render_prometheus,
+)
+
+# ---------------------------------------------------------------------------
+# instruments
+
+
+def test_counter_monotonic():
+    c = Counter("c_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_inc():
+    g = Gauge("g", "help")
+    g.set(7)
+    g.inc(3)
+    assert g.value == 10.0
+
+
+def test_histogram_bucket_placement():
+    h = Histogram("h", "help", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 1.5, 4.0, 5.0):
+        h.observe(v)
+    s = h.samples()[0]
+    # le is inclusive (Prometheus contract): 1.0 lands in le=1, 4.0 in le=4.
+    assert s["counts"] == [2, 1, 1, 1]
+    assert s["count"] == 5
+    assert s["sum"] == pytest.approx(12.0)
+
+
+def test_histogram_rejects_bad_buckets():
+    with pytest.raises(ValueError):
+        Histogram("h", "help", buckets=(2.0, 1.0))
+    with pytest.raises(ValueError):
+        Histogram("h", "help", buckets=(1.0, 1.0))
+    with pytest.raises(ValueError):
+        Histogram("h", "help", buckets=())
+
+
+def test_histogram_time_context():
+    h = Histogram("h", "help", buckets=(10.0,))
+    with h.time():
+        pass
+    s = h.samples()[0]
+    assert s["count"] == 1
+    assert 0 <= s["sum"] < 10.0
+
+
+def test_labels_children_and_validation():
+    c = Counter("c_total", "help", labelnames=("partition",))
+    c.labels(0).inc()
+    c.labels(partition=0).inc()
+    c.labels("1").inc(5)
+    by = {tuple(s["labels"].items()): s["value"] for s in c.samples()}
+    assert by[(("partition", "0"),)] == 2.0
+    assert by[(("partition", "1"),)] == 5.0
+    with pytest.raises(ValueError):
+        c.labels("0", "extra")
+    with pytest.raises(ValueError):
+        Counter("c", "help", labelnames=("bad-name",))
+
+
+def test_registry_get_or_create_and_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "help")
+    assert reg.counter("x_total", "other help") is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "now a gauge?")
+    with pytest.raises(ValueError):
+        reg.counter("bad name", "help")
+
+
+def test_registry_reset_keeps_registrations():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "help")
+    h = reg.histogram("h", "help", buckets=(1.0,))
+    c.inc(3)
+    h.observe(0.5)
+    reg.reset()
+    assert c.value == 0.0
+    assert reg.counter("x_total", "help") is c
+    assert h.samples()[0]["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+
+
+def test_render_prometheus_counter_and_histogram():
+    reg = MetricsRegistry()
+    reg.counter("kta_x_total", "records\nseen").inc(3)
+    h = reg.histogram("kta_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = render_prometheus(reg.snapshot())
+    assert "# HELP kta_x_total records seen\n" in text  # newline escaped
+    assert "# TYPE kta_x_total counter\n" in text
+    assert "kta_x_total 3\n" in text
+    assert 'kta_lat_seconds_bucket{le="0.1"} 1\n' in text
+    assert 'kta_lat_seconds_bucket{le="1"} 2\n' in text
+    assert 'kta_lat_seconds_bucket{le="+Inf"} 3\n' in text  # cumulative
+    assert "kta_lat_seconds_count 3\n" in text
+
+
+def test_render_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.gauge("g", "help", labelnames=("t",)).labels('a"b\\c\nd').set(1)
+    text = render_prometheus(reg.snapshot())
+    assert 'g{t="a\\"b\\\\c\\nd"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# merge algebra
+
+
+def _snap(build):
+    reg = MetricsRegistry()
+    build(reg)
+    return reg.snapshot()
+
+
+def test_merge_counters_add_gauges_max():
+    a = _snap(lambda r: (r.counter("c_total", "h").inc(2),
+                         r.gauge("g", "h").set(5)))
+    b = _snap(lambda r: (r.counter("c_total", "h").inc(3),
+                         r.gauge("g", "h").set(4)))
+    merged = merge_snapshots([a, b])
+    assert merged["c_total"]["samples"][0]["value"] == 5.0
+    assert merged["g"]["samples"][0]["value"] == 5.0
+
+
+def test_merge_sum_policy_gauge():
+    # Disjoint per-process counts (e.g. locally-degraded partitions)
+    # declare merge="sum"; the policy rides in the snapshot.
+    a = _snap(lambda r: r.gauge("deg", "h", merge="sum").set(2))
+    b = _snap(lambda r: r.gauge("deg", "h", merge="sum").set(3))
+    merged = merge_snapshots([a, b])
+    assert merged["deg"]["samples"][0]["value"] == 5.0
+    assert a["deg"]["merge"] == "sum"
+    with pytest.raises(ValueError):
+        MetricsRegistry().gauge("bad", "h", merge="median")
+
+
+def test_merge_disjoint_labels_union():
+    a = _snap(lambda r: r.gauge("lag", "h", labelnames=("p",)).labels(0).set(10))
+    b = _snap(lambda r: r.gauge("lag", "h", labelnames=("p",)).labels(1).set(20))
+    merged = merge_snapshots([a, b])
+    assert [
+        (s["labels"]["p"], s["value"]) for s in merged["lag"]["samples"]
+    ] == [("0", 10.0), ("1", 20.0)]
+
+
+def test_merge_histogram_bucket_mismatch_raises():
+    a = _snap(lambda r: r.histogram("h", "h", buckets=(1.0, 2.0)).observe(1))
+    b = _snap(lambda r: r.histogram("h", "h", buckets=(1.0, 4.0)).observe(1))
+    with pytest.raises(ValueError, match="bucket layouts"):
+        merge_snapshots([a, b])
+
+
+def test_merge_type_conflict_raises():
+    a = _snap(lambda r: r.counter("m_total", "h").inc())
+    b = _snap(lambda r: r.gauge("m_total", "h").set(1))
+    with pytest.raises(ValueError, match="conflicting types"):
+        merge_snapshots([a, b])
+
+
+def test_merge_does_not_mutate_inputs():
+    a = _snap(lambda r: r.counter("c_total", "h").inc(1))
+    b = _snap(lambda r: r.counter("c_total", "h").inc(2))
+    merge_snapshots([a, b])
+    merge_snapshots([a, b])
+    assert a["c_total"]["samples"][0]["value"] == 1.0
+
+
+def test_merge_n_shards_equals_observing_union_property():
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    buckets = (0.001, 0.01, 0.1, 1.0, 10.0)
+    samples_strategy = st.lists(
+        st.lists(
+            st.floats(
+                min_value=0.0, max_value=100.0,
+                allow_nan=False, allow_infinity=False,
+            ),
+            max_size=30,
+        ),
+        min_size=1,
+        max_size=6,
+    )
+
+    @settings(max_examples=60, deadline=None)
+    @given(shards=samples_strategy)
+    def law(shards):
+        snaps = []
+        for values in shards:
+            reg = MetricsRegistry()
+            h = reg.histogram("h", "help", buckets=buckets)
+            c = reg.counter("n_total", "help")
+            for v in values:
+                h.observe(v)
+                c.inc()
+            snaps.append(reg.snapshot())
+        union_reg = MetricsRegistry()
+        uh = union_reg.histogram("h", "help", buckets=buckets)
+        uc = union_reg.counter("n_total", "help")
+        for values in shards:
+            for v in values:
+                uh.observe(v)
+                uc.inc()
+        merged = merge_snapshots(snaps)
+        want = union_reg.snapshot()
+        got_h = merged["h"]["samples"][0]
+        want_h = want["h"]["samples"][0]
+        assert got_h["counts"] == want_h["counts"]
+        assert got_h["count"] == want_h["count"]
+        assert got_h["sum"] == pytest.approx(want_h["sum"])
+        assert (
+            merged["n_total"]["samples"][0]["value"]
+            == want["n_total"]["samples"][0]["value"]
+        )
+
+    law()
+
+
+# ---------------------------------------------------------------------------
+# event bus
+
+
+def test_emit_without_sinks_is_noop():
+    events.emit("anything", x=1)  # must not raise, must not allocate sinks
+
+
+def test_jsonl_sink_and_capture(tmp_path):
+    path = tmp_path / "events.jsonl"
+    clock = iter([10.0, 11.5])
+    sink = events.JsonlEventLog(str(path), clock=lambda: next(clock))
+    events.add_sink(sink)
+    try:
+        events.emit("scan_start", topic="t", partitions=3)
+        events.emit("scan_end", topic="t", records=5)
+    finally:
+        events.remove_sink(sink)
+        sink.close()
+    docs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [d["type"] for d in docs] == ["scan_start", "scan_end"]
+    assert docs[0]["ts"] == 10.0
+    assert docs[0]["partitions"] == 3
+    assert docs[1]["records"] == 5
+
+
+def test_failing_sink_is_detached():
+    calls = []
+
+    def bad(etype, fields):
+        calls.append(etype)
+        raise RuntimeError("disk full")
+
+    events.add_sink(bad)
+    try:
+        events.emit("one")
+        events.emit("two")  # bad sink already detached; no raise
+    finally:
+        events.remove_sink(bad)
+    assert calls == ["one"]
+
+
+def test_heartbeat_rate_limit_and_force():
+    t = [0.0]
+    hb = events.Heartbeat(10.0, clock=lambda: t[0])
+    assert hb.ready()
+    t[0] = 5.0
+    assert not hb.ready()
+    t[0] = 10.0
+    assert hb.ready()
+    t[0] = 11.0
+    hb.force()
+    assert hb.ready()
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+
+
+def test_tracer_spans_and_chrome_format(tmp_path):
+    t = [0.0]
+    tr = trace.SpanTracer(clock=lambda: t[0])
+    with tr.span("fetch", cat="io"):
+        t[0] += 0.25
+    tr.add_complete("decode", 1.0, 0.5, cat="io", args={"n": 3})
+    doc = tr.chrome_trace()
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    ev = {e["name"]: e for e in doc["traceEvents"]}
+    assert ev["fetch"]["ph"] == "X"
+    assert ev["fetch"]["dur"] == pytest.approx(0.25e6)
+    assert ev["decode"]["ts"] == pytest.approx(1.0e6)
+    assert ev["decode"]["args"] == {"n": 3}
+    path = tmp_path / "trace.json"
+    tr.write(str(path))
+    assert json.loads(path.read_text())["traceEvents"]
+
+
+def test_maybe_span_active_and_inactive():
+    with trace.maybe_span("idle"):
+        pass  # no active tracer: a pure no-op
+    tr = trace.SpanTracer()
+    trace.set_active(tr)
+    try:
+        with trace.maybe_span("work"):
+            pass
+    finally:
+        trace.set_active(None)
+    assert [e["name"] for e in tr.events()] == ["work"]
+
+
+def test_telemetry_session_bad_trace_path_fails_fast(tmp_path):
+    from kafka_topic_analyzer_tpu.obs import telemetry_session
+
+    with pytest.raises(OSError):
+        with telemetry_session(trace_json=str(tmp_path / "no" / "t.json")):
+            raise AssertionError("session body must not run")
+
+
+def test_telemetry_session_write_failure_does_not_mask(tmp_path):
+    from kafka_topic_analyzer_tpu.obs import telemetry_session
+
+    trace_path = tmp_path / "t.json"
+    events_path = tmp_path / "e.jsonl"
+
+    class Boom(RuntimeError):
+        pass
+
+    # The scan's own exception must survive a failing trace write at
+    # teardown (the path turns into a directory mid-session), and the
+    # event sink must still be detached.
+    with pytest.raises(Boom):
+        with telemetry_session(
+            events_jsonl=str(events_path), trace_json=str(trace_path)
+        ):
+            trace_path.unlink()
+            trace_path.mkdir()
+            raise Boom()
+    assert events._sinks == []
+
+
+# ---------------------------------------------------------------------------
+# scrape endpoint
+
+
+def test_prometheus_exporter_serves_registry():
+    reg = MetricsRegistry()
+    reg.counter("kta_test_total", "scrape me").inc(7)
+    exporter = PrometheusExporter(0, registry=reg)
+    try:
+        url = f"http://127.0.0.1:{exporter.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"] == CONTENT_TYPE
+        assert "kta_test_total 7\n" in body
+        reg.counter("kta_test_total", "").inc()  # live: next scrape moves
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert "kta_test_total 8\n" in resp.read().decode()
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exporter.port}/nope", timeout=5
+            )
+    finally:
+        exporter.close()

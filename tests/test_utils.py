@@ -23,10 +23,101 @@ def test_log_level_parsing():
     assert parse_level("off") == logging.CRITICAL
 
 
+def test_log_spec_per_target_levels():
+    import logging
+
+    from kafka_topic_analyzer_tpu.utils.log import parse_spec
+
+    assert parse_spec("warn") == (logging.WARNING, {})
+    assert parse_spec("kta.io=debug,error") == (
+        logging.ERROR, {"kta.io": logging.DEBUG}
+    )
+    # Junk segments are ignored; a spec with no usable default → ERROR.
+    assert parse_spec("garbage,=debug,kta.io=loud") == (logging.ERROR, {})
+    assert parse_spec("") == (logging.ERROR, {})
+    # Junk around a good target doesn't poison it.
+    assert parse_spec("nope,kta=trace") == (
+        logging.ERROR, {"kta": logging.DEBUG}
+    )
+
+
+def test_log_target_alias_resolution():
+    from kafka_topic_analyzer_tpu.utils.log import resolve_target
+
+    assert resolve_target("kta") == "kafka_topic_analyzer_tpu"
+    assert resolve_target("kta.io") == "kafka_topic_analyzer_tpu.io"
+    assert resolve_target("ktax.io") == "ktax.io"  # no false prefix match
+    assert resolve_target("other.mod") == "other.mod"
+
+
+def test_init_logging_configures_named_loggers(monkeypatch):
+    import logging
+
+    from kafka_topic_analyzer_tpu.utils.log import init_logging
+
+    monkeypatch.setenv("KTA_LOG", "warn,kta.io=debug")
+    io_logger = logging.getLogger("kafka_topic_analyzer_tpu.io")
+    old_level = io_logger.level
+    try:
+        init_logging()
+        assert io_logger.level == logging.DEBUG
+        # Hierarchy: module loggers under the target inherit its level.
+        child = logging.getLogger("kafka_topic_analyzer_tpu.io.kafka_wire")
+        assert child.getEffectiveLevel() == logging.DEBUG
+    finally:
+        io_logger.setLevel(old_level)
+
+
 def test_spinner_disabled_writes_nothing(capsys):
     sp = Spinner(enabled=False)
     sp.set_message("x")
     sp.finish_with_message("done")
+    assert capsys.readouterr().err == ""
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def test_spinner_flushes_final_rate_limited_update(capsys):
+    clock = _FakeClock()
+    sp = Spinner(enabled=True, min_interval_s=0.1, clock=clock)
+    clock.t += 1.0
+    sp.set_message("first")
+    clock.t += 0.01  # inside the rate-limit window: held as pending
+    sp.set_message("last-frame")
+    err_so_far = capsys.readouterr().err
+    assert "first" in err_so_far
+    assert "last-frame" not in err_so_far  # rate-limited, not yet drawn
+    sp.finish_with_message("done")
+    err = capsys.readouterr().err
+    # The held update lands before the finish line replaces it.
+    assert "last-frame" in err
+    assert err.index("last-frame") < err.index("done")
+
+
+def test_spinner_finish_silent_when_no_frame_drawn(capsys):
+    clock = _FakeClock()
+    sp = Spinner(enabled=True, min_interval_s=0.1, clock=clock)
+    # No set_message ever drew a frame: finish has nothing to overwrite.
+    sp.finish_with_message("done")
+    assert capsys.readouterr().err == ""
+
+
+def test_spinner_finish_after_frame_writes_once(capsys):
+    clock = _FakeClock()
+    sp = Spinner(enabled=True, min_interval_s=0.1, clock=clock)
+    clock.t += 1.0
+    sp.set_message("work")
+    sp.finish_with_message("done")
+    err = capsys.readouterr().err
+    assert "done" in err and err.endswith("\n")
+    # Second finish is a no-op: the frame was already consumed.
+    sp.finish_with_message("again")
     assert capsys.readouterr().err == ""
 
 
@@ -45,6 +136,73 @@ def test_scan_profile_counters():
     assert st.items == 15
     assert st.items_per_sec > 0
     assert "x: " in prof.summary()
+
+
+def test_stage_stats_rate_math():
+    from kafka_topic_analyzer_tpu.utils.profiling import StageStats
+
+    st = StageStats(seconds=2.0, items=100, bytes=4_000_000)
+    assert st.items_per_sec == pytest.approx(50.0)
+    assert st.mb_per_sec == pytest.approx(2.0)
+    # Zero-duration stages report 0 rather than dividing by zero.
+    empty = StageStats()
+    assert empty.items_per_sec == 0.0
+    assert empty.mb_per_sec == 0.0
+
+
+def test_scan_profile_summary_order_and_mbs():
+    prof = ScanProfile()
+    # Insert out of pipeline order (a resumed scan snapshots first).
+    for name in ("snapshot", "finalize", "dispatch", "zeta", "ingest"):
+        with prof.stage(name, items=1, nbytes=1_000_000):
+            pass
+    names = [n for n, _ in prof.ordered_stages()]
+    # Canonical pipeline order, then alphabetical for out-of-canon stages.
+    assert names == ["ingest", "dispatch", "snapshot", "finalize", "zeta"]
+    assert "MB" in prof.summary() and "MB/s" in prof.summary()
+
+
+def test_scan_profile_stages_mirror_into_tracer():
+    from kafka_topic_analyzer_tpu.obs.trace import SpanTracer
+
+    tracer = SpanTracer()
+    prof = ScanProfile(tracer=tracer)
+    with prof.stage("ingest", items=3):
+        pass
+    (ev,) = tracer.events()
+    assert ev["name"] == "ingest" and ev["cat"] == "stage"
+    # Same measurement: the trace duration IS the profiled seconds.
+    assert ev["dur"] == pytest.approx(prof.stages["ingest"].seconds * 1e6)
+
+
+def test_maybe_jax_trace_noop_path():
+    from kafka_topic_analyzer_tpu.utils.profiling import maybe_jax_trace
+
+    # Falsy dirs skip the profiler entirely (no jax import needed).
+    with maybe_jax_trace(None):
+        pass
+    with maybe_jax_trace(""):
+        pass
+
+
+def test_maybe_jax_trace_trace_path(monkeypatch, tmp_path):
+    import contextlib
+
+    import jax
+
+    from kafka_topic_analyzer_tpu.utils.profiling import maybe_jax_trace
+
+    seen = []
+
+    @contextlib.contextmanager
+    def fake_trace(profile_dir):
+        seen.append(profile_dir)
+        yield
+
+    monkeypatch.setattr(jax.profiler, "trace", fake_trace)
+    with maybe_jax_trace(str(tmp_path)):
+        pass
+    assert seen == [str(tmp_path)]
 
 
 def test_timefmt_chrono_display():

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Static lint pass, run as part of tools/tier1.sh.
+#
+# Rule: library modules never call print().  User-facing output must route
+# through report.py (the renderer), the spinner (utils/progress.py), or the
+# obs exporters — a print() buried in a library module corrupts --json
+# stdout and bypasses the quiet/stats flags.  CLI entry points are exempt:
+# cli.py (renders the report + banners), report.py (builds the strings the
+# CLI prints), and the kafka_topic_analyzer_tpu/tools/ bench/probe scripts
+# (standalone __main__ programs whose stdout IS their output format).
+#
+# AST-based, not grep: strings like the `python -c "print('ok', ...)"`
+# subprocess probe in jax_support.py must not trip it.
+cd "$(dirname "$0")/.." || exit 1
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PKG = pathlib.Path("kafka_topic_analyzer_tpu")
+ALLOWED = {
+    PKG / "cli.py",
+    PKG / "report.py",
+}
+ALLOWED_DIRS = (PKG / "tools",)
+
+failures = []
+for path in sorted(PKG.rglob("*.py")):
+    if path in ALLOWED or any(d in path.parents for d in ALLOWED_DIRS):
+        continue
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            failures.append(f"{path}:{node.lineno}: print() in library module")
+
+if failures:
+    print("lint: bare print() calls found (route output through report.py,")
+    print("lint: the spinner, or the obs exporters):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (no print() in library modules)")
+EOF

@@ -5,4 +5,5 @@
 # (DOTS_PASSED) that survives pytest's output quirks.  Run from the repo
 # root: `bash tools/tier1.sh` (or `make tier1` if you add a Makefile).
 cd "$(dirname "$0")/.." || exit 1
+bash tools/lint.sh || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
