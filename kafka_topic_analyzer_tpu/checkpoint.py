@@ -111,6 +111,7 @@ def save_snapshot(
     init_now_s: int,
     scope=None,
     degraded: "Optional[Dict[int, str]]" = None,
+    corrupt: "Optional[list]" = None,
 ) -> str:
     """Atomically write the snapshot; returns its path.
 
@@ -122,7 +123,14 @@ def save_snapshot(
     (transport-fault degradation).  Informational only — resume reads
     ``next_offsets``, which already stop at each degraded partition's last
     folded record — but it lets an operator see from the snapshot alone
-    why a rerun is needed."""
+    why a rerun is needed.
+
+    ``corrupt``: the span list of poisoned frames the scan skipped or
+    quarantined (KafkaWireSource.corruption_spans format).  NOT merely
+    informational: a --resume seeds the source with it
+    (`load_corrupt_spans`) so re-walking an already-skipped span — the
+    offset tracker cannot advance past a span that yielded no records —
+    neither re-counts nor double-quarantines it."""
     os.makedirs(directory, exist_ok=True)
     host_state = jax.tree.map(np.asarray, jax.device_get(state))
     flat = _flatten(host_state)
@@ -135,6 +143,8 @@ def save_snapshot(
     }
     if degraded:
         meta["degraded"] = {str(k): str(v) for k, v in degraded.items()}
+    if corrupt:
+        meta["corrupt_spans"] = list(corrupt)
     if scope is not None:
         meta["process"] = [int(scope[0]), int(scope[1])]
         meta["local_rows"] = [int(r) for r in scope[2]]
@@ -204,3 +214,16 @@ def load_snapshot(
     state = jax.tree_util.tree_unflatten(treedef, new_leaves)
     offsets = {int(k): int(v) for k, v in meta["next_offsets"].items()}
     return state, offsets, int(meta["records_seen"]), int(meta["init_now_s"])
+
+
+def load_corrupt_spans(directory: str, scope=None) -> list:
+    """The ``corrupt_spans`` metadata of a snapshot, or [] when the
+    snapshot (or the list) is absent.  Split from `load_snapshot` so the
+    engine can seed the source without changing that function's
+    long-standing 4-tuple contract."""
+    path = _snapshot_path(directory, scope)
+    if not os.path.exists(path):
+        return []
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+    return list(meta.get("corrupt_spans", []))

@@ -24,22 +24,44 @@ from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 #: failure.  Distinct from 1 (hard error) and -2 (empty topic).
 EXIT_DEGRADED = 3
 
+#: Exit code when the scan COMPLETED but skipped (or quarantined) one or
+#: more deterministically corrupt frames (--on-corruption=skip/quarantine):
+#: the metrics exclude exactly those frames' records, which automation must
+#: distinguish from both a clean run (0) and a degraded one (3 — an
+#: unbounded undercount; degradation therefore takes precedence when both
+#: occur).
+EXIT_CORRUPT = 4
 
-def _degraded_exit(result, doc=None, render=False) -> int:
-    """Shared tail of every report path: surface the degraded partitions —
-    into ``doc`` as a str-keyed map (``--json``) and/or as the post-table
-    warning block (``render``) — and pick the exit code."""
-    if not result.degraded_partitions:
-        return 0
-    if doc is not None:
-        doc["degraded_partitions"] = {
-            str(p): r for p, r in result.degraded_partitions.items()
-        }
-    if render:
-        from kafka_topic_analyzer_tpu.report import render_degraded_block
 
-        sys.stdout.write(render_degraded_block(result.degraded_partitions))
-    return EXIT_DEGRADED
+def _scan_issue_exit(result, doc=None, render=False) -> int:
+    """Shared tail of every report path: surface corrupt and degraded
+    partitions — into ``doc`` as str-keyed maps (``--json``) and/or as the
+    post-table warning blocks (``render``) — and pick the exit code."""
+    rc = 0
+    corrupt = getattr(result, "corrupt_partitions", None) or {}
+    if corrupt:
+        if doc is not None:
+            doc["corrupt_partitions"] = {
+                str(p): d for p, d in corrupt.items()
+            }
+        if render:
+            from kafka_topic_analyzer_tpu.report import render_corrupt_block
+
+            sys.stdout.write(render_corrupt_block(corrupt))
+        rc = EXIT_CORRUPT
+    if result.degraded_partitions:
+        if doc is not None:
+            doc["degraded_partitions"] = {
+                str(p): r for p, r in result.degraded_partitions.items()
+            }
+        if render:
+            from kafka_topic_analyzer_tpu.report import render_degraded_block
+
+            sys.stdout.write(
+                render_degraded_block(result.degraded_partitions)
+            )
+        rc = EXIT_DEGRADED
+    return rc
 
 
 class UserInputError(ValueError):
@@ -172,6 +194,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Write a Chrome trace-event JSON of host-side scan "
                         "spans (fetch/decode/stages) to FILE; combine with "
                         "--profile-dir for the XLA timeline")
+    p.add_argument("--check-crcs", action="store_true",
+                   help="Verify record-batch checksums (CRC32-C) while "
+                        "decoding, like librdkafka's check.crcs. Without it, "
+                        "corruption detection only catches structural "
+                        "damage; payload bit rot decodes as garbage values")
+    p.add_argument("--on-corruption", choices=["fail", "skip", "quarantine"],
+                   default="fail", metavar="POLICY",
+                   help="What to do with a deterministically corrupt record "
+                        "frame (one that fails decode identically on a "
+                        "re-fetch): 'fail' aborts the scan (default), 'skip' "
+                        "skips exactly that frame and finishes with exit "
+                        f"code {EXIT_CORRUPT}, 'quarantine' additionally "
+                        "spools the raw frame + JSON sidecar to "
+                        "--quarantine-dir")
+    p.add_argument("--quarantine-dir", metavar="DIR",
+                   help="Directory for quarantined corrupt frames "
+                        "(requires --on-corruption=quarantine)")
     p.add_argument("--quiet", action="store_true", help="No progress spinner")
     return p
 
@@ -225,6 +264,14 @@ def parse_mesh(text: str) -> "tuple[int, int]":
 
 def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object":
     topic = topic if topic is not None else args.topic
+    if args.source != "kafka" and (
+        getattr(args, "on_corruption", "fail") != "fail"
+        or getattr(args, "quarantine_dir", None)
+    ):
+        raise ValueError(
+            "--on-corruption/--quarantine-dir require --source kafka "
+            "(only the wire scan can classify and re-fetch frames)"
+        )
     if args.source == "synthetic":
         from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
 
@@ -248,13 +295,32 @@ def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object
     # kafka
     if not args.bootstrap_server:
         raise SystemExit("--source kafka requires -b/--bootstrap-server")
+    from kafka_topic_analyzer_tpu.config import CorruptionConfig
     from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
 
+    overrides = parse_kv_pairs(args.librdkafka)
+    if getattr(args, "check_crcs", False):
+        # First-class flag for the knob that upgrades corruption detection
+        # from structural damage to full payload checksums; the explicit
+        # flag wins over a --librdkafka check.crcs override.
+        overrides["check.crcs"] = "true"
+    corruption = None
+    if (
+        getattr(args, "on_corruption", "fail") != "fail"
+        or getattr(args, "quarantine_dir", None)
+    ):
+        corruption = CorruptionConfig(
+            policy=getattr(args, "on_corruption", "fail"),
+            quarantine_dir=getattr(args, "quarantine_dir", None),
+        )
     return KafkaWireSource(
         bootstrap_servers=args.bootstrap_server,
         topic=topic,
-        overrides=parse_kv_pairs(args.librdkafka),
+        overrides=overrides,
         use_native_hashing=args.native != "off",
+        # None lets an --librdkafka on.corruption/quarantine.dir override
+        # apply; explicit flags win.
+        corruption=corruption,
     )
 
 
@@ -415,7 +481,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     _print_stats(args, result)
     multi.close()  # flush per-topic segment dumps, release connections
     if _not_report_process(args):
-        return _degraded_exit(result)  # multi-host: one report, from process 0
+        return _scan_issue_exit(result)  # multi-host: one report, from process 0
 
     union = result.metrics
     # Per-topic projections, computed once for both output formats.
@@ -451,7 +517,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         doc["union"] = union_doc
         doc["telemetry"] = result.telemetry
         # Degraded keys are dense fan-in rows; reasons carry topic/partition.
-        rc = _degraded_exit(result, doc=doc)
+        rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))
         return rc
     # Per-topic reports from the shared projections.
@@ -492,7 +558,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         )
         print(f"Message size quantiles (union): {qs}")
     print(eq)
-    return _degraded_exit(result, render=True)
+    return _scan_issue_exit(result, render=True)
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -595,7 +661,7 @@ def _run(args) -> int:
         # Multi-host: one report, from process 0 — but every process must
         # agree on the degraded exit code for orchestrators (run_scan
         # reduces the degraded flag across processes).
-        return _degraded_exit(result)
+        return _scan_issue_exit(result)
 
     if args.json:
         import json
@@ -604,7 +670,7 @@ def _run(args) -> int:
         doc["topic"] = args.topic
         doc["duration_secs"] = result.duration_secs
         doc["telemetry"] = result.telemetry
-        rc = _degraded_exit(result, doc=doc)
+        rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))
         return rc
     sys.stdout.write(
@@ -621,7 +687,7 @@ def _run(args) -> int:
         from kafka_topic_analyzer_tpu.report import render_extremes_table
 
         sys.stdout.write(render_extremes_table(result.metrics))
-    return _degraded_exit(result, render=True)
+    return _scan_issue_exit(result, render=True)
 
 
 if __name__ == "__main__":

@@ -68,6 +68,49 @@ class TransportRetryConfig:
         )
 
 
+#: Valid --on-corruption policies, in escalation order.
+CORRUPTION_POLICIES = ("fail", "skip", "quarantine")
+
+
+@dataclasses.dataclass(frozen=True)
+class CorruptionConfig:
+    """Poison-frame policy for the live Kafka scan (io/kafka_wire.py).
+
+    Like `TransportRetryConfig`, deliberately NOT part of `AnalyzerConfig`:
+    how the scan reacts to corrupt frames changes neither state shapes nor
+    fold semantics, so it must not churn the checkpoint fingerprint.
+
+    Policies (applied only after a re-fetch reproduced the identical
+    failure — a one-shot in-flight bit flip is retried, not classified):
+
+    - ``fail``: abort the scan with the classified error (the default —
+      exactly the pre-corruption-layer behavior);
+    - ``skip``: skip exactly the poisoned frame, account for it
+      per-partition, finish the scan, exit `cli.EXIT_CORRUPT`;
+    - ``quarantine``: like skip, plus the raw frame bytes are spooled to
+      ``quarantine_dir`` with a JSON sidecar (io/quarantine.py) so the
+      evidence survives for offline analysis.
+    """
+
+    policy: str = "fail"
+    quarantine_dir: "str | None" = None
+
+    def __post_init__(self) -> None:
+        if self.policy not in CORRUPTION_POLICIES:
+            raise ValueError(
+                f"on-corruption policy {self.policy!r} invalid "
+                f"({', '.join(CORRUPTION_POLICIES)})"
+            )
+        if self.policy == "quarantine" and not self.quarantine_dir:
+            raise ValueError(
+                "--on-corruption=quarantine requires --quarantine-dir"
+            )
+        if self.quarantine_dir and self.policy != "quarantine":
+            raise ValueError(
+                "--quarantine-dir only applies with --on-corruption=quarantine"
+            )
+
+
 @dataclasses.dataclass(frozen=True)
 class AnalyzerConfig:
     """Static configuration for one analysis run.

@@ -69,6 +69,15 @@ class ScanResult:
     degraded_partitions: "dict[int, str]" = dataclasses.field(
         default_factory=dict
     )
+    #: partition -> {"frames", "records", "bytes", "quarantined", "kinds",
+    #: "spans"} for poisoned frames the source skipped or quarantined under
+    #: --on-corruption (cumulative across a --resume chain: the snapshot
+    #: carries the spans and the source is re-seeded with them).  Non-empty
+    #: means the metrics exclude exactly those frames' records: the report
+    #: renders a CORRUPT block and the CLI exits EXIT_CORRUPT.
+    corrupt_partitions: "dict[int, dict]" = dataclasses.field(
+        default_factory=dict
+    )
     #: Registry snapshot taken at scan end (obs.registry format) — under
     #: multi-controller, the cluster-wide merge of every process's
     #: registry, so the report process can render fleet totals
@@ -235,6 +244,18 @@ def run_scan(
             tracker.next_offsets.update(offsets)
             start_at = offsets
             seq = records_seen
+            if hasattr(source, "seed_corrupt_spans"):
+                from kafka_topic_analyzer_tpu.checkpoint import (
+                    load_corrupt_spans,
+                )
+
+                # Spans a previous run already skipped/quarantined: seed
+                # the source so re-walking one (corruption skips leave no
+                # records for the offset tracker to advance past) neither
+                # re-counts nor double-quarantines it.
+                spans = load_corrupt_spans(snapshot_dir, scope=snap_scope)
+                if spans:
+                    source.seed_corrupt_spans(spans)
     seq_base = seq  # resumed records predate t0; rate math excludes them
     last_snap = time.monotonic()
 
@@ -272,6 +293,11 @@ def run_scan(
                 degraded=(
                     source.degraded_partitions()
                     if hasattr(source, "degraded_partitions")
+                    else None
+                ),
+                corrupt=(
+                    source.corruption_spans()
+                    if hasattr(source, "corruption_spans")
                     else None
                 ),
             )
@@ -474,22 +500,44 @@ def run_scan(
         if hasattr(source, "degraded_partitions")
         else {}
     )
-    # Multi-controller: each process feeds (and can only degrade) its own
-    # rows, but process 0 renders the report and orchestrators read every
-    # process's exit code — so "did the scan degrade" must be a global
-    # agreement, like the per-round continuation above.
+    corrupt = (
+        source.corruption_stats()
+        if hasattr(source, "corruption_stats")
+        else {}
+    )
+    # Multi-controller: each process feeds (and can only degrade or observe
+    # corruption on) its own rows, but process 0 renders the report and
+    # orchestrators read every process's exit code — so "did the scan hit
+    # this issue" must be a global agreement, like the per-round
+    # continuation above.  One lockstep call per issue, same order on every
+    # process.
     lockstep = getattr(backend, "global_any", None)
-    if lockstep is not None:
-        d = backend.config.data_shards
-        feed_rows = list(getattr(backend, "local_rows", range(d)))
-        if len(feed_rows) < d and lockstep(bool(degraded)) and not degraded:
-            degraded = {
-                -1: "partition(s) degraded on another process (see its log)"
+    multiproc = lockstep is not None and len(
+        list(getattr(backend, "local_rows", range(backend.config.data_shards)))
+    ) < backend.config.data_shards
+
+    def issue_elsewhere(local_flag: bool) -> bool:
+        """True when another process saw the issue and this one did not
+        (the collective still runs when local_flag is True — every process
+        must participate in every lockstep call)."""
+        return multiproc and lockstep(local_flag) and not local_flag
+
+    if issue_elsewhere(bool(degraded)):
+        degraded = {
+            -1: "partition(s) degraded on another process (see its log)"
+        }
+    if issue_elsewhere(bool(corrupt)):
+        corrupt = {
+            -1: {
+                "frames": 0, "records": 0, "bytes": 0, "quarantined": 0,
+                "kinds": {}, "spans": [],
+                "note": "corrupt frame(s) on another process (see its log)",
             }
-    if degraded:
-        # Degraded partitions carry an unscanned tail; snapshot so a rerun
-        # resumes them once the cluster recovers (their next_offsets stop
-        # at the last successfully folded record).
+        }
+    if degraded or corrupt:
+        # Degraded partitions carry an unscanned tail; corrupt ones carry
+        # skipped spans the offset tracker never saw.  Snapshot so a rerun
+        # resumes correctly (and, for corruption, re-seeds the skip list).
         maybe_snapshot(force=True)
 
     with profile.stage("finalize"):
@@ -513,6 +561,9 @@ def run_scan(
         records=seq,
         duration_secs=duration_secs,
         degraded=local_degraded,
+        corrupt_frames=sum(
+            d.get("frames", 0) for p, d in corrupt.items() if p >= 0
+        ),
     )
     # Cluster-wide registry view.  gather_telemetry is a lockstep
     # collective, so it runs here — a point every process reaches — never
@@ -528,5 +579,6 @@ def run_scan(
         start_offsets=start_offsets,
         end_offsets=end_offsets,
         degraded_partitions=degraded,
+        corrupt_partitions=corrupt,
         telemetry=telemetry,
     )

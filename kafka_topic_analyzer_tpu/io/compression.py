@@ -29,6 +29,14 @@ class UnsupportedCodecError(RuntimeError):
     pass
 
 
+class CorruptPayloadError(ValueError):
+    """A compressed codec stream that does not decode (truncated, bad
+    framing, length mismatch, over-cap).  Subclasses ValueError so callers
+    written against the decoders' historical "raise ValueError on garbage"
+    contract keep working; the codec layer (io/kafka_codec.py) re-wraps it
+    into the `BadCompressionError` corruption classification."""
+
+
 # ---------------------------------------------------------------------------
 # pure-Python decoders (fallback path)
 
@@ -44,7 +52,7 @@ def _total(fn):
         try:
             return fn(*a, **k)
         except IndexError as e:
-            raise ValueError("truncated compressed payload") from e
+            raise CorruptPayloadError("truncated compressed payload") from e
 
     return wrapper
 
@@ -73,7 +81,7 @@ def _snappy_raw_py(data: bytes) -> bytes:
                 length = int.from_bytes(data[ip : ip + extra], "little") + 1
                 ip += extra
             if ip + length > n:
-                raise ValueError("truncated snappy literal run")
+                raise CorruptPayloadError("truncated snappy literal run")
             out += data[ip : ip + length]
             ip += length
         else:
@@ -90,11 +98,11 @@ def _snappy_raw_py(data: bytes) -> bytes:
                 offset = int.from_bytes(data[ip : ip + 4], "little")
                 ip += 4
             if offset <= 0 or offset > len(out):
-                raise ValueError("bad snappy copy offset")
+                raise CorruptPayloadError("bad snappy copy offset")
             for _ in range(length):  # may overlap (RLE)
                 out.append(out[-offset])
     if len(out) != ulen:
-        raise ValueError(f"snappy length mismatch: {len(out)} != {ulen}")
+        raise CorruptPayloadError(f"snappy length mismatch: {len(out)} != {ulen}")
     return bytes(out)
 
 
@@ -110,7 +118,7 @@ def snappy_decompress_py(data: bytes) -> bytes:
             # (this decoder's totality cannot depend on callers validating
             # first).
             if blen < 0 or ip + blen > len(data):
-                raise ValueError("bad xerial block length")
+                raise CorruptPayloadError("bad xerial block length")
             out += _snappy_raw_py(data[ip : ip + blen])
             ip += blen
         return bytes(out)
@@ -127,14 +135,14 @@ def _lz4_block_py(data: bytes, out: bytearray) -> None:
         if lit == 15:
             while True:
                 if ip >= n:
-                    raise ValueError("truncated lz4 length extension")
+                    raise CorruptPayloadError("truncated lz4 length extension")
                 b = data[ip]
                 ip += 1
                 lit += b
                 if b != 255:
                     break
         if ip + lit > n:
-            raise ValueError("truncated lz4 literal run")
+            raise CorruptPayloadError("truncated lz4 literal run")
         out += data[ip : ip + lit]
         ip += lit
         if ip >= n:
@@ -142,12 +150,12 @@ def _lz4_block_py(data: bytes, out: bytearray) -> None:
         offset = int.from_bytes(data[ip : ip + 2], "little")
         ip += 2
         if offset == 0 or offset > len(out):
-            raise ValueError("bad lz4 match offset")
+            raise CorruptPayloadError("bad lz4 match offset")
         mlen = token & 0x0F
         if mlen == 15:
             while True:
                 if ip >= n:
-                    raise ValueError("truncated lz4 length extension")
+                    raise CorruptPayloadError("truncated lz4 length extension")
                 b = data[ip]
                 ip += 1
                 mlen += b
@@ -155,7 +163,7 @@ def _lz4_block_py(data: bytes, out: bytearray) -> None:
                     break
         mlen += 4
         if len(out) + mlen > MAX_DECOMPRESSED:
-            raise ValueError("lz4 output exceeds 1 GiB cap")
+            raise CorruptPayloadError("lz4 output exceeds 1 GiB cap")
         for _ in range(mlen):
             out.append(out[-offset])
 
@@ -167,7 +175,7 @@ def lz4_decompress_py(data: bytes) -> bytes:
         flg = data[ip]
         ip += 2  # FLG + BD
         if flg & 0x01:
-            raise ValueError("lz4 dictionaries unsupported")
+            raise CorruptPayloadError("lz4 dictionaries unsupported")
         if flg & 0x08:  # content size present
             ip += 8
         ip += 1  # header checksum
@@ -185,10 +193,10 @@ def lz4_decompress_py(data: bytes) -> bytes:
             else:
                 _lz4_block_py(block, out)
             if len(out) > MAX_DECOMPRESSED:
-                raise ValueError("lz4 output exceeds 1 GiB cap")
+                raise CorruptPayloadError("lz4 output exceeds 1 GiB cap")
             if flg & 0x10:  # block checksum
                 ip += 4
-        raise ValueError("lz4 frame missing EndMark")
+        raise CorruptPayloadError("lz4 frame missing EndMark")
     out = bytearray()
     _lz4_block_py(data, out)
     return bytes(out)
@@ -210,7 +218,7 @@ def _read_uvarint(data: bytes, pos: int) -> "tuple[int, int]":
         shift += 7
         if shift > 35:
             break
-    raise ValueError("bad varint in compressed payload")
+    raise CorruptPayloadError("bad varint in compressed payload")
 
 
 def _snappy_output_size(data: bytes) -> int:
@@ -222,7 +230,7 @@ def _snappy_output_size(data: bytes) -> int:
             (blen,) = struct.unpack(">i", data[ip : ip + 4])
             ip += 4
             if blen < 0 or ip + blen > len(data):
-                raise ValueError("bad xerial block length")
+                raise CorruptPayloadError("bad xerial block length")
             size, _ = _read_uvarint(data, ip)
             total += size
             ip += blen
@@ -238,7 +246,7 @@ def _lz4_output_bound(data: bytes) -> int:
         flg = data[4]
         if flg & 0x08:
             if len(data) < 14:
-                raise ValueError("truncated lz4 frame header")
+                raise CorruptPayloadError("truncated lz4 frame header")
             return struct.unpack("<Q", data[6:14])[0]
     return len(data) * 255 + 64
 
@@ -276,7 +284,7 @@ def _native_decompress(fn_name: str, data: bytes, cap: int) -> "bytes | None":
 def snappy_decompress(data: bytes) -> bytes:
     size = _snappy_output_size(data)  # raises on malformed preambles
     if size > MAX_DECOMPRESSED:
-        raise ValueError(f"snappy payload declares {size} bytes (> 1 GiB cap)")
+        raise CorruptPayloadError(f"snappy payload declares {size} bytes (> 1 GiB cap)")
     out = _native_decompress("kta_snappy_decompress", data, size)
     return out if out is not None else snappy_decompress_py(data)
 
@@ -306,21 +314,21 @@ def gzip_decompress(payload: bytes) -> bytes:
     try:
         out = d.decompress(payload, MAX_DECOMPRESSED)
     except zlib.error as e:
-        raise ValueError(f"corrupt gzip stream: {e}") from e
+        raise CorruptPayloadError(f"corrupt gzip stream: {e}") from e
     if d.unconsumed_tail:
-        raise ValueError(
+        raise CorruptPayloadError(
             f"gzip batch exceeds decompressed size cap ({MAX_DECOMPRESSED} B)"
         )
     out += d.flush()
     if len(out) > MAX_DECOMPRESSED:
-        raise ValueError(
+        raise CorruptPayloadError(
             f"gzip batch exceeds decompressed size cap ({MAX_DECOMPRESSED} B)"
         )
     # zlib.decompress raised on truncated streams; a decompressobj only
     # signals it via eof.  Trailing bytes after a complete stream stay
     # ignored (old zlib.decompress(wbits=47) behavior).
     if not d.eof:
-        raise ValueError("truncated gzip stream")
+        raise CorruptPayloadError("truncated gzip stream")
     return out
 
 
@@ -407,7 +415,7 @@ def _zstd_stream_decompress(lib, data: bytes) -> "bytes | None":
                 return None  # no progress: treat as corrupt
             out += ctypes.string_at(chunk, outbuf.pos)
             if len(out) > MAX_DECOMPRESSED:
-                raise ValueError(
+                raise CorruptPayloadError(
                     f"zstd batch exceeds decompressed size cap "
                     f"({MAX_DECOMPRESSED} B)"
                 )
@@ -437,7 +445,7 @@ def zstd_decompress(data: bytes) -> bytes:
         csize = int(lib.ZSTD_getFrameContentSize(data, len(data)))
         if csize not in (_ZSTD_CONTENTSIZE_UNKNOWN, _ZSTD_CONTENTSIZE_ERROR):
             if csize > MAX_DECOMPRESSED:
-                raise ValueError(
+                raise CorruptPayloadError(
                     f"zstd batch declares {csize} bytes (> 1 GiB cap)"
                 )
             buf = ctypes.create_string_buffer(max(csize, 1))

@@ -53,6 +53,92 @@ class KafkaProtocolError(RuntimeError):
     pass
 
 
+class CorruptFrameError(KafkaProtocolError):
+    """A record frame whose *bytes* are wrong — as opposed to transport
+    faults (handled by io/retry.py) or protocol-level errors.  Corruption
+    on the broker's disk is deterministic: every re-fetch returns the same
+    poisoned bytes, so retrying is useless and callers need to decide
+    (fail / skip / quarantine) instead.
+
+    ``kind`` classifies the damage (one of CORRUPTION_KINDS); the context
+    fields let the wire layer account for and quarantine the frame:
+
+    - ``partition``: filled by the wire layer (the codec never knows it)
+    - ``base_offset``: the frame header's claimed base offset (-1 unknown)
+    - ``span``: (start, end) byte range of the frame in the record-set
+      buffer, when the frame's bounds were readable (None otherwise)
+    - ``claimed_end``: base + last_offset_delta + 1 when the header was
+      parseable (-1 otherwise) — the offset a skip should resume at
+    - ``num_records``: header-claimed record count (0 when unreadable)
+    - ``crc_expected`` / ``crc_actual``: set for CRC mismatches
+    """
+
+    kind = "corrupt"
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        partition: "Optional[int]" = None,
+        base_offset: int = -1,
+        span: "Optional[Tuple[int, int]]" = None,
+        claimed_end: int = -1,
+        num_records: int = 0,
+        crc_expected: "Optional[int]" = None,
+        crc_actual: "Optional[int]" = None,
+    ):
+        super().__init__(message)
+        self.partition = partition
+        self.base_offset = base_offset
+        self.span = span
+        self.claimed_end = claimed_end
+        self.num_records = num_records
+        self.crc_expected = crc_expected
+        self.crc_actual = crc_actual
+
+
+class CrcMismatchError(CorruptFrameError):
+    """Stored CRC32-C (v2) / CRC32 (legacy) disagrees with the bytes."""
+
+    kind = "crc-mismatch"
+
+
+class TruncatedFrameError(CorruptFrameError):
+    """A frame or record body ends before its declared length (inside the
+    buffer — a partial *trailing* batch is the broker's byte-limit
+    truncation and is tolerated, not classified)."""
+
+    kind = "truncated"
+
+
+class MalformedHeaderError(CorruptFrameError):
+    """Structurally impossible header fields: non-positive batch length,
+    unknown magic, negative record count/length, bad nesting."""
+
+    kind = "malformed-header"
+
+
+class BadCompressionError(CorruptFrameError):
+    """The frame's compressed payload does not decode (bad gzip/snappy/
+    LZ4/zstd stream, or an unknown codec id)."""
+
+    kind = "bad-compression"
+
+
+class BadUtf8Error(CorruptFrameError):
+    """A wire field declared as a string is not valid UTF-8."""
+
+    kind = "bad-utf8"
+
+
+#: The full classification surface — untrusted wire input must map onto
+#: exactly these (tests/test_corruption.py fuzzes the contract).
+CORRUPTION_KINDS = (
+    "crc-mismatch", "truncated", "malformed-header", "bad-compression",
+    "bad-utf8",
+)
+
+
 class UnsupportedVersionError(KafkaProtocolError):
     """Error 35: the broker rejected the request's api version — the
     caller may retry at a lower version (KIP-511 ApiVersions dance)."""
@@ -200,7 +286,7 @@ class ByteReader:
             return bytes(self._take(n)).decode()
         except UnicodeDecodeError as e:
             # Untrusted wire input must not leak UnicodeDecodeError.
-            raise KafkaProtocolError(f"invalid UTF-8 string on the wire: {e}") from e
+            raise BadUtf8Error(f"invalid UTF-8 string on the wire: {e}") from e
 
     def bytes_(self) -> Optional[bytes]:
         n = self.i32()
@@ -265,7 +351,7 @@ class ByteReader:
         try:
             return bytes(self._take(n - 1)).decode()
         except UnicodeDecodeError as e:
-            raise KafkaProtocolError(f"invalid UTF-8 string on the wire: {e}") from e
+            raise BadUtf8Error(f"invalid UTF-8 string on the wire: {e}") from e
 
     def compact_bytes(self) -> Optional[bytes]:
         n = self.uvarint()
@@ -1444,6 +1530,11 @@ class BatchFrame:
     #: the per-record decoders read from here (the native array decoder
     #: returns None so callers fall back).
     legacy_records: Optional[list] = None
+    #: Byte range of this frame in the record-set buffer it was parsed
+    #: from (-1 when unknown) — the corruption layer slices the raw frame
+    #: for quarantine from these.
+    byte_start: int = -1
+    byte_end: int = -1
 
 
 def _decode_legacy_entry(
@@ -1456,40 +1547,45 @@ def _decode_legacy_entry(
     message while inner messages store relative offsets (KIP-31, gaps
     preserved); magic-0 wrappers hold absolute inner offsets."""
     if end - pos < 26:  # header(12) + crc(4) + magic+attrs(2) + klen+vlen(8)
-        raise KafkaProtocolError("legacy message below minimum size")
+        raise MalformedHeaderError("legacy message below minimum size")
     offset = struct.unpack_from(">q", buf, pos)[0]
     crc = struct.unpack_from(">I", buf, pos + 12)[0]
     magic = buf[pos + 16]
     attributes = buf[pos + 17]
-    if verify_crc and zlib.crc32(buf[pos + 16 : end]) != crc:
-        raise KafkaProtocolError(
-            f"legacy message CRC mismatch at offset {offset}"
-        )
+    if verify_crc:
+        actual = zlib.crc32(buf[pos + 16 : end])
+        if actual != crc:
+            raise CrcMismatchError(
+                f"legacy message CRC mismatch at offset {offset}",
+                base_offset=offset,
+                crc_expected=crc,
+                crc_actual=actual,
+            )
     p = pos + 18
     ts_ms = -1
     if magic == 1:
         if p + 8 > end:
-            raise KafkaProtocolError("truncated v1 message timestamp")
+            raise TruncatedFrameError("truncated v1 message timestamp")
         ts_ms = struct.unpack_from(">q", buf, p)[0]
         p += 8
     if p + 4 > end:
-        raise KafkaProtocolError("truncated legacy message key")
+        raise TruncatedFrameError("truncated legacy message key")
     (klen,) = struct.unpack_from(">i", buf, p)
     p += 4
     key = None
     if klen >= 0:
         if p + klen > end:
-            raise KafkaProtocolError("truncated legacy message key")
+            raise TruncatedFrameError("truncated legacy message key")
         key = buf[p : p + klen]
         p += klen
     if p + 4 > end:
-        raise KafkaProtocolError("truncated legacy message value")
+        raise TruncatedFrameError("truncated legacy message value")
     (vlen,) = struct.unpack_from(">i", buf, p)
     p += 4
     value = None
     if vlen >= 0:
         if p + vlen > end:
-            raise KafkaProtocolError("truncated legacy message value")
+            raise TruncatedFrameError("truncated legacy message value")
         value = buf[p : p + vlen]
         p += vlen
     codec = attributes & 0x07
@@ -1499,18 +1595,19 @@ def _decode_legacy_entry(
     if depth >= 1:
         # Valid Kafka data nests exactly one wrapper level; deeper nesting
         # would multiply the per-decompression memory cap per level.
-        raise KafkaProtocolError("nested compressed wrapper messages")
+        raise MalformedHeaderError("nested compressed wrapper messages")
     if value is None:
-        raise KafkaProtocolError("compressed wrapper message with null value")
+        raise MalformedHeaderError("compressed wrapper message with null value")
     from kafka_topic_analyzer_tpu.io.compression import decompress
 
     try:
         inner_buf = decompress(codec, value)
-    except KafkaProtocolError:
+    except CorruptFrameError:
         raise
     except Exception as e:
-        raise KafkaProtocolError(
-            f"legacy wrapper message at offset {offset}: {e}"
+        raise BadCompressionError(
+            f"legacy wrapper message at offset {offset}: {e}",
+            base_offset=offset,
         ) from e
     inner: "list[tuple[int, int, Optional[bytes], Optional[bytes]]]" = []
     ipos = 0
@@ -1518,7 +1615,7 @@ def _decode_legacy_entry(
         (isize,) = struct.unpack_from(">i", inner_buf, ipos + 8)
         iend = ipos + 12 + isize
         if isize <= 0 or iend > len(inner_buf):
-            raise KafkaProtocolError("truncated inner message set")
+            raise TruncatedFrameError("truncated inner message set")
         inner.extend(
             _decode_legacy_entry(inner_buf, ipos, iend, verify_crc, depth + 1)
         )
@@ -1538,123 +1635,380 @@ def _decode_legacy_entry(
     return inner
 
 
+@dataclasses.dataclass
+class CorruptSpan:
+    """One poisoned byte span isolated by `salvage_batch_frames`: the
+    classified error plus everything the wire layer needs to skip, account
+    for, and quarantine the frame — byte bounds for the raw evidence,
+    claimed offsets for the resume position."""
+
+    error: CorruptFrameError
+    start: int           # byte start of the poisoned span in the buffer
+    end: int             # byte end (exclusive) — iteration resumes here
+    base_offset: int = -1   # header-claimed base offset (-1 unreadable)
+    claimed_end: int = -1   # base + last_offset_delta + 1 when readable
+    resume_offset: int = -1  # next salvaged frame's base offset (-1 unknown)
+    num_records: int = 0    # header-claimed record count when plausible
+
+    def skip_offset(self, floor: int) -> int:
+        """Offset a skip should resume the partition at, or -1 when the
+        span gives no bound past ``floor`` (unskippable)."""
+        return preferred_skip_offset(
+            floor, self.resume_offset, self.claimed_end
+        )
+
+
+def preferred_skip_offset(
+    floor: int, resume_offset: int, claimed_end: int
+) -> int:
+    """The ONE skip-bound policy (CorruptSpan.skip_offset and the wire
+    layer's _note_corrupt both use it): prefer the validated next-frame
+    base over the corrupt frame's own claimed coverage.  ``claimed_end``
+    comes from a header that just FAILED its checksum — a bit-flipped
+    last_offset_delta must not swallow the rest of the partition — while
+    ``resume_offset`` was structurally (and, under check.crcs, checksum-)
+    validated by the salvage resync.  Offsets between the true coverage
+    and the next retained frame hold no records (compaction holes), so
+    preferring resume_offset never skips data.  -1 when neither candidate
+    exceeds ``floor``."""
+    for candidate in (resume_offset, claimed_end):
+        if candidate > floor:
+            return candidate
+    return -1
+
+
+#: Minimum plausible v2 batch_length: the fields it covers
+#: (leader_epoch+magic+crc+attrs+delta+2 ts+pid+pepoch+bseq+count).
+_MIN_V2_BATCH_LENGTH = 4 + 1 + 4 + 2 + 4 + 8 + 8 + 8 + 2 + 4 + 4
+#: Minimum plausible legacy message_size: crc+magic+attrs+klen+vlen.
+_MIN_LEGACY_MESSAGE_SIZE = 4 + 1 + 1 + 4 + 4
+
+
+def _parse_frame_at(
+    buf: bytes, pos: int, end: int, verify_crc: bool
+) -> Optional[BatchFrame]:
+    """Parse one complete frame at ``pos`` (bounds already validated) into
+    a BatchFrame — or None for an empty legacy entry.  Every failure mode
+    raises a classified CorruptFrameError carrying the frame's byte span
+    and whatever header fields were readable."""
+    base_offset = struct.unpack_from(">q", buf, pos)[0]
+    magic = buf[pos + 16]
+    if magic in (0, 1):
+        try:
+            records = _decode_legacy_entry(buf, pos, end, verify_crc)
+        except CorruptFrameError as e:
+            e.span = (pos, end)
+            if e.base_offset < 0:
+                e.base_offset = base_offset
+            if e.claimed_end < 0 and base_offset >= 0:
+                # Legacy wrapper offsets are the LAST covered offset.
+                e.claimed_end = base_offset + 1
+            raise
+        if not records:
+            return None
+        return BatchFrame(
+            base_offset=records[0][0],
+            first_ts=records[0][1],
+            num_records=len(records),
+            payload=b"",
+            end_offset=records[-1][0] + 1,
+            legacy_records=records,
+            byte_start=pos,
+            byte_end=end,
+        )
+    if magic != 2:
+        raise MalformedHeaderError(
+            f"unsupported record format magic={magic} (need magic <= 2)",
+            base_offset=base_offset,
+            span=(pos, end),
+        )
+    r = ByteReader(buf, pos + 17)
+    crc = r.u32()
+    crc_start = r.pos
+    attributes = r.i16()
+    last_offset_delta = r.i32()
+    first_ts = r.i64()
+    r.i64()  # max_ts
+    r.i64()  # producer id
+    r.i16()  # producer epoch
+    r.i32()  # base sequence
+    num_records = r.i32()
+    claimed_end = base_offset + max(last_offset_delta, 0) + 1
+    if num_records < 0:
+        raise MalformedHeaderError(
+            f"negative record count at offset {base_offset}",
+            base_offset=base_offset,
+            span=(pos, end),
+            claimed_end=claimed_end,
+        )
+    payload = buf[r.pos : end]
+    if verify_crc:
+        actual = _crc32c(buf[crc_start:end])
+        if actual != crc:
+            raise CrcMismatchError(
+                f"record batch CRC mismatch at offset {base_offset}",
+                base_offset=base_offset,
+                span=(pos, end),
+                claimed_end=claimed_end,
+                num_records=num_records,
+                crc_expected=crc,
+                crc_actual=actual,
+            )
+    if attributes & 0x20:
+        # Control batch (transaction commit/abort markers): consumers
+        # never see these as messages — librdkafka filters them at any
+        # isolation level — but their offsets ARE part of the log, so
+        # the frame still advances the covered range.
+        return BatchFrame(
+            base_offset,
+            first_ts,
+            0,
+            b"",
+            end_offset=claimed_end,
+            byte_start=pos,
+            byte_end=end,
+        )
+    codec = attributes & 0x07
+    if codec != COMPRESSION_NONE:
+        from kafka_topic_analyzer_tpu.io.compression import decompress
+
+        try:
+            payload = decompress(codec, payload)
+        except Exception as e:
+            # Unknown codec or corrupt codec stream: classify so callers
+            # (and the CLI) report one clean line — or skip/quarantine.
+            raise BadCompressionError(
+                f"record batch at offset {base_offset}: {e}",
+                base_offset=base_offset,
+                span=(pos, end),
+                claimed_end=claimed_end,
+                num_records=num_records,
+            ) from e
+    return BatchFrame(
+        base_offset,
+        first_ts,
+        num_records,
+        payload,
+        end_offset=claimed_end,
+        byte_start=pos,
+        byte_end=end,
+    )
+
+
+def _plausible_frame_at(buf, q: int, n: int, verify_crc: bool) -> bool:
+    """Is ``q`` a believable frame boundary?  Structural checks always;
+    with ``verify_crc`` the candidate's checksum must also pass, so a
+    resync cannot lock onto bytes that merely look like a header."""
+    base = struct.unpack_from(">q", buf, q)[0]
+    if base < 0:
+        return False
+    blen = struct.unpack_from(">i", buf, q + 8)[0]
+    end = q + 12 + blen
+    magic = buf[q + 16]
+    if magic == 2:
+        if blen < _MIN_V2_BATCH_LENGTH or end > n:
+            return False
+        if verify_crc:
+            crc = struct.unpack_from(">I", buf, q + 17)[0]
+            return _crc32c(buf[q + 21 : end]) == crc
+        return True
+    if magic in (0, 1):
+        if blen < _MIN_LEGACY_MESSAGE_SIZE or end > n:
+            return False
+        if verify_crc:
+            crc = struct.unpack_from(">I", buf, q + 12)[0]
+            return zlib.crc32(buf[q + 16 : end]) == crc
+        return True
+    return False
+
+
+def _resync(buf, pos: int, n: int, verify_crc: bool) -> "Tuple[int, int]":
+    """Scan forward from a poisoned position for the next plausible frame
+    boundary: (resync_byte, resume_offset).  (n, -1) when the rest of the
+    buffer yields nothing — the caller then skips to the buffer end."""
+    q = pos + 1
+    while q + 17 <= n:
+        if buf[q + 16] in (0, 1, 2) and _plausible_frame_at(
+            buf, q, n, verify_crc
+        ):
+            return q, struct.unpack_from(">q", buf, q)[0]
+        q += 1
+    return n, -1
+
+
+def _iter_frames(
+    buf: bytes, verify_crc: bool, salvage: bool
+) -> "Iterator[BatchFrame | CorruptSpan]":
+    pos = 0
+    n = len(buf)
+    while pos + 17 <= n:  # base_offset + batch_length + leader_epoch + magic
+        batch_length = struct.unpack_from(">i", buf, pos + 8)[0]
+        end = pos + 12 + batch_length
+        err: Optional[CorruptFrameError] = None
+        frame: Optional[BatchFrame] = None
+        magic = buf[pos + 16]
+        min_len = (
+            _MIN_LEGACY_MESSAGE_SIZE if magic in (0, 1)
+            else _MIN_V2_BATCH_LENGTH
+        )
+        if batch_length <= 0:
+            # A non-positive length is never a broker's byte-limit
+            # truncation — silently stopping here would drop every frame
+            # after it in the fetch response.
+            err = MalformedHeaderError(
+                f"non-positive batch length {batch_length} at record-set "
+                f"byte {pos}",
+                base_offset=struct.unpack_from(">q", buf, pos)[0],
+            )
+        elif magic in (0, 1, 2) and batch_length < min_len:
+            # A positive length too small to hold the format's own header
+            # is corruption, not truncation — and it must be rejected
+            # BEFORE parsing, or the header reader would run past the
+            # frame's declared end into the next frame's bytes (an
+            # unclassified overrun at the buffer tail, silent garbage
+            # fields mid-buffer).  The length field itself is suspect, so
+            # the salvage skip re-syncs (span=None) instead of trusting it.
+            err = MalformedHeaderError(
+                f"batch length {batch_length} below the magic-{magic} "
+                f"minimum size ({min_len}) at record-set byte {pos}",
+                base_offset=struct.unpack_from(">q", buf, pos)[0],
+            )
+        elif end > n:
+            return  # partial trailing batch (broker truncates at max_bytes)
+        else:
+            try:
+                frame = _parse_frame_at(buf, pos, end, verify_crc)
+            except CorruptFrameError as e:
+                err = e
+        if err is None:
+            if frame is not None:
+                yield frame
+            pos = end
+            continue
+        if not salvage:
+            raise err
+        if err.span is not None:
+            # The frame's bounds were readable: skip exactly this frame
+            # using its length prefix — frames after it still decode.
+            span_end = err.span[1]
+            resume_q, resume_off = span_end, -1
+            if span_end + 17 <= n:
+                if _plausible_frame_at(buf, span_end, n, verify_crc):
+                    # A validated boundary: its base offset is trustworthy.
+                    resume_off = struct.unpack_from(">q", buf, span_end)[0]
+                else:
+                    blen_next = struct.unpack_from(">i", buf, span_end + 8)[0]
+                    if blen_next > 0 and span_end + 12 + blen_next > n:
+                        # Looks like the broker's trailing partial batch:
+                        # stop at span_end, but offer NO resume offset —
+                        # these bytes failed the plausibility check, so an
+                        # i64 read from them would be arbitrary garbage.
+                        pass
+                    else:
+                        # The claimed length lands on implausible bytes
+                        # (the length field itself may be the corrupt
+                        # part): fall back to the scan.
+                        resume_q, resume_off = _resync(
+                            buf, pos, n, verify_crc
+                        )
+        else:
+            resume_q, resume_off = _resync(buf, pos, n, verify_crc)
+        yield CorruptSpan(
+            error=err,
+            start=pos,
+            end=resume_q,
+            base_offset=err.base_offset,
+            claimed_end=err.claimed_end,
+            resume_offset=resume_off,
+            num_records=err.num_records,
+        )
+        pos = max(resume_q, pos + 1)
+
+
 def iter_batch_frames(buf: bytes, verify_crc: bool = False) -> Iterator[BatchFrame]:
     """Parse batch headers (CRC check, decompression) without touching
     records.  Tolerates a trailing partial batch (brokers may truncate at
     max_bytes).  Legacy MessageSet v0/v1 entries (pre-0.11 segments that
     survive on upgraded clusters) are decoded eagerly into
     ``legacy_records`` — the magic byte sits at entry offset 16 in all
-    three formats, so mixed-format record sets stream through one loop."""
-    pos = 0
-    n = len(buf)
-    while pos + 17 <= n:  # base_offset + batch_length + leader_epoch + magic
-        base_offset = struct.unpack_from(">q", buf, pos)[0]
-        batch_length = struct.unpack_from(">i", buf, pos + 8)[0]
-        end = pos + 12 + batch_length
-        if batch_length <= 0 or end > n:
-            return  # partial trailing batch
-        magic = buf[pos + 16]
-        if magic in (0, 1):
-            records = _decode_legacy_entry(buf, pos, end, verify_crc)
-            if records:
-                yield BatchFrame(
-                    base_offset=records[0][0],
-                    first_ts=records[0][1],
-                    num_records=len(records),
-                    payload=b"",
-                    end_offset=records[-1][0] + 1,
-                    legacy_records=records,
-                )
-            pos = end
-            continue
-        if magic != 2:
-            raise KafkaProtocolError(
-                f"unsupported record format magic={magic} (need magic <= 2)"
-            )
-        r = ByteReader(buf, pos + 17)
-        crc = r.u32()
-        crc_start = r.pos
-        attributes = r.i16()
-        last_offset_delta = r.i32()
-        first_ts = r.i64()
-        r.i64()  # max_ts
-        r.i64()  # producer id
-        r.i16()  # producer epoch
-        r.i32()  # base sequence
-        num_records = r.i32()
-        if num_records < 0:
-            raise KafkaProtocolError(
-                f"negative record count at offset {base_offset}"
-            )
-        payload = buf[r.pos : end]
-        if verify_crc and _crc32c(buf[crc_start:end]) != crc:
-            raise KafkaProtocolError(f"record batch CRC mismatch at offset {base_offset}")
-        if attributes & 0x20:
-            # Control batch (transaction commit/abort markers): consumers
-            # never see these as messages — librdkafka filters them at any
-            # isolation level — but their offsets ARE part of the log, so
-            # the frame still advances the covered range.
-            yield BatchFrame(
-                base_offset,
-                first_ts,
-                0,
-                b"",
-                end_offset=base_offset + max(last_offset_delta, 0) + 1,
-            )
-            pos = end
-            continue
-        codec = attributes & 0x07
-        if codec != COMPRESSION_NONE:
-            from kafka_topic_analyzer_tpu.io.compression import decompress
+    three formats, so mixed-format record sets stream through one loop.
+    Corrupt frames raise a classified `CorruptFrameError`; use
+    `salvage_batch_frames` to skip them instead."""
+    for item in _iter_frames(buf, verify_crc, salvage=False):
+        yield item  # salvage=False never yields CorruptSpan
 
-            try:
-                payload = decompress(codec, payload)
-            except Exception as e:
-                # Unsupported codec or corrupt payload: surface as a protocol
-                # error so callers (and the CLI) report one clean line.
-                raise KafkaProtocolError(
-                    f"record batch at offset {base_offset}: {e}"
-                ) from e
-        yield BatchFrame(
-            base_offset,
-            first_ts,
-            num_records,
-            payload,
-            end_offset=base_offset + max(last_offset_delta, 0) + 1,
-        )
-        pos = end
+
+def salvage_batch_frames(
+    buf: bytes, verify_crc: bool = False
+) -> "Iterator[BatchFrame | CorruptSpan]":
+    """Like `iter_batch_frames`, but poisoned frames are isolated instead
+    of raising: the stream yields a `CorruptSpan` for each and resumes at
+    the next batch boundary.  A frame whose length prefix is intact is
+    skipped exactly (payload-level damage: CRC mismatch, bad codec
+    stream); when the header itself is mangled, the iterator re-syncs by
+    scanning for the next plausible frame header (CRC-checked when
+    ``verify_crc``, structural checks otherwise)."""
+    return _iter_frames(buf, verify_crc, salvage=True)
 
 
 def decode_frame_records(frame: BatchFrame) -> Iterator[Tuple[int, RecordTuple]]:
     """Per-record Python decode of one frame (reference implementation; the
-    hot path uses the native array decoder)."""
+    hot path uses the native array decoder).  Record-body damage — only
+    reachable when the batch CRC wasn't verified or didn't cover it —
+    raises classified `CorruptFrameError` subtypes carrying the frame's
+    byte span, so the wire layer's skip/quarantine policy applies to
+    payload corruption exactly like header corruption."""
     if frame.legacy_records is not None:
         for off, ts_ms, key, value in frame.legacy_records:
             yield off, (ts_ms, key, value)
         return
     payload = frame.payload
     rr = ByteReader(payload)
-    for _ in range(frame.num_records):
-        length = rr.varint()
-        rec_end = rr.pos + length
-        # A negative declared length would walk the reader backwards
-        # (negative positions slice "successfully" in Python).
-        if length < 0 or rec_end > len(payload):
-            raise KafkaProtocolError(
-                f"record length {length} out of range at offset {frame.base_offset}"
-            )
-        rr.i8()  # attributes
-        ts_delta = rr.varint()
-        off_delta = rr.varint()
-        key = rr.varbytes()
-        value = rr.varbytes()
-        nheaders = rr.varint()
-        for _ in range(nheaders):
-            hk = rr.varbytes()
-            rr.varbytes()
-            del hk
-        rr.pos = rec_end  # tolerate unknown trailing record fields
-        yield frame.base_offset + off_delta, (frame.first_ts + ts_delta, key, value)
+    try:
+        for _ in range(frame.num_records):
+            length = rr.varint()
+            rec_end = rr.pos + length
+            # A negative declared length would walk the reader backwards
+            # (negative positions slice "successfully" in Python).
+            if length < 0 or rec_end > len(payload):
+                cls = MalformedHeaderError if length < 0 else TruncatedFrameError
+                raise cls(
+                    f"record length {length} out of range at offset "
+                    f"{frame.base_offset}",
+                    base_offset=frame.base_offset,
+                    span=_frame_span(frame),
+                    claimed_end=frame.end_offset,
+                    num_records=frame.num_records,
+                )
+            rr.i8()  # attributes
+            ts_delta = rr.varint()
+            off_delta = rr.varint()
+            key = rr.varbytes()
+            value = rr.varbytes()
+            nheaders = rr.varint()
+            for _ in range(nheaders):
+                hk = rr.varbytes()
+                rr.varbytes()
+                del hk
+            rr.pos = rec_end  # tolerate unknown trailing record fields
+            yield frame.base_offset + off_delta, (frame.first_ts + ts_delta, key, value)
+    except CorruptFrameError:
+        raise
+    except KafkaProtocolError as e:
+        # ByteReader overruns (truncated varint/field) inside a record body.
+        raise TruncatedFrameError(
+            f"corrupt record body in batch at offset {frame.base_offset}: {e}",
+            base_offset=frame.base_offset,
+            span=_frame_span(frame),
+            claimed_end=frame.end_offset,
+            num_records=frame.num_records,
+        ) from e
+
+
+def _frame_span(frame: BatchFrame) -> "Optional[Tuple[int, int]]":
+    if frame.byte_start < 0 or frame.byte_end < 0:
+        return None
+    return (frame.byte_start, frame.byte_end)
 
 
 def decode_record_batches(

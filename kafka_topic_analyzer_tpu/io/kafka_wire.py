@@ -29,6 +29,17 @@ reproduces the reference consumer's observable behavior (src/kafka.rs):
   (KNOWN_NOOP_PROPERTIES — group/commit settings the reference disables
   anyway) are accepted silently; truly unknown keys warn, like librdkafka
   logs unknown properties.
+- corrupt-data resilience (``--on-corruption``/``--quarantine-dir``, or
+  the ``on.corruption``/``quarantine.dir`` overrides): a frame that fails
+  decode is re-fetched once to rule out an in-flight bit flip; a second
+  byte-identical failure is deterministic on-disk corruption, and policy
+  applies — ``fail`` aborts with the classified `CorruptFrameError`
+  (default), ``skip``/``quarantine`` skip exactly the poisoned frame
+  (salvaging the rest of the response via
+  kafka_codec.salvage_batch_frames), account for it per partition
+  (``corruption_stats``), and optionally spool the raw bytes + JSON
+  sidecar (io/quarantine.py).  ``check.crcs`` (or ``--check-crcs``)
+  upgrades detection from structural damage to full payload checksums.
 
 Record metadata is extracted batch-at-a-time: key/value lengths, null flags,
 second-granularity timestamps (truncated toward zero like Rust's ``/ 1000``,
@@ -48,7 +59,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from kafka_topic_analyzer_tpu.config import TransportRetryConfig
+from kafka_topic_analyzer_tpu.config import CorruptionConfig, TransportRetryConfig
 from kafka_topic_analyzer_tpu.io import kafka_codec as kc
 from kafka_topic_analyzer_tpu.io.retry import (
     Backoff,
@@ -68,6 +79,13 @@ CLIENT_ID = "topic-analyzer"  # src/kafka.rs:36
 #: Ceiling for the auto-grown per-partition fetch size (librdkafka caps
 #: message.max.bytes at ~1 GB; also keeps the i32 wire field safe).
 MAX_PARTITION_FETCH_BYTES = 1 << 30
+
+#: Disambiguation re-fetches a corrupt span survives at one anchor before
+#: the verdict is forced even when the classification KIND keeps changing
+#: (a link that mutates every response differently must not re-fetch
+#: forever; a matching kind — the deterministic-on-disk case — settles
+#: after a single re-fetch regardless).
+_MAX_SUSPECT_ROUNDS = 4
 
 #: librdkafka property names that are VALID for the reference's consumer
 #: (src/kafka.rs:24-44 sets several of them) but have no observable effect
@@ -365,10 +383,50 @@ class KafkaWireSource(RecordSource):
         overrides: Optional[Dict[str, str]] = None,
         timeout_s: float = 10.0,
         use_native_hashing: bool = True,
+        corruption: Optional[CorruptionConfig] = None,
     ):
         self.topic = topic
         self.use_native_hashing = use_native_hashing
         overrides = dict(overrides or {})
+        #: Poison-frame policy (--on-corruption / --quarantine-dir; also
+        #: reachable as on.corruption / quarantine.dir overrides).  On-disk
+        #: corruption is deterministic — every re-fetch returns the same
+        #: bytes — so after ONE disambiguating re-fetch reproduces the
+        #: failure, the policy applies: fail aborts (the default, today's
+        #: behavior), skip/quarantine resume at the next batch boundary.
+        policy_override = overrides.pop("on.corruption", "fail")
+        qdir_override = overrides.pop("quarantine.dir", None)
+        if corruption is not None:
+            # Explicit config wins; the override keys are still popped so
+            # they don't trip the unknown-property warning, but their
+            # values (and their validation) are discarded.
+            if policy_override != "fail" or qdir_override:
+                log.warning(
+                    "on.corruption/quarantine.dir overrides ignored: an "
+                    "explicit corruption config (--on-corruption/"
+                    "--quarantine-dir) takes precedence"
+                )
+            self.corruption = corruption
+        else:
+            self.corruption = CorruptionConfig(
+                policy=policy_override, quarantine_dir=qdir_override
+            )
+        self._quarantine = None
+        if self.corruption.policy == "quarantine":
+            from kafka_topic_analyzer_tpu.io.quarantine import QuarantineStore
+
+            self._quarantine = QuarantineStore(self.corruption.quarantine_dir)
+        #: (partition, anchor) -> span record, for every poisoned span this
+        #: scan skipped (or, seeded from a snapshot, a previous run
+        #: skipped).  Guarded by _corrupt_lock: sharded scans run several
+        #: batches() streams against one source.
+        self._corrupt_spans: "Dict[Tuple[int, int], dict]" = {}
+        #: partition -> (anchor, kind, rounds) of the span awaiting its
+        #: disambiguating re-fetch.  ``rounds`` bounds the cycle: a link
+        #: that corrupts every response *differently* at the same position
+        #: (so the kind never matches) must not re-fetch forever.
+        self._corrupt_suspects: "Dict[int, Tuple[int, str, int]]" = {}
+        self._corrupt_lock = threading.Lock()
         # librdkafka-name knobs this client honors (others warned+ignored).
         self.max_wait_ms = int(overrides.pop("fetch.wait.max.ms", 100))
         self.min_bytes = int(overrides.pop("fetch.min.bytes", 1))
@@ -493,6 +551,153 @@ class KafkaWireSource(RecordSource):
 
     def degraded_partitions(self) -> Dict[int, str]:
         return dict(self.degraded)
+
+    # -- corruption accounting ------------------------------------------------
+
+    def corruption_spans(self) -> "list[dict]":
+        """Every skipped poison span as a JSON-safe record (checkpoint
+        metadata format; `seed_corrupt_spans` round-trips it)."""
+        with self._corrupt_lock:
+            return [dict(s) for s in self._corrupt_spans.values()]
+
+    def corruption_stats(self) -> "Dict[int, dict]":
+        """Per-partition corruption accounting: frame/record/byte counts,
+        per-kind breakdown, and the span list — the engine snapshots this
+        into `ScanResult.corrupt_partitions`."""
+        out: "Dict[int, dict]" = {}
+        for s in self.corruption_spans():
+            d = out.setdefault(
+                s["partition"],
+                {
+                    "frames": 0, "records": 0, "bytes": 0,
+                    "quarantined": 0, "kinds": {}, "spans": [],
+                },
+            )
+            d["frames"] += s.get("frames", 1)
+            d["records"] += s.get("records", 0)
+            d["bytes"] += s.get("bytes", 0)
+            d["quarantined"] += 1 if s.get("quarantined") else 0
+            d["kinds"][s["kind"]] = d["kinds"].get(s["kind"], 0) + 1
+            d["spans"].append(s)
+        return out
+
+    def seed_corrupt_spans(self, spans: "list[dict]") -> None:
+        """Pre-load spans a previous run already skipped (snapshot resume):
+        re-encountering one skips it immediately — no disambiguating
+        re-fetch, no re-count, no re-quarantine."""
+        with self._corrupt_lock:
+            for s in spans:
+                key = (int(s["partition"]), int(s["anchor"]))
+                if key not in self._corrupt_spans:
+                    self._corrupt_spans[key] = dict(s, seeded=True)
+
+    def _note_corrupt(
+        self,
+        p: int,
+        anchor: int,
+        err: "kc.CorruptFrameError",
+        claimed_end: int,
+        resume_offset: int,
+        num_records: int,
+        raw: bytes,
+    ) -> Optional[int]:
+        """Book one corrupt-frame sighting at scan position ``anchor``.
+
+        Returns the offset to skip the partition to; ``None`` when the
+        caller must stop this partition's round so the span is re-fetched
+        once (first sighting — an in-flight bit flip would not reproduce);
+        ``-1`` when the span is deterministically corrupt but gives no
+        skip bound (the caller degrades the partition).  Raises the
+        classified error under the ``fail`` policy once deterministic.
+        """
+        key = (p, anchor)
+        with self._corrupt_lock:
+            known = self._corrupt_spans.get(key)
+        if known is not None:
+            return int(known["skip_to"])  # seeded/already-skipped span
+        prev = self._corrupt_suspects.get(p)
+        rounds = prev[2] + 1 if prev is not None and prev[0] == anchor else 1
+        deterministic = (
+            prev is not None
+            and prev[0] == anchor
+            and (prev[1] == err.kind or rounds > _MAX_SUSPECT_ROUNDS)
+        )
+        if not deterministic:
+            # Suspect an in-flight flip.  Leaving the partition's offset
+            # untouched makes the next round re-fetch the identical span —
+            # one extra fetch on a healthy connection, none of the
+            # transport retry budget.  A matching kind on the re-fetch
+            # (the common case) settles it in one round; a link that
+            # mutates the damage differently every round is settled by the
+            # rounds bound instead of re-fetching forever.
+            self._corrupt_suspects[p] = (anchor, err.kind, rounds)
+            obs_metrics.CORRUPT_REFETCHES.inc()
+            obs_events.emit(
+                "corrupt_suspect", partition=p, anchor=anchor, kind=err.kind
+            )
+            log.warning(
+                "partition %d: suspect corrupt frame at offset %d (%s); "
+                "re-fetching once to rule out an in-flight bit flip",
+                p, anchor, err.kind,
+            )
+            return None
+        # Identical failure on the re-fetched bytes (or the re-fetch
+        # budget ran out): deterministic corruption.  Apply policy.
+        self._corrupt_suspects.pop(p, None)
+        err.partition = p
+        if self.corruption.policy == "fail":
+            raise err
+        skip_to = kc.preferred_skip_offset(anchor, resume_offset, claimed_end)
+        span_rec = {
+            "partition": p,
+            "anchor": anchor,
+            "skip_to": int(skip_to),  # -1 when the span gave no bound
+            "kind": err.kind,
+            "base_offset": int(err.base_offset),
+            "frames": 1,
+            "records": int(max(num_records, 0)),
+            "bytes": len(raw),
+            "quarantined": False,
+        }
+        if self._quarantine is not None:
+            sidecar = self._quarantine.spool(
+                topic=self.topic,
+                partition=p,
+                anchor=anchor,
+                raw=raw,
+                classification=err.kind,
+                base_offset=err.base_offset,
+                offset_start=err.base_offset,
+                offset_end=claimed_end,
+                crc_expected=err.crc_expected,
+                crc_actual=err.crc_actual,
+                error=str(err),
+            )
+            span_rec["quarantined"] = True
+            if sidecar is not None:
+                obs_metrics.CORRUPT_QUARANTINED.inc()
+        obs_metrics.CORRUPT_FRAMES.labels(kind=err.kind).inc()
+        obs_metrics.CORRUPT_RECORDS.inc(span_rec["records"])
+        obs_metrics.CORRUPT_BYTES.inc(len(raw))
+        obs_events.emit(
+            "corrupt_frame",
+            partition=p,
+            anchor=anchor,
+            skip_to=span_rec["skip_to"],
+            kind=err.kind,
+            action=self.corruption.policy,
+            quarantined=span_rec["quarantined"],
+        )
+        log.error(
+            "partition %d: deterministically corrupt frame at offset %d "
+            "(%s): %s — %s",
+            p, anchor, err.kind, err,
+            "quarantined + skipped"
+            if span_rec["quarantined"] else "skipped",
+        )
+        with self._corrupt_lock:
+            self._corrupt_spans[key] = span_rec
+        return span_rec["skip_to"]
 
     # -- connections ---------------------------------------------------------
 
@@ -1295,9 +1500,56 @@ class KafkaWireSource(RecordSource):
                         # goes through the per-frame Python decoders, which
                         # expect a real bytes-like (str decode, hashing).
                         data = bytes(data)
-                    for frame in kc.iter_batch_frames(
+                    corrupt_stop = False
+                    corrupt_skipped = False
+
+                    def book_corruption(
+                        err, claimed_end, resume_offset, n_records, raw
+                    ) -> bool:
+                        """One corrupt-frame sighting for partition ``p``:
+                        True to keep salvaging this record set, False to
+                        stop the partition's round (the span's identical
+                        re-fetch is pending, or the partition degraded).
+                        Raises under the ``fail`` policy once the damage
+                        proves deterministic."""
+                        nonlocal progressed, corrupt_skipped
+                        anchor = next_offset[p]
+                        skip_to = self._note_corrupt(
+                            p, anchor, err, claimed_end, resume_offset,
+                            n_records, raw,
+                        )
+                        if skip_to is None:
+                            return False  # disambiguating re-fetch pending
+                        if skip_to <= anchor:
+                            # No usable skip bound (mangled header at the
+                            # response tail): retrying would loop on the
+                            # same bytes forever, so drop the partition.
+                            degrade(
+                                p,
+                                "unskippable corrupt frame at offset "
+                                f"{anchor} ({err.kind})",
+                            )
+                            return False
+                        next_offset[p] = min(skip_to, end[p])
+                        corrupt_skipped = True
+                        progressed = True
+                        return True
+
+                    for item in kc.salvage_batch_frames(
                         data, verify_crc=self.verify_crc
                     ):
+                        if isinstance(item, kc.CorruptSpan):
+                            if not book_corruption(
+                                item.error,
+                                item.claimed_end,
+                                item.resume_offset,
+                                item.num_records,
+                                bytes(data[item.start : item.end]),
+                            ):
+                                corrupt_stop = True
+                                break
+                            continue
+                        frame = item
                         max_frame_end = max(max_frame_end, frame.end_offset)
                         chunk = (
                             decode_records_native(frame)
@@ -1315,26 +1567,55 @@ class KafkaWireSource(RecordSource):
                             continue
                         # Python fallback (no shim, or malformed frame — the
                         # reference decoder raises the precise error).
+                        # Rows commit only after the frame decodes fully, so
+                        # a record-body corruption mid-frame cannot leave a
+                        # half-accepted frame behind.
                         rows = []
                         row_offs = []
-                        for off, (ts_ms, key, value) in kc.decode_frame_records(
-                            frame
-                        ):
-                            if off < next_offset[p]:
-                                continue
-                            if off >= end[p]:
+                        frame_next = next_offset[p]
+                        try:
+                            for off, (ts_ms, key, value) in kc.decode_frame_records(
+                                frame
+                            ):
+                                if off < frame_next:
+                                    continue
+                                if off >= end[p]:
+                                    break
+                                rows.append((p, ts_ms, key, value))
+                                row_offs.append(off)
+                                frame_next = off + 1
+                        except kc.CorruptFrameError as ce:
+                            raw = (
+                                bytes(data[frame.byte_start : frame.byte_end])
+                                if frame.byte_start >= 0
+                                else b""
+                            )
+                            if not book_corruption(
+                                ce, frame.end_offset, -1,
+                                frame.num_records, raw,
+                            ):
+                                corrupt_stop = True
                                 break
-                            rows.append((p, ts_ms, key, value))
-                            row_offs.append(off)
-                            next_offset[p] = off + 1
-                            consumed += 1
-                            progressed = True
+                            continue  # poisoned frame's rows are dropped
                         if rows:
                             batch = records_to_batch(
                                 rows, use_native=self.use_native_hashing
                             )
                             batch.offsets = np.array(row_offs, dtype=np.int64)
                             push_chunk(batch)
+                            next_offset[p] = frame_next
+                            consumed += len(rows)
+                            progressed = True
+                    if corrupt_stop:
+                        # The partition's round ended at a poisoned span:
+                        # either its identical re-fetch happens next round,
+                        # or the partition just degraded.  Skip the stall/
+                        # fetch-size heuristics — they reason about byte
+                        # limits, not poison.
+                        stall_streak[p] = 0
+                        if next_offset[p] >= end[p]:
+                            remaining.discard(p)
+                        continue
                     if consumed:
                         stall_streak[p] = 0
                         if max_frame_end > next_offset[p]:
@@ -1343,6 +1624,13 @@ class KafkaWireSource(RecordSource):
                             # compaction): advance to the covered end so
                             # the next fetch doesn't re-serve this batch
                             # just to discard it.
+                            next_offset[p] = min(max_frame_end, end[p])
+                    elif corrupt_skipped:
+                        # Poison skipped but nothing accepted this round
+                        # (the skipped frame was the only in-range one):
+                        # the skip itself is the progress.
+                        stall_streak[p] = 0
+                        if max_frame_end > next_offset[p]:
                             next_offset[p] = min(max_frame_end, end[p])
                     elif next_offset[p] < end[p]:
                         if max_frame_end > next_offset[p]:

@@ -101,6 +101,52 @@ class MultiTopicSource(RecordSource):
                 out[self._row_of[(topic, p)]] = f"{topic}/{p}: {reason}"
         return out
 
+    def corruption_stats(self) -> Dict[int, dict]:
+        """Corruption accounting across the fan-in, keyed by dense row id
+        like `degraded_partitions`; spans gain ``topic``/``topic_partition``
+        so `seed_corrupt_spans` can route them back."""
+        out: Dict[int, dict] = {}
+        for topic, src in self.topic_sources:
+            for p, d in src.corruption_stats().items():
+                row = self._row_of[(topic, p)]
+                d = dict(d, topic=topic)
+                d["spans"] = [
+                    dict(s, partition=row, topic=topic, topic_partition=p)
+                    for s in d.get("spans", [])
+                ]
+                out[row] = d
+        return out
+
+    def corruption_spans(self) -> "list[dict]":
+        return [
+            dict(
+                s,
+                partition=self._row_of[(topic, s["partition"])],
+                topic=topic,
+                topic_partition=s["partition"],
+            )
+            for topic, src in self.topic_sources
+            for s in src.corruption_spans()
+        ]
+
+    def seed_corrupt_spans(self, spans: "list[dict]") -> None:
+        by_topic: Dict[str, list] = {}
+        for s in spans:
+            topic = s.get("topic")
+            if topic is not None and "topic_partition" in s:
+                by_topic.setdefault(topic, []).append(
+                    dict(s, partition=int(s["topic_partition"]))
+                )
+                continue
+            row = int(s["partition"])  # pre-fan-in snapshot shape: row id
+            if 0 <= row < len(self.rows):
+                t, p = self.rows[row]
+                by_topic.setdefault(t, []).append(dict(s, partition=p))
+        for topic, src in self.topic_sources:
+            seed = getattr(src, "seed_corrupt_spans", None)
+            if seed is not None and topic in by_topic:
+                seed(by_topic[topic])
+
     def batches(
         self,
         batch_size: int,

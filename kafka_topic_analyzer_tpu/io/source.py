@@ -47,6 +47,20 @@ class RecordSource(abc.ABC):
         degrade (synthetic, segment files)."""
         return {}
 
+    def corruption_stats(self) -> Dict[int, dict]:
+        """partition -> corruption accounting (frames/records/bytes/kinds/
+        spans) for poisoned frames the scan skipped or quarantined under
+        ``--on-corruption`` (io/kafka_wire.py).  Empty for sources that
+        cannot observe corruption."""
+        return {}
+
+    def corruption_spans(self) -> "list[dict]":
+        """Flat JSON-safe span list for checkpoint metadata (the engine
+        persists it so a --resume neither re-counts nor re-quarantines an
+        already-skipped span; see ``seed_corrupt_spans`` on the wire
+        source)."""
+        return []
+
     def total_records(self) -> int:
         start, end = self.watermarks()
         return sum(end[p] - start[p] for p in end)

@@ -32,8 +32,15 @@ SKIPPABLE_MAGIC_MAX = 0x184D2A5F
 _BLOCK_MAX = 128 * 1024
 
 
-class _Err(ValueError):
-    pass
+class CorruptZstdStream(ValueError):
+    """Classified malformed-zstd error: every decode failure in this
+    module raises this (never a bare ValueError/struct.error/IndexError),
+    so the codec layer can map it onto the corruption taxonomy
+    (io/kafka_codec.py ``BadCompressionError``) while callers written
+    against the historical ValueError contract keep working."""
+
+
+_Err = CorruptZstdStream  # short internal alias (raised ~60x below)
 
 
 # ---------------------------------------------------------------------------

@@ -16,7 +16,11 @@ Event catalog (field names stable — they are an output format):
 - ``fetch_error``           partition, code
 - ``retry_budget_exhausted`` partition, reason
 - ``partition_degraded``    partition, reason
-- ``scan_end``              topic, records, duration_secs, degraded
+- ``corrupt_suspect``       partition, anchor, kind   (re-fetch pending)
+- ``corrupt_frame``         partition, anchor, skip_to, kind, action,
+                            quarantined
+- ``scan_end``              topic, records, duration_secs, degraded,
+                            corrupt_frames
 """
 
 from __future__ import annotations

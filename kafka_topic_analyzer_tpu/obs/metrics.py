@@ -75,6 +75,24 @@ METADATA_RELOADS = _REG.counter(
     "kta_metadata_reloads_total",
     "Cluster metadata refreshes attempted during recovery")
 
+# -- corruption (io/kafka_wire + io/kafka_codec) ------------------------------
+
+CORRUPT_FRAMES = _REG.counter(
+    "kta_corrupt_frames_total",
+    "Frames classified deterministically corrupt and handled by policy",
+    labelnames=("kind",))
+CORRUPT_RECORDS = _REG.counter(
+    "kta_corrupt_records_total",
+    "Header-claimed records inside corrupt frames (0 when unreadable)")
+CORRUPT_BYTES = _REG.counter(
+    "kta_corrupt_bytes_total", "Raw bytes of corrupt frames skipped")
+CORRUPT_QUARANTINED = _REG.counter(
+    "kta_corrupt_quarantined_total",
+    "Corrupt frames spooled to the quarantine directory")
+CORRUPT_REFETCHES = _REG.counter(
+    "kta_corrupt_refetches_total",
+    "Suspect spans re-fetched once to rule out an in-flight bit flip")
+
 # -- io/retry -----------------------------------------------------------------
 
 BACKOFF_SLEEPS = _REG.counter(

@@ -108,6 +108,48 @@ def render_degraded_block(degraded: "Dict[int, str]") -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_corrupt_block(corrupt: "Dict[int, dict]") -> str:
+    """Post-table block for poisoned frames skipped/quarantined under
+    --on-corruption.  Like the degraded block, rendered OUTSIDE the
+    reference-compatible report: the scan COMPLETED, but the metrics
+    exclude exactly the unreadable frames' records, and the reader must
+    see what was lost and where the evidence went."""
+    if not corrupt:
+        return ""
+    bar = "%" * 120
+    frames = sum(d.get("frames", 0) for d in corrupt.values())
+    quarantined = sum(d.get("quarantined", 0) for d in corrupt.values())
+    lines = [
+        bar,
+        f"CORRUPT: {frames} unreadable frame(s) across "
+        f"{len(corrupt)} partition(s) — skipped; metrics exclude exactly "
+        "their records",
+    ]
+    for p in sorted(corrupt):
+        d = corrupt[p]
+        kinds = ", ".join(
+            f"{k} x{n}" for k, n in sorted(d.get("kinds", {}).items())
+        )
+        where = f"partition {p}" if p >= 0 else "another process"
+        lines.append(
+            f"  {where}: {d.get('frames', 0)} frame(s), "
+            f"{d.get('records', 0)} record(s), {d.get('bytes', 0)} bytes"
+            + (f" [{kinds}]" if kinds else "")
+            + (" — quarantined" if d.get("quarantined") else "")
+        )
+    if quarantined:
+        lines.append(
+            "Raw frames + JSON sidecars are spooled in --quarantine-dir."
+        )
+    else:
+        lines.append(
+            "Rerun with --on-corruption=quarantine --quarantine-dir to "
+            "preserve the raw frames."
+        )
+    lines.append(bar)
+    return "\n".join(lines) + "\n"
+
+
 def _metric_total(snapshot: Dict, name: str) -> float:
     """Sum of a metric's sample values across label sets (0 if absent)."""
     metric = snapshot.get(name)
@@ -145,6 +187,14 @@ def render_telemetry_stats(snapshot: Optional[Dict]) -> str:
             f"sleeps ({t('kta_backoff_sleep_seconds_total'):.2f}s), "
             f"{t('kta_retry_budget_exhaustions_total'):,.0f} budget "
             f"exhaustions"
+        ),
+        (
+            f"  corruption: {t('kta_corrupt_frames_total'):,.0f} corrupt "
+            f"frames ({t('kta_corrupt_records_total'):,.0f} records, "
+            f"{t('kta_corrupt_bytes_total'):,.0f} B), "
+            f"{t('kta_corrupt_quarantined_total'):,.0f} quarantined, "
+            f"{t('kta_corrupt_refetches_total'):,.0f} disambiguation "
+            f"re-fetches"
         ),
         (
             f"  state: {t('kta_snapshots_saved_total'):,.0f} snapshots "

@@ -98,6 +98,95 @@ class FaultInjector:
             )
 
 
+class CorruptionInjector:
+    """Deterministic poison plan for a FakeBroker's pre-encoded chunks.
+
+    Unlike `FaultInjector` (transient transport faults that heal after a
+    bounded fire count), corruption is applied ONCE, at chunk pre-encode
+    time — modeling bit rot on the broker's disk: every fetch of a
+    poisoned range returns byte-identical garbage, so the client's
+    disambiguating re-fetch must conclude "deterministically corrupt" and
+    apply its --on-corruption policy.
+
+    Mutations target ``(partition, chunk_index)`` (chunks are
+    ``max_records_per_fetch``-sized; for magic-2 topics each chunk is one
+    RecordBatch v2 frame):
+
+    - ``flip_byte``: XOR one byte (default: the last payload byte — a CRC
+      mismatch under check.crcs, silent value garbage without);
+    - ``corrupt_length``: overwrite the frame's batch_length prefix (a
+      negative value exercises the mid-buffer classification the codec's
+      old "partial trailing batch" path silently swallowed);
+    - ``garbage_compression``: set the codec bits to gzip, scramble the
+      payload, and RE-COMPUTE the CRC — only decompression can fail, the
+      checksum is valid (the bad-compression classification);
+    - ``truncate``: drop trailing bytes of the chunk.
+    """
+
+    def __init__(self) -> None:
+        self._plans: Dict[Tuple[int, int], list] = {}
+        #: Every (partition, chunk_index) a mutation targets.
+        self.poisoned: "set[Tuple[int, int]]" = set()
+
+    @property
+    def poisoned_frames(self) -> int:
+        return len(self.poisoned)
+
+    def _plan(self, partition: int, chunk: int, fn) -> "CorruptionInjector":
+        self._plans.setdefault((partition, chunk), []).append(fn)
+        self.poisoned.add((partition, chunk))
+        return self
+
+    def flip_byte(
+        self, partition: int, chunk: int = 0, offset: int = -1, xor: int = 0xFF
+    ) -> "CorruptionInjector":
+        def fn(b: bytearray) -> bytearray:
+            b[offset] ^= xor
+            return b
+
+        return self._plan(partition, chunk, fn)
+
+    def corrupt_length(
+        self, partition: int, chunk: int = 0, value: int = -5
+    ) -> "CorruptionInjector":
+        def fn(b: bytearray) -> bytearray:
+            struct.pack_into(">i", b, 8, value)
+            return b
+
+        return self._plan(partition, chunk, fn)
+
+    def truncate(
+        self, partition: int, chunk: int = 0, drop: int = 10
+    ) -> "CorruptionInjector":
+        def fn(b: bytearray) -> bytearray:
+            return b[: max(len(b) - drop, 0)]
+
+        return self._plan(partition, chunk, fn)
+
+    def garbage_compression(
+        self, partition: int, chunk: int = 0
+    ) -> "CorruptionInjector":
+        def fn(b: bytearray) -> bytearray:
+            # v2 frame layout: attributes i16 at byte 21, payload from 61.
+            attrs = struct.unpack_from(">h", b, 21)[0]
+            struct.pack_into(">h", b, 21, (attrs & ~0x07) | kc.COMPRESSION_GZIP)
+            for i in range(61, len(b)):
+                b[i] = (b[i] * 31 + 7) & 0xFF  # deterministic garbage
+            struct.pack_into(">I", b, 17, kc._crc32c(bytes(b[21:])))
+            return b
+
+        return self._plan(partition, chunk, fn)
+
+    def apply(self, partition: int, chunk_index: int, data: bytes) -> bytes:
+        fns = self._plans.get((partition, chunk_index))
+        if not fns:
+            return data
+        b = bytearray(data)
+        for fn in fns:
+            b = bytearray(fn(b))
+        return bytes(b)
+
+
 class FakeBroker:
     def __init__(
         self,
@@ -122,12 +211,16 @@ class FakeBroker:
         control_offsets: "Optional[Dict[int, set]]" = None,
         response_delay=None,
         faults: "Optional[FaultInjector]" = None,
+        corruption: "Optional[CorruptionInjector]" = None,
     ):
         #: Transport-fault plan (connection drops/refusals, stalls,
         #: transient fetch errors); None = behave.  Mutable attribute, so
         #: tests can arm faults mid-scan or give FakeCluster nodes
         #: distinct injectors after construction.
         self.faults = faults
+        #: Poison plan applied to the pre-encoded chunks at startup (bit
+        #: rot on disk: deterministic, identical on every fetch).
+        self.corruption = corruption
         #: Optional callable (api_key, node_id) -> seconds, slept before
         #: each response send: induces cross-leader timing skew so the
         #: client's concurrent fetch threads interleave differently every
@@ -243,6 +336,8 @@ class FakeBroker:
                     encoded = kc.encode_message_set(
                         part, magic=message_magic, compression=compression
                     )
+                if self.corruption is not None:
+                    encoded = self.corruption.apply(p, ci, encoded)
                 chunks.append((part[0][0], last, encoded))
             self._chunks[p] = chunks
             self._chunk_last_offsets[p] = [c[1] for c in chunks]

@@ -1,0 +1,721 @@
+"""Corrupt-data resilience: poison-frame isolation, quarantine, salvage.
+
+Three layers of proof:
+
+1. Codec units: the `CorruptFrameError` taxonomy classifies every damage
+   class; `salvage_batch_frames` skips exactly the poisoned frame and
+   keeps decoding the rest of the record set; a *negative* batch length
+   mid-buffer classifies instead of silently dropping the rest of the
+   fetch response (the old ``partial trailing batch`` confusion).
+2. Chaos end-to-end: a `CorruptionInjector`-poisoned FakeBroker topic
+   scanned under ``--on-corruption=skip``/``quarantine`` completes with
+   metrics BYTE-IDENTICAL to a clean scan of the same topic minus exactly
+   the poisoned frames' records; the CORRUPT report block,
+   ``kta_corrupt_*`` counters, quarantine spool round-trip, EXIT_CORRUPT,
+   and ``--resume`` idempotence (no re-scan, no double-quarantine) all
+   hold.  Default ``fail`` still aborts.
+3. Fuzz: ≥200 seeded random mutations (byte flips, truncations,
+   length-field rewrites) over ``encode_record_batch`` output never hang,
+   never raise an unclassified exception, and never let salvage invent
+   records — plus hypothesis variants when available.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig, CorruptionConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+from kafka_topic_analyzer_tpu.io.quarantine import QuarantineStore
+from kafka_topic_analyzer_tpu.obs.registry import default_registry
+
+from fake_broker import CorruptionInjector, FakeBroker
+
+pytestmark = pytest.mark.chaos
+
+TOPIC = "corrupt.topic"
+
+FAST_RETRY = {
+    "retry.backoff.ms": "5",
+    "reconnect.backoff.max.ms": "40",
+}
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    default_registry().reset()
+    yield
+    default_registry().reset()
+
+
+def _mk_records(partition: int, n: int):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 37}".encode() if i % 5 else None,
+            bytes(20 + (i % 13)) if i % 7 else None,
+        )
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# 1. codec units: taxonomy + salvage + the negative-length bugfix
+
+
+def _three_frames():
+    recs = [(i, 1000 + i, f"k{i}".encode(), f"v{i}".encode()) for i in range(9)]
+    return (
+        kc.encode_record_batch(recs[:3]),
+        kc.encode_record_batch(recs[3:6]),
+        kc.encode_record_batch(recs[6:]),
+    )
+
+
+def _drain(items):
+    good, spans = [], []
+    for item in items:
+        if isinstance(item, kc.CorruptSpan):
+            spans.append(item)
+        else:
+            good.extend(off for off, _ in kc.decode_frame_records(item))
+    return good, spans
+
+
+def test_crc_mismatch_classifies_with_context():
+    f1, f2, f3 = _three_frames()
+    buf = bytearray(f1 + f2 + f3)
+    buf[len(f1) + len(f2) - 1] ^= 0xFF  # last payload byte of frame 2
+    with pytest.raises(kc.CrcMismatchError) as ei:
+        list(kc.iter_batch_frames(bytes(buf), verify_crc=True))
+    e = ei.value
+    assert e.kind == "crc-mismatch"
+    assert e.base_offset == 3
+    assert e.span == (len(f1), len(f1) + len(f2))
+    assert e.claimed_end == 6
+    assert e.crc_expected != e.crc_actual
+    assert isinstance(e, kc.KafkaProtocolError)  # existing handlers still fire
+
+
+def test_salvage_skips_exactly_the_poisoned_frame():
+    f1, f2, f3 = _three_frames()
+    buf = bytearray(f1 + f2 + f3)
+    buf[len(f1) + len(f2) - 1] ^= 0xFF
+    good, spans = _drain(kc.salvage_batch_frames(bytes(buf), verify_crc=True))
+    assert good == [0, 1, 2, 6, 7, 8]  # frames after the poison still decode
+    assert len(spans) == 1
+    s = spans[0]
+    assert (s.start, s.end) == (len(f1), len(f1) + len(f2))
+    assert s.error.kind == "crc-mismatch"
+    assert s.skip_offset(3) == 6  # resume exactly past the poisoned range
+
+
+def test_negative_batch_length_mid_buffer_classifies():
+    """The satellite bugfix: a negative batch_length used to be treated as
+    a partial trailing batch, silently ending iteration and dropping every
+    frame after it in the fetch response."""
+    f1, f2, f3 = _three_frames()
+    buf = bytearray(f1 + f2 + f3)
+    struct.pack_into(">i", buf, len(f1) + 8, -5)
+    with pytest.raises(kc.MalformedHeaderError, match="non-positive"):
+        list(kc.iter_batch_frames(bytes(buf)))
+    good, spans = _drain(kc.salvage_batch_frames(bytes(buf), verify_crc=True))
+    assert good == [0, 1, 2, 6, 7, 8]  # resync recovered the third frame
+    assert spans[0].error.kind == "malformed-header"
+    assert spans[0].resume_offset == 6
+
+
+def test_undersized_batch_length_classifies_not_overruns():
+    """A positive batch_length too small to hold the v2 header must
+    classify BEFORE parsing: at the buffer tail the header reader would
+    otherwise overrun with an unclassified error; mid-buffer it would
+    silently read the next frame's bytes as header fields."""
+    f1, f2, f3 = _three_frames()
+    # Tail: lone frame claiming a 20-byte batch.
+    tail = bytearray(f1)
+    struct.pack_into(">i", tail, 8, 20)
+    with pytest.raises(kc.MalformedHeaderError, match="below the magic-2"):
+        list(kc.iter_batch_frames(bytes(tail)))
+    good, spans = _drain(kc.salvage_batch_frames(bytes(tail), verify_crc=True))
+    assert good == [] and spans[0].error.kind == "malformed-header"
+    # Mid-buffer: the frames after the mangled length must salvage.
+    mid = bytearray(f1 + f2 + f3)
+    struct.pack_into(">i", mid, len(f1) + 8, 20)
+    good, spans = _drain(kc.salvage_batch_frames(bytes(mid), verify_crc=True))
+    assert good == [0, 1, 2, 6, 7, 8]
+    assert spans[0].error.kind == "malformed-header"
+
+
+def test_source_wrappers_forward_corruption_surface():
+    """TeeSource (--dump-segments) and MultiTopicSource (fan-in) must
+    forward the corruption accounting the engine discovers by hasattr —
+    otherwise a corrupt scan through them exits 0 with silent undercounts."""
+    from kafka_topic_analyzer_tpu.io.multi import MultiTopicSource
+    from kafka_topic_analyzer_tpu.io.segfile import TeeSource
+    from kafka_topic_analyzer_tpu.io.source import RecordSource
+
+    class Stub(RecordSource):
+        def __init__(self, parts, spans):
+            self._parts = parts
+            self._spans = spans
+            self.seeded = None
+
+        def partitions(self):
+            return self._parts
+
+        def watermarks(self):
+            return ({p: 0 for p in self._parts}, {p: 10 for p in self._parts})
+
+        def batches(self, batch_size, partitions=None, start_at=None):
+            return iter(())
+
+        def corruption_spans(self):
+            return list(self._spans)
+
+        def corruption_stats(self):
+            out = {}
+            for s in self._spans:
+                d = out.setdefault(
+                    s["partition"],
+                    {"frames": 0, "records": 0, "bytes": 0,
+                     "quarantined": 0, "kinds": {}, "spans": []},
+                )
+                d["frames"] += 1
+                d["spans"].append(dict(s))
+            return out
+
+        def seed_corrupt_spans(self, spans):
+            self.seeded = list(spans)
+
+    span = {"partition": 1, "anchor": 4, "skip_to": 6,
+            "kind": "crc-mismatch", "frames": 1, "records": 2, "bytes": 9}
+    inner = Stub([0, 1], [span])
+
+    class W:
+        def append(self, b): pass
+        def close(self): pass
+        def set_base_offsets(self, o): pass
+
+    tee = TeeSource(inner, W())
+    assert tee.corruption_stats() == inner.corruption_stats()
+    assert tee.corruption_spans() == [span]
+    tee.seed_corrupt_spans([span])
+    assert inner.seeded == [span]
+
+    # Fan-in: topic b's partitions follow topic a's in dense row space,
+    # so b/partition-1 is row 3; spans round-trip through the remap.
+    a, b = Stub([0, 1], []), Stub([0, 1], [span])
+    multi = MultiTopicSource([("a", a), ("b", b)])
+    stats = multi.corruption_stats()
+    assert set(stats) == {3} and stats[3]["topic"] == "b"
+    spans_out = multi.corruption_spans()
+    assert spans_out[0]["partition"] == 3
+    assert spans_out[0]["topic_partition"] == 1
+    multi.seed_corrupt_spans(spans_out)
+    assert a.seeded is None or a.seeded == []
+    assert b.seeded == [dict(spans_out[0], partition=1)]
+
+
+def test_skip_prefers_validated_resume_over_corrupt_claimed_end():
+    """A bit flip in last_offset_delta makes the corrupt frame's own
+    claimed_end garbage-high; the skip bound must prefer the NEXT salvaged
+    frame's validated base offset or the rest of the partition would be
+    silently swallowed."""
+    f1, f2, f3 = _three_frames()
+    buf = bytearray(f1 + f2 + f3)
+    # last_offset_delta is the i32 at frame byte 23 (after leader_epoch,
+    # magic, crc, attributes) — inside the CRC-covered region.
+    struct.pack_into(">i", buf, len(f1) + 23, 1 << 29)
+    good, spans = _drain(kc.salvage_batch_frames(bytes(buf), verify_crc=True))
+    assert good == [0, 1, 2, 6, 7, 8]
+    s = spans[0]
+    assert s.error.kind == "crc-mismatch"
+    assert s.claimed_end == 3 + (1 << 29) + 1  # the poisoned field
+    assert s.resume_offset == 6                # the validated boundary
+    assert s.skip_offset(3) == 6               # ...which must win
+
+
+def test_oscillating_corruption_kind_is_bounded():
+    """A link that corrupts every re-fetch DIFFERENTLY at the same anchor
+    must not cycle suspect re-fetches forever: after _MAX_SUSPECT_ROUNDS
+    the verdict is forced with the latest classification."""
+    import threading
+
+    from kafka_topic_analyzer_tpu.io import kafka_wire as kw
+
+    src = KafkaWireSource.__new__(KafkaWireSource)
+    src.topic = "t"
+    src.corruption = CorruptionConfig(policy="skip")
+    src._quarantine = None
+    src._corrupt_spans = {}
+    src._corrupt_suspects = {}
+    src._corrupt_lock = threading.Lock()
+    kinds = [kc.TruncatedFrameError, kc.CrcMismatchError,
+             kc.MalformedHeaderError, kc.BadCompressionError,
+             kc.TruncatedFrameError, kc.CrcMismatchError]
+    outcomes = []
+    for cls in kinds:
+        out = src._note_corrupt(
+            0, 100, cls("x", base_offset=100), 150, -1, 50, b"raw"
+        )
+        outcomes.append(out)
+        if out is not None:
+            break
+    # Re-fetched at most the bound, then forced the skip verdict.
+    assert outcomes[-1] == 150
+    assert len(outcomes) <= kw._MAX_SUSPECT_ROUNDS + 1
+    assert (0, 100) in src._corrupt_spans
+
+
+def test_explicit_config_wins_over_discarded_overrides():
+    """--on-corruption=skip plus a stray --librdkafka quarantine.dir must
+    not raise the quarantine-dir validation error for a config that is
+    discarded anyway (the explicit flag wins; the override is ignored)."""
+    records = {0: _mk_records(0, 60)}
+    with FakeBroker(TOPIC, records, max_records_per_fetch=30) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC,
+            overrides=dict(FAST_RETRY, **{"quarantine.dir": "/spool"}),
+            corruption=CorruptionConfig(policy="skip"),
+        )
+        assert src.corruption.policy == "skip"
+        assert src.corruption.quarantine_dir is None
+        src.close()
+
+
+def test_genuine_tail_truncation_still_tolerated():
+    """A partial TRAILING batch is the broker's max_bytes cut, not
+    corruption: iteration (and salvage) end cleanly, no span."""
+    f1, f2, _ = _three_frames()
+    buf = f1 + f2[: len(f2) // 2]
+    frames = list(kc.iter_batch_frames(buf, verify_crc=True))
+    assert [f.base_offset for f in frames] == [0]
+    good, spans = _drain(kc.salvage_batch_frames(buf, verify_crc=True))
+    assert good == [0, 1, 2] and spans == []
+
+
+def test_bad_compression_classifies():
+    recs = [(0, 1000, b"k", b"v"), (1, 1001, b"k2", b"v2")]
+    buf = bytearray(kc.encode_record_batch(recs, kc.COMPRESSION_GZIP))
+    # Scramble the compressed payload but repair the CRC: only the codec
+    # stream is damaged, which must classify as bad-compression (not crc).
+    for i in range(61, len(buf)):
+        buf[i] = (buf[i] * 31 + 7) & 0xFF
+    buf[17:21] = struct.pack(">I", kc._crc32c(bytes(buf[21:])))
+    with pytest.raises(kc.BadCompressionError):
+        list(kc.iter_batch_frames(bytes(buf), verify_crc=True))
+
+
+def test_record_body_corruption_classifies():
+    """Payload damage below the CRC's reach (verify off) surfaces in the
+    record parser as a classified error carrying the frame span."""
+    recs = [(i, 1000, b"key", b"value") for i in range(4)]
+    buf = bytearray(kc.encode_record_batch(recs))
+    buf[61] = 0x7E  # first record's length varint now claims 63 bytes
+    frames = list(kc.iter_batch_frames(bytes(buf), verify_crc=False))
+    with pytest.raises(kc.CorruptFrameError) as ei:
+        for f in frames:
+            list(kc.decode_frame_records(f))
+    assert ei.value.kind in ("truncated", "malformed-header")
+    assert ei.value.span == (0, len(buf))
+
+
+def test_legacy_messageset_crc_classifies_and_salvages():
+    recs = [(i, 1_600_000_000_000 + i, f"k{i}".encode(), b"v") for i in range(4)]
+    entries = [
+        kc.encode_message_set(recs[i : i + 1], magic=1) for i in range(4)
+    ]
+    buf = bytearray(b"".join(entries))
+    pos = len(entries[0]) + len(entries[1])
+    buf[pos + 20] ^= 0xFF  # inside entry 2's body -> CRC mismatch
+    with pytest.raises(kc.CrcMismatchError):
+        list(kc.iter_batch_frames(bytes(buf), verify_crc=True))
+    good, spans = _drain(kc.salvage_batch_frames(bytes(buf), verify_crc=True))
+    assert good == [0, 1, 3]
+    assert spans[0].error.kind == "crc-mismatch"
+
+
+def test_quarantine_store_round_trip(tmp_path):
+    store = QuarantineStore(str(tmp_path))
+    raw = b"\xde\xad\xbe\xef" * 10
+    sidecar = store.spool(
+        topic="t/../x", partition=3, anchor=17, raw=raw,
+        classification="crc-mismatch", base_offset=17, offset_start=17,
+        offset_end=20, crc_expected=1, crc_actual=2, error="boom",
+    )
+    assert sidecar is not None and os.path.dirname(sidecar) == str(tmp_path)
+    meta, loaded = QuarantineStore.load(sidecar)
+    assert loaded == raw
+    assert meta["classification"] == "crc-mismatch"
+    assert meta["partition"] == 3 and meta["anchor"] == 17
+    assert meta["offset_end"] == 20
+    # Idempotent: the same span never spools twice (resume contract).
+    assert store.spool(
+        topic="t/../x", partition=3, anchor=17, raw=raw,
+        classification="crc-mismatch",
+    ) is None
+    assert len(store.entries()) == 1
+
+
+# ---------------------------------------------------------------------------
+# 2. chaos end-to-end through the wire source + engine + CLI
+
+#: 6 chunks of 50 records per partition; poison plan: 3 frames, 2 partitions.
+N_REC = 300
+CHUNK = 50
+POISON = {0: [2, 4], 1: [1]}  # partition -> poisoned chunk indices
+
+
+def _poisoned_broker(**kwargs):
+    inj = (
+        CorruptionInjector()
+        .flip_byte(0, chunk=2, offset=-1)       # crc-mismatch
+        .garbage_compression(0, chunk=4)        # bad-compression
+        .flip_byte(1, chunk=1, offset=-3)       # crc-mismatch
+    )
+    records = {p: _mk_records(p, N_REC) for p in range(2)}
+    return FakeBroker(
+        TOPIC, records, max_records_per_fetch=CHUNK, corruption=inj,
+        honor_partition_max_bytes=True, **kwargs,
+    ), inj
+
+
+def _clean_minus_poison_doc():
+    """Referee: a clean scan of the same topic with the poisoned chunks'
+    records REMOVED (offsets/watermarks preserved) — what a corrupt scan
+    under skip/quarantine must reproduce byte-for-byte."""
+    records = {
+        p: [
+            r for i, r in enumerate(_mk_records(p, N_REC))
+            if i // CHUNK not in POISON.get(p, [])
+        ]
+        for p in range(2)
+    }
+    with FakeBroker(
+        TOPIC, records,
+        max_records_per_fetch=CHUNK,
+        start_offsets={0: 0, 1: 0},
+        end_offsets={0: N_REC, 1: N_REC},
+        honor_partition_max_bytes=True,
+    ) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC,
+            overrides=dict(FAST_RETRY, **{"check.crcs": "true"}),
+        )
+        result = _scan(src)
+    assert not result.degraded_partitions and not result.corrupt_partitions
+    return _doc(result)
+
+
+def _scan(source, batch_size=128):
+    cfg = AnalyzerConfig(
+        num_partitions=2, batch_size=batch_size,
+        count_alive_keys=True, alive_bitmap_bits=16,
+    )
+    backend = CpuExactBackend(cfg, init_now_s=10**10)
+    result = run_scan(TOPIC, source, backend, batch_size)
+    source.close()
+    return result
+
+
+def _doc(result):
+    return result.metrics.to_dict(result.start_offsets, result.end_offsets)
+
+
+def _corrupt_source(port, policy, qdir=None):
+    return KafkaWireSource(
+        f"127.0.0.1:{port}", TOPIC,
+        overrides=dict(FAST_RETRY, **{"check.crcs": "true"}),
+        corruption=CorruptionConfig(policy=policy, quarantine_dir=qdir),
+    )
+
+
+def test_default_fail_policy_aborts_like_today():
+    broker, _ = _poisoned_broker()
+    with broker:
+        src = _corrupt_source(broker.port, "fail")
+        with pytest.raises(kc.CorruptFrameError):
+            _scan(src)
+
+
+def test_skip_policy_completes_with_exact_metrics():
+    baseline = _clean_minus_poison_doc()
+    broker, inj = _poisoned_broker()
+    with broker:
+        src = _corrupt_source(broker.port, "skip")
+        result = _scan(src)
+    assert not result.degraded_partitions
+    assert _doc(result) == baseline  # byte-identical minus the poison
+    corrupt = result.corrupt_partitions
+    assert set(corrupt) == {0, 1}
+    assert sum(d["frames"] for d in corrupt.values()) == inj.poisoned_frames
+    assert corrupt[0]["frames"] == 2 and corrupt[1]["frames"] == 1
+    assert corrupt[0]["records"] == 2 * CHUNK and corrupt[1]["records"] == CHUNK
+    kinds = {}
+    for d in corrupt.values():
+        for k, n in d["kinds"].items():
+            kinds[k] = kinds.get(k, 0) + n
+    assert kinds == {"crc-mismatch": 2, "bad-compression": 1}
+    # Registry counters agree with the injected plan.
+    snap = default_registry().snapshot()
+    frames_total = sum(
+        s["value"] for s in snap["kta_corrupt_frames_total"]["samples"]
+    )
+    assert frames_total == inj.poisoned_frames
+    # Each poisoned span was re-fetched once before the verdict.
+    refetches = sum(
+        s["value"] for s in snap["kta_corrupt_refetches_total"]["samples"]
+    )
+    assert refetches == inj.poisoned_frames
+
+
+def test_quarantine_policy_spools_evidence(tmp_path):
+    baseline = _clean_minus_poison_doc()
+    qdir = str(tmp_path / "quarantine")
+    broker, inj = _poisoned_broker()
+    with broker:
+        src = _corrupt_source(broker.port, "quarantine", qdir)
+        result = _scan(src)
+    assert _doc(result) == baseline
+    store = QuarantineStore(qdir)
+    entries = store.entries()
+    assert len(entries) == inj.poisoned_frames
+    seen = set()
+    for sidecar in entries:
+        meta, raw = QuarantineStore.load(sidecar)  # sha256-verified
+        assert meta["topic"] == TOPIC
+        assert meta["classification"] in kc.CORRUPTION_KINDS
+        assert len(raw) == meta["length"] > 0
+        seen.add((meta["partition"], meta["anchor"]))
+    # One spool per poisoned chunk, at the chunk's first offset.
+    assert seen == {
+        (p, ci * CHUNK) for p, cis in POISON.items() for ci in cis
+    }
+    assert all(d["quarantined"] for d in result.corrupt_partitions.values())
+
+
+def test_cli_end_to_end_exit_corrupt_and_report(tmp_path, capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    qdir = str(tmp_path / "q")
+    broker, inj = _poisoned_broker()
+    with broker:
+        rc = cli.main([
+            "-t", TOPIC, "-b", f"127.0.0.1:{broker.port}",
+            "--quiet", "--check-crcs",
+            "--on-corruption", "quarantine", "--quarantine-dir", qdir,
+            "--librdkafka", "retry.backoff.ms=5,reconnect.backoff.max.ms=40",
+        ])
+    assert rc == cli.EXIT_CORRUPT
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out
+    assert f"{inj.poisoned_frames} unreadable frame(s)" in out
+    assert "partition 0:" in out and "partition 1:" in out
+    assert len(QuarantineStore(qdir).entries()) == inj.poisoned_frames
+
+
+def test_cli_json_carries_corrupt_block(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    broker, inj = _poisoned_broker()
+    with broker:
+        rc = cli.main([
+            "-t", TOPIC, "-b", f"127.0.0.1:{broker.port}",
+            "--quiet", "--check-crcs", "--json",
+            "--on-corruption", "skip",
+            "--librdkafka", "retry.backoff.ms=5,reconnect.backoff.max.ms=40",
+        ])
+    assert rc == cli.EXIT_CORRUPT
+    doc = json.loads(capsys.readouterr().out)
+    got = doc["corrupt_partitions"]
+    assert set(got) == {"0", "1"}
+    assert sum(d["frames"] for d in got.values()) == inj.poisoned_frames
+    # The telemetry block carries the kta_corrupt_* catalog too.
+    assert "kta_corrupt_frames_total" in doc["telemetry"]
+
+
+def test_cli_flag_validation():
+    from kafka_topic_analyzer_tpu import cli
+
+    # quarantine without a directory
+    rc = cli.main([
+        "-t", "t", "-b", "127.0.0.1:1", "--on-corruption", "quarantine",
+    ])
+    assert rc == 1
+    # quarantine dir without the policy
+    rc = cli.main([
+        "-t", "t", "-b", "127.0.0.1:1", "--quarantine-dir", "/tmp/x",
+    ])
+    assert rc == 1
+    # corruption policy needs the wire source
+    rc = cli.main([
+        "-t", "t", "--source", "synthetic", "--synthetic", "messages=10",
+        "--on-corruption", "skip",
+    ])
+    assert rc == 1
+
+
+def test_librdkafka_override_path_sets_policy():
+    """on.corruption/quarantine.dir are also reachable through the usual
+    --librdkafka overrides table (the CLI flags win when both are given)."""
+    broker, inj = _poisoned_broker()
+    with broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC,
+            overrides=dict(
+                FAST_RETRY,
+                **{"check.crcs": "true", "on.corruption": "skip"},
+            ),
+        )
+        result = _scan(src)
+    assert sum(
+        d["frames"] for d in result.corrupt_partitions.values()
+    ) == inj.poisoned_frames
+
+
+def test_resume_neither_rescans_nor_double_quarantines(tmp_path):
+    """Tail poison: the last chunk of partition 1 is corrupt, so the
+    engine's offset tracker (which only sees records) stops short of the
+    skipped span.  A --resume must re-seed the span from the snapshot:
+    same totals, no new quarantine files, no double counting."""
+    from kafka_topic_analyzer_tpu import cli
+
+    qdir = str(tmp_path / "q")
+    snapdir = str(tmp_path / "snap")
+    inj = CorruptionInjector().flip_byte(1, chunk=5, offset=-1)
+    records = {p: _mk_records(p, N_REC) for p in range(2)}
+    argv = [
+        "-t", TOPIC, "--quiet", "--check-crcs", "--backend", "tpu",
+        "--on-corruption", "quarantine", "--quarantine-dir", qdir,
+        "--snapshot-dir", snapdir, "--resume",
+        "--librdkafka", "retry.backoff.ms=5,reconnect.backoff.max.ms=40",
+    ]
+    with FakeBroker(
+        TOPIC, records, max_records_per_fetch=CHUNK, corruption=inj,
+        honor_partition_max_bytes=True,
+    ) as broker:
+        rc1 = cli.main(argv + ["-b", f"127.0.0.1:{broker.port}"])
+        assert rc1 == cli.EXIT_CORRUPT
+        entries_after_first = QuarantineStore(qdir).entries()
+        assert len(entries_after_first) == 1
+        snap = os.path.join(snapdir, "scan_snapshot.npz")
+        with np.load(snap, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+        assert len(meta["corrupt_spans"]) == 1
+        assert meta["corrupt_spans"][0]["partition"] == 1
+        fetches_before = broker.fetch_count
+        rc2 = cli.main(argv + ["-b", f"127.0.0.1:{broker.port}"])
+    assert rc2 == cli.EXIT_CORRUPT  # still reported (seeded), still exit 4
+    assert QuarantineStore(qdir).entries() == entries_after_first
+    # The resumed run re-walked at most the seeded span's neighborhood —
+    # nowhere near the ~a-dozen-plus fetch rounds of a full rescan.
+    assert broker.fetch_count - fetches_before <= 6
+
+
+# ---------------------------------------------------------------------------
+# 3. fuzz: classified-or-silent over ≥200 seeded mutations, salvage total
+
+pytestmark_fuzz = pytest.mark.fuzz
+
+
+def _fuzz_record_set(rng):
+    recs = [
+        (
+            i,
+            1000 + i,
+            bytes(rng.integers(0, 256, rng.integers(0, 8), dtype=np.uint8)),
+            bytes(rng.integers(0, 256, rng.integers(0, 12), dtype=np.uint8)),
+        )
+        for i in range(int(rng.integers(1, 6)))
+    ]
+    codec = int(rng.choice([0, 0, 1]))  # mostly uncompressed, some gzip
+    return kc.encode_record_batch(recs, codec), len(recs)
+
+
+def _mutate(buf, rng):
+    b = bytearray(buf)
+    mode = int(rng.integers(0, 3))
+    if mode == 0 and len(b):  # single-byte flip
+        b[int(rng.integers(0, len(b)))] ^= int(rng.integers(1, 256))
+    elif mode == 1 and len(b) > 1:  # truncation
+        del b[int(rng.integers(1, len(b))):]
+    else:  # length-field rewrite (includes negatives)
+        struct.pack_into(
+            ">i", b, 8, int(rng.integers(-(1 << 31), 1 << 31))
+        )
+    return bytes(b)
+
+
+@pytest.mark.fuzz
+@pytest.mark.parametrize("verify_crc", [True, False])
+def test_fuzz_mutations_classify_and_never_miscount(verify_crc):
+    rng = np.random.default_rng(20260802 if verify_crc else 20260803)
+    classified = 0
+    for trial in range(220):
+        sets = []
+        total = 0
+        for _ in range(int(rng.integers(1, 4))):
+            s, n = _fuzz_record_set(rng)
+            sets.append(s)
+            total += n
+        buf = _mutate(b"".join(sets), rng)
+        # fail mode: records or a classified error, nothing else.
+        try:
+            list(kc.decode_record_batches(buf, verify_crc=verify_crc))
+        except kc.CorruptFrameError:
+            classified += 1
+        # salvage mode: must terminate, raise nothing from the frame walk,
+        # and never yield more records than were encoded (with CRC on, a
+        # salvaged frame is either untouched or astronomically unlucky).
+        salvaged = 0
+        for item in kc.salvage_batch_frames(buf, verify_crc=verify_crc):
+            if isinstance(item, kc.CorruptSpan):
+                assert item.error.kind in kc.CORRUPTION_KINDS
+                assert item.end > item.start or item.end == len(buf)
+                continue
+            try:
+                salvaged += sum(1 for _ in kc.decode_frame_records(item))
+            except kc.CorruptFrameError:
+                pass  # record-body damage: classified, handled by policy
+        if verify_crc:
+            assert salvaged <= total
+    assert classified > 20  # the mutations genuinely exercised the taxonomy
+
+
+def test_fuzz_hypothesis_single_byte_flips():
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    recs = [(i, 1000 + i, f"key{i}".encode(), bytes(range(i, i + 16)))
+            for i in range(6)]
+    base = (
+        kc.encode_record_batch(recs[:3])
+        + kc.encode_record_batch(recs[3:], kc.COMPRESSION_GZIP)
+    )
+
+    @hyp.settings(max_examples=120, deadline=None)
+    @hyp.given(st.integers(0, len(base) - 1), st.integers(1, 255))
+    def run(pos, mask):
+        b = bytearray(base)
+        b[pos] ^= mask
+        try:
+            list(kc.decode_record_batches(bytes(b), verify_crc=True))
+        except kc.CorruptFrameError:
+            pass
+        got = []
+        for item in kc.salvage_batch_frames(bytes(b), verify_crc=True):
+            if isinstance(item, kc.CorruptSpan):
+                assert item.error.kind in kc.CORRUPTION_KINDS
+            else:
+                got.extend(off for off, _ in kc.decode_frame_records(item))
+        assert len(got) <= len(recs)
+        assert all(0 <= off < len(recs) for off in got)
+
+    run()

@@ -45,3 +45,78 @@ if failures:
     sys.exit(1)
 print("lint: OK (no print() in library modules)")
 EOF
+
+# Second rule: decode-surface functions under io/ must never raise a BARE
+# ValueError or struct.error — untrusted wire input must classify (a typed
+# subclass: kafka_codec's CorruptFrameError taxonomy, compression's
+# CorruptPayloadError, zstd_py's CorruptZstdStream).  Encode-side helpers
+# (ByteWriter, encode_*, *_compress_*) are exempt: they validate caller
+# input, not wire bytes.
+python - <<'EOF'
+import ast
+import pathlib
+import re
+import sys
+
+IO_DIR = pathlib.Path("kafka_topic_analyzer_tpu") / "io"
+DECODE_SURFACE = re.compile(
+    r"decode|decompress|salvage|iter_batch|_iter_frames|_parse_frame"
+    r"|_resync|_plausible|scan_record|_read_uvarint|_output_size"
+    r"|_output_bound|_snappy_raw|_lz4_block|_decode_legacy"
+)
+ENCODE_SIDE = re.compile(r"encode|compress_xerial|compress_frame|_compress\b")
+
+failures = []
+for path in sorted(IO_DIR.glob("*.py")):
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = []
+
+        def visit_FunctionDef(self, node):
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        def visit_Raise(self, node):
+            name = None
+            exc = node.exc
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            if isinstance(exc, ast.Name):
+                name = exc.id
+            elif isinstance(exc, ast.Attribute):
+                name = f"{getattr(exc.value, 'id', '?')}.{exc.attr}"
+            if name in ("ValueError", "struct.error"):
+                qual = ".".join(self.stack)
+                in_decode = any(DECODE_SURFACE.search(s) for s in self.stack)
+                in_encode = any(
+                    ENCODE_SIDE.search(s) and "decompress" not in s
+                    for s in self.stack
+                ) or "ByteWriter" in self.stack
+                if in_decode and not in_encode:
+                    failures.append(
+                        f"{path}:{node.lineno}: bare {name} in decode-surface "
+                        f"function {qual!r}"
+                    )
+            self.generic_visit(node)
+
+    V().visit(tree)
+
+if failures:
+    print("lint: bare ValueError/struct.error raised on the io/ decode")
+    print("lint: surface (untrusted wire input must raise a classified")
+    print("lint: error type — see io/kafka_codec.py CorruptFrameError):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (io/ decode surface raises only classified error types)")
+EOF
