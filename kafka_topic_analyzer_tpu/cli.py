@@ -146,6 +146,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(implies --quantiles)")
     p.add_argument("--mesh", metavar="DATA[,SPACE]", default="1",
                    help="Device mesh shape: data shards[, space shards]")
+    p.add_argument("--ingest-workers", default="1", metavar="N|auto",
+                   help="Parallel partition-sharded ingest for one scan: "
+                        "shard the partition set over N private "
+                        "fetch+decode+pack worker threads feeding the "
+                        "backend through a deterministic fan-in — results "
+                        "stay byte-identical to the sequential scan. "
+                        "'auto' sizes from the host (min(cores-1, "
+                        "partitions)). Default: 1. Requires --mesh 1 "
+                        "(sharded-mesh scans already run one ingest "
+                        "stream per data shard)")
     p.add_argument("--pallas", action="store_true",
                    help="Use the Pallas MXU counter kernel for the "
                         "per-partition counters (tpu backend; requires "
@@ -340,6 +350,31 @@ def wrap_with_dump(args, topic: str, source):
 
 
 
+def resolve_ingest_workers(args, mesh_shape, num_partitions) -> int:
+    """Parse + validate --ingest-workers against the mesh (shared by the
+    single- and multi-topic paths).  Returns the concrete worker count
+    after 'auto'/partition-count resolution."""
+    from kafka_topic_analyzer_tpu.config import IngestConfig
+
+    cfg = IngestConfig.parse(args.ingest_workers)
+    if mesh_shape != (1, 1):
+        # ANY non-trivial mesh (data- OR space-sharded) routes through the
+        # sharded backend's update_shards scan path, which runs its own
+        # per-data-shard ingest streams.  An EXPLICIT N>1 request is a
+        # contradiction — reject rather than silently underdeliver.
+        # 'auto' means "size appropriately", and under a mesh the
+        # appropriate count is 1; deciding on the RESOLVED value instead
+        # would make `--mesh 2 --ingest-workers auto` pass on a 1-core CI
+        # box and error on a many-core host.
+        if cfg.workers != "auto" and int(cfg.workers) > 1:
+            raise ValueError(
+                "--ingest-workers requires --mesh 1 (the sharded-mesh "
+                "scan path runs one ingest stream per data shard instead)"
+            )
+        return 1
+    return cfg.resolve(num_partitions)
+
+
 def _print_stats(args, result) -> None:
     """--stats stderr dump: per-stage profile + the telemetry counter
     digest (cluster-wide under multi-controller)."""
@@ -349,7 +384,11 @@ def _print_stats(args, result) -> None:
 
     print("scan stages:", file=sys.stderr)
     print(result.profile.summary(), file=sys.stderr)
-    sys.stderr.write(render_telemetry_stats(result.telemetry))
+    sys.stderr.write(
+        render_telemetry_stats(
+            result.telemetry, ingest_workers=result.ingest_workers
+        )
+    )
 
 
 def _not_report_process(args) -> bool:
@@ -460,6 +499,9 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             mesh_shape=mesh_shape,
             use_pallas_counters=args.pallas,
         )
+        ingest_workers = resolve_ingest_workers(
+            args, mesh_shape, len(multi.partitions())
+        )
     backend = _make_cli_backend(args, config, mesh_shape)
 
     banner_out = sys.stderr if args.json else sys.stdout
@@ -477,6 +519,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             snapshot_every_s=args.snapshot_every,
             resume=args.resume,
             start_at=start_at,
+            ingest_workers=ingest_workers,
         )
     _print_stats(args, result)
     multi.close()  # flush per-topic segment dumps, release connections
@@ -497,7 +540,11 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     if args.json:
         import json
 
-        doc: dict = {"topics": {}, "duration_secs": result.duration_secs}
+        doc: dict = {
+            "topics": {},
+            "duration_secs": result.duration_secs,
+            "ingest_workers": result.ingest_workers,
+        }
         for topic, sliced, start, end in slices:
             doc["topics"][topic] = sliced.to_dict(start, end)
         union_doc = {
@@ -631,6 +678,9 @@ def _run(args) -> int:
             mesh_shape=mesh_shape,
             use_pallas_counters=args.pallas,
         )
+        ingest_workers = resolve_ingest_workers(
+            args, mesh_shape, len(source.partitions())
+        )
 
     from kafka_topic_analyzer_tpu.engine import run_scan
     from kafka_topic_analyzer_tpu.report import render_report
@@ -653,6 +703,7 @@ def _run(args) -> int:
             snapshot_every_s=args.snapshot_every,
             resume=args.resume,
             start_at=start_at,
+            ingest_workers=ingest_workers,
         )
     _print_stats(args, result)
     if hasattr(source, "close"):
@@ -669,6 +720,7 @@ def _run(args) -> int:
         doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)
         doc["topic"] = args.topic
         doc["duration_secs"] = result.duration_secs
+        doc["ingest_workers"] = result.ingest_workers
         doc["telemetry"] = result.telemetry
         rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))

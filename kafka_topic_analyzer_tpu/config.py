@@ -68,6 +68,67 @@ class TransportRetryConfig:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """Single-scan parallel-ingest sizing (``--ingest-workers``).
+
+    Like `TransportRetryConfig`, deliberately NOT part of `AnalyzerConfig`:
+    how many host threads feed the device changes neither state shapes nor
+    fold semantics (the fan-in merge is exact — DESIGN.md §11), so it must
+    not churn the checkpoint fingerprint.  A snapshot taken by an N-worker
+    scan resumes under any other worker count.
+    """
+
+    #: ``1`` = the sequential path (today's default), ``N`` = that many
+    #: partition-sharded ingest workers, ``"auto"`` = size from the host:
+    #: min(cores - 1, partitions), keeping one core for the merge loop +
+    #: device dispatch.
+    workers: "int | str" = 1
+
+    def __post_init__(self) -> None:
+        if isinstance(self.workers, str):
+            if self.workers != "auto":
+                raise ValueError(
+                    f"ingest workers {self.workers!r} invalid "
+                    "(a positive integer, or 'auto')"
+                )
+        elif self.workers < 1:
+            raise ValueError("ingest workers must be >= 1")
+
+    @classmethod
+    def parse(cls, text: str) -> "IngestConfig":
+        """CLI spelling: a positive integer or ``auto``."""
+        if text.strip().lower() == "auto":
+            return cls(workers="auto")
+        try:
+            n = int(text)
+        except ValueError:
+            raise ValueError(
+                f"bad --ingest-workers {text!r}: expected a positive "
+                "integer or 'auto'"
+            ) from None
+        return cls(workers=n)
+
+    def resolve(self, num_partitions: int) -> int:
+        """Concrete worker count for a topic with ``num_partitions``
+        partitions (workers beyond the partition count would sit idle —
+        each partition lives in exactly one worker)."""
+        import os
+
+        if self.workers == "auto":
+            # Cores this process may actually RUN on: in a cgroup/affinity
+            # -limited container os.cpu_count() reports the host's cores,
+            # and sizing from it would oversubscribe badly.
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except AttributeError:  # non-Linux
+                cores = os.cpu_count() or 1
+            want = max(1, cores - 1)
+        else:
+            want = int(self.workers)
+        return max(1, min(want, num_partitions))
+
+
 #: Valid --on-corruption policies, in escalation order.
 CORRUPTION_POLICIES = ("fail", "skip", "quarantine")
 

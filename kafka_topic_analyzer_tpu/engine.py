@@ -83,6 +83,11 @@ class ScanResult:
     #: registry, so the report process can render fleet totals
     #: (``--stats``) and ``--json`` can embed them (``telemetry`` block).
     telemetry: "Optional[dict]" = None
+    #: Parallel-ingest worker threads the scan actually ran (after
+    #: clamping to the partition count); 1 = the sequential path.  The
+    #: ``--stats`` digest and ``--json`` report surface it so a recorded
+    #: throughput number always carries its parallelism.
+    ingest_workers: int = 1
 
 
 class _ProgressTracker:
@@ -124,6 +129,7 @@ def run_scan(
     start_at: "Optional[dict[int, int]]" = None,
     tracer=None,
     heartbeat_every_s: float = 10.0,
+    ingest_workers: int = 1,
 ) -> ScanResult:
     """Full earliest→latest scan of the topic through the backend.
 
@@ -137,7 +143,14 @@ def run_scan(
     Chrome trace; scan metrics/events flow to the default obs registry and
     event bus unconditionally (both are no-ops until a sink/exporter
     attaches), with per-partition lag/ETA gauges refreshed at the
-    ``heartbeat_every_s`` cadence."""
+    ``heartbeat_every_s`` cadence.
+
+    ``ingest_workers`` > 1 shards the partition set over that many private
+    fetch→decode→pack worker streams feeding the single-device backend
+    through a deterministic round-robin fan-in (parallel/ingest.py) —
+    results stay byte-identical to the sequential scan (DESIGN.md §11).
+    Clamped to the partition count; ignored (with a warning) on sharded
+    backends, which already run one ingest stream per data shard."""
     pindex = PartitionIndex(source.partitions())
     start_offsets, end_offsets = source.watermarks()
     if tracer is None:
@@ -326,8 +339,17 @@ def run_scan(
             return b  # nothing to rewrite; safe to alias
         return dataclasses.replace(b, partition=pindex.to_dense(b.partition))
 
+    used_workers = 1
     try:
         if hasattr(backend, "update_shards"):
+            if ingest_workers > 1:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "--ingest-workers ignored on a sharded backend (the "
+                    "sharded scan already runs one ingest stream per data "
+                    "shard)"
+                )
             # Sharded scan: one batch stream per data shard, each restricted
             # to its own partitions (records.py ordering contract), zipped so
             # every device step carries one full batch per shard.  Under
@@ -421,20 +443,49 @@ def run_scan(
             # copy carrying the dense ids instead.  Prefetch depth bounds
             # the in-flight device buffers.
             prepare = getattr(backend, "prepare", None)
-
-            def _with_staging(it):
-                if prepare is None:
-                    return ((b, None) for b in it)
-                return ((b, prepare(_dense_copy(b))) for b in it)
-
-            batches = _closing(
-                prefetch(
-                    _with_staging(
-                        source.batches(batch_size, start_at=start_at)
-                    ),
-                    prefetch_depth,
-                )
+            stage = (
+                (lambda b: prepare(_dense_copy(b)))
+                if prepare is not None
+                else None
             )
+            used_workers = max(1, min(int(ingest_workers), len(pindex)))
+            if used_workers > 1:
+                # Partition-sharded parallel ingest (--ingest-workers): N
+                # private fetch→decode→pack streams, merged through a
+                # deterministic round-robin fan-in.  Yields the same
+                # (batch, staged) items as the prefetch path below, so the
+                # bookkeeping loop is shared — and the fold order is a pure
+                # function of the inputs, keeping results byte-identical to
+                # the sequential scan (DESIGN.md §11).
+                from kafka_topic_analyzer_tpu.parallel.ingest import (
+                    ParallelIngest,
+                    shard_partitions,
+                )
+
+                batches = _closing(
+                    ParallelIngest(
+                        source,
+                        batch_size,
+                        shard_partitions(pindex.ids, used_workers),
+                        start_at=start_at,
+                        stage=stage,
+                        depth=max(prefetch_depth, 1),
+                    )
+                )
+            else:
+                from kafka_topic_analyzer_tpu.parallel.ingest import (
+                    iter_staged,
+                )
+
+                batches = _closing(
+                    prefetch(
+                        iter_staged(
+                            source.batches(batch_size, start_at=start_at),
+                            stage,
+                        ),
+                        prefetch_depth,
+                    )
+                )
             while True:
                 with profile.stage("ingest"):
                     item = next(batches, None)
@@ -581,4 +632,5 @@ def run_scan(
         degraded_partitions=degraded,
         corrupt_partitions=corrupt,
         telemetry=telemetry,
+        ingest_workers=used_workers,
     )

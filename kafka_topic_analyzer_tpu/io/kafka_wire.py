@@ -424,7 +424,10 @@ class KafkaWireSource(RecordSource):
         #: partition -> (anchor, kind, rounds) of the span awaiting its
         #: disambiguating re-fetch.  ``rounds`` bounds the cycle: a link
         #: that corrupts every response *differently* at the same position
-        #: (so the kind never matches) must not re-fetch forever.
+        #: (so the kind never matches) must not re-fetch forever.  A
+        #: partition lives in exactly one stream, so entries are disjoint
+        #: across workers — but the DICT is shared, so mutation stays
+        #: under _corrupt_lock like the spans map.
         self._corrupt_suspects: "Dict[int, Tuple[int, str, int]]" = {}
         self._corrupt_lock = threading.Lock()
         # librdkafka-name knobs this client honors (others warned+ignored).
@@ -544,13 +547,23 @@ class KafkaWireSource(RecordSource):
         self._watermarks: Optional[Tuple[Dict[int, int], Dict[int, int]]] = None
         #: partition -> reason, for every partition dropped from a scan
         #: after exhausting its transport/protocol retry budget.  Sharded
-        #: scans run several batches() streams against one source, so this
-        #: accumulates across streams; the engine snapshots it per scan.
+        #: scans AND parallel-ingest workers (parallel/ingest.py) run
+        #: several batches() streams against one source, so this
+        #: accumulates across streams (each partition belongs to exactly
+        #: one stream, but the dict is shared: writes hold
+        #: _degraded_lock); the engine snapshots it per scan.
         self.degraded: Dict[int, str] = {}
+        self._degraded_lock = threading.Lock()
+        #: Serializes the read-modify-write growth of partition_max_bytes:
+        #: concurrent streams each learning "batch exceeds fetch size"
+        #: must not lose each other's doubling.  Reads stay lock-free —
+        #: a stale size only costs one extra growth round.
+        self._fetch_grow_lock = threading.Lock()
         self._load_metadata()
 
     def degraded_partitions(self) -> Dict[int, str]:
-        return dict(self.degraded)
+        with self._degraded_lock:
+            return dict(self.degraded)
 
     # -- corruption accounting ------------------------------------------------
 
@@ -613,9 +626,9 @@ class KafkaWireSource(RecordSource):
         key = (p, anchor)
         with self._corrupt_lock:
             known = self._corrupt_spans.get(key)
+            prev = self._corrupt_suspects.get(p)
         if known is not None:
             return int(known["skip_to"])  # seeded/already-skipped span
-        prev = self._corrupt_suspects.get(p)
         rounds = prev[2] + 1 if prev is not None and prev[0] == anchor else 1
         deterministic = (
             prev is not None
@@ -630,7 +643,8 @@ class KafkaWireSource(RecordSource):
             # (the common case) settles it in one round; a link that
             # mutates the damage differently every round is settled by the
             # rounds bound instead of re-fetching forever.
-            self._corrupt_suspects[p] = (anchor, err.kind, rounds)
+            with self._corrupt_lock:
+                self._corrupt_suspects[p] = (anchor, err.kind, rounds)
             obs_metrics.CORRUPT_REFETCHES.inc()
             obs_events.emit(
                 "corrupt_suspect", partition=p, anchor=anchor, kind=err.kind
@@ -643,7 +657,8 @@ class KafkaWireSource(RecordSource):
             return None
         # Identical failure on the re-fetched bytes (or the re-fetch
         # budget ran out): deterministic corruption.  Apply policy.
-        self._corrupt_suspects.pop(p, None)
+        with self._corrupt_lock:
+            self._corrupt_suspects.pop(p, None)
         err.partition = p
         if self.corruption.policy == "fail":
             raise err
@@ -999,9 +1014,13 @@ class KafkaWireSource(RecordSource):
         start_at: Optional[Dict[int, int]] = None,
     ) -> Iterator[RecordBatch]:
         # Fetch connections are private to this iterator: sharded scans
-        # run one batches() stream per shard from worker threads, and the
-        # pipelined send/read halves cannot share a socket with another
-        # stream (responses would be claimed by the wrong reader).
+        # and parallel ingest (parallel/ingest.py) run one batches()
+        # stream per shard/worker from worker threads, and the pipelined
+        # send/read halves cannot share a socket with another stream
+        # (responses would be claimed by the wrong reader).  Everything
+        # scan-shared that a stream can mutate — degraded, the corruption
+        # spans/suspects, partition_max_bytes growth — is lock-guarded;
+        # per-stream state (offsets, streaks, inflight) lives below.
         own_conns: Dict[int, BrokerConnection] = {}
         pools: "list" = []
         try:
@@ -1121,8 +1140,9 @@ class KafkaWireSource(RecordSource):
             if p not in remaining:
                 return
             log.error("partition %d degraded: %s", p, reason)
-            remaining.discard(p)
-            self.degraded[p] = reason
+            remaining.discard(p)  # stream-local (this worker's partitions)
+            with self._degraded_lock:  # scan-shared across worker streams
+                self.degraded[p] = reason
             obs_events.emit("partition_degraded", partition=p, reason=reason)
         # Consecutive fetches for a partition that neither consumed records
         # nor advanced the offset (possible under response-budget pressure
@@ -1681,10 +1701,14 @@ class KafkaWireSource(RecordSource):
                                         f"{pmax_sent}",
                                     )
                                     continue
-                                self.partition_max_bytes = min(
-                                    max(self.partition_max_bytes, pmax_sent * 2),
-                                    MAX_PARTITION_FETCH_BYTES,
-                                )
+                                with self._fetch_grow_lock:
+                                    self.partition_max_bytes = min(
+                                        max(
+                                            self.partition_max_bytes,
+                                            pmax_sent * 2,
+                                        ),
+                                        MAX_PARTITION_FETCH_BYTES,
+                                    )
                                 log.warning(
                                     "partition %d: batch exceeds fetch size,"
                                     " growing max.partition.fetch.bytes to %d",

@@ -56,6 +56,22 @@ DEGRADED_PARTITIONS = _REG.gauge(
     # disjoint), so the cluster-wide figure is the sum, not the max.
     merge="sum")
 
+# -- parallel ingest (parallel/ingest.py) -------------------------------------
+
+INGEST_QUEUE_DEPTH = _REG.gauge(
+    "kta_ingest_queue_depth",
+    "Staged batches waiting in the parallel-ingest fan-in queues "
+    "(all workers; 0 when the merge loop keeps up)")
+INGEST_WORKER_RECORDS = _REG.counter(
+    "kta_ingest_worker_records_total",
+    "Valid records produced per parallel-ingest worker",
+    labelnames=("worker",))
+INGEST_WORKER_STALL_SECONDS = _REG.counter(
+    "kta_ingest_worker_stall_seconds_total",
+    "Seconds each parallel-ingest worker spent blocked on its full "
+    "fan-in queue (backpressure from the merge loop/device)",
+    labelnames=("worker",))
+
 # -- io/kafka_wire ------------------------------------------------------------
 
 FETCH_REQUESTS = _REG.counter(

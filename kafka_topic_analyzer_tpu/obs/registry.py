@@ -16,6 +16,22 @@ Three representations, one source of truth:
 - ``render_prometheus(snapshot)`` — Prometheus text exposition v0.0.4,
   served by ``obs.exporters.PrometheusExporter``.
 
+Thread-safety (audited for N-ingest-worker scans, where the wire counters
+and per-worker instruments are hit from several threads concurrently —
+tests/test_obs.py has the hammer):
+
+- every mutation of an instrument's numeric state (``inc``/``set``/
+  ``observe``/``_reset_values``) holds that instrument's own lock, so
+  concurrent writers never lose updates;
+- child creation (``labels``) and registry get-or-create hold the family/
+  registry lock; the child *lookup* is deliberately lock-free (a CPython
+  dict read is atomic under the GIL, children are only ever added) so the
+  per-observation cost on labeled hot paths is one dict get, not a shared
+  lock acquire per worker per batch;
+- ``reset()`` is NOT atomic with respect to concurrent traffic (children
+  can be re-created mid-reset); it is a test-isolation helper, called only
+  between scans.
+
 Merge semantics (multi-controller aggregation, parallel/sharded.py):
 counters and histograms are additive; gauges take the elementwise max by
 default (per-partition gauges carry disjoint label sets across processes,
@@ -87,6 +103,14 @@ class _Instrument:
             raise ValueError(
                 f"{self.name}: expected labels {self.labelnames}, got {values}"
             )
+        # Lock-free fast path: children are only ever ADDED (reset() is
+        # confined to between-scan test isolation), and a CPython dict get
+        # is atomic — so the steady-state labeled hot path (per-worker
+        # counters, per-partition gauges) costs one dict lookup instead of
+        # serializing every ingest worker on the family lock.
+        child = self._children.get(values)
+        if child is not None:
+            return child
         with self._lock:
             child = self._children.get(values)
             if child is None:

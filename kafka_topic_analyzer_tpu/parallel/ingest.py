@@ -1,0 +1,291 @@
+"""Partition-sharded parallel ingest for a SINGLE scan.
+
+The reference consumes a topic strictly sequentially (src/kafka.rs:74-137),
+and the single-device scan path used to as well: one ``batches()`` stream
+feeding the device through a depth-2 prefetch thread, which caps a scan at
+the one-core host-ingest ceiling (BENCH_NOTES.md round 5: ~3.2-3.7M rec/s).
+The same round's multi-stream measurement showed the GIL share stays flat
+as streams are added (the native fetch/decode/pack path releases the GIL),
+so the way past the ceiling is more ingest *threads*, not faster ones.
+
+This module runs N of them inside one scan:
+
+- the partition set is sharded into N disjoint groups
+  (``shard_partitions`` — same round-robin rule as the mesh's data-shard
+  assignment, so skew balancing matches parallel/mesh.py);
+- each group gets a private ``source.batches()`` stream on its own worker
+  thread (the wire layer guarantees per-stream connection privacy, so
+  workers never share a socket), which also stages decode→remap→pack so
+  the native GIL-releasing work parallelizes;
+- workers push (batch, staged) pairs into bounded per-worker queues
+  (backpressure = queue depth, the prefetch contract's ``prefetch_depth``);
+- the consuming thread merges the queues in a DETERMINISTIC round-robin
+  order (worker 0, 1, ..., N-1, 0, ... — exhausted workers drop out of the
+  rotation).
+
+Why the merge can be any fixed order at all: every fold the backend runs is
+associative and commutative ACROSS partitions (counters add, min/max and
+HLL registers merge by max, DDSketch rows add), and the only
+order-sensitive fold — last-writer-wins alive-key tracking — is
+order-sensitive strictly WITHIN a partition, whose records all travel in
+one worker's stream in offset order.  So the N-worker scan's ``ScanResult``
+is byte-identical to the 1-worker scan's (DESIGN.md §11), checkpoints stay
+fold-consistent per partition (each partition lives in exactly one worker,
+``next_offset`` semantics unchanged), and the chaos/corruption policies of
+PRs 1-3 compose per worker: degraded/corrupt partitions aggregate on the
+shared source exactly as they do for sharded multi-stream scans.
+
+Thread-safety rule for this module (enforced by tools/lint.sh): worker
+code paths (anything that runs on an ``_IngestWorker`` thread) never
+mutate scan-shared dict/list/set state without holding a lock — shared
+mutable state is either confined to the consumer thread (the merge loop)
+or crosses threads only through the per-worker ``queue.Queue``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from kafka_topic_analyzer_tpu.io.source import RecordSource
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+_SENTINEL = object()
+
+
+class _Error:
+    """Exception envelope (mirrors utils/prefetch.py): raised on the
+    consumer side at the failed worker's position in the rotation."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def shard_partitions(partitions: List[int], workers: int) -> List[List[int]]:
+    """Disjoint round-robin partition groups, one per worker — LITERALLY
+    the mesh data axis' assignment rule (delegated, so a future
+    skew-aware change there cannot desynchronize worker sharding from
+    mesh sharding).  Empty groups are dropped (callers clamp ``workers``
+    to the partition count first, but a caller that does not must still
+    get only live workers)."""
+    if workers < 1:
+        raise ValueError("workers must be >= 1")
+    from kafka_topic_analyzer_tpu.parallel.mesh import assign_partitions
+
+    return [g for g in assign_partitions(partitions, workers) if g]
+
+
+class _IngestWorker(threading.Thread):
+    """One worker: a private ``source.batches()`` stream for one partition
+    group, staged (pack + host→device transfer start) on this thread, fed
+    into a bounded queue.  Mirrors the prefetch contract: errors travel to
+    the consumer as `_Error`, exhaustion as a sentinel, and close-on-exit
+    drains the thread AND closes the underlying generator."""
+
+    def __init__(
+        self,
+        wid: int,
+        source: RecordSource,
+        batch_size: int,
+        group: List[int],
+        start_at: "Optional[Dict[int, int]]",
+        stage: "Optional[Callable[[RecordBatch], object]]",
+        depth: int,
+        cancel: threading.Event,
+    ):
+        super().__init__(daemon=True, name=f"kta-ingest-{wid}")
+        self.wid = wid
+        self.group = list(group)
+        self.queue: "queue.Queue[object]" = queue.Queue(maxsize=max(depth, 1))
+        self._stage = stage
+        self._cancel = cancel
+        # The generator object is created here (cheap — the body only runs
+        # on first next()) so close() can reach it even if the thread never
+        # gets scheduled; only this thread ever *advances* it.
+        self._it = source.batches(
+            batch_size, partitions=self.group, start_at=start_at
+        )
+        self._source_closed = False
+        self._stall = obs_metrics.INGEST_WORKER_STALL_SECONDS.labels(
+            worker=wid
+        )
+
+    def _put(self, item: object) -> bool:
+        """Bounded put; gives up when the consumer cancelled.  Time spent
+        blocked on a full queue is the worker's backpressure stall — booked
+        per worker so ``--stats``/Prometheus show which shard outruns the
+        device."""
+        if self._cancel.is_set():
+            # Checked BEFORE the fast path (mirroring prefetch._put): an
+            # aborting close() drains the queues, and a cancelled worker
+            # must not slip items into the fresh space and keep fetching/
+            # staging dead work for up to `depth` more rounds.
+            return False
+        try:
+            self.queue.put_nowait(item)
+            return True
+        except queue.Full:
+            pass
+        t0 = time.perf_counter()
+        try:
+            while not self._cancel.is_set():
+                try:
+                    self.queue.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+        finally:
+            self._stall.inc(time.perf_counter() - t0)
+
+    def run(self) -> None:
+        try:
+            for batch in self._it:
+                staged = self._stage(batch) if self._stage is not None else None
+                if not self._put((batch, staged)):
+                    return  # cancelled; finally closes the source stream
+        except BaseException as e:
+            self._put(_Error(e))
+            return
+        finally:
+            if self._cancel.is_set():
+                self.close_source()
+        self._put(_SENTINEL)
+
+    def close_source(self) -> None:
+        """Close the underlying batches() generator (GeneratorExit unwinds
+        its finally blocks, releasing the stream's private connections).
+        Called from the owning thread on cancel, or from ``close()`` after
+        the thread has exited (a generator can only be closed while no
+        thread is executing it)."""
+        if self._source_closed:
+            return
+        self._source_closed = True
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # a dying stream must not mask the real error
+                pass
+
+
+class ParallelIngest:
+    """Fan-in over N ingest workers with a deterministic round-robin merge.
+
+    Iterating yields ``(batch, staged)`` exactly like the staged prefetch
+    stream the single-worker path consumes, so the engine's bookkeeping
+    loop is identical for N=1 and N>1.  ``close()`` mirrors the prefetch
+    close-on-exit contract: cancel, drain, join, and release every
+    worker's underlying stream — the engine calls it from its ``finally``
+    so errors and interrupts never leak threads or connections.
+    """
+
+    def __init__(
+        self,
+        source: RecordSource,
+        batch_size: int,
+        groups: List[List[int]],
+        start_at: "Optional[Dict[int, int]]" = None,
+        stage: "Optional[Callable[[RecordBatch], object]]" = None,
+        depth: int = 2,
+    ):
+        if not groups:
+            raise ValueError("parallel ingest needs at least one group")
+        self._cancel = threading.Event()
+        self.workers = [
+            _IngestWorker(
+                w, source, batch_size, g, start_at, stage, depth, self._cancel
+            )
+            for w, g in enumerate(groups)
+        ]
+        #: Rotation position and per-worker liveness for the merge.
+        self._rr = 0
+        self._alive = [True] * len(self.workers)
+        self._alive_count = len(self.workers)
+        self._closed = False
+        for w in self.workers:
+            w.start()
+
+    def __iter__(self) -> "ParallelIngest":
+        return self
+
+    def __next__(self) -> "Tuple[RecordBatch, object]":
+        # Deterministic rotation: always poll workers in index order,
+        # blocking on each worker's own queue until it produces or
+        # finishes.  Given deterministic per-worker streams this makes the
+        # merged fold order a pure function of the inputs — N-worker runs
+        # reproduce each other exactly, not just statistically.
+        while self._alive_count:
+            w = self.workers[self._rr]
+            if not self._alive[self._rr]:
+                self._rr = (self._rr + 1) % len(self.workers)
+                continue
+            item = w.queue.get()
+            if item is _SENTINEL:
+                self._alive[self._rr] = False
+                self._alive_count -= 1
+                self._rr = (self._rr + 1) % len(self.workers)
+                continue
+            if isinstance(item, _Error):
+                # One worker died: the scan aborts (the engine's failure
+                # path snapshots committed progress and its finally calls
+                # close(), cancelling the surviving workers).
+                self._alive[self._rr] = False
+                self._alive_count -= 1
+                raise item.exc
+            self._rr = (self._rr + 1) % len(self.workers)
+            batch, staged = item
+            obs_metrics.INGEST_WORKER_RECORDS.labels(worker=w.wid).inc(
+                batch.num_valid
+            )
+            obs_metrics.INGEST_QUEUE_DEPTH.set(self.queue_depth())
+            return batch, staged
+        raise StopIteration
+
+    def queue_depth(self) -> int:
+        """Total staged batches waiting in the fan-in (all workers)."""
+        return sum(w.queue.qsize() for w in self.workers)
+
+    def close(self) -> None:
+        """Stop every worker and release their streams.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._cancel.set()
+        # Drain so blocked workers observe the cancel promptly (their puts
+        # poll the event between bounded-put timeouts).
+        for w in self.workers:
+            try:
+                while True:
+                    w.queue.get_nowait()
+            except queue.Empty:
+                pass
+        # One SHARED deadline across all joins: N workers blocked in
+        # broker I/O must cost ~5s of shutdown latency total, not N x 5s.
+        deadline = time.monotonic() + 5.0
+        for w in self.workers:
+            w.join(timeout=max(0.0, deadline - time.monotonic()))
+        for w in self.workers:
+            if not w.is_alive():
+                # The thread exited without running its cancel-path close
+                # (error, exhaustion, or cancel won the race after the
+                # loop): close the generator from here — safe now that no
+                # thread is executing it.
+                w.close_source()
+        obs_metrics.INGEST_QUEUE_DEPTH.set(0)
+
+
+def iter_staged(
+    it: "Iterator[RecordBatch]",
+    stage: "Optional[Callable[[RecordBatch], object]]",
+) -> "Iterator[Tuple[RecordBatch, object]]":
+    """Single-worker staging adapter: the same (batch, staged) item shape
+    ParallelIngest yields, for the N=1 path's prefetch worker."""
+    if stage is None:
+        return ((b, None) for b in it)
+    return ((b, stage(b)) for b in it)

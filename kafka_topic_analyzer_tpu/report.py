@@ -158,7 +158,9 @@ def _metric_total(snapshot: Dict, name: str) -> float:
     return sum(s.get("value", 0.0) for s in metric["samples"])
 
 
-def render_telemetry_stats(snapshot: Optional[Dict]) -> str:
+def render_telemetry_stats(
+    snapshot: Optional[Dict], ingest_workers: int = 1
+) -> str:
     """``--stats`` telemetry section from a registry snapshot (cluster-wide
     under multi-controller: the engine merges every process's registry
     before this renders).  Counter-only digest — the full instrument set,
@@ -202,6 +204,23 @@ def render_telemetry_stats(snapshot: Optional[Dict]) -> str:
             f"partitions"
         ),
     ]
+    # Parallelism context for every throughput number above: worker count
+    # always, the per-worker split when the scan actually ran parallel
+    # (sequential scans never touch the per-worker instruments).
+    from kafka_topic_analyzer_tpu.results import IngestStats
+
+    ingest = IngestStats.from_telemetry(snapshot)
+    line = f"  ingest: {ingest_workers} worker(s)"
+    if ingest.workers:
+        per = ", ".join(
+            f"w{w} {n:,}" + (
+                f" (stalled {ingest.stalls[w]:.1f}s)"
+                if ingest.stalls.get(w, 0) >= 0.05 else ""
+            )
+            for w, n in sorted(ingest.workers.items(), key=lambda kv: int(kv[0]))
+        )
+        line += f" — records {per}"
+    lines.append(line)
     return "\n".join(lines) + "\n"
 
 

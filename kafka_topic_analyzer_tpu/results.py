@@ -57,6 +57,42 @@ class QuantileSummary:
 
 
 @dataclasses.dataclass
+class IngestStats:
+    """Parallel-ingest accounting extracted from a telemetry snapshot
+    (`ScanResult.telemetry`): per-worker record counts and backpressure
+    stalls.  Consumed by the ``--stats`` digest (report.py) and the
+    round-6 ingest benchmark; empty (``workers == {}``) for sequential
+    scans, which never touch the per-worker instruments."""
+
+    #: worker label -> valid records that worker produced.
+    workers: "Dict[str, int]"
+    #: worker label -> seconds blocked on a full fan-in queue.
+    stalls: "Dict[str, float]"
+
+    @classmethod
+    def from_telemetry(cls, snapshot: "Optional[dict]") -> "IngestStats":
+        def by_worker(name: str) -> "Dict[str, float]":
+            metric = (snapshot or {}).get(name)
+            if not metric:
+                return {}
+            return {
+                s["labels"]["worker"]: s["value"]
+                for s in metric["samples"]
+                if "worker" in s.get("labels", {})
+            }
+
+        return cls(
+            workers={
+                w: int(v)
+                for w, v in by_worker(
+                    "kta_ingest_worker_records_total"
+                ).items()
+            },
+            stalls=by_worker("kta_ingest_worker_stall_seconds_total"),
+        )
+
+
+@dataclasses.dataclass
 class TopicMetrics:
     """Finalized topic metrics.
 

@@ -39,6 +39,7 @@ class PrefetchIterator:
         self._q: "queue.Queue[object]" = queue.Queue(maxsize=depth)
         self._it = it
         self._cancel = threading.Event()
+        self._source_closed = False
         self._thread = threading.Thread(target=self._fill, daemon=True)
         self._thread.start()
 
@@ -52,6 +53,21 @@ class PrefetchIterator:
                 continue
         return False
 
+    def _close_source(self) -> None:
+        """Throw GeneratorExit into the wrapped iterator (idempotent) so
+        its finally blocks run — sources hold real resources (the wire
+        client's per-stream broker connections).  Only called while no
+        thread is executing the generator: from the worker after its loop
+        exits, or from ``close()`` after the worker thread is gone."""
+        if self._source_closed:
+            return
+        self._source_closed = True
+        if hasattr(self._it, "close"):
+            try:
+                self._it.close()
+            except Exception:
+                pass  # a dying source must not mask the scan's real error
+
     def _fill(self) -> None:
         try:
             for item in self._it:
@@ -61,14 +77,15 @@ class PrefetchIterator:
             self._put(_Error(e))
             return
         finally:
-            if self._cancel.is_set() and hasattr(self._it, "close"):
-                self._it.close()  # close the abandoned generator
+            if self._cancel.is_set():
+                self._close_source()  # close the abandoned generator
         self._put(_SENTINEL)
 
     def close(self) -> None:
         """Stop the worker and release the wrapped iterator.  Safe to call
         multiple times; the engine calls it from a finally so early exits
-        (errors, interrupts) never leak the thread or its connections."""
+        (errors, interrupts) never leak the thread, the underlying
+        generator, or its connections."""
         self._cancel.set()
         # Drain so a blocked worker can observe the cancel promptly.
         try:
@@ -77,6 +94,14 @@ class PrefetchIterator:
         except queue.Empty:
             pass
         self._thread.join(timeout=5.0)
+        if not self._thread.is_alive():
+            # The worker can exit without taking its cancel-path close: it
+            # already finished (exhaustion, error) before close() was
+            # called, or it lost the cancel race right after its loop.
+            # Either way the generator is quiescent now — close it HERE so
+            # an early consumer exit always unwinds the source's finally
+            # blocks, not just the worker thread.
+            self._close_source()
 
     def __iter__(self) -> "PrefetchIterator":
         return self

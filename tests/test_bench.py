@@ -111,3 +111,25 @@ def test_mesh_parse_error_names_the_flag():
         parse_mesh("0")
     with pytest.raises(ValueError, match=r"--mesh '-4,2'.*positive"):
         parse_mesh("-4,2")
+
+
+@pytest.mark.ingest
+def test_bench_ingest_workers_smoke(capsys):
+    """--workers N drives the real fan-in over a loopback broker and
+    reports aggregate + per-worker rates."""
+    import json as _json
+
+    from kafka_topic_analyzer_tpu.tools import bench_ingest
+
+    rc = bench_ingest.main([
+        "--records", "120000", "--records-per-batch", "512",
+        "--partitions", "4", "--batch-size", "4096",
+        "--repeat", "1", "--skip-drain", "--workers", "2",
+    ])
+    assert rc == 0
+    doc = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["workers"] == 2
+    assert doc["scan_msgs_per_sec"] > 0
+    assert set(doc["scan_worker_records"]) == {"0", "1"}
+    # windows = 120000 // (4 partitions * 512 rpb) = 58 -> 58*512*4 records
+    assert sum(doc["scan_worker_records"].values()) == 58 * 512 * 4

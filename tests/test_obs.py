@@ -396,3 +396,59 @@ def test_prometheus_exporter_serves_registry():
             )
     finally:
         exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# thread-safety under N ingest workers (parallel/ingest.py)
+
+
+@pytest.mark.ingest
+def test_registry_hammer_no_lost_updates():
+    """The multi-writer contract the parallel-ingest workers lean on:
+    concurrent inc/observe/labels() from N threads lose NOTHING — every
+    instrument's numeric state is guarded by its own lock, and the
+    lock-free labeled-child fast path never hands two threads distinct
+    children for the same label set."""
+    import threading
+
+    reg = MetricsRegistry()
+    counter = reg.counter("h_total", "hammered counter")
+    labeled = reg.counter("h_by_worker_total", "per-worker", labelnames=("w",))
+    gauge = reg.gauge("h_gauge", "hammered gauge")
+    hist = reg.histogram("h_hist", "hammered histogram", buckets=(1.0, 10.0))
+
+    N_THREADS, N_OPS = 8, 5_000
+    start = threading.Barrier(N_THREADS)
+    children = [None] * N_THREADS
+
+    def worker(t: int) -> None:
+        start.wait()
+        for i in range(N_OPS):
+            counter.inc()
+            labeled.labels(w=t % 2).inc(2)
+            gauge.inc(1)
+            hist.observe(float(i % 20))
+        # Same label values from every thread must resolve to ONE child.
+        children[t] = labeled.labels(w=t % 2)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(N_THREADS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert counter.value == N_THREADS * N_OPS
+    assert gauge.value == N_THREADS * N_OPS
+    snap = reg.snapshot()
+    by_w = {
+        s["labels"]["w"]: s["value"]
+        for s in snap["h_by_worker_total"]["samples"]
+    }
+    assert by_w == {"0": 2 * (N_THREADS // 2) * N_OPS,
+                    "1": 2 * (N_THREADS // 2) * N_OPS}
+    h = snap["h_hist"]["samples"][0]
+    assert h["count"] == N_THREADS * N_OPS
+    assert sum(h["counts"]) == N_THREADS * N_OPS
+    assert children[0] is children[2]  # fast path: one child per label set

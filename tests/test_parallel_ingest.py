@@ -1,0 +1,475 @@
+"""Parallel partition-sharded ingest: determinism + fault composition.
+
+The tentpole contract (DESIGN.md §11): for any worker count N, a scan's
+`ScanResult` — metrics, degraded/corrupt maps, resume offsets — is
+byte-identical to the sequential (N=1) scan of the same topic.  That must
+hold not just for clean topics but COMPOSED with the resilience machinery
+of earlier PRs: transport faults (`FaultInjector` kills) and deterministic
+corruption (`CorruptionInjector` poison) landing inside one worker's
+partition group.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.config import (
+    AnalyzerConfig,
+    CorruptionConfig,
+    IngestConfig,
+)
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+from kafka_topic_analyzer_tpu.parallel.ingest import (
+    ParallelIngest,
+    shard_partitions,
+)
+
+from fake_broker import (
+    ChaosTrigger,
+    CorruptionInjector,
+    FakeBroker,
+    FakeCluster,
+    FaultInjector,
+)
+
+pytestmark = pytest.mark.ingest
+
+TOPIC = "pingest.topic"
+
+FAST_RETRY = {
+    "retry.backoff.ms": "5",
+    "reconnect.backoff.max.ms": "40",
+}
+
+
+def _mk_records(partition: int, n: int):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 29}".encode() if i % 5 else None,
+            bytes(20 + (i % 13)) if i % 7 else None,
+        )
+        for i in range(n)
+    ]
+
+
+N_PARTS = 4
+N_REC = 300
+RECORDS = {p: _mk_records(p, N_REC) for p in range(N_PARTS)}
+
+CFG = AnalyzerConfig(
+    num_partitions=N_PARTS, batch_size=128,
+    count_alive_keys=True, alive_bitmap_bits=16,
+)
+
+
+def _scan(source, workers=1, batch_size=128):
+    backend = CpuExactBackend(CFG, init_now_s=10**10)
+    result = run_scan(
+        TOPIC, source, backend, batch_size, ingest_workers=workers
+    )
+    close = getattr(source, "inner", source)
+    close.close()
+    return result
+
+
+def _full_doc(result) -> dict:
+    """EVERYTHING the determinism contract covers, in one comparable doc:
+    metrics, scan window, degraded/corrupt maps."""
+    return {
+        "metrics": result.metrics.to_dict(
+            result.start_offsets, result.end_offsets
+        ),
+        "start": result.start_offsets,
+        "end": result.end_offsets,
+        "degraded": result.degraded_partitions,
+        "corrupt": result.corrupt_partitions,
+    }
+
+
+# ---------------------------------------------------------------------------
+# unit: sharding + sizing
+
+
+def test_shard_partitions_disjoint_cover():
+    parts = [0, 2, 3, 7, 9, 11, 40]
+    for n in (1, 2, 3, 4, 7, 9):
+        groups = shard_partitions(parts, n)
+        assert len(groups) == min(n, len(parts))
+        flat = sorted(p for g in groups for p in g)
+        assert flat == sorted(parts)  # disjoint cover, nothing dropped
+        # Round-robin rule matches the mesh data-shard assignment.
+        if n <= len(parts):
+            assert groups[0][0] == 0
+    with pytest.raises(ValueError):
+        shard_partitions(parts, 0)
+
+
+def test_ingest_config_sizing():
+    assert IngestConfig.parse("3").resolve(64) == 3
+    assert IngestConfig.parse("8").resolve(4) == 4  # clamp to partitions
+    auto = IngestConfig.parse("auto").resolve(10**6)
+    # auto sizes from SCHEDULABLE cores (cgroup/affinity aware), one short.
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    assert auto == max(1, cores - 1)
+    assert IngestConfig.parse("auto").resolve(2) == 2 or auto == 1
+    with pytest.raises(ValueError):
+        IngestConfig.parse("0")
+    with pytest.raises(ValueError):
+        IngestConfig.parse("many")
+
+
+# ---------------------------------------------------------------------------
+# determinism: N workers == 1 worker, byte for byte
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """Sequential (N=1) fake-broker scan — the byte-exact referee."""
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        result = _scan(
+            KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+            )
+        )
+    assert not result.degraded_partitions
+    return _full_doc(result)
+
+
+@pytest.mark.parametrize("workers", [2, 3, 4])
+def test_n_workers_byte_identical_to_sequential(baseline, workers):
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        result = _scan(
+            KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+            ),
+            workers=workers,
+        )
+    assert result.ingest_workers == workers
+    assert _full_doc(result) == baseline
+
+
+def test_workers_beyond_partitions_clamp(baseline):
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        result = _scan(
+            KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+            ),
+            workers=99,
+        )
+    assert result.ingest_workers == N_PARTS
+    assert _full_doc(result) == baseline
+
+
+def test_parallel_synthetic_and_staged_backend_deterministic():
+    """Cluster-free determinism across worker counts AND the staged
+    (prepare-on-worker) path: the TPU backend packs on the ingest workers."""
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+
+    spec = SyntheticSpec(
+        num_partitions=5, messages_per_partition=1500,
+        keys_per_partition=31, seed=3,
+    )
+    cfg = AnalyzerConfig(
+        num_partitions=5, batch_size=256,
+        count_alive_keys=True, alive_bitmap_bits=16, enable_hll=True,
+    )
+
+    def doc(workers):
+        r = run_scan(
+            "t", SyntheticSource(spec), TpuBackend(cfg, init_now_s=10**10),
+            256, ingest_workers=workers,
+        )
+        return r.metrics.to_dict(r.start_offsets, r.end_offsets)
+
+    ref = doc(1)
+    for n in (2, 3, 5):
+        assert doc(n) == ref
+
+
+# ---------------------------------------------------------------------------
+# fault composition: chaos + corruption confined to one worker's partitions
+
+
+def test_transport_fault_in_one_worker_absorbed(baseline):
+    """A connection kill mid-scan (FaultInjector) lands on one worker's
+    stream; recovery must keep the N-worker result byte-identical."""
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        trigger = ChaosTrigger(
+            src, 2,
+            lambda: setattr(
+                broker, "faults",
+                FaultInjector().drop_connection(100, times=2),
+            ),
+        )
+        result = _scan(trigger, workers=3)
+    assert not result.degraded_partitions
+    assert _full_doc(result) == baseline
+
+
+def test_degraded_partition_in_one_worker_matches_sequential():
+    """Node 1 dies for good: its partitions degrade inside whichever worker
+    owns them, the other workers finish exact, and the whole ScanResult
+    (including the degraded map) matches the sequential scan under the
+    same fault plan."""
+
+    def run(workers):
+        armed = []
+
+        def arm_on_first_fetch(api_key: int, node_id: int) -> float:
+            if api_key == kc.API_FETCH and node_id == 1 and not armed:
+                armed.append(True)
+                cluster.nodes[1].faults = (
+                    FaultInjector()
+                    .drop_connection(0, times=10**6)
+                    .refuse_connections(times=10**6)
+                )
+            return 0.0
+
+        with FakeCluster(
+            TOPIC, RECORDS, n_nodes=2, max_records_per_fetch=60,
+            response_delay=arm_on_first_fetch,
+        ) as cluster:
+            src = KafkaWireSource(
+                cluster.bootstrap, TOPIC,
+                overrides=dict(
+                    FAST_RETRY,
+                    **{
+                        "transport.retry.budget": "3",
+                        "socket.timeout.ms": "500",
+                    },
+                ),
+            )
+            return _scan(src, workers=workers)
+
+    seq = run(1)
+    par = run(3)
+    # Reason strings embed each run's ephemeral broker port, so the
+    # cross-run comparison is structural: same partitions, same cause.
+    assert seq.degraded_partitions
+    assert set(par.degraded_partitions) == set(seq.degraded_partitions)
+    for p, reason in par.degraded_partitions.items():
+        assert "transport failures" in reason
+        assert "transport failures" in seq.degraded_partitions[p]
+    # Healthy partitions' rows byte-match; the degraded tail undercounts
+    # identically (the kill point is deterministic: first fetch to node 1).
+    sdoc, pdoc = _full_doc(seq), _full_doc(par)
+    healthy = [
+        str(p) for p in range(N_PARTS) if p not in seq.degraded_partitions
+    ]
+    for p in healthy:
+        assert pdoc["metrics"]["partitions"][p] == sdoc["metrics"]["partitions"][p]
+    assert pdoc["start"] == sdoc["start"] and pdoc["end"] == sdoc["end"]
+
+
+def test_corruption_in_one_worker_matches_sequential(tmp_path):
+    """Deterministic poison in partition 1's chunks (exactly one worker's
+    group under N=3) with --on-corruption=quarantine: metrics, the corrupt
+    accounting map, and the quarantine spool all match the sequential scan."""
+    def poisoned():
+        inj = (
+            CorruptionInjector()
+            .flip_byte(1, chunk=1, offset=-1)     # crc-mismatch
+            .flip_byte(1, chunk=3, offset=-3)     # crc-mismatch
+        )
+        return FakeBroker(
+            TOPIC, RECORDS, max_records_per_fetch=50, corruption=inj,
+            honor_partition_max_bytes=True,
+        )
+
+    def run(workers, qdir):
+        with poisoned() as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC,
+                overrides=dict(FAST_RETRY, **{"check.crcs": "true"}),
+                corruption=CorruptionConfig(
+                    policy="quarantine", quarantine_dir=qdir
+                ),
+            )
+            return _scan(src, workers=workers)
+
+    seq = run(1, str(tmp_path / "q1"))
+    par = run(3, str(tmp_path / "q3"))
+    assert set(seq.corrupt_partitions) == {1}
+    assert _full_doc(par) == _full_doc(seq)
+    spooled = sorted(os.listdir(tmp_path / "q3"))
+    assert spooled == sorted(os.listdir(tmp_path / "q1"))
+    assert len([f for f in spooled if f.endswith(".bin")]) == 2
+
+
+def test_snapshot_offsets_identical_across_worker_counts(tmp_path):
+    """Checkpoints stay fold-consistent per partition: the final snapshot's
+    resume offsets (and records_seen) are byte-identical for N=1 and N=3."""
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+
+    def snap_meta(workers, d):
+        with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+            )
+            run_scan(
+                TOPIC, src, TpuBackend(CFG, init_now_s=10**10), 128,
+                snapshot_dir=str(d), snapshot_every_s=0.0,
+                ingest_workers=workers,
+            )
+            src.close()
+        with np.load(
+            os.path.join(str(d), "scan_snapshot.npz"), allow_pickle=False
+        ) as z:
+            meta = json.loads(str(z["__meta__"]))
+        return meta["next_offsets"], meta["records_seen"]
+
+    assert snap_meta(1, tmp_path / "w1") == snap_meta(3, tmp_path / "w3")
+
+
+# ---------------------------------------------------------------------------
+# pool mechanics: error propagation, close-on-exit, metrics
+
+
+class _Boom(Exception):
+    pass
+
+
+class _ExplodingSource(SyntheticSource):
+    """batches() dies after 2 batches — but only the stream owning
+    ``bad_partition``; other workers' streams run clean."""
+
+    def __init__(self, spec, bad_partition):
+        super().__init__(spec)
+        self.bad = bad_partition
+
+    def batches(self, batch_size, partitions=None, start_at=None):
+        it = super().batches(batch_size, partitions, start_at)
+        if partitions is None or self.bad not in partitions:
+            yield from it
+            return
+        for i, b in enumerate(it):
+            if i >= 2:
+                raise _Boom()
+            yield b
+
+
+def test_worker_error_aborts_scan_without_leaks():
+    spec = SyntheticSpec(num_partitions=4, messages_per_partition=4000)
+    cfg = AnalyzerConfig(num_partitions=4, batch_size=128)
+    before = threading.active_count()
+    with pytest.raises(_Boom):
+        run_scan(
+            "t", _ExplodingSource(spec, bad_partition=1),
+            CpuExactBackend(cfg, init_now_s=0), 128, ingest_workers=3,
+        )
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+def test_pool_close_cancels_and_closes_streams():
+    """Abandoning the fan-in mid-stream closes every worker's underlying
+    generator (GeneratorExit), not just the threads."""
+    spec = SyntheticSpec(num_partitions=3, messages_per_partition=5000)
+    closed = []
+
+    class Tracking(SyntheticSource):
+        def batches(self, batch_size, partitions=None, start_at=None):
+            try:
+                yield from super().batches(batch_size, partitions, start_at)
+            finally:
+                closed.append(tuple(partitions or ()))
+
+    pool = ParallelIngest(
+        Tracking(spec), 64, shard_partitions([0, 1, 2], 3), depth=2
+    )
+    next(iter(pool))
+    pool.close()
+    pool.close()  # idempotent
+    assert len(closed) == 3
+
+
+def test_per_worker_telemetry_recorded():
+    from kafka_topic_analyzer_tpu.results import IngestStats
+
+    spec = SyntheticSpec(num_partitions=4, messages_per_partition=1000)
+    cfg = AnalyzerConfig(num_partitions=4, batch_size=256)
+    result = run_scan(
+        "t", SyntheticSource(spec), CpuExactBackend(cfg, init_now_s=0),
+        256, ingest_workers=2,
+    )
+    stats = IngestStats.from_telemetry(result.telemetry)
+    assert set(stats.workers) >= {"0", "1"}
+    assert sum(stats.workers.values()) >= 4000  # cumulative registry
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+
+
+@pytest.mark.parametrize("mesh", ["2", "1,2"])
+def test_cli_rejects_workers_with_sharded_mesh(capsys, mesh):
+    """Both mesh axes route through the sharded scan path, which would
+    silently ignore the flag — data-only AND space-only meshes reject."""
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "t", "--source", "synthetic",
+        "--synthetic", "partitions=4,messages=100",
+        "--mesh", mesh, "--backend", "tpu",
+        "--ingest-workers", "2", "--quiet",
+    ])
+    assert rc == 1
+    assert "--mesh 1" in capsys.readouterr().err
+
+
+def test_cli_auto_workers_resolve_to_one_under_mesh():
+    """'auto' under a sharded mesh must resolve to 1 on ANY host — a
+    host-core-count-dependent hard error would pass CI and fail prod."""
+    from kafka_topic_analyzer_tpu.cli import build_parser, resolve_ingest_workers
+
+    args = build_parser().parse_args(
+        ["-t", "t", "--ingest-workers", "auto"]
+    )
+    assert resolve_ingest_workers(args, (2, 1), 64) == 1
+    assert resolve_ingest_workers(args, (1, 2), 64) == 1
+    assert resolve_ingest_workers(args, (1, 1), 64) >= 1
+
+
+def test_cli_rejects_bad_worker_spec(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "t", "--source", "synthetic",
+        "--synthetic", "partitions=4,messages=100",
+        "--ingest-workers", "lots", "--quiet",
+    ])
+    assert rc == 1
+    assert "--ingest-workers" in capsys.readouterr().err
+
+
+def test_cli_stats_and_json_report_worker_count(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "t", "--source", "synthetic",
+        "--synthetic", "partitions=4,messages=2000",
+        "--ingest-workers", "3", "--stats", "--json", "--quiet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr()
+    doc = json.loads(out.out.splitlines()[-1])
+    assert doc["ingest_workers"] == 3
+    assert "ingest: 3 worker(s)" in out.err

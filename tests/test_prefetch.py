@@ -66,3 +66,38 @@ def test_no_thread_leak_after_scans():
     while threading.active_count() > before and time.monotonic() < deadline:
         time.sleep(0.05)
     assert threading.active_count() <= before
+
+
+def test_close_closes_underlying_generator():
+    """close() must unwind the source generator's finally blocks
+    (GeneratorExit) on early exit — sources hold real resources (broker
+    connections), so draining the worker thread alone is not enough."""
+    closed = []
+
+    def gen():
+        try:
+            for i in range(1000):
+                yield i
+        finally:
+            closed.append(True)
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 0
+    it.close()
+    assert closed == [True]
+    it.close()  # idempotent
+
+
+def test_close_after_exhaustion_is_noop():
+    closed = []
+
+    def gen():
+        try:
+            yield 1
+        finally:
+            closed.append(True)
+
+    it = prefetch(gen(), depth=2)
+    assert list(it) == [1]
+    it.close()
+    assert closed == [True]  # closed once, by natural exhaustion

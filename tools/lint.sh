@@ -120,3 +120,127 @@ if failures:
     sys.exit(1)
 print("lint: OK (io/ decode surface raises only classified error types)")
 EOF
+
+# Third rule: parallel-ingest WORKER code paths (methods of *Worker*
+# classes in parallel/ingest.py — code that runs on an ingest worker
+# thread) must never mutate scan-shared container state without a lock.
+# Shared mutable state crosses worker threads ONLY through the per-worker
+# queue.Queue (thread-safe by construction) or the obs instruments (each
+# guarded by its own lock); any container mutation on `self.X` / a
+# closed-over name is flagged unless it sits inside a `with <...lock...>:`
+# block.  Local variables are exempt (thread-confined).
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PATH = pathlib.Path("kafka_topic_analyzer_tpu") / "parallel" / "ingest.py"
+MUTATORS = {
+    "update", "setdefault", "pop", "popitem", "clear",
+    "append", "extend", "insert", "remove", "add", "discard",
+}
+#: Receivers whose mutation is the sanctioned cross-thread channel.
+SAFE_RECEIVERS = ("queue",)
+
+tree = ast.parse(PATH.read_text(encoding="utf-8"), filename=str(PATH))
+failures = []
+
+
+def local_names(fn) -> set:
+    out = set(a.arg for a in fn.args.args)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            for n in ast.walk(node.optional_vars):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+def receiver_root(expr):
+    """(root, dotted) for a Name/Attribute chain; (None, repr) otherwise."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return expr.id, ".".join(reversed(parts))
+    return None, ast.dump(expr)[:40]
+
+
+def check_worker_fn(cls_name, fn):
+    locals_ = local_names(fn)
+    guarded = set()  # nodes lexically under a `with <...lock...>` item
+
+    def mark_guarded(node):
+        for child in ast.walk(node):
+            guarded.add(id(child))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                src = ast.unparse(item.context_expr).lower()
+                if "lock" in src:
+                    mark_guarded(node)
+
+    def flag(node, what, recv):
+        if id(node) in guarded:
+            return
+        failures.append(
+            f"{PATH}:{node.lineno}: {what} on scan-shared {recv!r} in "
+            f"worker path {cls_name}.{fn.name} without a lock"
+        )
+
+    for node in ast.walk(fn):
+        # container[key] = / del container[key] / container[key] += on a
+        # non-local receiver
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target] if isinstance(node, ast.AugAssign)
+                else node.targets
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    root, dotted = receiver_root(t.value)
+                    leaf = dotted.rsplit(".", 1)[-1]
+                    if (root == "self" or root not in locals_) and not any(
+                        s in leaf for s in SAFE_RECEIVERS
+                    ):
+                        flag(node, "subscript mutation", dotted)
+        # container.mutator(...) on a non-local receiver
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in MUTATORS:
+                root, dotted = receiver_root(node.func.value)
+                leaf = dotted.rsplit(".", 1)[-1]
+                if (root == "self" or root not in locals_) and not any(
+                    s in leaf for s in SAFE_RECEIVERS
+                ):
+                    flag(node, f".{node.func.attr}()", dotted)
+
+
+for node in ast.walk(tree):
+    if isinstance(node, ast.ClassDef) and "Worker" in node.name:
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_worker_fn(node.name, item)
+
+if failures:
+    print("lint: unsynchronized scan-shared container mutation in a")
+    print("lint: parallel-ingest worker code path (share through the")
+    print("lint: worker queue / obs instruments, or hold a lock):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (parallel-ingest worker paths mutate no unlocked shared state)")
+EOF
