@@ -1,14 +1,84 @@
-"""Backend protocol + factory."""
+"""Backend protocol + factory + the superbatch dispatch queue."""
 
 from __future__ import annotations
 
 import abc
+import collections
 import functools
+import time
 
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.records import RecordBatch
 from kafka_topic_analyzer_tpu.results import TopicMetrics
+
+
+class DispatchQueue:
+    """Bounded in-flight superbatch dispatch tracking (``--dispatch-depth``).
+
+    Device dispatch is asynchronous: without a bound, a fast ingest side
+    could stack arbitrarily many staged superbatches (host staging rows +
+    device input buffers) behind a slow device.  This queue caps the
+    in-flight count at ``depth`` using per-dispatch completion tokens —
+    small non-donated step outputs that become ready exactly when their
+    superbatch's fold (and therefore its host→device transfer) completed.
+
+    Contract, enforced by tools/lint.sh rule 4: all in-flight bookkeeping
+    lives HERE (no other module touches an inflight container), and every
+    dispatch site calls ``throttle()`` before launching + ``launched()``
+    after — so no drive loop can ever hold more than ``depth`` staged
+    superbatches.  Blocking inside ``throttle`` is the backpressure that
+    propagates into the ingest fan-in (the engine thread stops draining
+    the worker queues, which fill, which stalls the workers).
+    """
+
+    def __init__(self, depth: int):
+        if depth < 1:
+            raise ValueError("dispatch depth must be >= 1")
+        self.depth = depth
+        self._inflight: "collections.deque" = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self._inflight)
+
+    def throttle(self) -> None:
+        """Block until fewer than ``depth`` dispatches are in flight —
+        call BEFORE staging the device transfer of the next superbatch."""
+        self._reap()
+        while len(self._inflight) >= self.depth:
+            self._retire(block=True)
+
+    def launched(self, token, batches: int) -> None:
+        """Record a dispatch just launched.  ``token`` must be a device
+        value that completes with the dispatch and is never donated to a
+        later dispatch (backends/step.py::superbatch_fold returns one)."""
+        self._inflight.append((token, time.perf_counter(), batches))
+        obs_metrics.DISPATCH_INFLIGHT.set(len(self._inflight))
+        obs_metrics.SUPERBATCH_SIZE.observe(batches)
+
+    def drain(self) -> None:
+        """Retire every in-flight dispatch (finalize / block_until_ready)."""
+        while self._inflight:
+            self._retire(block=True)
+
+    def _reap(self) -> None:
+        """Opportunistically retire already-completed dispatches so the
+        latency histogram and in-flight gauge stay fresh without blocking."""
+        while self._inflight:
+            ready = getattr(self._inflight[0][0], "is_ready", None)
+            if ready is None or not ready():
+                return
+            self._retire(block=False)
+
+    def _retire(self, block: bool) -> None:
+        import jax
+
+        token, t0, _batches = self._inflight[0]
+        if block:
+            jax.block_until_ready(token)
+        self._inflight.popleft()
+        obs_metrics.DISPATCH_SECONDS.observe(time.perf_counter() - t0)
+        obs_metrics.DISPATCH_INFLIGHT.set(len(self._inflight))
 
 
 class MetricBackend(abc.ABC):
@@ -54,13 +124,25 @@ def instrument_steps(cls):
     step = "update_shards" if "update_shards" in cls.__dict__ else "update"
     setattr(cls, step, _timed(
         cls.__dict__[step], obs_metrics.BACKEND_STEP_SECONDS))
+    # Superbatch entry points are separate engine-facing steps (they do not
+    # delegate to update/update_shards), so they book their own dispatch
+    # latency — includes throttle blocking, i.e. real backpressure time.
+    for super_step in ("update_superbatch", "update_shards_superbatch"):
+        if super_step in cls.__dict__:
+            setattr(cls, super_step, _timed(
+                cls.__dict__[super_step], obs_metrics.BACKEND_STEP_SECONDS))
     setattr(cls, "finalize", _timed(
         cls.__dict__["finalize"], obs_metrics.BACKEND_FINALIZE_SECONDS))
     return cls
 
 
-def make_backend(name: str, config: AnalyzerConfig) -> MetricBackend:
-    """Factory for ``--backend {cpu,tpu}`` (default cpu per BASELINE.json)."""
+def make_backend(
+    name: str, config: AnalyzerConfig, dispatch=None
+) -> MetricBackend:
+    """Factory for ``--backend {cpu,tpu}`` (default cpu per BASELINE.json).
+    ``dispatch`` (config.DispatchConfig) sizes the tpu backend's superbatch
+    layer; the cpu oracle has no device dispatch to amortize, so callers
+    must not pass a K>1 dispatch config with it (cli.resolve_dispatch)."""
     if name == "cpu":
         from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
 
@@ -68,5 +150,5 @@ def make_backend(name: str, config: AnalyzerConfig) -> MetricBackend:
     if name == "tpu":
         from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
 
-        return TpuBackend(config)
+        return TpuBackend(config, dispatch=dispatch)
     raise ValueError(f"unknown backend {name!r} (expected 'cpu' or 'tpu')")

@@ -25,6 +25,47 @@ from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_update
 from kafka_topic_analyzer_tpu.ops.hll import hll_apply
 
 
+def superbatch_fold(
+    state: AnalyzerState,
+    bufs,
+    unpack,
+    config: AnalyzerConfig,
+    space_index=0,
+    space_axis: "str | None" = None,
+):
+    """Fold a stacked superbatch — K packed buffers on a leading axis —
+    into the state with a single ``lax.scan`` over that axis.
+
+    This is the dispatch-amortization half of the superbatch layer: ONE
+    jitted dispatch (state donated once) folds K batches, where the
+    per-batch path paid K dispatches and K donation round-trips.  The
+    scan body is exactly ``analyzer_step`` on ``unpack(bufs[k])``, applied
+    k = 0..K-1 in order — the same order the sequential path dispatches —
+    so every fold (including the order-sensitive last-writer-wins alive
+    bitmap) produces byte-identical state.  ``unpack`` is injected (a
+    closure over ``packing.unpack_device`` and the per-chunk config) so
+    this module stays free of the wire-layout dependency; under a mesh it
+    may use ``space_axis`` collectives — collectives inside a scan body
+    run once per step, in step order, preserving the lockstep contract.
+
+    Returns ``(state, n_valid)`` where ``n_valid`` is the int32[K] vector
+    of per-batch valid counts: a small non-donated output the backends
+    use as a completion token for the bounded in-flight dispatch queue
+    (it cannot alias a donated state leaf, so blocking on it is safe
+    after later dispatches have consumed the state).
+    """
+    from kafka_topic_analyzer_tpu.jax_support import lax
+
+    def body(st, buf):
+        arrays = unpack(buf)
+        return (
+            analyzer_step(st, arrays, config, space_index, space_axis),
+            arrays["n_valid"],
+        )
+
+    return lax.scan(body, state, bufs)
+
+
 def analyzer_step(
     state: AnalyzerState,
     arrays: Dict[str, "jnp.ndarray"],

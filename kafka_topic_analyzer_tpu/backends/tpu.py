@@ -5,10 +5,15 @@ Design (SURVEY.md §7 M3 + the transfer work in packing.py):
   dispatches one jitted step with the state buffers *donated*, so XLA updates
   them in place and the host never round-trips the state (hard part (e));
 - each batch crosses the host→device boundary as ONE packed uint8 buffer in
-  wire format v3 (packing.py) — minimal bytes per record, host-side
-  pre-reduction for the bitmap/HLL updates;
+  wire format v4 (packing.py's module docstring is the layout contract) —
+  minimal bytes per record, host-side pre-reduction for the bitmap/HLL
+  updates;
 - dispatch is asynchronous — the host thread returns immediately and keeps
   packing the next batch while the device works; `finalize` synchronizes;
+- at ``--superbatch K`` > 1, K packed buffers stack into one contiguous
+  ``uint8[K, N]`` host array folded by a single jitted ``lax.scan`` dispatch
+  (state donated once per superbatch, one large transfer), with up to
+  ``--dispatch-depth`` superbatches in flight (bounded by DispatchQueue);
 - a one-time pack→unpack self-check at init guards the bitcast layout
   against byte-order surprises on new platforms.
 
@@ -23,12 +28,22 @@ import os
 import jax
 import numpy as np
 
-from kafka_topic_analyzer_tpu.backends.base import MetricBackend, instrument_steps
+from kafka_topic_analyzer_tpu.backends.base import (
+    DispatchQueue,
+    MetricBackend,
+    instrument_steps,
+)
 from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
-from kafka_topic_analyzer_tpu.backends.step import analyzer_step
-from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.backends.step import analyzer_step, superbatch_fold
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig, DispatchConfig
 from kafka_topic_analyzer_tpu.models.state import AnalyzerState
-from kafka_topic_analyzer_tpu.packing import pack_batch, unpack_device, unpack_numpy
+from kafka_topic_analyzer_tpu.packing import (
+    SuperbatchStager,
+    pack_batch,
+    packed_nbytes,
+    unpack_device,
+    unpack_numpy,
+)
 from kafka_topic_analyzer_tpu.records import RecordBatch
 from kafka_topic_analyzer_tpu.results import TopicMetrics
 from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
@@ -43,15 +58,35 @@ def make_packed_step(config: AnalyzerConfig):
     return step
 
 
+def make_packed_superstep(config: AnalyzerConfig):
+    """The jittable superbatch step: (state, uint8[K, N]) → (state, token).
+
+    One dispatch scan-folds the K stacked packed buffers in order
+    (backends/step.py::superbatch_fold), donating the state once per
+    superbatch instead of once per batch.  The token (int32[K] of
+    per-batch valid counts) is a small non-donated output used by the
+    bounded dispatch queue as a completion marker."""
+
+    def superstep(state: AnalyzerState, bufs):
+        return superbatch_fold(
+            state, bufs, lambda b: unpack_device(b, config), config
+        )
+
+    return superstep
+
+
 class StagedBatch:
-    """A batch already packed and launched host→device.
+    """A batch already packed for (or launched into) host→device transfer.
 
     Produced by ``TpuBackend.prepare`` — designed to run on a prefetch
     worker thread (engine.run_scan stages there), so the pack (native,
-    GIL-released) and the async ``device_put`` transfer both overlap the
-    device's current step instead of serializing in front of the next
-    dispatch.  The explicit double-buffered host→device pipeline
-    SURVEY.md §7 M5 calls for; prefetch depth bounds in-flight buffers.
+    GIL-released) overlaps the device's current step instead of
+    serializing in front of the next dispatch.  At superbatch K=1 the
+    worker also starts the async ``device_put`` (the explicit
+    double-buffered host→device pipeline SURVEY.md §7 M5 calls for;
+    prefetch depth bounds in-flight buffers); at K>1 ``buf`` stays a HOST
+    buffer — the fan-in order decides which superbatch row it lands in,
+    and the whole stack crosses in one large transfer at dispatch time.
     Deliberately just a typed buffer: all bookkeeping (counts, bytes,
     offsets) stays with the decoded batch the engine already holds.
     """
@@ -104,6 +139,7 @@ class TpuBackend(MetricBackend):
         init_now_s: "int | None" = None,
         device=None,
         use_native: bool = True,
+        dispatch: "DispatchConfig | None" = None,
     ):
         super().__init__(config)
         self.init_now_s = utc_now_seconds() if init_now_s is None else init_now_s
@@ -116,12 +152,35 @@ class TpuBackend(MetricBackend):
         with jax.default_device(self.device):
             self.state = AnalyzerState.init(config)
         self._step = jax.jit(make_packed_step(config), donate_argnums=(0,))
+        # Superbatch dispatch layer (config.DispatchConfig): K packed
+        # buffers per jitted scan dispatch, up to `depth` superbatches in
+        # flight.  K=1 keeps the classic one-dispatch-per-batch path
+        # (prepare launches the transfer itself) untouched.
+        self.dispatch_config = dispatch if dispatch is not None else DispatchConfig()
+        self.superbatch_k = self.dispatch_config.resolve(config.batch_size)
+        self.dispatch_depth = self.dispatch_config.depth
+        if self.superbatch_k > 1:
+            self._superstep = jax.jit(
+                make_packed_superstep(config), donate_argnums=(0,)
+            )
+            self._stager = SuperbatchStager(
+                (packed_nbytes(config, config.batch_size),),
+                self.superbatch_k,
+                self.dispatch_depth,
+            )
+            self._queue = DispatchQueue(self.dispatch_depth)
+            self._empty_buf: "np.ndarray | None" = None
 
     def prepare(self, batch: RecordBatch) -> StagedBatch:
-        """Pack + start the host→device transfer for a batch that will be
-        fed to ``update`` later.  Safe to call from a worker thread (jax
-        dispatch is thread-safe; the packers are pure numpy/C++)."""
+        """Pack (and, at superbatch K=1, start the host→device transfer
+        for) a batch that will be fed to ``update``/``update_superbatch``
+        later.  Safe to call from a worker thread (jax dispatch is
+        thread-safe; the packers are pure numpy/C++).  At K>1 the buffer
+        stays on the host: it is copied into its superbatch row at fan-in
+        time and crosses in the stack's single large transfer."""
         buf = pack_batch(batch, self.config, use_native=self.use_native)
+        if self.superbatch_k > 1:
+            return StagedBatch(buf)
         return StagedBatch(jax.device_put(buf, self.device))
 
     def update(self, batch: "RecordBatch | StagedBatch") -> None:
@@ -131,7 +190,44 @@ class TpuBackend(MetricBackend):
         buf = pack_batch(batch, self.config, use_native=self.use_native)
         self.state = self._step(self.state, jax.device_put(buf, self.device))
 
+    def _empty_packed(self) -> np.ndarray:
+        """Identity-fold pad for a partial superbatch tail: a packed empty
+        batch (n_valid 0, n_pairs 0, identity-filled extreme tables, zero
+        HLL registers) folds as a no-op, so padding the tail to K keeps
+        ONE compiled superstep instead of one per tail length."""
+        if self._empty_buf is None:
+            self._empty_buf = pack_batch(
+                RecordBatch.empty(0), self.config, use_native=self.use_native
+            )
+        return self._empty_buf
+
+    def update_superbatch(self, staged: "list[StagedBatch | RecordBatch]") -> None:
+        """Fold up to K batches in one scanned dispatch (in list order —
+        byte-identical to K sequential ``update`` calls).  Blocks in the
+        dispatch queue's throttle while ``dispatch_depth`` superbatches
+        are already in flight; that blocking is the backpressure that
+        keeps staged-buffer memory bounded."""
+        k = self.superbatch_k
+        if not staged or len(staged) > k:
+            raise ValueError(f"superbatch of {len(staged)} batches (K={k})")
+        self._queue.throttle()  # before staging: bounds host rows too
+        rows = self._stager.next_slot()
+        for i, item in enumerate(staged):
+            if isinstance(item, StagedBatch):
+                np.copyto(rows[i], np.asarray(item.buf))
+            else:
+                pack_batch(
+                    item, self.config, use_native=self.use_native, out=rows[i]
+                )
+        for i in range(len(staged), k):
+            np.copyto(rows[i], self._empty_packed())
+        bufs = jax.device_put(rows, self.device)
+        self.state, token = self._superstep(self.state, bufs)
+        self._queue.launched(token, len(staged))
+
     def block_until_ready(self) -> None:
+        if self.superbatch_k > 1:
+            self._queue.drain()
         jax.block_until_ready(self.state)
 
     # -- snapshot/resume (checkpoint.py) -------------------------------------
@@ -145,5 +241,9 @@ class TpuBackend(MetricBackend):
         )
 
     def finalize(self) -> TopicMetrics:
+        if self.superbatch_k > 1:
+            # Retire every in-flight dispatch first so the latency
+            # histogram is complete (device_get below syncs anyway).
+            self._queue.drain()
         host_state = jax.tree.map(np.asarray, jax.device_get(self.state))
         return metrics_from_state(host_state, self.config, self.init_now_s)

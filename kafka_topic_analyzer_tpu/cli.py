@@ -156,6 +156,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "partitions)). Default: 1. Requires --mesh 1 "
                         "(sharded-mesh scans already run one ingest "
                         "stream per data shard)")
+    p.add_argument("--superbatch", default="1", metavar="K|auto",
+                   help="Superbatch dispatch: stack K packed batches into "
+                        "one uint8[K, N] host array and fold them in a "
+                        "single jitted lax.scan dispatch (state donated "
+                        "once per superbatch, one large host->device "
+                        "transfer) — K x fewer dispatches with "
+                        "byte-identical results. 'auto' targets 2^20 "
+                        "records per dispatch (min 1, max 16). Default: 1. "
+                        "Requires --backend tpu")
+    p.add_argument("--dispatch-depth", type=int, default=2, metavar="D",
+                   help="Superbatches allowed in flight (staged/"
+                        "transferring) while the device folds; the drive "
+                        "loop blocks — backpressuring ingest — beyond it. "
+                        "2 overlaps the next transfer with the current "
+                        "fold. Default: 2")
     p.add_argument("--pallas", action="store_true",
                    help="Use the Pallas MXU counter kernel for the "
                         "per-partition counters (tpu backend; requires "
@@ -375,6 +390,26 @@ def resolve_ingest_workers(args, mesh_shape, num_partitions) -> int:
     return cfg.resolve(num_partitions)
 
 
+def resolve_dispatch(args):
+    """Parse + validate --superbatch/--dispatch-depth against the backend
+    (shared by the single- and multi-topic paths).  Returns the
+    DispatchConfig for the device backends, or None for the cpu oracle —
+    which has no device dispatch to amortize, so an EXPLICIT K>1 request
+    there is a contradiction (reject rather than silently underdeliver;
+    'auto' means "size appropriately" and resolves to no superbatching)."""
+    from kafka_topic_analyzer_tpu.config import DispatchConfig
+
+    cfg = DispatchConfig.parse(args.superbatch, args.dispatch_depth)
+    if args.backend != "tpu":
+        if cfg.superbatch != "auto" and int(cfg.superbatch) > 1:
+            raise ValueError(
+                "--superbatch requires --backend tpu (the cpu oracle has "
+                "no device dispatch to amortize)"
+            )
+        return None
+    return cfg
+
+
 def _print_stats(args, result) -> None:
     """--stats stderr dump: per-stage profile + the telemetry counter
     digest (cluster-wide under multi-controller)."""
@@ -386,7 +421,10 @@ def _print_stats(args, result) -> None:
     print(result.profile.summary(), file=sys.stderr)
     sys.stderr.write(
         render_telemetry_stats(
-            result.telemetry, ingest_workers=result.ingest_workers
+            result.telemetry,
+            ingest_workers=result.ingest_workers,
+            superbatch_k=result.superbatch_k,
+            dispatch_depth=result.dispatch_depth,
         )
     )
 
@@ -401,7 +439,7 @@ def _not_report_process(args) -> bool:
     return jax.process_index() != 0
 
 
-def _make_cli_backend(args, config: AnalyzerConfig, mesh_shape):
+def _make_cli_backend(args, config: AnalyzerConfig, mesh_shape, dispatch=None):
     """cpu oracle, single-device tpu, or sharded mesh backend per flags."""
     if args.backend == "tpu":
         # A wedged accelerator tunnel blocks forever inside backend init;
@@ -415,10 +453,10 @@ def _make_cli_backend(args, config: AnalyzerConfig, mesh_shape):
     if args.backend == "tpu" and mesh_shape != (1, 1):
         from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
 
-        return ShardedTpuBackend(config)
+        return ShardedTpuBackend(config, dispatch=dispatch)
     from kafka_topic_analyzer_tpu.backends.base import make_backend
 
-    return make_backend(args.backend, config)
+    return make_backend(args.backend, config, dispatch=dispatch)
 
 
 def parse_from_timestamp_flag(args) -> "int | None":
@@ -502,7 +540,8 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         ingest_workers = resolve_ingest_workers(
             args, mesh_shape, len(multi.partitions())
         )
-    backend = _make_cli_backend(args, config, mesh_shape)
+        dispatch = resolve_dispatch(args)
+    backend = _make_cli_backend(args, config, mesh_shape, dispatch=dispatch)
 
     banner_out = sys.stderr if args.json else sys.stdout
     print(f"Subscribing to {', '.join(topics)} ({len(topics)}-topic fan-in)",
@@ -544,6 +583,8 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             "topics": {},
             "duration_secs": result.duration_secs,
             "ingest_workers": result.ingest_workers,
+            "superbatch_k": result.superbatch_k,
+            "dispatch_depth": result.dispatch_depth,
         }
         for topic, sliced, start, end in slices:
             doc["topics"][topic] = sliced.to_dict(start, end)
@@ -681,13 +722,14 @@ def _run(args) -> int:
         ingest_workers = resolve_ingest_workers(
             args, mesh_shape, len(source.partitions())
         )
+        dispatch = resolve_dispatch(args)
 
     from kafka_topic_analyzer_tpu.engine import run_scan
     from kafka_topic_analyzer_tpu.report import render_report
     from kafka_topic_analyzer_tpu.utils.profiling import maybe_jax_trace
     from kafka_topic_analyzer_tpu.utils.progress import Spinner
 
-    backend = _make_cli_backend(args, config, mesh_shape)
+    backend = _make_cli_backend(args, config, mesh_shape, dispatch=dispatch)
 
     banner_out = sys.stderr if args.json else sys.stdout
     print(f"Subscribing to {args.topic}", file=banner_out)
@@ -721,6 +763,8 @@ def _run(args) -> int:
         doc["topic"] = args.topic
         doc["duration_secs"] = result.duration_secs
         doc["ingest_workers"] = result.ingest_workers
+        doc["superbatch_k"] = result.superbatch_k
+        doc["dispatch_depth"] = result.dispatch_depth
         doc["telemetry"] = result.telemetry
         rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))

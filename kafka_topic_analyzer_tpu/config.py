@@ -129,6 +129,72 @@ class IngestConfig:
         return max(1, min(want, num_partitions))
 
 
+@dataclasses.dataclass(frozen=True)
+class DispatchConfig:
+    """Superbatch device-dispatch sizing (``--superbatch``/``--dispatch-depth``).
+
+    Like `IngestConfig`, deliberately NOT part of `AnalyzerConfig`: how many
+    packed batches ride one jitted dispatch (and how many superbatches may
+    be in flight) changes neither state shapes nor fold semantics — the
+    scan-folded superstep applies the K batches in exactly the order the
+    sequential path would (backends/step.py::superbatch_fold), so results
+    stay byte-identical and it must not churn the checkpoint fingerprint.
+    A snapshot taken by a K-superbatch scan resumes under any other K or D
+    (snapshots land only at superbatch boundaries — engine.py).
+    """
+
+    #: Packed batches stacked into one ``uint8[K, N]`` host array and
+    #: folded by a single jitted ``lax.scan`` dispatch (state donated once
+    #: per superbatch).  ``1`` = today's one-dispatch-per-batch path;
+    #: ``"auto"`` restores the proven-good 2^20 records per dispatch:
+    #: max(1, min(16, 2^20 // batch_size)).
+    superbatch: "int | str" = 1
+    #: Bound on superbatches staged/transferring while the device folds
+    #: (the in-flight dispatch queue, backends/base.py::DispatchQueue).
+    #: 2 = transfer of superbatch i+1 overlaps the fold of i; higher
+    #: values deepen the pipeline at the cost of host+device memory for
+    #: the extra staged buffers.
+    depth: int = 2
+
+    def __post_init__(self) -> None:
+        if isinstance(self.superbatch, str):
+            if self.superbatch != "auto":
+                raise ValueError(
+                    f"superbatch {self.superbatch!r} invalid "
+                    "(a positive integer, or 'auto')"
+                )
+        elif self.superbatch < 1:
+            raise ValueError("superbatch must be >= 1")
+        if self.depth < 1:
+            raise ValueError("dispatch depth must be >= 1")
+
+    @classmethod
+    def parse(cls, superbatch: str, depth: int = 2) -> "DispatchConfig":
+        """CLI spelling: ``--superbatch K|auto`` + ``--dispatch-depth D``."""
+        text = superbatch.strip().lower()
+        if text == "auto":
+            return cls(superbatch="auto", depth=depth)
+        try:
+            k = int(text)
+        except ValueError:
+            raise ValueError(
+                f"bad --superbatch {superbatch!r}: expected a positive "
+                "integer or 'auto'"
+            ) from None
+        return cls(superbatch=k, depth=depth)
+
+    def resolve(self, batch_size: int) -> int:
+        """Concrete K for a given batch size.  ``auto`` targets the
+        proven-good 2^20 records per device dispatch (BENCH_NOTES round 2
+        established 2^20 as the default batch; the axon-relay wedge forced
+        B=2^16, multiplying per-dispatch overhead 16x — auto wins that
+        amortization back without touching the per-batch packed layout),
+        capped at 16 stacked buffers of host staging."""
+        if self.superbatch == "auto":
+            return max(1, min(16, (1 << 20) // max(1, batch_size)))
+        return int(self.superbatch)
+
+
 #: Valid --on-corruption policies, in escalation order.
 CORRUPTION_POLICIES = ("fail", "skip", "quarantine")
 

@@ -88,6 +88,14 @@ class ScanResult:
     #: ``--stats`` digest and ``--json`` report surface it so a recorded
     #: throughput number always carries its parallelism.
     ingest_workers: int = 1
+    #: Superbatch size the device backend actually ran (resolved
+    #: ``--superbatch``): packed batches folded per jitted dispatch.
+    #: 1 = the classic one-dispatch-per-batch path.  Reported alongside
+    #: ingest_workers for the same reason — dispatch amortization is part
+    #: of any recorded throughput number's configuration.
+    superbatch_k: int = 1
+    #: Bound on in-flight superbatch dispatches (``--dispatch-depth``).
+    dispatch_depth: int = 1
 
 
 class _ProgressTracker:
@@ -340,6 +348,72 @@ def run_scan(
         return dataclasses.replace(b, partition=pindex.to_dense(b.partition))
 
     used_workers = 1
+    # Superbatch dispatch (config.DispatchConfig, resolved by the backend):
+    # accumulate K staged batches and fold them in ONE scanned device
+    # dispatch.  Fold-consistency rule: progress commits (and therefore
+    # snapshots) happen ONLY at superbatch boundaries — between them the
+    # tracker runs ahead of the device state by the pending tail, and a
+    # snapshot there would skip those records on resume.  On stop/fault/
+    # corruption the pending tail is flushed as a partial superbatch
+    # (identity-padded to K by the backend) so PRs 1-3 semantics — every
+    # observed batch folded and committed before the failure snapshot —
+    # are unchanged.  `fault_flush` is that best-effort hook; it stays
+    # None when flushing from a failure path would itself be a collective
+    # (multi-controller sharded runs: peers may not reach the flush, and
+    # a one-sided collective deadlocks — resume simply re-scans the tail).
+    super_k = int(getattr(backend, "superbatch_k", 1) or 1)
+    fault_flush = None
+
+    def make_superbatch(dispatch_fn):
+        """(add, flush) pair for one drive loop's superbatch accumulation.
+
+        ONE implementation for both the sharded and single-device branches
+        so the commit/snapshot semantics can never diverge between them.
+        ``add`` records the tracker offsets AT APPEND TIME: the tracker
+        observes a batch slightly before it is staged into the pending
+        tail, so a fault landing in that window must not let ``flush``
+        commit offsets for a batch it never folded — the flush commits the
+        last appended batch's snapshot, not the live tracker.
+        """
+        pend = {"items": [], "valid": 0, "nbytes": 0,
+                "offsets": None, "seq": 0}
+
+        def add(item, nvalid: int, nbytes: int) -> None:
+            nonlocal seq
+            pend["items"].append(item)
+            pend["valid"] += nvalid
+            pend["nbytes"] += nbytes
+            seq += nvalid
+            pend["offsets"] = dict(tracker.next_offsets)
+            pend["seq"] = seq
+            if len(pend["items"]) == super_k:
+                flush()
+
+        def flush() -> None:
+            """Dispatch the accumulated (possibly partial) superbatch and
+            commit fold progress — the only point the superbatch path
+            snapshots.  Under multi-controller the dispatch is collective:
+            every process reaches each flush at the same round count (the
+            accumulation length is driven by the per-round lockstep
+            agreement), and the fault path never calls this there."""
+            nonlocal committed_offsets, committed_seq
+            if not pend["items"]:
+                return
+            with profile.stage(
+                "dispatch", items=pend["valid"], nbytes=pend["nbytes"],
+            ):
+                dispatch_fn(pend["items"])
+            pend["items"] = []
+            pend["valid"] = 0
+            pend["nbytes"] = 0
+            committed_offsets = pend["offsets"]
+            committed_seq = pend["seq"]
+            maybe_snapshot(
+                offsets=committed_offsets, records_seen=committed_seq
+            )
+
+        return add, flush
+
     try:
         if hasattr(backend, "update_shards"):
             if ingest_workers > 1:
@@ -393,6 +467,18 @@ def run_scan(
                 else iter(())
                 for r in feed_rows
             }
+            dispatch_rounds = (
+                backend.update_shards_superbatch
+                if super_k > 1 and hasattr(backend, "update_shards_superbatch")
+                else None
+            )
+            if dispatch_rounds is None:
+                super_k = 1  # report the EFFECTIVE superbatch size
+                add_round = flush_rounds = None
+            else:
+                add_round, flush_rounds = make_superbatch(dispatch_rounds)
+                if not multiproc:
+                    fault_flush = flush_rounds
             alive = {r: True for r in feed_rows}
             while True:
                 shard_batches: "list" = [None] * d
@@ -417,20 +503,27 @@ def run_scan(
                     have_data = lockstep(have_data)
                 if not have_data:
                     break
-                with profile.stage(
-                    "dispatch", items=step_valid, nbytes=step_bytes,
-                ):
-                    backend.update_shards(shard_batches)
-                seq += step_valid
+                if add_round is not None:
+                    add_round(shard_batches, step_valid, step_bytes)
+                else:
+                    with profile.stage(
+                        "dispatch", items=step_valid, nbytes=step_bytes,
+                    ):
+                        backend.update_shards(shard_batches)
+                    seq += step_valid
+                    committed_offsets = dict(tracker.next_offsets)
+                    committed_seq = seq
+                    maybe_snapshot()
                 obs_metrics.SCAN_RECORDS.inc(step_valid)
                 obs_metrics.SCAN_BATCHES.inc()
                 obs_metrics.SCAN_BYTES.inc(step_bytes)
                 obs_metrics.BATCH_RECORDS.observe(step_valid)
-                committed_offsets = dict(tracker.next_offsets)
-                committed_seq = seq
-                maybe_snapshot()
                 maybe_heartbeat()
                 spinner.set_message(f"[Sq: {seq} | T: {topic} | shards: {d}]")
+            if flush_rounds is not None:
+                # Stream drained on every process (lockstep agreement):
+                # flush the partial superbatch tail collectively.
+                flush_rounds()
         else:
             # Backends with a `prepare` method (the packed single-device
             # path) stage INSIDE the prefetch worker: remap + pack (native,
@@ -486,6 +579,17 @@ def run_scan(
                         prefetch_depth,
                     )
                 )
+            dispatch_super = (
+                backend.update_superbatch
+                if super_k > 1 and hasattr(backend, "update_superbatch")
+                else None
+            )
+            if dispatch_super is None:
+                super_k = 1  # report the EFFECTIVE superbatch size
+                add_batch = flush_pending = None
+            else:
+                add_batch, flush_pending = make_superbatch(dispatch_super)
+                fault_flush = flush_pending
             while True:
                 with profile.stage("ingest"):
                     item = next(batches, None)
@@ -503,31 +607,50 @@ def run_scan(
                 tracker.observe(batch, batch.partition)
                 if staged is None:
                     staged = pindex.remap_batch(batch)
-                # nbytes is always the DECODED batch size (remap doesn't
-                # change it) so the stat stays comparable across backends.
-                with profile.stage(
-                    "dispatch", items=nvalid, nbytes=batch.nbytes,
-                ):
-                    backend.update(staged)
-                seq += nvalid
+                if add_batch is not None:
+                    add_batch(staged, nvalid, batch.nbytes)
+                else:
+                    # nbytes is always the DECODED batch size (remap doesn't
+                    # change it) so the stat stays comparable across backends.
+                    with profile.stage(
+                        "dispatch", items=nvalid, nbytes=batch.nbytes,
+                    ):
+                        backend.update(staged)
+                    seq += nvalid
+                    committed_offsets = dict(tracker.next_offsets)
+                    committed_seq = seq
+                    maybe_snapshot()
                 obs_metrics.SCAN_RECORDS.inc(nvalid)
                 obs_metrics.SCAN_BATCHES.inc()
                 obs_metrics.SCAN_BYTES.inc(batch.nbytes)
                 obs_metrics.BATCH_RECORDS.observe(nvalid)
-                committed_offsets = dict(tracker.next_offsets)
-                committed_seq = seq
-                maybe_snapshot()
                 maybe_heartbeat()
                 # indicatif-template message like src/kafka.rs:111-113.
                 spinner.set_message(
                     f"[Sq: {seq} | T: {topic} | P: {last_partition} | "
                     f"O: {last_offset} | Ts: {format_utc_seconds(int(batch.ts_s[last]))}]"
                 )
+            if flush_pending is not None:
+                flush_pending()  # partial superbatch tail at stream end
     except BaseException:
-        # Irrecoverable mid-scan failure (or interrupt): persist the
-        # progress as a final snapshot so a rerun with --resume continues
-        # where this one died instead of rescanning from earliest.  Best
-        # effort — the original failure is what must surface.
+        # Irrecoverable mid-scan failure (or interrupt): flush the pending
+        # superbatch tail (so every observed batch is folded — the same
+        # invariant the per-batch path holds at failure time), then persist
+        # the progress as a final snapshot so a rerun with --resume
+        # continues where this one died instead of rescanning from
+        # earliest.  Best effort — the original failure is what must
+        # surface; an unflushable tail just means resume re-scans it.
+        if fault_flush is not None:
+            try:
+                fault_flush()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "pending superbatch tail could not be flushed; the "
+                    "failure snapshot falls back to the last committed "
+                    "superbatch boundary"
+                )
         try:
             maybe_snapshot(
                 force=True,
@@ -633,4 +756,6 @@ def run_scan(
         corrupt_partitions=corrupt,
         telemetry=telemetry,
         ingest_workers=used_workers,
+        superbatch_k=super_k,
+        dispatch_depth=int(getattr(backend, "dispatch_depth", 1) or 1),
     )

@@ -10,7 +10,10 @@ is this module's whole job; it is a test rig, not a product surface — no
 batching, retries, idempotence, or transactions.
 
 Wire format: ApiVersions-negotiated CreateTopics (v0–v4 classic) and
-Produce (v3–v8 classic; v3 is the Kafka 4.0 / KIP-896 floor).  Record sets
+Produce (v3–v7 classic; v3 is the Kafka 4.0 / KIP-896 floor, and v7 is
+the ceiling this parser actually consumes — v8 appended per-partition
+``record_errors``/``error_message`` fields the response loop below does
+not read, so negotiating it would desync the connection).  Record sets
 are encoded by the same ``kafka_codec.encode_record_batch`` the fake broker
 uses, so the bytes a live broker stores are the bytes the decode path is
 golden-locked against (tests/test_golden.py).
@@ -103,7 +106,7 @@ def create_topic(bootstrap: str, topic: str, partitions: int,
 def encode_produce_request(topic: str, partition: int, record_set: bytes,
                            acks: int = -1,
                            timeout_ms: int = 30_000) -> "kc.ByteWriter":
-    """Produce v3–v8 body (the schema is identical across that range):
+    """Produce v3–v7 body (the schema is identical across that range):
     transactional_id, acks, timeout, one topic, one partition."""
     w = kc.ByteWriter()
     w.string(None)          # transactional_id
@@ -174,7 +177,12 @@ def produce(bootstrap: str, topic: str,
                 nh, np_ = meta.brokers[node]
                 conns[node] = BrokerConnection(nh, np_)
             conn = conns[node]
-            v = _negotiated(conn, API_PRODUCE, 3, 8)
+            # Ceiling v7: the parse loop below consumes exactly the
+            # v3–v7 partition_response schema.  v8 appended record_errors
+            # + error_message per partition; negotiating it without
+            # parsing that tail would leave unread bytes on the
+            # connection and desync every later request.
+            v = _negotiated(conn, API_PRODUCE, 3, 7)
             record_set = kc.encode_record_batch(
                 [(i, ts, k, val) for i, (ts, k, val) in enumerate(recs)]
             )
@@ -183,6 +191,12 @@ def produce(bootstrap: str, topic: str,
                 encode_produce_request(topic, pid, record_set,
                                        timeout_ms=timeout_ms).done(),
             )
+            # Invariant this parse loop relies on: each request carries
+            # exactly ONE topic with ONE partition (encode_produce_request
+            # builds it that way), so the nested loops run once each and
+            # `rp == pid` always matches the partition just produced —
+            # a multi-partition request would need per-entry routing of
+            # base offsets and errors.
             for _ in range(r.i32()):       # responses[]
                 r.string()                 # topic
                 for _ in range(r.i32()):   # partition_responses[]

@@ -119,6 +119,22 @@ RETRY_BUDGET_EXHAUSTIONS = _REG.counter(
     "kta_retry_budget_exhaustions_total",
     "Partitions whose consecutive-transport-failure budget ran out")
 
+# -- superbatch dispatch (backends/base.py DispatchQueue) ---------------------
+
+DISPATCH_INFLIGHT = _REG.gauge(
+    "kta_dispatch_inflight",
+    "Superbatch dispatches launched but not yet retired (bounded by "
+    "--dispatch-depth; 0 when the device keeps up)")
+SUPERBATCH_SIZE = _REG.histogram(
+    "kta_superbatch_size",
+    "Packed batches folded per device dispatch (K, or the partial tail)",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+DISPATCH_SECONDS = _REG.histogram(
+    "kta_dispatch_seconds",
+    "Per-dispatch latency: superbatch launch to fold completion "
+    "(includes device queue time at depth > 1)",
+    buckets=LATENCY_BUCKETS_S)
+
 # -- backends -----------------------------------------------------------------
 
 BACKEND_STEP_SECONDS = _REG.histogram(

@@ -63,8 +63,11 @@ MAX_VALUE_LEN = (1 << 24) - 1
 
 
 def _sections(config: AnalyzerConfig, batch_size: int):
-    """(name, dtype, count) section list, in buffer order (wire format v3;
-    v3 = v2 plus the global-HLL register-table section below).
+    """(name, dtype, count) section list, in buffer order.
+
+    The layout contract lives in ONE place — the module docstring above
+    (wire format v4); this builder, the packers, the unpackers, and the
+    device step all derive from this list, so they cannot skew from it.
 
     v2 removed the 8 B/record ``ts_s`` column: the device only ever
     reduces timestamps to per-partition min/max (ops/counters.py
@@ -248,11 +251,20 @@ def pack_batch(
     batch: RecordBatch,
     config: AnalyzerConfig,
     use_native: bool = True,
+    out: "np.ndarray | None" = None,
 ) -> np.ndarray:
-    """RecordBatch → one contiguous uint8 buffer (wire format v4).
+    """RecordBatch → one contiguous uint8 buffer (wire format v4 — the
+    module docstring is the layout's single source of truth).
 
     The batch's valid records must be a prefix (all sources produce
     prefix-valid batches; padding lives at the tail).
+
+    ``out`` packs into a caller-provided ``uint8[packed_nbytes]`` view —
+    superbatch staging (SuperbatchStager) hands out rows of its stacked
+    host array so the numpy path writes the row directly instead of
+    allocating a buffer that would be copied into the stack anyway.
+    Every byte of ``out`` is overwritten (header + the full section list
+    cover the buffer exactly), so rows need no re-zeroing between uses.
     """
     b = config.batch_size
     n = len(batch)
@@ -305,13 +317,19 @@ def pack_batch(
             )
 
             if native_available():
-                out = pack_batch_native(batch, config)
-                if out is not None:
-                    return out
+                packed = pack_batch_native(batch, config)
+                if packed is not None:
+                    if out is not None:
+                        np.copyto(out, packed)
+                        return out
+                    return packed
         except ImportError:
             pass
 
-    out = np.zeros(packed_nbytes(config, b), dtype=np.uint8)
+    if out is None:
+        out = np.zeros(packed_nbytes(config, b), dtype=np.uint8)
+    elif out.shape != (packed_nbytes(config, b),) or out.dtype != np.uint8:
+        raise ValueError("pack_batch out= must be uint8[packed_nbytes]")
     header = np.zeros(4, dtype=np.int32)
     header[0] = n_valid
 
@@ -374,6 +392,51 @@ def pack_batch(
         out[pos : pos + nbytes] = sec.view(np.uint8)
         pos += nbytes
     return out
+
+
+class SuperbatchStager:
+    """Reusable host staging for stacked superbatch dispatch.
+
+    A superbatch crosses the host→device boundary as ONE contiguous
+    ``uint8[K, N]`` array (one large ``device_put`` instead of K small
+    ones).  This stager owns a ring of ``depth + 1`` such arrays so
+    assembling superbatch ``i`` never allocates and never overwrites
+    memory an in-flight transfer may still be reading: the slot being
+    reused was last dispatched as superbatch ``i - depth - 1``, and the
+    dispatch queue (backends/base.py::DispatchQueue) guarantees that
+    dispatch retired — its device step consumed the transfer — before
+    dispatch ``i`` may launch.  Safe under either PJRT host-buffer
+    semantics (immediate copy or zero-copy-until-transfer-completes).
+
+    Callers either pack straight into a row (``pack_batch(..., out=row)``
+    — no intermediate buffer at all) or ``np.copyto`` a worker-staged
+    buffer into it (parallel ingest packs on worker threads before the
+    fan-in order — and hence the row index — is known).
+
+    ``row_shape`` is one batch's staged shape: ``(nbytes,)`` for the
+    single-device backend, ``(local_rows, S, chunk_nbytes)`` for one
+    collective round of the sharded backend — the ring arrays are
+    ``uint8[(k,) + row_shape]`` either way.
+    """
+
+    def __init__(self, row_shape: "tuple[int, ...]", k: int, depth: int):
+        if k < 1 or depth < 1:
+            raise ValueError("superbatch k and dispatch depth must be >= 1")
+        self.k = k
+        self.row_shape = tuple(row_shape)
+        self._ring = [
+            np.empty((k,) + self.row_shape, dtype=np.uint8)
+            for _ in range(depth + 1)
+        ]
+        self._next = 0
+
+    def next_slot(self) -> np.ndarray:
+        """The ``uint8[(K,) + row_shape]`` host array to assemble the next
+        superbatch into.  Rotates the ring; see the class docstring for
+        why the returned memory is quiescent."""
+        slot = self._ring[self._next]
+        self._next = (self._next + 1) % len(self._ring)
+        return slot
 
 
 def unpack_numpy(buf: np.ndarray, config: AnalyzerConfig) -> Dict[str, np.ndarray]:

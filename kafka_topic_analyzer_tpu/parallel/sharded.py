@@ -41,10 +41,14 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from kafka_topic_analyzer_tpu.backends.base import MetricBackend, instrument_steps
+from kafka_topic_analyzer_tpu.backends.base import (
+    DispatchQueue,
+    MetricBackend,
+    instrument_steps,
+)
 from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
-from kafka_topic_analyzer_tpu.backends.step import analyzer_step
-from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.backends.step import analyzer_step, superbatch_fold
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig, DispatchConfig
 from kafka_topic_analyzer_tpu.packing import pack_batch, unpack_device
 from kafka_topic_analyzer_tpu.jax_support import jnp, lax, shard_map
 from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState
@@ -170,6 +174,7 @@ class ShardedTpuBackend(MetricBackend):
         mesh=None,
         init_now_s: "int | None" = None,
         use_native: bool = True,
+        dispatch: "DispatchConfig | None" = None,
     ):
         super().__init__(config)
         self.init_now_s = utc_now_seconds() if init_now_s is None else init_now_s
@@ -251,6 +256,67 @@ class ShardedTpuBackend(MetricBackend):
         )
         self._step = jax.jit(step, donate_argnums=(0,))
         self._merge = jax.jit(self._build_merge())
+
+        # Superbatch dispatch layer: K rounds of per-row chunk stacks
+        # folded by ONE scanned collective dispatch (state donated once
+        # per superbatch).  The scanned axis is the ROUND axis: scan step
+        # k replays exactly what the per-round collective step would have
+        # done at round k — including the alive-pair all_gather over
+        # 'space', which runs once per scan step in step order, so
+        # last-writer-wins application order is preserved across the
+        # scanned axis and results stay byte-identical.
+        self.dispatch_config = dispatch if dispatch is not None else DispatchConfig()
+        self.superbatch_k = self.dispatch_config.resolve(config.batch_size)
+        self.dispatch_depth = self.dispatch_config.depth
+        if self.superbatch_k > 1:
+            def _superstep_body(state, bufs):
+                # bufs block: [K, 1, 1, chunk_nbytes] per (data, space)
+                # device (in_spec puts the round axis on no mesh axis).
+                local = jax.tree.map(lambda x: x[0], state)
+                space_idx = lax.axis_index(SPACE_AXIS)
+                local, n_valid = superbatch_fold(
+                    local,
+                    bufs,
+                    lambda buf: unpack_device(buf[0, 0], chunk_config),
+                    chunk_config,
+                    space_index=space_idx,
+                    space_axis=SPACE_AXIS,
+                )
+                # Completion token: per-device [1, 1] block → global
+                # [D, S] (no extra collective; any leaf syncs the step).
+                token = jnp.sum(n_valid).astype(jnp.int32).reshape(1, 1)
+                return jax.tree.map(lambda x: x[None], local), token
+
+            superstep = shard_map(
+                _superstep_body,
+                mesh=self.mesh,
+                in_specs=(self._specs, P(None, DATA_AXIS, SPACE_AXIS)),
+                out_specs=(self._specs, P(DATA_AXIS, SPACE_AXIS)),
+                check_vma=not relax_vma,
+            )
+            self._superstep = jax.jit(superstep, donate_argnums=(0,))
+            self._superbuf_sharding = NamedSharding(
+                self.mesh, P(None, DATA_AXIS, SPACE_AXIS)
+            )
+            self._queue = DispatchQueue(self.dispatch_depth)
+            from kafka_topic_analyzer_tpu.packing import (
+                SuperbatchStager,
+                packed_nbytes,
+            )
+
+            # One collective round stages as [local_rows, S, chunk_nbytes];
+            # the ring assembles K of them in a single pass (no
+            # stack-then-restack copy) into transfer-quiescent memory.
+            self._stager = SuperbatchStager(
+                (
+                    len(self.local_rows),
+                    config.space_shards,
+                    packed_nbytes(self._chunk_config, config.chunk_size),
+                ),
+                self.superbatch_k,
+                self.dispatch_depth,
+            )
+            self._empty_chunks: "Optional[np.ndarray]" = None
 
     # -- merge ---------------------------------------------------------------
 
@@ -369,6 +435,54 @@ class ShardedTpuBackend(MetricBackend):
             bufs = jax.device_put(per_shard, self._buf_sharding)
         self.state = self._step(self.state, bufs)
 
+    def update_shards_superbatch(
+        self, rounds: "List[List[RecordBatch | PackedShard | None]]"
+    ) -> None:
+        """Fold up to K rounds of shard batches in ONE scanned collective
+        dispatch — byte-identical to K sequential ``update_shards`` calls
+        (the scan replays them in order).  A partial tail is padded to K
+        with empty rounds (identity folds) so the compiled program count
+        stays one.  Collective: under multi-controller every process must
+        call this in lockstep with the same round count — the engine's
+        per-round ``global_any`` agreement guarantees all processes
+        accumulate and flush at the same rounds."""
+        k = self.superbatch_k
+        if not rounds or len(rounds) > k:
+            raise ValueError(f"superbatch of {len(rounds)} rounds (K={k})")
+        d = self.config.data_shards
+        for batches in rounds:
+            if len(batches) != d:
+                raise ValueError(
+                    f"expected {d} shard batches per round, got {len(batches)}"
+                )
+        self._queue.throttle()  # before staging: bounds host stacks too
+        stacked = self._stager.next_slot()  # [K, local_rows, S, chunk_nbytes]
+        for i, batches in enumerate(rounds):
+            for j, r in enumerate(self.local_rows):
+                b = batches[r]
+                np.copyto(
+                    stacked[i, j],
+                    b.chunks if isinstance(b, PackedShard)
+                    else self._pack_chunks(b),
+                )
+        if len(rounds) < k:
+            if self._empty_chunks is None:
+                self._empty_chunks = np.stack(
+                    [self._pack_chunks(None) for _ in self.local_rows]
+                )
+            for i in range(len(rounds), k):
+                np.copyto(stacked[i], self._empty_chunks)
+        if self._multiprocess:
+            bufs = jax.make_array_from_process_local_data(
+                self._superbuf_sharding,
+                stacked,
+                global_shape=(k, d) + stacked.shape[2:],
+            )
+        else:
+            bufs = jax.device_put(stacked, self._superbuf_sharding)
+        self.state, token = self._superstep(self.state, bufs)
+        self._queue.launched(token, len(rounds))
+
     def global_any(self, flag: bool) -> bool:
         """All-process OR of a host flag, via a psum over the data axis.
 
@@ -482,6 +596,8 @@ class ShardedTpuBackend(MetricBackend):
         )
 
     def block_until_ready(self) -> None:
+        if self.superbatch_k > 1:
+            self._queue.drain()
         jax.block_until_ready(self.state)
 
     # -- snapshot/resume (checkpoint.py) -------------------------------------
@@ -557,6 +673,10 @@ class ShardedTpuBackend(MetricBackend):
     # -- finalize ------------------------------------------------------------
 
     def finalize(self) -> TopicMetrics:
+        if self.superbatch_k > 1:
+            # Complete the dispatch-latency histogram before the merge
+            # collective syncs the state anyway.
+            self._queue.drain()
         merged, alive_count, hll_regs, dd_counts = self._merge(self.state)
         merged = jax.tree.map(np.asarray, jax.device_get(merged))
         alive_count = int(alive_count)

@@ -159,7 +159,10 @@ def _metric_total(snapshot: Dict, name: str) -> float:
 
 
 def render_telemetry_stats(
-    snapshot: Optional[Dict], ingest_workers: int = 1
+    snapshot: Optional[Dict],
+    ingest_workers: int = 1,
+    superbatch_k: int = 1,
+    dispatch_depth: int = 1,
 ) -> str:
     """``--stats`` telemetry section from a registry snapshot (cluster-wide
     under multi-controller: the engine merges every process's registry
@@ -221,6 +224,20 @@ def render_telemetry_stats(
         )
         line += f" — records {per}"
     lines.append(line)
+    # Dispatch amortization context (the superbatch layer): device
+    # dispatches, batches per dispatch, and mean per-dispatch latency.
+    # Only rendered when the scan actually ran superbatched — the
+    # per-batch path never touches the dispatch instruments.
+    from kafka_topic_analyzer_tpu.results import DispatchStats
+
+    dispatch = DispatchStats.from_telemetry(snapshot)
+    if dispatch.dispatches:
+        lines.append(
+            f"  dispatch: {dispatch.dispatches:,} superbatch dispatches "
+            f"(K={superbatch_k}, depth={dispatch_depth}), "
+            f"{dispatch.batches:,} batches folded, "
+            f"{dispatch.mean_latency_ms:.1f} ms mean dispatch latency"
+        )
     return "\n".join(lines) + "\n"
 
 

@@ -93,6 +93,49 @@ class IngestStats:
 
 
 @dataclasses.dataclass
+class DispatchStats:
+    """Superbatch-dispatch accounting extracted from a telemetry snapshot
+    (`ScanResult.telemetry`): device dispatches, batches folded through
+    them, and per-dispatch latency totals.  Consumed by the ``--stats``
+    digest (report.py); empty (``dispatches == 0``) for per-batch scans,
+    which never touch the dispatch instruments."""
+
+    #: Superbatch dispatches launched (kta_superbatch_size sample count).
+    dispatches: int
+    #: Packed batches folded through them (kta_superbatch_size sum).
+    batches: int
+    #: (count, seconds) of the per-dispatch latency histogram.
+    latency_count: int
+    latency_seconds: float
+
+    @property
+    def mean_latency_ms(self) -> float:
+        if not self.latency_count:
+            return 0.0
+        return (self.latency_seconds / self.latency_count) * 1e3
+
+    @classmethod
+    def from_telemetry(cls, snapshot: "Optional[dict]") -> "DispatchStats":
+        def agg(name: str) -> "tuple[float, float]":
+            metric = (snapshot or {}).get(name)
+            if not metric:
+                return 0.0, 0.0
+            return (
+                sum(s.get("count", 0.0) for s in metric["samples"]),
+                sum(s.get("sum", 0.0) for s in metric["samples"]),
+            )
+
+        n_dispatch, n_batches = agg("kta_superbatch_size")
+        lat_n, lat_s = agg("kta_dispatch_seconds")
+        return cls(
+            dispatches=int(n_dispatch),
+            batches=int(n_batches),
+            latency_count=int(lat_n),
+            latency_seconds=lat_s,
+        )
+
+
+@dataclasses.dataclass
 class TopicMetrics:
     """Finalized topic metrics.
 

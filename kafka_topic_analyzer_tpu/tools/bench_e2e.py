@@ -402,6 +402,16 @@ def run(argv: "list[str] | None" = None) -> int:
                     help="advertised broker nodes (partition p led by "
                          "p %% N) — exercises leader-parallel fetching")
     ap.add_argument("--alive-bits", type=int, default=26)
+    ap.add_argument("--superbatch", default="1", metavar="K|auto",
+                    help="stack K packed batches per jitted scan dispatch "
+                         "(tpu backend; 'auto' targets 2^20 records per "
+                         "dispatch)")
+    ap.add_argument("--dispatch-depth", type=int, default=2,
+                    help="superbatches allowed in flight while the device "
+                         "folds (default 2)")
+    ap.add_argument("--ingest-workers", type=int, default=1,
+                    help="partition-sharded parallel ingest workers for "
+                         "the scan (engine --ingest-workers)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -440,7 +450,17 @@ def run(argv: "list[str] | None" = None) -> int:
         )
 
         degraded = not ensure_responsive_accelerator() or detect_cpu_fallback()
-    backend = make_backend(args.backend, config)
+    # Same validation as the CLI (cli.resolve_dispatch): an explicit
+    # --superbatch K>1 on the cpu backend is rejected, never silently
+    # dropped — a published bench number must not claim a dispatch
+    # configuration that never ran.
+    from kafka_topic_analyzer_tpu.cli import resolve_dispatch
+
+    try:
+        dispatch = resolve_dispatch(args)
+    except ValueError as e:
+        ap.error(str(e))
+    backend = make_backend(args.backend, config, dispatch=dispatch)
 
     with BrokerProcess(
         topic="bench-e2e", partitions=args.partitions, windows=windows,
@@ -456,6 +476,7 @@ def run(argv: "list[str] | None" = None) -> int:
             backend,
             batch_size=args.batch_size,
             spinner=Spinner(enabled=False),
+            ingest_workers=args.ingest_workers,
         )
         if hasattr(backend, "block_until_ready"):
             backend.block_until_ready()
@@ -483,6 +504,10 @@ def run(argv: "list[str] | None" = None) -> int:
         "value": round(value),
         "unit": "msgs/s",
         "vs_baseline": round(value / BASELINE_MSGS_PER_SEC, 2),
+        "superbatch_k": result.superbatch_k,
+        "dispatch_depth": result.dispatch_depth,
+        "ingest_workers": result.ingest_workers,
+        "batch_size": args.batch_size,
     }
     if degraded:
         # Same honesty rule as bench.py; --backend cpu runs are deliberate

@@ -60,9 +60,18 @@ class _StallSampler(threading.Thread):
     def finish(self) -> "dict[str, float]":
         self._halt.set()
         self.join(2)
-        if not self.delays:
+        if self.is_alive():
+            # The sampler never confirmed stopping (a pathological stall
+            # outlived the join timeout): computing percentiles would race
+            # its ongoing appends — list growth mid-sort can misindex.
+            # No numbers beat wrong numbers in a published benchmark.
             return {}
-        d = np.sort(np.asarray(self.delays))
+        # Snapshot only now that the thread has provably exited: the list
+        # is quiescent, so sort + percentile indexing see one stable view.
+        delays = list(self.delays)
+        if not delays:
+            return {}
+        d = np.sort(np.asarray(delays))
         return {
             "gil_stall_p50_ms": round(float(d[len(d) // 2]) * 1e3, 2),
             "gil_stall_p99_ms": round(float(d[int(len(d) * 0.99)]) * 1e3, 2),

@@ -1,4 +1,5 @@
-"""Packed transfer (wire format v2): layout roundtrip, host pre-reductions."""
+"""Packed transfer (wire format v4 — the layout contract is packing.py's
+module docstring): layout roundtrip, host pre-reductions."""
 
 import jax
 import numpy as np

@@ -1,0 +1,555 @@
+"""Superbatch dispatch: scan-folded multi-batch device steps + the bounded
+in-flight dispatch queue.
+
+The tentpole contract (DESIGN.md §12): for any superbatch size K and
+dispatch depth D, a scan's `ScanResult` — metrics, degraded/corrupt maps,
+resume offsets — is byte-identical to the per-batch (K=1, D=1) scan of the
+same topic.  That must hold composed with the resilience machinery of
+earlier PRs (transport faults, deterministic corruption, parallel ingest),
+with fold-consistent checkpoints (snapshots only at superbatch boundaries,
+partial-tail flush on stop/fault), and across resume chains that change K.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.base import DispatchQueue
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.config import (
+    AnalyzerConfig,
+    CorruptionConfig,
+    DispatchConfig,
+)
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+
+from fake_broker import (
+    ChaosTrigger,
+    CorruptionInjector,
+    FakeBroker,
+    FaultInjector,
+)
+
+pytestmark = pytest.mark.superbatch
+
+TOPIC = "superbatch.topic"
+
+FAST_RETRY = {
+    "retry.backoff.ms": "5",
+    "reconnect.backoff.max.ms": "40",
+}
+
+
+def _mk_records(partition: int, n: int):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 29}".encode() if i % 5 else None,
+            bytes(20 + (i % 13)) if i % 7 else None,
+        )
+        for i in range(n)
+    ]
+
+
+N_PARTS = 4
+N_REC = 300
+RECORDS = {p: _mk_records(p, N_REC) for p in range(N_PARTS)}
+
+CFG = AnalyzerConfig(
+    num_partitions=N_PARTS, batch_size=128,
+    count_alive_keys=True, alive_bitmap_bits=16,
+)
+
+SPEC = SyntheticSpec(
+    num_partitions=5, messages_per_partition=1000,
+    keys_per_partition=31, tombstone_permille=120, seed=3,
+)
+SYN_CFG = AnalyzerConfig(
+    num_partitions=5, batch_size=256,
+    count_alive_keys=True, alive_bitmap_bits=16,
+    enable_hll=True, hll_p=10, enable_quantiles=True,
+)
+
+
+def _backend(cfg=SYN_CFG, k=1, d=1):
+    return TpuBackend(
+        cfg, init_now_s=10**10,
+        dispatch=DispatchConfig(superbatch=k, depth=d),
+    )
+
+
+def _full_doc(result) -> dict:
+    return {
+        "metrics": result.metrics.to_dict(
+            result.start_offsets, result.end_offsets
+        ),
+        "start": result.start_offsets,
+        "end": result.end_offsets,
+        "degraded": result.degraded_partitions,
+        "corrupt": result.corrupt_partitions,
+    }
+
+
+# ---------------------------------------------------------------------------
+# unit: DispatchConfig sizing + the dispatch queue
+
+
+def test_dispatch_config_parse_and_resolve():
+    assert DispatchConfig.parse("4", 3) == DispatchConfig(superbatch=4, depth=3)
+    assert DispatchConfig.parse("auto").resolve(1 << 16) == 16
+    assert DispatchConfig.parse("auto").resolve(1 << 18) == 4
+    assert DispatchConfig.parse("auto").resolve(1 << 20) == 1
+    assert DispatchConfig.parse("auto").resolve(1 << 22) == 1  # floor 1
+    assert DispatchConfig.parse("1").resolve(1 << 16) == 1
+    with pytest.raises(ValueError):
+        DispatchConfig.parse("0")
+    with pytest.raises(ValueError):
+        DispatchConfig.parse("lots")
+    with pytest.raises(ValueError):
+        DispatchConfig(superbatch=2, depth=0)
+
+
+class _Tok:
+    """Completion-token double: not ready until something blocks on it
+    (the jax.block_until_ready duck-type protocol)."""
+
+    def __init__(self):
+        self.ready = False
+
+    def is_ready(self):
+        return self.ready
+
+    def block_until_ready(self):
+        self.ready = True
+        return self
+
+
+def test_dispatch_queue_bounds_inflight():
+    from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+
+    q = DispatchQueue(2)
+    t1, t2, t3 = _Tok(), _Tok(), _Tok()
+    q.throttle(); q.launched(t1, 4)
+    q.throttle(); q.launched(t2, 4)
+    assert len(q) == 2
+    # At the bound: throttle must BLOCK on (and retire) the oldest before
+    # a third launch may record — the drive loop's memory guarantee.
+    q.throttle()
+    assert t1.ready and len(q) == 1
+    q.launched(t3, 2)
+    q.drain()
+    assert t2.ready and t3.ready and len(q) == 0
+    assert obs_metrics.DISPATCH_INFLIGHT.value == 0
+    with pytest.raises(ValueError):
+        DispatchQueue(0)
+
+
+def test_backend_rejects_oversized_superbatch():
+    be = _backend(k=2, d=1)
+    batches = list(SyntheticSource(SPEC).batches(256))
+    with pytest.raises(ValueError):
+        be.update_superbatch(batches[:3])
+    with pytest.raises(ValueError):
+        be.update_superbatch([])
+
+
+# ---------------------------------------------------------------------------
+# determinism: every (K, D) == the K=1 per-batch scan, byte for byte
+
+
+@pytest.fixture(scope="module")
+def syn_baseline():
+    """Per-batch (K=1) synthetic scan — the byte-exact referee."""
+    r = run_scan("t", SyntheticSource(SPEC), _backend(), 256)
+    assert r.superbatch_k == 1
+    return _full_doc(r)
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+@pytest.mark.parametrize("d", [1, 2, 4])
+def test_k_by_d_byte_identical(syn_baseline, k, d):
+    # 20 batches per scan: K=8 exercises a partial (identity-padded) tail,
+    # K∈{2,4} exact multiples — both must match the referee exactly.
+    r = run_scan("t", SyntheticSource(SPEC), _backend(k=k, d=d), 256)
+    assert (r.superbatch_k, r.dispatch_depth) == (k, d)
+    assert _full_doc(r) == syn_baseline
+
+
+def test_superbatch_composes_with_parallel_ingest(syn_baseline):
+    """PR-4 composition: N ingest workers feeding the accumulate-K loop
+    (staged host buffers routed through the fan-in) changes nothing."""
+    r = run_scan(
+        "t", SyntheticSource(SPEC), _backend(k=4, d=2), 256,
+        ingest_workers=3,
+    )
+    assert r.ingest_workers == 3
+    assert _full_doc(r) == syn_baseline
+
+
+def test_single_batch_topic_partial_superbatch(syn_baseline):
+    """K far beyond the batch count: the whole scan is one partial tail."""
+    r = run_scan("t", SyntheticSource(SPEC), _backend(k=16, d=2), 256)
+    assert _full_doc(r) == syn_baseline
+
+
+# ---------------------------------------------------------------------------
+# fault composition: chaos + corruption landing mid-superbatch
+
+
+@pytest.fixture(scope="module")
+def wire_baseline():
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        result = run_scan(
+            TOPIC, src, TpuBackend(CFG, init_now_s=10**10), 128
+        )
+        src.close()
+    assert not result.degraded_partitions
+    return _full_doc(result)
+
+
+def test_transport_fault_mid_superbatch_absorbed(wire_baseline):
+    """A connection kill lands while a superbatch is accumulating; retry +
+    recovery must keep the K=4 result byte-identical to per-batch."""
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        trigger = ChaosTrigger(
+            src, 2,
+            lambda: setattr(
+                broker, "faults",
+                FaultInjector().drop_connection(100, times=2),
+            ),
+        )
+        result = run_scan(
+            TOPIC, trigger, TpuBackend(CFG, init_now_s=10**10, dispatch=DispatchConfig(superbatch=4, depth=2)),
+            128,
+        )
+        src.close()
+    assert not result.degraded_partitions
+    assert _full_doc(result) == wire_baseline
+
+
+def test_corruption_mid_superbatch_matches_per_batch(tmp_path):
+    """Deterministic poison under --on-corruption=quarantine: the corrupt
+    accounting map, metrics, and quarantine spool all match K=1."""
+
+    def poisoned():
+        inj = (
+            CorruptionInjector()
+            .flip_byte(1, chunk=1, offset=-1)
+            .flip_byte(1, chunk=3, offset=-3)
+        )
+        return FakeBroker(
+            TOPIC, RECORDS, max_records_per_fetch=50, corruption=inj,
+            honor_partition_max_bytes=True,
+        )
+
+    def run(k, d, qdir):
+        with poisoned() as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC,
+                overrides=dict(FAST_RETRY, **{"check.crcs": "true"}),
+                corruption=CorruptionConfig(
+                    policy="quarantine", quarantine_dir=qdir
+                ),
+            )
+            result = run_scan(
+                TOPIC, src,
+                TpuBackend(CFG, init_now_s=10**10,
+                           dispatch=DispatchConfig(superbatch=k, depth=d)),
+                128,
+            )
+            src.close()
+        return result
+
+    seq = run(1, 1, str(tmp_path / "q1"))
+    sup = run(4, 2, str(tmp_path / "q4"))
+    assert set(seq.corrupt_partitions) == {1}
+    assert _full_doc(sup) == _full_doc(seq)
+    assert sorted(os.listdir(tmp_path / "q4")) == sorted(
+        os.listdir(tmp_path / "q1")
+    )
+
+
+# ---------------------------------------------------------------------------
+# checkpoints: boundary-only snapshots, partial-tail flush, any-K resume
+
+
+def _snapshot_seqs(monkeypatch):
+    """Record every save_snapshot call's records_seen (in call order)."""
+    from kafka_topic_analyzer_tpu import checkpoint
+
+    seen = []
+    real = checkpoint.save_snapshot
+
+    def spy(*args, **kwargs):
+        seen.append(args[5] if len(args) > 5 else kwargs["records_seen"])
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(checkpoint, "save_snapshot", spy)
+    return seen
+
+
+def test_snapshots_land_only_at_superbatch_boundaries(tmp_path, monkeypatch):
+    """With a zero snapshot interval the per-batch scan snapshots after
+    every batch; the K=4 scan may only snapshot at superbatch boundaries —
+    every 4th batch's cumulative count, plus the flushed tail."""
+    seqs = _snapshot_seqs(monkeypatch)
+    run_scan(
+        "t", SyntheticSource(SPEC), _backend(), 256,
+        snapshot_dir=str(tmp_path / "k1"), snapshot_every_s=0.0,
+    )
+    per_batch = list(seqs)
+    assert per_batch  # one per batch
+    seqs.clear()
+    run_scan(
+        "t", SyntheticSource(SPEC), _backend(k=4, d=2), 256,
+        snapshot_dir=str(tmp_path / "k4"), snapshot_every_s=0.0,
+    )
+    boundaries = per_batch[3::4]
+    if per_batch[-1] not in boundaries:
+        boundaries.append(per_batch[-1])  # the partial-tail flush
+    assert seqs == boundaries
+
+
+def test_final_snapshot_identical_across_k(tmp_path):
+    def snap_meta(k, d):
+        with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+            )
+            run_scan(
+                TOPIC, src,
+                TpuBackend(CFG, init_now_s=10**10,
+                           dispatch=DispatchConfig(superbatch=k, depth=2)),
+                128, snapshot_dir=str(d), snapshot_every_s=0.0,
+            )
+            src.close()
+        with np.load(
+            os.path.join(str(d), "scan_snapshot.npz"), allow_pickle=False
+        ) as z:
+            meta = json.loads(str(z["__meta__"]))
+        return meta["next_offsets"], meta["records_seen"]
+
+    assert snap_meta(1, tmp_path / "k1") == snap_meta(4, tmp_path / "k4")
+
+
+class _Interrupt(Exception):
+    pass
+
+
+class _InterruptingSource(SyntheticSource):
+    """Raises after `limit` batches — a crash landing mid-superbatch."""
+
+    def __init__(self, spec, limit):
+        super().__init__(spec)
+        self.limit = limit
+
+    def batches(self, batch_size, partitions=None, start_at=None):
+        it = super().batches(batch_size, partitions, start_at)
+        for i, b in enumerate(it):
+            if start_at is None and i >= self.limit:
+                raise _Interrupt()
+            yield b
+
+
+def test_fault_flushes_partial_tail_and_resumes_across_k(tmp_path):
+    """A crash with 3 batches pending (K=4, 7 batches seen) must flush the
+    partial tail before the failure snapshot — every observed batch folded
+    and committed, exactly the per-batch path's invariant — and the resume
+    may run under a DIFFERENT K and still reproduce the clean scan."""
+    full = run_scan("t", SyntheticSource(SPEC), _backend(), 256).metrics
+
+    be1 = _backend(k=4, d=2)
+    with pytest.raises(_Interrupt):
+        run_scan(
+            "t", _InterruptingSource(SPEC, limit=7), be1, 256,
+            snapshot_dir=str(tmp_path), snapshot_every_s=3600.0,
+        )
+    from kafka_topic_analyzer_tpu.checkpoint import load_snapshot
+
+    snap = load_snapshot(
+        str(tmp_path), "t", SYN_CFG, template=be1.get_state()
+    )
+    assert snap is not None
+    # All 7 observed batches committed: 4 from the full superbatch, 3 from
+    # the fault-path partial flush.
+    assert snap[2] == 7 * 256
+
+    be2 = TpuBackend(
+        SYN_CFG, init_now_s=0, dispatch=DispatchConfig(superbatch=3, depth=1)
+    )
+    result = run_scan(
+        "t", SyntheticSource(SPEC), be2, 256,
+        snapshot_dir=str(tmp_path), resume=True,
+    )
+    assert result.metrics.to_dict(
+        result.start_offsets, result.end_offsets
+    ) == full.to_dict(result.start_offsets, result.end_offsets)
+
+
+# ---------------------------------------------------------------------------
+# queue mechanics: error propagation, close-on-exit, no leaks
+
+
+class _Boom(Exception):
+    pass
+
+
+class _ExplodingSource(SyntheticSource):
+    def __init__(self, spec, bad_partition):
+        super().__init__(spec)
+        self.bad = bad_partition
+
+    def batches(self, batch_size, partitions=None, start_at=None):
+        it = super().batches(batch_size, partitions, start_at)
+        if partitions is None or self.bad not in partitions:
+            yield from it
+            return
+        for i, b in enumerate(it):
+            if i >= 2:
+                raise _Boom()
+            yield b
+
+
+def test_worker_error_aborts_superbatch_scan_without_leaks():
+    """An ingest-worker death mid-accumulation: the scan aborts, the
+    fault path flushes what it can, and no worker threads leak."""
+    spec = SyntheticSpec(num_partitions=4, messages_per_partition=4000)
+    cfg = AnalyzerConfig(num_partitions=4, batch_size=128)
+    before = threading.active_count()
+    with pytest.raises(_Boom):
+        run_scan(
+            "t", _ExplodingSource(spec, bad_partition=1),
+            TpuBackend(cfg, init_now_s=0,
+                       dispatch=DispatchConfig(superbatch=4, depth=2)),
+            128, ingest_workers=3,
+        )
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+def test_dispatch_telemetry_recorded():
+    from kafka_topic_analyzer_tpu.obs.registry import default_registry
+
+    def agg(snapshot, name):
+        metric = snapshot.get(name) or {"samples": []}
+        return sum(s.get("count", 0) for s in metric["samples"])
+
+    before = default_registry().snapshot()
+    result = run_scan("t", SyntheticSource(SPEC), _backend(k=4, d=2), 256)
+    # 20 batches at K=4 → exactly 5 dispatches, each with a latency sample.
+    dispatches = agg(result.telemetry, "kta_superbatch_size") - agg(
+        before, "kta_superbatch_size"
+    )
+    latencies = agg(result.telemetry, "kta_dispatch_seconds") - agg(
+        before, "kta_dispatch_seconds"
+    )
+    assert dispatches == 5
+    assert latencies == 5
+
+
+# ---------------------------------------------------------------------------
+# sharded mesh: the scanned collective step
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 1), (2, 2)])
+def test_sharded_superbatch_byte_identical(mesh_shape):
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    cfg = AnalyzerConfig(
+        num_partitions=5, batch_size=256,
+        count_alive_keys=True, alive_bitmap_bits=16,
+        enable_hll=True, hll_p=10, mesh_shape=mesh_shape,
+    )
+
+    def doc(k, d):
+        be = ShardedTpuBackend(
+            cfg, init_now_s=10**10,
+            dispatch=DispatchConfig(superbatch=k, depth=d),
+        )
+        r = run_scan("t", SyntheticSource(SPEC), be, 256)
+        return _full_doc(r)
+
+    ref = doc(1, 1)
+    for k, d in [(2, 1), (4, 2)]:
+        assert doc(k, d) == ref
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+
+
+def test_cli_superbatch_json_and_stats(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "t", "--source", "synthetic",
+        "--synthetic", "partitions=4,messages=2000",
+        "--backend", "tpu", "--batch-size", "512",
+        "--superbatch", "4", "--dispatch-depth", "2",
+        "--stats", "--json", "--quiet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr()
+    doc = json.loads(out.out.splitlines()[-1])
+    assert doc["superbatch_k"] == 4
+    assert doc["dispatch_depth"] == 2
+    assert "superbatch dispatches (K=4, depth=2)" in out.err
+
+
+def test_cli_rejects_superbatch_on_cpu_backend(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "t", "--source", "synthetic",
+        "--synthetic", "partitions=4,messages=100",
+        "--backend", "cpu", "--superbatch", "4", "--quiet",
+    ])
+    assert rc == 1
+    assert "--backend tpu" in capsys.readouterr().err
+
+
+def test_cli_superbatch_auto_on_cpu_backend_is_noop(capsys):
+    """'auto' means "size appropriately" — on the cpu oracle that is no
+    superbatching, not an error (mirrors --ingest-workers auto under a
+    mesh: host-dependent hard errors would pass CI and fail prod)."""
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "t", "--source", "synthetic",
+        "--synthetic", "partitions=4,messages=100",
+        "--backend", "cpu", "--superbatch", "auto", "--json", "--quiet",
+    ])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert doc["superbatch_k"] == 1
+
+
+def test_cli_rejects_bad_superbatch_spec(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "t", "--source", "synthetic",
+        "--synthetic", "partitions=4,messages=100",
+        "--backend", "tpu", "--superbatch", "many", "--quiet",
+    ])
+    assert rc == 1
+    assert "--superbatch" in capsys.readouterr().err

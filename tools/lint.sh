@@ -244,3 +244,61 @@ if failures:
     sys.exit(1)
 print("lint: OK (parallel-ingest worker paths mutate no unlocked shared state)")
 EOF
+
+# Fourth rule: the superbatch drive loop can never hold more than
+# --dispatch-depth staged superbatches.  Structurally enforced two ways:
+# (a) in-flight dispatch bookkeeping (any attribute whose name contains
+#     'inflight') is CONFINED to backends/base.py's DispatchQueue — no
+#     drive loop or backend keeps its own unbounded in-flight list;
+# (b) every function that records a launch (`.launched(`) also calls the
+#     bound (`.throttle(`) in the same body, so a dispatch site cannot
+#     launch without first blocking below the depth limit.
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PKG = pathlib.Path("kafka_topic_analyzer_tpu")
+QUEUE_HOME = PKG / "backends" / "base.py"
+#: Where device dispatch lives: the backends, the mesh layer, the engine.
+#: (io/kafka_wire.py has its own fetch-request `_inflight` — a different,
+#: per-connection send-ahead window, bounded by the wire layer itself.)
+DISPATCH_SCOPE = [PKG / "engine.py"] + sorted(
+    (PKG / "backends").glob("*.py")
+) + sorted((PKG / "parallel").glob("*.py"))
+
+failures = []
+for path in sorted(PKG.rglob("*.py")):
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    # (a) inflight bookkeeping confined to DispatchQueue.
+    if path != QUEUE_HOME and path in DISPATCH_SCOPE:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and "inflight" in node.attr.lower():
+                failures.append(
+                    f"{path}:{node.lineno}: in-flight dispatch bookkeeping "
+                    f"({node.attr!r}) outside backends/base.DispatchQueue"
+                )
+    # (b) launch sites must throttle.
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = {
+            n.func.attr
+            for n in ast.walk(node)
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        }
+        if "launched" in calls and "throttle" not in calls:
+            failures.append(
+                f"{path}:{node.lineno}: {node.name!r} launches a dispatch "
+                "without calling the depth throttle first"
+            )
+
+if failures:
+    print("lint: superbatch dispatch-depth bound violated (in-flight")
+    print("lint: tracking lives in backends/base.DispatchQueue; every")
+    print("lint: launch site must throttle to --dispatch-depth first):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (drive loops bound staged superbatches by dispatch depth)")
+EOF
