@@ -129,7 +129,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "partitions,messages,keys,key_null,tombstones,vmin,"
                         "vmax,seed")
     p.add_argument("--segment-dir", metavar="DIR",
-                   help="Directory of .ktaseg segment dumps (--source segfile)")
+                   help="Segment store of .ktaseg dumps (--source segfile): "
+                        "a local directory today; scheme:// specs are "
+                        "reserved for object stores (io/segstore.py). "
+                        "Composes with --ingest-workers (partitions shard "
+                        "across parallel decode+pack workers, balanced by "
+                        "the catalog's record counts) and --superbatch")
     p.add_argument("--batch-size", type=int, default=1 << 18,
                    help="Records per device step")
     p.add_argument("--alive-bitmap-bits", type=int, default=32,
@@ -163,7 +168,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "once per superbatch, one large host->device "
                         "transfer) — K x fewer dispatches with "
                         "byte-identical results. 'auto' targets 2^20 "
-                        "records per dispatch (min 1, max 16). Default: 1. "
+                        "records per dispatch (min 1, max 16), capped at "
+                        "2^18 records per fold so a long synchronous fold "
+                        "cannot starve ingest overlap (DESIGN.md §12); an "
+                        "explicit K is never capped. Default: 1. "
                         "Requires --backend tpu")
     p.add_argument("--dispatch-depth", type=int, default=2, metavar="D",
                    help="Superbatches allowed in flight (staged/"
@@ -410,6 +418,19 @@ def resolve_dispatch(args):
     return cfg
 
 
+def _attach_segment_digest(doc: dict, result) -> None:
+    """--json cold-path digest: when the scan read from a segment store,
+    surface what the catalog opened and how much came off the mapped
+    chunks as a first-class ``segments`` block (the raw counters also ride
+    in ``telemetry``, but automation should not need to know instrument
+    names to see cold-path coverage)."""
+    from kafka_topic_analyzer_tpu.results import SegmentStats
+
+    seg = SegmentStats.from_telemetry(result.telemetry)
+    if seg.files:
+        doc["segments"] = seg.as_dict()
+
+
 def _print_stats(args, result) -> None:
     """--stats stderr dump: per-stage profile + the telemetry counter
     digest (cluster-wide under multi-controller)."""
@@ -604,6 +625,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             union_doc["size_quantiles"] = union.quantiles.as_dict()
         doc["union"] = union_doc
         doc["telemetry"] = result.telemetry
+        _attach_segment_digest(doc, result)
         # Degraded keys are dense fan-in rows; reasons carry topic/partition.
         rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))
@@ -766,6 +788,7 @@ def _run(args) -> int:
         doc["superbatch_k"] = result.superbatch_k
         doc["dispatch_depth"] = result.dispatch_depth
         doc["telemetry"] = result.telemetry
+        _attach_segment_digest(doc, result)
         rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))
         return rc

@@ -155,6 +155,17 @@ class DispatchConfig:
     #: values deepen the pipeline at the cost of host+device memory for
     #: the extra staged buffers.
     depth: int = 2
+    #: Guardrail on ``auto``'s fold size: at most this many records per
+    #: scanned dispatch.  BENCH round 7 measured the failure mode auto must
+    #: avoid — K=16 × B=2^16 (2^20 records ≈ a multi-hundred-ms synchronous
+    #: fold on a host-CPU jit) regressed e2e to 0.63× because the drive
+    #: thread disappears into one fold long enough to starve the ingest
+    #: overlap, while K=4 at the same B measured 1.02×.  2^18 records caps
+    #: the estimated fold wall at ~30-130 ms across measured rigs (~0.12
+    #: µs/record host-CPU fold, ~0.04 device) — long enough to amortize
+    #: dispatch overhead, short enough that backpressure stays responsive.
+    #: Explicit K is never capped: an operator who asks for 16 gets 16.
+    auto_fold_cap_records: int = 1 << 18
 
     def __post_init__(self) -> None:
         if isinstance(self.superbatch, str):
@@ -167,6 +178,8 @@ class DispatchConfig:
             raise ValueError("superbatch must be >= 1")
         if self.depth < 1:
             raise ValueError("dispatch depth must be >= 1")
+        if self.auto_fold_cap_records < 1:
+            raise ValueError("auto fold cap must be >= 1 record")
 
     @classmethod
     def parse(cls, superbatch: str, depth: int = 2) -> "DispatchConfig":
@@ -189,9 +202,14 @@ class DispatchConfig:
         established 2^20 as the default batch; the axon-relay wedge forced
         B=2^16, multiplying per-dispatch overhead 16x — auto wins that
         amortization back without touching the per-batch packed layout),
-        capped at 16 stacked buffers of host staging."""
+        capped at 16 stacked buffers of host staging AND at
+        ``auto_fold_cap_records`` per dispatch — the round-7 guardrail
+        against pushing a multi-hundred-ms synchronous fold onto the drive
+        thread (K=16 at B=2^16 regressed e2e to 0.63×; DESIGN.md §12)."""
         if self.superbatch == "auto":
-            return max(1, min(16, (1 << 20) // max(1, batch_size)))
+            k = max(1, min(16, (1 << 20) // max(1, batch_size)))
+            fold_cap = max(1, self.auto_fold_cap_records // max(1, batch_size))
+            return min(k, fold_cap)
         return int(self.superbatch)
 
 

@@ -555,11 +555,21 @@ def run_scan(
                     shard_partitions,
                 )
 
+                # Cold sources (segment catalogs) know per-partition record
+                # counts up front: balance workers by records, not partition
+                # count.  Byte-identity is grouping-independent (DESIGN §11),
+                # so the wire scan's round-robin rule and this weighted rule
+                # fold to the same result.
+                weigher = getattr(source, "partition_record_counts", None)
                 batches = _closing(
                     ParallelIngest(
                         source,
                         batch_size,
-                        shard_partitions(pindex.ids, used_workers),
+                        shard_partitions(
+                            pindex.ids,
+                            used_workers,
+                            weights=weigher() if weigher is not None else None,
+                        ),
                         start_at=start_at,
                         stage=stage,
                         depth=max(prefetch_depth, 1),

@@ -383,10 +383,16 @@ def decode_record_set_native(
     return out, int(consumed.value), int(covered.value)
 
 
-def pack_batch_native(batch, config) -> "np.ndarray | None":
+def pack_batch_native(
+    batch, config, out: "np.ndarray | None" = None
+) -> "np.ndarray | None":
     """Fused SoA→wire-format-v4 packing in C++ (see packing.py for the
     layout contract).  Returns None when the shim rejects the batch (out of
-    range values) so the numpy path can raise its descriptive error."""
+    range values) so the numpy path can raise its descriptive error.
+    ``out`` packs into a caller-provided contiguous ``uint8[packed_nbytes]``
+    buffer (e.g. a SuperbatchStager row) instead of allocating one — note
+    that a rejected batch may leave partial bytes in it (the numpy
+    fallback overwrites every byte before raising or returning)."""
     from kafka_topic_analyzer_tpu.packing import (
         MAX_VALUE_LEN,
         hll_table_rows,
@@ -399,7 +405,16 @@ def pack_batch_native(batch, config) -> "np.ndarray | None":
     if n > b:
         raise ValueError(f"batch of {n} exceeds batch_size {b}")
     hll_rows = hll_table_rows(config, b)
-    out = np.empty(packed_nbytes(config, b), dtype=np.uint8)
+    if out is None:
+        out = np.empty(packed_nbytes(config, b), dtype=np.uint8)
+    elif (
+        out.shape != (packed_nbytes(config, b),)
+        or out.dtype != np.uint8
+        or not out.flags.c_contiguous
+    ):
+        raise ValueError(
+            "pack_batch_native out= must be contiguous uint8[packed_nbytes]"
+        )
     c = np.ascontiguousarray  # strided views would be read with wrong strides
     nbytes = lib.kta_pack_batch(
         _as_ptr(c(batch.partition), ctypes.c_int32),

@@ -35,8 +35,43 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from kafka_topic_analyzer_tpu.io.kafka_codec import CorruptFrameError
 from kafka_topic_analyzer_tpu.io.source import RecordSource
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.records import RecordBatch
+
+
+class CorruptSegmentError(CorruptFrameError, ValueError):
+    """A .ktaseg file whose *bytes* are wrong — the cold-path analog of the
+    wire scan's corrupt-frame taxonomy (io/kafka_codec.py), so operators
+    triaging a broken dump see the same classified kinds, with the file
+    path in place of the fetch span.  ``ValueError`` stays in the MRO for
+    callers that pre-date the classification.
+
+    ``path`` names the damaged file; the inherited ``partition``/``span``
+    context fields carry the header's claim and the damaged byte range.
+    """
+
+    kind = "corrupt-segment"
+
+    def __init__(self, message: str, *, path: "Optional[str]" = None, **kw):
+        super().__init__(message, **kw)
+        self.path = path
+
+
+class TruncatedSegmentError(CorruptSegmentError):
+    """The file ends before its header-declared column payload (or before
+    the header itself) — an interrupted dump or a partial copy."""
+
+    kind = "truncated"
+
+
+class MalformedSegmentError(CorruptSegmentError):
+    """Structurally impossible header or layout: bad magic, negative
+    count/partition, header↔filename disagreement, overlapping chunks."""
+
+    kind = "malformed-header"
+
 
 MAGIC = b"KTASEG01"
 _HEADER = struct.Struct("<8sii qq")  # magic, partition, flags, start, count
@@ -118,10 +153,26 @@ class SegmentFile:
         with open(path, "rb") as f:
             header = f.read(HEADER_SIZE)
         if len(header) != HEADER_SIZE:
-            raise ValueError(f"{path}: truncated header")
+            raise TruncatedSegmentError(
+                f"{path}: truncated header ({len(header)} of "
+                f"{HEADER_SIZE} bytes)",
+                path=path,
+                span=(0, len(header)),
+            )
         magic, partition, flags, start_offset, count = _HEADER.unpack(header)
         if magic != MAGIC:
-            raise ValueError(f"{path}: bad magic {magic!r}")
+            raise MalformedSegmentError(
+                f"{path}: bad magic {magic!r}", path=path, span=(0, 8)
+            )
+        if count < 0 or partition < 0:
+            raise MalformedSegmentError(
+                f"{path}: impossible header (partition {partition}, "
+                f"count {count})",
+                path=path,
+                partition=partition,
+                span=(0, HEADER_SIZE),
+                num_records=max(count, 0),
+            )
         self.partition = partition
         self.start_offset = start_offset
         self.count = count
@@ -135,8 +186,29 @@ class SegmentFile:
         expected = off
         actual = os.path.getsize(path)
         if actual != expected:
-            raise ValueError(f"{path}: size {actual} != expected {expected}")
+            kind = (
+                TruncatedSegmentError if actual < expected
+                else MalformedSegmentError
+            )
+            raise kind(
+                f"{path}: size {actual} != expected {expected} for "
+                f"{count} records",
+                path=path,
+                partition=partition,
+                span=(0, actual),
+                num_records=count,
+            )
         self._mm = np.memmap(path, dtype=np.uint8, mode="r")
+        #: Lazily-built constants for the zero-copy read path: every batch
+        #: of this file shares one partition/valid array via prefix views.
+        #: Sized to the LARGEST SPAN READ (bounded by the scan's batch
+        #: size), not the file's record count — a year-scale chunk must
+        #: not pin O(file) host RAM for two constant columns.  Marked
+        #: read-only so an accidental in-place mutator downstream fails
+        #: loudly instead of corrupting sibling batches (the memmap
+        #: columns are mode="r" and give the same guarantee).
+        self._const_partition: "Optional[np.ndarray]" = None
+        self._const_valid: "Optional[np.ndarray]" = None
 
     @property
     def end_offset(self) -> int:
@@ -152,22 +224,42 @@ class SegmentFile:
         stop = off + hi * dtype.itemsize
         return self._mm[start:stop].view(dtype)
 
-    def read_batch(self, lo: int, hi: int) -> RecordBatch:
+    def read_batch(self, lo: int, hi: int, copy: bool = False) -> RecordBatch:
+        """Rows [lo, hi) as a RecordBatch — ZERO-COPY by default.
+
+        The int/hash columns and both null flags are direct views of the
+        memmap (bool and uint8 share a byte layout, so the flags reinterpret
+        in place); partition and valid slice per-file read-only constants.
+        The only per-batch allocation is the ms→s timestamp division — the
+        one column whose stored unit differs from the batch contract.  The
+        cold path packs straight from these views (wire v4 sections copy
+        from the mapped pages exactly once, pack_batch ``out=``), so a
+        segment scan's per-record byte traffic is file page → packed row.
+
+        ``copy=True`` detaches every column (the pre-catalog behavior) for
+        callers that must outlive or mutate the mapping.
+        """
         n = hi - lo
+        if self._const_partition is None or len(self._const_partition) < n:
+            part = np.full(n, self.partition, dtype=np.int32)
+            part.flags.writeable = False
+            ones = np.ones(n, dtype=np.bool_)
+            ones.flags.writeable = False
+            self._const_partition, self._const_valid = part, ones
         batch = RecordBatch(
-            partition=np.full(n, self.partition, dtype=np.int32),
-            key_len=self.column("key_len", lo, hi).copy(),
-            value_len=self.column("value_len", lo, hi).copy(),
-            key_null=self.column("key_null", lo, hi).astype(np.bool_),
-            value_null=self.column("value_null", lo, hi).astype(np.bool_),
+            partition=self._const_partition[:n],
+            key_len=self.column("key_len", lo, hi),
+            value_len=self.column("value_len", lo, hi),
+            key_null=self.column("key_null", lo, hi).view(np.bool_),
+            value_null=self.column("value_null", lo, hi).view(np.bool_),
             ts_s=self.column("ts_ms", lo, hi) // 1000,
-            key_hash32=self.column("key_hash32", lo, hi).copy(),
-            key_hash64=self.column("key_hash64", lo, hi).copy(),
-            valid=np.ones(n, dtype=np.bool_),
+            key_hash32=self.column("key_hash32", lo, hi),
+            key_hash64=self.column("key_hash64", lo, hi),
+            valid=self._const_valid[:n],
         )
         if self.has_offsets:
-            batch.offsets = self.column("offsets", lo, hi).copy()
-        return batch
+            batch.offsets = self.column("offsets", lo, hi)
+        return batch.copy() if copy else batch
 
 
 class SegmentDumpWriter:
@@ -185,10 +277,12 @@ class SegmentDumpWriter:
         os.makedirs(directory, exist_ok=True)
         # Refuse a directory that already holds this topic's segments: a
         # shorter re-dump would leave stale chunks behind, and the reader
-        # would silently merge old and new records.
-        import re
+        # would silently merge old and new records.  Same name pattern as
+        # the reader's enumeration (segstore), so the staleness check can
+        # never desync from what a later scan would pick up.
+        from kafka_topic_analyzer_tpu.io.segstore import topic_chunk_pattern
 
-        pattern = re.compile(rf"^{re.escape(topic)}-\d+(?:\.c\d+)?\.ktaseg$")
+        pattern = topic_chunk_pattern(topic)
         stale = [f for f in os.listdir(directory) if pattern.match(f)]
         if stale:
             raise ValueError(
@@ -311,53 +405,48 @@ class TeeSource(RecordSource):
 
 
 class SegmentFileSource(RecordSource):
-    """RecordSource over a directory of {topic}-{partition}[.cN].ktaseg
-    files; a partition's chunks are ordered by start offset."""
+    """RecordSource over a catalog of {topic}-{partition}[.cN].ktaseg
+    chunks in a SegmentStore (a local directory today — io/segstore.py is
+    the object-store seam); a partition's chunks are ordered by start
+    offset.
 
-    def __init__(self, segment_dir: str, topic: str):
-        self.segment_dir = segment_dir
+    This is the first-class cold path: with ``--ingest-workers N`` the
+    engine shards the catalog's partitions over N decode→pack workers
+    (record-count-balanced via `partition_record_counts`), each draining
+    its own ``batches()`` stream — safe because distinct partitions touch
+    distinct SegmentFiles, so workers never share mutable reader state,
+    and exact for the same reason the wire fan-in is (DESIGN.md §11: each
+    partition's records travel one worker's stream in offset order).
+    """
+
+    def __init__(self, store, topic: str):
+        from kafka_topic_analyzer_tpu.io.segstore import (
+            SegmentCatalog,
+            open_segment_store,
+        )
+
+        if isinstance(store, str):
+            store = open_segment_store(store)
+        self.store = store
         self.topic = topic
-        # Exact match on "{topic}-{int}[.c{int}].ktaseg": a prefix match
-        # would also swallow segments of topics like "{topic}-extra".
-        import re
-
-        pattern = re.compile(rf"^{re.escape(topic)}-(\d+)(?:\.c\d+)?\.ktaseg$")
-        self.segments: Dict[int, List[SegmentFile]] = {}
-        for fname in sorted(os.listdir(segment_dir)):
-            m = pattern.match(fname)
-            if not m:
-                continue
-            seg = SegmentFile(os.path.join(segment_dir, fname))
-            if seg.partition != int(m.group(1)):
-                raise ValueError(
-                    f"{fname}: header partition {seg.partition} does not "
-                    f"match filename"
-                )
-            self.segments.setdefault(seg.partition, []).append(seg)
-        for p, chunks in self.segments.items():
-            chunks.sort(key=lambda s: s.start_offset)
-            for prev, nxt in zip(chunks, chunks[1:]):
-                if nxt.start_offset < prev.end_offset:
-                    raise ValueError(
-                        f"overlapping segment chunks for partition {p}: "
-                        f"{os.path.basename(prev.path)} ends at "
-                        f"{prev.end_offset} but "
-                        f"{os.path.basename(nxt.path)} starts at "
-                        f"{nxt.start_offset} — stale chunks from an older "
-                        "dump?"
-                    )
+        self.catalog = SegmentCatalog(store, topic)
+        self.segments: Dict[int, List[SegmentFile]] = self.catalog.segments
         if not self.segments:
             raise SystemExit(
-                f"no {topic}-*.ktaseg files in {segment_dir!r}"
+                f"no {topic}-*.ktaseg files in {store.describe()!r}"
             )
 
     def partitions(self) -> List[int]:
         return sorted(self.segments)
 
     def watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
-        start = {p: chunks[0].start_offset for p, chunks in self.segments.items()}
-        end = {p: chunks[-1].end_offset for p, chunks in self.segments.items()}
-        return start, end
+        return self.catalog.watermarks()
+
+    def partition_record_counts(self) -> Dict[int, int]:
+        """Exact retained records per partition (catalog metadata) — the
+        engine balances parallel-ingest workers by these instead of by
+        partition count, since cold catalogs know their sizes up front."""
+        return self.catalog.record_counts()
 
     def batches(
         self,
@@ -381,4 +470,7 @@ class SegmentFileSource(RecordSource):
                     else:
                         first = min(max(resume - seg.start_offset, 0), seg.count)
                 for lo in range(first, seg.count, batch_size):
-                    yield seg.read_batch(lo, min(lo + batch_size, seg.count))
+                    hi = min(lo + batch_size, seg.count)
+                    obs_metrics.SEGMENT_RECORDS.inc(hi - lo)
+                    obs_metrics.SEGMENT_BATCHES.inc()
+                    yield seg.read_batch(lo, hi)

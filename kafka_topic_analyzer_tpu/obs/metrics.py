@@ -72,6 +72,21 @@ INGEST_WORKER_STALL_SECONDS = _REG.counter(
     "fan-in queue (backpressure from the merge loop/device)",
     labelnames=("worker",))
 
+# -- cold segment path (io/segfile.py + io/segstore.py) -----------------------
+
+SEGMENT_FILES_OPENED = _REG.counter(
+    "kta_segment_files_opened_total",
+    "Segment chunks (.ktaseg) opened by the cold-path catalog")
+SEGMENT_BYTES_MAPPED = _REG.counter(
+    "kta_segment_bytes_mapped_total",
+    "Bytes of segment chunks memory-mapped by the cold-path catalog")
+SEGMENT_RECORDS = _REG.counter(
+    "kta_segment_records_total",
+    "Records read from memory-mapped segment chunks")
+SEGMENT_BATCHES = _REG.counter(
+    "kta_segment_batches_total",
+    "Batches cut from memory-mapped segment chunks")
+
 # -- io/kafka_wire ------------------------------------------------------------
 
 FETCH_REQUESTS = _REG.counter(

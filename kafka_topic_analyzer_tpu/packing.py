@@ -307,8 +307,10 @@ def pack_batch(
         )
 
     if use_native:
-        # Fused C++ pack (columns + dedupe + HLL split in one pass).  A None
-        # return means the shim rejected the batch; the numpy path below
+        # Fused C++ pack (columns + dedupe + HLL split in one pass),
+        # writing straight into ``out`` when given (superbatch rows take
+        # the packed bytes with no intermediate buffer).  A None return
+        # means the shim rejected the batch; the numpy path below
         # re-derives the descriptive error.
         try:
             from kafka_topic_analyzer_tpu.io.native import (
@@ -317,27 +319,28 @@ def pack_batch(
             )
 
             if native_available():
-                packed = pack_batch_native(batch, config)
+                packed = pack_batch_native(batch, config, out=out)
                 if packed is not None:
-                    if out is not None:
-                        np.copyto(out, packed)
-                        return out
                     return packed
         except ImportError:
             pass
 
     if out is None:
-        out = np.zeros(packed_nbytes(config, b), dtype=np.uint8)
+        out = np.empty(packed_nbytes(config, b), dtype=np.uint8)
     elif out.shape != (packed_nbytes(config, b),) or out.dtype != np.uint8:
         raise ValueError("pack_batch out= must be uint8[packed_nbytes]")
     header = np.zeros(4, dtype=np.int32)
     header[0] = n_valid
 
     pos = HEADER_BYTES
+    # Integer columns go in uncast: the section write below assigns through
+    # a typed view, which narrows exactly like the astype it replaces —
+    # minus one intermediate array per column (range checks above already
+    # guarantee the narrowing is lossless).
     fields: Dict[str, np.ndarray] = {
-        "partition": batch.partition.astype(np.int16),
-        "key_len": batch.key_len.astype(np.uint16),
-        "value_len": batch.value_len.astype(np.uint32),
+        "partition": batch.partition,
+        "key_len": batch.key_len,
+        "value_len": batch.value_len,
         "flags": (
             batch.key_null.astype(np.uint8) | (batch.value_null.astype(np.uint8) << 1)
         ),
@@ -385,11 +388,15 @@ def pack_batch(
 
     out[:HEADER_BYTES] = header.view(np.uint8)
     for name, dtype, count in _sections(config, b):
+        # Write each section directly through a typed view of the output
+        # buffer — no staging array, so the bytes move source→buffer once.
+        # With memmap-backed columns (SegmentFile.read_batch) that makes
+        # the whole numpy pack a single file-page→wire-row copy per column.
         nbytes = np.dtype(dtype).itemsize * count
         src = fields[name]
-        sec = np.zeros(count, dtype=dtype)
-        sec[: len(src)] = src.astype(dtype, copy=False)
-        out[pos : pos + nbytes] = sec.view(np.uint8)
+        sec = out[pos : pos + nbytes].view(dtype)
+        sec[: len(src)] = src
+        sec[len(src):] = 0  # tail padding past the batch's rows
         pos += nbytes
     return out
 

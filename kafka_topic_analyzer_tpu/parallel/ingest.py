@@ -12,7 +12,9 @@ This module runs N of them inside one scan:
 
 - the partition set is sharded into N disjoint groups
   (``shard_partitions`` — same round-robin rule as the mesh's data-shard
-  assignment, so skew balancing matches parallel/mesh.py);
+  assignment, so skew balancing matches parallel/mesh.py; cold sources
+  whose catalogs know exact per-partition record counts pass ``weights``
+  and get a deterministic greedy-LPT balance instead);
 - each group gets a private ``source.batches()`` stream on its own worker
   thread (the wire layer guarantees per-stream connection privacy, so
   workers never share a socket), which also stages decode→remap→pack so
@@ -66,15 +68,41 @@ class _Error:
         self.exc = exc
 
 
-def shard_partitions(partitions: List[int], workers: int) -> List[List[int]]:
-    """Disjoint round-robin partition groups, one per worker — LITERALLY
-    the mesh data axis' assignment rule (delegated, so a future
-    skew-aware change there cannot desynchronize worker sharding from
-    mesh sharding).  Empty groups are dropped (callers clamp ``workers``
-    to the partition count first, but a caller that does not must still
-    get only live workers)."""
+def shard_partitions(
+    partitions: List[int],
+    workers: int,
+    weights: "Optional[Dict[int, int]]" = None,
+) -> List[List[int]]:
+    """Disjoint partition groups, one per worker.
+
+    Without ``weights``: round-robin — LITERALLY the mesh data axis'
+    assignment rule (delegated, so a future skew-aware change there cannot
+    desynchronize worker sharding from mesh sharding).
+
+    With ``weights`` (partition -> expected records; the cold segment
+    path's catalog knows these exactly — SegmentFileSource.
+    partition_record_counts): deterministic greedy LPT — partitions
+    descend by weight (ties by id) onto the least-loaded group (ties by
+    group index), so a skewed catalog doesn't leave workers idle behind
+    one hot partition.  The grouping stays a pure function of the inputs,
+    and ANY disjoint grouping folds byte-identically (DESIGN.md §11 — a
+    partition's records still travel one worker's stream in offset order).
+
+    Empty groups are dropped (callers clamp ``workers`` to the partition
+    count first, but a caller that does not must still get only live
+    workers)."""
     if workers < 1:
         raise ValueError("workers must be >= 1")
+    if weights:
+        loads = [0] * workers
+        groups: "List[List[int]]" = [[] for _ in range(workers)]
+        for p in sorted(partitions, key=lambda p: (-weights.get(p, 0), p)):
+            w = min(range(workers), key=lambda i: (loads[i], i))
+            groups[w].append(p)
+            loads[w] += weights.get(p, 0)
+        # Offset order within a worker's stream is per partition either
+        # way; ascending ids keep the group layout readable in --stats.
+        return [sorted(g) for g in groups if g]
     from kafka_topic_analyzer_tpu.parallel.mesh import assign_partitions
 
     return [g for g in assign_partitions(partitions, workers) if g]

@@ -207,6 +207,18 @@ def render_telemetry_stats(
             f"partitions"
         ),
     ]
+    # Cold-path digest: what the segment catalog opened/mapped and how many
+    # records came off the mapped chunks.  Only rendered when the scan
+    # actually read segments (broker scans never touch these instruments).
+    from kafka_topic_analyzer_tpu.results import SegmentStats
+
+    seg = SegmentStats.from_telemetry(snapshot)
+    if seg.files:
+        lines.append(
+            f"  segments: {seg.files:,} chunk(s) "
+            f"({seg.bytes_mapped / 1e6:,.1f} MB mapped), "
+            f"{seg.records:,.0f} records in {seg.batches:,.0f} batches"
+        )
     # Parallelism context for every throughput number above: worker count
     # always, the per-worker split when the scan actually ran parallel
     # (sequential scans never touch the per-worker instruments).

@@ -93,6 +93,48 @@ class IngestStats:
 
 
 @dataclasses.dataclass
+class SegmentStats:
+    """Cold-path accounting extracted from a telemetry snapshot
+    (`ScanResult.telemetry`): segment chunks the catalog opened, bytes it
+    mapped, and the records/batches read from them.  Consumed by the
+    ``--stats`` digest (report.py) and the ``--json`` ``segments`` block
+    (cli.py); empty (``files == 0``) for scans that never touched a
+    segment store."""
+
+    #: .ktaseg chunks opened by the catalog.
+    files: int
+    #: Bytes of chunk data memory-mapped.
+    bytes_mapped: int
+    #: Records read out of the mapped chunks.
+    records: int
+    #: Batches cut from them.
+    batches: int
+
+    @classmethod
+    def from_telemetry(cls, snapshot: "Optional[dict]") -> "SegmentStats":
+        def total(name: str) -> float:
+            metric = (snapshot or {}).get(name)
+            if not metric:
+                return 0.0
+            return sum(s.get("value", 0.0) for s in metric["samples"])
+
+        return cls(
+            files=int(total("kta_segment_files_opened_total")),
+            bytes_mapped=int(total("kta_segment_bytes_mapped_total")),
+            records=int(total("kta_segment_records_total")),
+            batches=int(total("kta_segment_batches_total")),
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "files": self.files,
+            "bytes_mapped": self.bytes_mapped,
+            "records": self.records,
+            "batches": self.batches,
+        }
+
+
+@dataclasses.dataclass
 class DispatchStats:
     """Superbatch-dispatch accounting extracted from a telemetry snapshot
     (`ScanResult.telemetry`): device dispatches, batches folded through

@@ -1,0 +1,217 @@
+"""Cold-path capacity benchmark: broker-bypass segment scanning rec/s vs
+worker count (BENCH round 8).
+
+Measures the `--source segfile` ingest pipeline — memory-mapped .ktaseg
+chunks → zero-copy column views → wire-v4 pack — through the same
+partition-sharded fan-in the engine runs (`parallel/ingest.py`), minus the
+device backend, so the number is the cold scan's host ingest ceiling.  The
+referee for the worker sweep is the round-3 socket-free pipeline
+measurement (12-13M rec/s/core on this class of box): the segment path
+deletes the kernel receive cost entirely, so N workers should aggregate
+toward N x the per-core pipeline rate until memory bandwidth binds.
+
+One JSON line, bench_ingest-style: per-N wall rates (best-of with the
+full run list), records/client-CPU-second, and the catalog digest.
+
+Usage:
+    python -m kafka_topic_analyzer_tpu.tools.bench_segments \
+        --records 8000000 --partitions 16 --workers 1,2,4,8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+
+
+def _build_segments(args, directory: str) -> None:
+    """Synthesize the workload as .ktaseg chunks (tools/make_segments with
+    the native generator when available)."""
+    from kafka_topic_analyzer_tpu.tools.make_segments import main as ms_main
+
+    per_part = max(args.records // args.partitions, 1)
+    spec = (
+        f"partitions={args.partitions},messages={per_part},"
+        f"keys={args.keys},tombstones=100"
+    )
+    rc = ms_main([
+        "--out", directory, "--topic", args.topic, "--synthetic", spec,
+        "--batch-size", str(max(args.batch_size, 1 << 18)),
+        "--native", args.native,
+    ])
+    if rc != 0:
+        raise SystemExit("segment generation failed")
+
+
+def _measure(source, batch_size: int, workers: int, stage) -> dict:
+    """One timed drain: N=1 is the sequential referee (plain batches()
+    loop + inline stage — the engine's prefetch path minus the thread),
+    N>1 the deterministic fan-in with per-worker staging, exactly what
+    `--ingest-workers N` runs inside the engine."""
+    from kafka_topic_analyzer_tpu.parallel.ingest import (
+        ParallelIngest,
+        shard_partitions,
+    )
+
+    got = 0
+    c0 = os.times()
+    t0 = time.perf_counter()
+    if workers == 1:
+        for batch in source.batches(batch_size):
+            if stage is not None:
+                stage(batch)
+            got += len(batch)
+    else:
+        groups = shard_partitions(
+            source.partitions(), workers,
+            weights=source.partition_record_counts(),
+        )
+        pool = ParallelIngest(source, batch_size, groups, stage=stage, depth=2)
+        try:
+            for batch, _staged in pool:
+                got += len(batch)
+        finally:
+            pool.close()
+    wall = time.perf_counter() - t0
+    c1 = os.times()
+    return {
+        "records": got,
+        "wall": wall,
+        "cpu": (c1.user - c0.user) + (c1.system - c0.system),
+    }
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--segment-dir",
+                    help="existing .ktaseg directory to scan; default: "
+                         "synthesize one from the workload flags below "
+                         "into a temp dir")
+    ap.add_argument("--topic", default="bench-seg")
+    ap.add_argument("--records", type=int, default=8_000_000)
+    ap.add_argument("--partitions", type=int, default=16)
+    ap.add_argument("--keys", type=int, default=5000)
+    ap.add_argument("--batch-size", type=int, default=1 << 16)
+    ap.add_argument("--workers", default="1,2,4,8",
+                    help="comma-separated worker counts to sweep")
+    ap.add_argument("--repeat", type=int, default=3,
+                    help="passes per worker count; best is the headline "
+                         "(capacity is a max on a shared box), with the "
+                         "full run list alongside")
+    ap.add_argument("--no-pack", action="store_true",
+                    help="skip the wire-v4 pack stage (isolates the "
+                         "mmap-read cost; default stages pack on the "
+                         "workers exactly like the tpu cold scan)")
+    ap.add_argument("--features", default="counters",
+                    help="comma list for the pack config: counters[,alive]"
+                         "[,hll][,quantiles]")
+    ap.add_argument("--native", choices=["auto", "on", "off"], default="auto")
+    args = ap.parse_args(argv)
+    sweep = [int(w) for w in args.workers.split(",") if w]
+    if any(w < 1 for w in sweep):
+        ap.error("--workers entries must be >= 1")
+
+    from kafka_topic_analyzer_tpu.io.segfile import SegmentFileSource
+    from kafka_topic_analyzer_tpu.packing import pack_batch
+
+    tmp = None
+    seg_dir = args.segment_dir
+    if seg_dir is None:
+        tmp = tempfile.mkdtemp(prefix="kta-bench-seg-")
+        seg_dir = tmp
+        print(f"bench_segments: building segments in {seg_dir}",
+              file=sys.stderr)
+        _build_segments(args, seg_dir)
+    try:
+        probe = SegmentFileSource(seg_dir, args.topic)
+        feats = {f.strip() for f in args.features.split(",") if f.strip()}
+        config = AnalyzerConfig(
+            num_partitions=len(probe.partitions()),
+            batch_size=args.batch_size,
+            count_alive_keys="alive" in feats,
+            enable_hll="hll" in feats,
+            enable_quantiles="quantiles" in feats,
+        )
+        use_native = args.native in ("auto", "on")
+        stage = None
+        if not args.no_pack:
+            # Mirror the engine's worker staging: dense ids + wire-v4 pack
+            # (native, GIL-released) on the worker thread.  Synthetic dumps
+            # are dense already; a user-supplied catalog may not be.
+            from kafka_topic_analyzer_tpu.engine import PartitionIndex
+
+            pindex = PartitionIndex(probe.partitions())
+
+            def stage(b):  # noqa: F811 — the staging callable
+                return pack_batch(
+                    pindex.remap_batch(b), config, use_native=use_native
+                )
+
+        doc: "dict[str, object]" = {
+            "metric": "segments",
+            "nproc": os.cpu_count(),
+            "topic": args.topic,
+            "batch_size": args.batch_size,
+            "pack": not args.no_pack,
+            "features": sorted(feats),
+            "catalog": {
+                "files": probe.catalog.num_files,
+                "bytes": probe.catalog.total_bytes,
+                "records": sum(probe.catalog.record_counts().values()),
+                "partitions": len(probe.partitions()),
+            },
+        }
+        rates: "dict[str, int]" = {}
+        runs: "dict[str, list[int]]" = {}
+        cpu_rates: "dict[str, int]" = {}
+        for n in sweep:
+            best = None
+            n_runs = []
+            for _ in range(max(args.repeat, 1)):
+                # A fresh source per pass: per-file constant caches and OS
+                # page cache persist (deliberately — cold *IO* is the disk's
+                # story; this measures the pipeline), but reader state does
+                # not leak across worker counts.
+                src = SegmentFileSource(seg_dir, args.topic)
+                r = _measure(src, args.batch_size, n, stage)
+                n_runs.append(round(r["records"] / r["wall"]))
+                if best is None or r["records"] / r["wall"] > (
+                    best["records"] / best["wall"]
+                ):
+                    best = r
+            rates[str(n)] = max(n_runs)
+            runs[str(n)] = n_runs
+            cpu_rates[str(n)] = (
+                round(best["records"] / best["cpu"]) if best["cpu"] else 0
+            )
+            print(
+                f"bench_segments: {n} worker(s) {best['records']} records, "
+                f"best of {len(n_runs)}: {max(n_runs):,}/s "
+                f"(wall={best['wall']:.2f}s cpu={best['cpu']:.2f}s)",
+                file=sys.stderr,
+            )
+        doc["seg_msgs_per_sec"] = rates
+        doc["seg_runs"] = runs
+        doc["seg_cpu_msgs_per_sec"] = cpu_rates
+        if "1" in rates:
+            doc["speedup_vs_1"] = {
+                n: round(v / rates["1"], 2) for n, v in rates.items()
+            }
+        print(json.dumps(doc))
+        return 0
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

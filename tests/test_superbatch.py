@@ -103,10 +103,21 @@ def _full_doc(result) -> dict:
 
 def test_dispatch_config_parse_and_resolve():
     assert DispatchConfig.parse("4", 3) == DispatchConfig(superbatch=4, depth=3)
-    assert DispatchConfig.parse("auto").resolve(1 << 16) == 16
-    assert DispatchConfig.parse("auto").resolve(1 << 18) == 4
+    # auto targets 2^20 records/dispatch but the round-7 guardrail caps the
+    # synchronous fold at auto_fold_cap_records (2^18 by default): the
+    # K=16 x B=2^16 e2e regression (0.63x, BENCH round 7) can no longer be
+    # reached through auto.
+    assert DispatchConfig.parse("auto").resolve(1 << 12) == 16
+    assert DispatchConfig.parse("auto").resolve(1 << 14) == 16
+    assert DispatchConfig.parse("auto").resolve(1 << 16) == 4
+    assert DispatchConfig.parse("auto").resolve(1 << 18) == 1
     assert DispatchConfig.parse("auto").resolve(1 << 20) == 1
     assert DispatchConfig.parse("auto").resolve(1 << 22) == 1  # floor 1
+    # A wider explicit cap restores the pure 2^20-records target...
+    wide = DispatchConfig(superbatch="auto", auto_fold_cap_records=1 << 20)
+    assert wide.resolve(1 << 16) == 16
+    # ...and explicit K is never capped: the operator's number wins.
+    assert DispatchConfig.parse("16").resolve(1 << 16) == 16
     assert DispatchConfig.parse("1").resolve(1 << 16) == 1
     with pytest.raises(ValueError):
         DispatchConfig.parse("0")
@@ -114,6 +125,8 @@ def test_dispatch_config_parse_and_resolve():
         DispatchConfig.parse("lots")
     with pytest.raises(ValueError):
         DispatchConfig(superbatch=2, depth=0)
+    with pytest.raises(ValueError):
+        DispatchConfig(superbatch=2, auto_fold_cap_records=0)
 
 
 class _Tok:

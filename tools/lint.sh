@@ -49,9 +49,13 @@ EOF
 # Second rule: decode-surface functions under io/ must never raise a BARE
 # ValueError or struct.error — untrusted wire input must classify (a typed
 # subclass: kafka_codec's CorruptFrameError taxonomy, compression's
-# CorruptPayloadError, zstd_py's CorruptZstdStream).  Encode-side helpers
-# (ByteWriter, encode_*, *_compress_*) are exempt: they validate caller
-# input, not wire bytes.
+# CorruptPayloadError, zstd_py's CorruptZstdStream, segfile's
+# CorruptSegmentError family).  The segment READER surface (SegmentFile*,
+# SegmentCatalog, *SegmentStore classes) counts as decode surface: .ktaseg
+# files are untrusted on-disk input exactly like fetched frames.
+# Encode-side helpers (ByteWriter, encode_*, *_compress_*, write_segment*,
+# SegmentDumpWriter) are exempt: they validate caller input, not stored
+# bytes.
 python - <<'EOF'
 import ast
 import pathlib
@@ -63,8 +67,12 @@ DECODE_SURFACE = re.compile(
     r"decode|decompress|salvage|iter_batch|_iter_frames|_parse_frame"
     r"|_resync|_plausible|scan_record|_read_uvarint|_output_size"
     r"|_output_bound|_snappy_raw|_lz4_block|_decode_legacy"
+    r"|SegmentFile|SegmentCatalog|SegmentStore"
 )
-ENCODE_SIDE = re.compile(r"encode|compress_xerial|compress_frame|_compress\b")
+ENCODE_SIDE = re.compile(
+    r"encode|compress_xerial|compress_frame|_compress\b"
+    r"|write_segment|SegmentDumpWriter"
+)
 
 failures = []
 for path in sorted(IO_DIR.glob("*.py")):
