@@ -225,9 +225,18 @@ class TpuBackend(MetricBackend):
         self.state, token = self._superstep(self.state, bufs)
         self._queue.launched(token, len(staged))
 
-    def block_until_ready(self) -> None:
+    def drain_dispatch(self) -> None:
+        """Retire every in-flight superbatch dispatch without launching a
+        new one — the engine's failure path calls this before the final
+        snapshot so the dispatch-latency histogram and in-flight gauge
+        close out and the snapshotted state is provably quiescent.  (The
+        single-device twin of ShardedTpuBackend.drain_dispatch, where the
+        no-new-collective property is what makes it lockstep-safe.)"""
         if self.superbatch_k > 1:
             self._queue.drain()
+
+    def block_until_ready(self) -> None:
+        self.drain_dispatch()
         jax.block_until_ready(self.state)
 
     # -- snapshot/resume (checkpoint.py) -------------------------------------
@@ -241,9 +250,8 @@ class TpuBackend(MetricBackend):
         )
 
     def finalize(self) -> TopicMetrics:
-        if self.superbatch_k > 1:
-            # Retire every in-flight dispatch first so the latency
-            # histogram is complete (device_get below syncs anyway).
-            self._queue.drain()
+        # Retire every in-flight dispatch first so the latency histogram
+        # is complete (device_get below syncs anyway).
+        self.drain_dispatch()
         host_state = jax.tree.map(np.asarray, jax.device_get(self.state))
         return metrics_from_state(host_state, self.config, self.init_now_s)

@@ -41,15 +41,42 @@ SNAPSHOT_NAME = "scan_snapshot.npz"
 _EXECUTION_ONLY_FIELDS = ("use_pallas_counters",)
 
 
-def _fingerprint_at(config: AnalyzerConfig, topic: str, version: int) -> str:
+def _fingerprint_at(
+    config: AnalyzerConfig, topic: str, version: int, mesh_free: bool = False
+) -> str:
     fields = dataclasses.asdict(config)
     for k in _EXECUTION_ONLY_FIELDS:
         fields.pop(k, None)
+    if mesh_free:
+        # Mesh-free snapshots store the CANONICAL (single-device-layout)
+        # state, which every mesh can adopt — so the mesh shape is pure
+        # execution strategy for them and must not pin the fingerprint.
+        fields.pop("mesh_shape", None)
     payload = json.dumps(
         {"topic": topic, "state_version": version, **fields},
         sort_keys=True,
     )
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def mesh_free_snapshots(config: AnalyzerConfig) -> bool:
+    """True when this config's snapshots canonicalize to the mesh-free
+    single-device layout and may resume under ANY mesh shape (and any
+    --ingest-workers / --superbatch / --dispatch-depth — those never
+    entered the fingerprint).
+
+    Every analyzer fold except one is associative AND commutative across
+    device rows (counters and DDSketch rows add, extremes and HLL
+    registers merge by min/max), so a stacked state folds down to one
+    canonical row at save time and redistributes as (canonical, identity,
+    identity, ...) at load time — the mesh's finalize reduction then
+    reproduces exactly the canonical values (DESIGN.md §14).  The
+    exception is the alive-key bitmap: last-writer-wins bit CLEARS only
+    resolve correctly against the same row that set the bit, and the
+    partition→row assignment changes with the mesh — so alive-key scans
+    keep the mesh-pinned fingerprint (resuming them under a different
+    mesh is a clean error, not a silent miscount)."""
+    return not config.count_alive_keys
 
 
 def config_fingerprint(config: AnalyzerConfig, topic: str) -> str:
@@ -64,20 +91,74 @@ def config_fingerprint(config: AnalyzerConfig, topic: str) -> str:
     accept the v3-stamped fingerprint for S=1 configs
     (`acceptable_fingerprints`), keeping both pre-r2 AND r2/r3-era
     single-space-shard snapshots resumable (the r2/r3 code stamped every
-    config v3)."""
+    config v3).  v4 (r7): configs without the alive bitmap store the
+    CANONICAL mesh-free layout (see `mesh_free_snapshots`) and drop
+    mesh_shape from the fingerprint — any-mesh↔any-mesh resume."""
+    if mesh_free_snapshots(config):
+        return _fingerprint_at(config, topic, 4, mesh_free=True)
     version = 2 if config.space_shards == 1 else 3
     return _fingerprint_at(config, topic, version)
 
 
 def acceptable_fingerprints(config: AnalyzerConfig, topic: str) -> "set[str]":
     """All fingerprints a loader should accept for this config: the
-    canonical one, plus the v3-stamped variant for S=1 configs whose state
-    layout is identical under both version labels (see
-    config_fingerprint)."""
+    canonical one, plus compatible legacy stamps — the v3 variant for S=1
+    configs whose state layout is identical under both version labels,
+    and (for mesh-free configs) the pre-v4 mesh-pinned stamps of the SAME
+    mesh, whose stacked leaves still match the current backend's template
+    exactly (see config_fingerprint)."""
     out = {config_fingerprint(config, topic)}
+    if mesh_free_snapshots(config):
+        # Legacy (pre-r7) snapshots of this exact mesh: stacked layout,
+        # mesh-pinned stamp.  Shapes match the current template, so they
+        # load directly.
+        out.add(_fingerprint_at(config, topic, 2 if config.space_shards == 1 else 3))
     if config.space_shards == 1:
         out.add(_fingerprint_at(config, topic, 3))
     return out
+
+
+def _canonicalize(state: AnalyzerState) -> AnalyzerState:
+    """Fold a stacked state's leading device axis down to the canonical
+    single-device layout via the state's OWN associative merge
+    (`AnalyzerState.merge` — the single source of the per-leaf law: sums
+    add, extremes min/max, HLL registers max, DDSketch buckets add).
+    Already-canonical states pass through untouched.  Never called with an
+    alive bitmap (mesh_free_snapshots gates it out: bit clears are only
+    exact against the row that set the bit)."""
+    assert state.alive is None, "alive-bitmap states are mesh-pinned"
+    probe = np.asarray(state.metrics.per_partition)
+    if probe.ndim == 2:
+        return state  # single-device layout already
+    acc = None
+    for i in range(probe.shape[0]):
+        row = jax.tree.map(lambda x: np.asarray(x)[i], state)
+        acc = row if acc is None else acc.merge(row)
+    return jax.tree.map(np.asarray, acc)
+
+
+def _distribute(
+    canonical: AnalyzerState, template: AnalyzerState, identity: AnalyzerState
+) -> AnalyzerState:
+    """Inverse placement for resuming a canonical snapshot on a stacked
+    (device-row-stacked) template: device row 0 carries the canonical
+    fold, every other row its leaf's merge IDENTITY — ``identity`` is a
+    fresh `AnalyzerState.init` (a fresh state IS the merge identity;
+    that is what makes merging one in a no-op), broadcast to the
+    template's stacked shape.  The backend's finalize reduction then
+    reproduces exactly the canonical values, and records folded after
+    the resume land in whichever row their partition now maps to —
+    byte-identical either way, because every one of these folds is
+    associative and commutative across rows."""
+
+    def place(ident, tmpl, canon) -> np.ndarray:
+        out = np.broadcast_to(
+            np.asarray(ident), np.asarray(tmpl).shape
+        ).copy()
+        out[0] = np.asarray(canon)
+        return out
+
+    return jax.tree.map(place, identity, template, canonical)
 
 
 def _flatten(state: AnalyzerState) -> Dict[str, np.ndarray]:
@@ -133,6 +214,11 @@ def save_snapshot(
     neither re-counts nor double-quarantines it."""
     os.makedirs(directory, exist_ok=True)
     host_state = jax.tree.map(np.asarray, jax.device_get(state))
+    if mesh_free_snapshots(config):
+        # Store the canonical mesh-free layout (v4 stamp): a stacked
+        # state folds its leading device axis down host-side, so ANY mesh
+        # (or the single device) can adopt the snapshot on resume.
+        host_state = _canonicalize(host_state)
     flat = _flatten(host_state)
     meta = {
         "fingerprint": config_fingerprint(config, topic),
@@ -204,6 +290,25 @@ def load_snapshot(
         flat = _flatten(template)
         loaded = {k: z[k] for k in flat}
     leaves_p, treedef = jax.tree_util.tree_flatten_with_path(template)
+    direct = all(
+        loaded["state" + "".join(str(p) for p in path_key)].shape
+        == np.asarray(leaf).shape
+        for path_key, leaf in leaves_p
+    )
+    canon_identity = None
+    if not direct and mesh_free_snapshots(config):
+        # Cross-mesh resume: the stored leaves are the canonical
+        # single-device layout (v4 snapshots always are), the template is
+        # this backend's stacked layout.  Validate against the canonical
+        # shapes instead, then redistribute below: row 0 = canonical,
+        # other rows = identity (see _distribute — the fresh init state
+        # doubles as both the shape template and the identity values).
+        canon_identity = jax.tree.map(
+            np.asarray, jax.device_get(AnalyzerState.init(config))
+        )
+        leaves_p, treedef = jax.tree_util.tree_flatten_with_path(
+            canon_identity
+        )
     new_leaves = []
     for path_key, leaf in leaves_p:
         key = "state" + "".join(str(p) for p in path_key)
@@ -212,6 +317,8 @@ def load_snapshot(
             raise ValueError(f"snapshot leaf {key} has shape {arr.shape}")
         new_leaves.append(arr)
     state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    if canon_identity is not None:
+        state = _distribute(state, template, canon_identity)
     offsets = {int(k): int(v) for k, v in meta["next_offsets"].items()}
     return state, offsets, int(meta["records_seen"]), int(meta["init_now_s"])
 

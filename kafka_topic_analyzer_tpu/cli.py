@@ -155,12 +155,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Parallel partition-sharded ingest for one scan: "
                         "shard the partition set over N private "
                         "fetch+decode+pack worker threads feeding the "
-                        "backend through a deterministic fan-in — results "
+                        "backend through deterministic fan-ins — results "
                         "stay byte-identical to the sequential scan. "
                         "'auto' sizes from the host (min(cores-1, "
-                        "partitions)). Default: 1. Requires --mesh 1 "
-                        "(sharded-mesh scans already run one ingest "
-                        "stream per data shard)")
+                        "partitions)). Composes with --mesh: each "
+                        "controller resolves the count against ITS data "
+                        "shard's partitions and fans in per data row "
+                        "(host x device x dispatch parallelism in one "
+                        "scan). Default: 1")
     p.add_argument("--superbatch", default="1", metavar="K|auto",
                    help="Superbatch dispatch: stack K packed batches into "
                         "one uint8[K, N] host array and fold them in a "
@@ -373,28 +375,20 @@ def wrap_with_dump(args, topic: str, source):
 
 
 
-def resolve_ingest_workers(args, mesh_shape, num_partitions) -> int:
-    """Parse + validate --ingest-workers against the mesh (shared by the
-    single- and multi-topic paths).  Returns the concrete worker count
-    after 'auto'/partition-count resolution."""
+def resolve_ingest_workers(args, mesh_shape, num_partitions):
+    """Parse --ingest-workers (shared by the single- and multi-topic
+    paths).  For the single-device scan, returns the concrete worker
+    count after 'auto'/partition-count resolution.  For a sharded mesh,
+    returns the parsed IngestConfig unresolved: the engine resolves it
+    PER CONTROLLER — auto = min(cores-1, that controller's shard
+    partition count), explicit N clamped the same way — because under
+    multi-controller neither the global partition count nor this
+    process's core count describes the other hosts (DESIGN.md §14)."""
     from kafka_topic_analyzer_tpu.config import IngestConfig
 
     cfg = IngestConfig.parse(args.ingest_workers)
     if mesh_shape != (1, 1):
-        # ANY non-trivial mesh (data- OR space-sharded) routes through the
-        # sharded backend's update_shards scan path, which runs its own
-        # per-data-shard ingest streams.  An EXPLICIT N>1 request is a
-        # contradiction — reject rather than silently underdeliver.
-        # 'auto' means "size appropriately", and under a mesh the
-        # appropriate count is 1; deciding on the RESOLVED value instead
-        # would make `--mesh 2 --ingest-workers auto` pass on a 1-core CI
-        # box and error on a many-core host.
-        if cfg.workers != "auto" and int(cfg.workers) > 1:
-            raise ValueError(
-                "--ingest-workers requires --mesh 1 (the sharded-mesh "
-                "scan path runs one ingest stream per data shard instead)"
-            )
-        return 1
+        return cfg
     return cfg.resolve(num_partitions)
 
 
@@ -444,6 +438,9 @@ def _print_stats(args, result) -> None:
         render_telemetry_stats(
             result.telemetry,
             ingest_workers=result.ingest_workers,
+            ingest_workers_per_controller=(
+                result.ingest_workers_per_controller
+            ),
             superbatch_k=result.superbatch_k,
             dispatch_depth=result.dispatch_depth,
         )
@@ -604,6 +601,9 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             "topics": {},
             "duration_secs": result.duration_secs,
             "ingest_workers": result.ingest_workers,
+            "ingest_workers_per_controller": (
+                result.ingest_workers_per_controller
+            ),
             "superbatch_k": result.superbatch_k,
             "dispatch_depth": result.dispatch_depth,
         }
@@ -785,6 +785,9 @@ def _run(args) -> int:
         doc["topic"] = args.topic
         doc["duration_secs"] = result.duration_secs
         doc["ingest_workers"] = result.ingest_workers
+        doc["ingest_workers_per_controller"] = (
+            result.ingest_workers_per_controller
+        )
         doc["superbatch_k"] = result.superbatch_k
         doc["dispatch_depth"] = result.dispatch_depth
         doc["telemetry"] = result.telemetry

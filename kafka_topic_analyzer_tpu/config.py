@@ -82,7 +82,11 @@ class IngestConfig:
     #: ``1`` = the sequential path (today's default), ``N`` = that many
     #: partition-sharded ingest workers, ``"auto"`` = size from the host:
     #: min(cores - 1, partitions), keeping one core for the merge loop +
-    #: device dispatch.
+    #: device dispatch.  On a sharded mesh the count resolves PER
+    #: CONTROLLER: ``resolve`` is called with that controller's shard
+    #: partition count, and the result splits across its data rows
+    #: (parallel/ingest.py::allocate_row_workers) — so the same CLI line
+    #: sizes every host of a heterogeneous fleet correctly (DESIGN.md §14).
     workers: "int | str" = 1
 
     def __post_init__(self) -> None:

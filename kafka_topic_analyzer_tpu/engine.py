@@ -18,6 +18,7 @@ from typing import Optional
 import numpy as np
 
 from kafka_topic_analyzer_tpu.backends.base import MetricBackend
+from kafka_topic_analyzer_tpu.config import IngestConfig
 from kafka_topic_analyzer_tpu.io.source import RecordSource
 from kafka_topic_analyzer_tpu.obs import events as obs_events
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
@@ -83,11 +84,21 @@ class ScanResult:
     #: registry, so the report process can render fleet totals
     #: (``--stats``) and ``--json`` can embed them (``telemetry`` block).
     telemetry: "Optional[dict]" = None
-    #: Parallel-ingest worker threads the scan actually ran (after
-    #: clamping to the partition count); 1 = the sequential path.  The
-    #: ``--stats`` digest and ``--json`` report surface it so a recorded
-    #: throughput number always carries its parallelism.
+    #: Parallel-ingest worker threads THIS process's scan actually ran
+    #: (after clamping to the partition count); 1 = the sequential path.
+    #: The ``--stats`` digest and ``--json`` report surface it so a
+    #: recorded throughput number always carries its parallelism.
     ingest_workers: int = 1
+    #: Resolved worker counts per controller, index = process id — under
+    #: multi-controller each process resolves ``--ingest-workers`` against
+    #: ITS shard's partition count, so a single scalar cannot describe the
+    #: fleet.  Collected over the same gather_telemetry collective as the
+    #: registry merge (per-process snapshots carry the
+    #: kta_ingest_resolved_workers gauge).  Single-controller scans hold
+    #: one entry equal to ``ingest_workers``.
+    ingest_workers_per_controller: "list[int]" = dataclasses.field(
+        default_factory=list
+    )
     #: Superbatch size the device backend actually ran (resolved
     #: ``--superbatch``): packed batches folded per jitted dispatch.
     #: 1 = the classic one-dispatch-per-batch path.  Reported alongside
@@ -137,7 +148,7 @@ def run_scan(
     start_at: "Optional[dict[int, int]]" = None,
     tracer=None,
     heartbeat_every_s: float = 10.0,
-    ingest_workers: int = 1,
+    ingest_workers: "int | str | IngestConfig" = 1,
 ) -> ScanResult:
     """Full earliest→latest scan of the topic through the backend.
 
@@ -153,12 +164,20 @@ def run_scan(
     attaches), with per-partition lag/ETA gauges refreshed at the
     ``heartbeat_every_s`` cadence.
 
-    ``ingest_workers`` > 1 shards the partition set over that many private
-    fetch→decode→pack worker streams feeding the single-device backend
-    through a deterministic round-robin fan-in (parallel/ingest.py) —
-    results stay byte-identical to the sequential scan (DESIGN.md §11).
-    Clamped to the partition count; ignored (with a warning) on sharded
-    backends, which already run one ingest stream per data shard."""
+    ``ingest_workers`` (an int, ``"auto"``, or a config.IngestConfig)
+    shards the partition set over that many private fetch→decode→pack
+    worker streams feeding the backend through deterministic round-robin
+    fan-ins (parallel/ingest.py) — results stay byte-identical to the
+    sequential scan (DESIGN.md §11).  On sharded backends the count
+    resolves PER CONTROLLER against this process's shard partition count
+    and splits across its data rows, composing host-parallel ingest with
+    the device-parallel collective scan (DESIGN.md §14); single-device
+    backends clamp to the topic's partition count as before."""
+    ingest_cfg = (
+        ingest_workers
+        if isinstance(ingest_workers, IngestConfig)
+        else IngestConfig(workers=ingest_workers)
+    )
     pindex = PartitionIndex(source.partitions())
     start_offsets, end_offsets = source.watermarks()
     if tracer is None:
@@ -416,20 +435,29 @@ def run_scan(
 
     try:
         if hasattr(backend, "update_shards"):
-            if ingest_workers > 1:
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "--ingest-workers ignored on a sharded backend (the "
-                    "sharded scan already runs one ingest stream per data "
-                    "shard)"
-                )
-            # Sharded scan: one batch stream per data shard, each restricted
-            # to its own partitions (records.py ordering contract), zipped so
-            # every device step carries one full batch per shard.  Under
-            # multi-controller (jax.distributed), this process feeds only
-            # the data rows it hosts (backend.local_rows) — the turnkey
-            # multi-host contract: run the same CLI on every host.
+            # Sharded scan: one batch stream PIPELINE per data shard, each
+            # restricted to its own partitions (records.py ordering
+            # contract), zipped so every device step carries one full batch
+            # per shard.  Under multi-controller (jax.distributed), this
+            # process feeds only the data rows it hosts
+            # (backend.local_rows) — the turnkey multi-host contract: run
+            # the same CLI on every host.
+            #
+            # Composed parallelism (DESIGN.md §14): each fed row's pipeline
+            # is either the classic single staged prefetch stream (1
+            # worker — byte-for-byte the pre-composition path) or an
+            # N-worker ParallelIngest fan-in over that row's partitions,
+            # so host-parallel fetch→decode→pack multiplies with the
+            # device-parallel collective fold and the superbatch dispatch
+            # layer below.  The round structure — and with it every
+            # lockstep collective — is untouched: fan-ins only change
+            # where a row's next batch comes from, never when the row
+            # participates in a round.
+            from kafka_topic_analyzer_tpu.parallel.ingest import (
+                ParallelIngest,
+                allocate_row_workers,
+                shard_partitions,
+            )
             from kafka_topic_analyzer_tpu.parallel.mesh import assign_partitions
 
             d = backend.config.data_shards
@@ -440,7 +468,7 @@ def run_scan(
             # per-round continuation is a global agreement, not a local one.
             lockstep = getattr(backend, "global_any", None)
             multiproc = lockstep is not None and len(feed_rows) < d
-            # Stage the S-way chunk packing on each row's prefetch worker
+            # Stage the S-way chunk packing on each row's ingest worker
             # (same contract as the single-device path below: pack a dense
             # COPY, keep the decoded batch for true-id bookkeeping).
             prepare_shard = getattr(backend, "prepare_shard", None)
@@ -450,23 +478,73 @@ def run_scan(
                     return ((b, None) for b in it)
                 return ((b, prepare_shard(_dense_copy(b))) for b in it)
 
-            iters = {
-                r: _closing(
-                    prefetch(
-                        _stage_row(
-                            source.batches(
-                                batch_size,
-                                partitions=shard_parts[r],
-                                start_at=start_at,
-                            )
-                        ),
-                        prefetch_depth,
+            stage_shard = (
+                (lambda b: prepare_shard(_dense_copy(b)))
+                if prepare_shard is not None
+                else None
+            )
+            # Per-controller resolution: the worker budget comes from THIS
+            # process's shard partition count (auto = min(cores-1, local
+            # partitions)) and splits deterministically across its rows.
+            row_workers = allocate_row_workers(
+                ingest_cfg.resolve(max(1, len(fed_partitions))),
+                {r: len(shard_parts[r]) for r in feed_rows},
+            )
+            used_workers = max(1, sum(row_workers.values()))
+            # Recorded per process so the gather below can report the
+            # RESOLVED per-controller counts, not just a global scalar.
+            obs_metrics.INGEST_RESOLVED_WORKERS.set(used_workers)
+            # Cold sources (segment catalogs) know per-partition record
+            # counts: balance each row's worker groups by records
+            # (greedy-LPT), exactly like the single-device path below.
+            # Only consulted when some row actually runs a fan-in.
+            weights = None
+            if any(nw > 1 for nw in row_workers.values()):
+                weigher = getattr(source, "partition_record_counts", None)
+                weights = weigher() if weigher is not None else None
+            # Worker telemetry labels must be disjoint across this
+            # controller's per-row pools AND across controllers (the
+            # gather_telemetry merge unions label sets).
+            label_prefix = (
+                f"c{backend.controller_index}."
+                if multiproc and hasattr(backend, "controller_index")
+                else ""
+            )
+            iters = {}
+            wid_base = 0
+            for r in feed_rows:
+                nw = row_workers.get(r, 0)
+                if not shard_parts[r]:
+                    iters[r] = iter(())
+                elif nw > 1:
+                    iters[r] = _closing(
+                        ParallelIngest(
+                            source,
+                            batch_size,
+                            shard_partitions(
+                                shard_parts[r], nw, weights=weights
+                            ),
+                            start_at=start_at,
+                            stage=stage_shard,
+                            depth=max(prefetch_depth, 1),
+                            wid_base=wid_base,
+                            label_prefix=label_prefix,
+                        )
                     )
-                )
-                if shard_parts[r]
-                else iter(())
-                for r in feed_rows
-            }
+                else:
+                    iters[r] = _closing(
+                        prefetch(
+                            _stage_row(
+                                source.batches(
+                                    batch_size,
+                                    partitions=shard_parts[r],
+                                    start_at=start_at,
+                                )
+                            ),
+                            prefetch_depth,
+                        )
+                    )
+                wid_base += nw
             dispatch_rounds = (
                 backend.update_shards_superbatch
                 if super_k > 1 and hasattr(backend, "update_shards_superbatch")
@@ -541,7 +619,8 @@ def run_scan(
                 if prepare is not None
                 else None
             )
-            used_workers = max(1, min(int(ingest_workers), len(pindex)))
+            used_workers = ingest_cfg.resolve(len(pindex))
+            obs_metrics.INGEST_RESOLVED_WORKERS.set(used_workers)
             if used_workers > 1:
                 # Partition-sharded parallel ingest (--ingest-workers): N
                 # private fetch→decode→pack streams, merged through a
@@ -661,6 +740,22 @@ def run_scan(
                     "failure snapshot falls back to the last committed "
                     "superbatch boundary"
                 )
+        # Retire in-flight superbatch dispatches before snapshotting.
+        # Lockstep-safe even on a one-sided stop: drain_dispatch blocks
+        # only on collectives every controller already launched at a
+        # lockstep-agreed round — it never initiates one (unlike the tail
+        # flush above, which is why THAT stays None under multiproc).
+        drain = getattr(backend, "drain_dispatch", None)
+        if drain is not None:
+            try:
+                drain()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "in-flight dispatches could not be drained before the "
+                    "failure snapshot"
+                )
         try:
             maybe_snapshot(
                 force=True,
@@ -753,9 +848,16 @@ def run_scan(
     # collective, so it runs here — a point every process reaches — never
     # from the report-only branch of the CLI.
     gather = getattr(backend, "gather_telemetry", None)
-    telemetry = merge_snapshots(
-        gather() if gather is not None else [default_registry().snapshot()]
-    )
+    snaps = gather() if gather is not None else [default_registry().snapshot()]
+    telemetry = merge_snapshots(snaps)
+    # Per-controller resolved worker counts, read from the UN-merged
+    # per-process snapshots (gather returns them pid-sorted): each process
+    # stamped its kta_ingest_resolved_workers gauge before the gather.
+    workers_per_controller = []
+    for s in snaps:
+        m = s.get("kta_ingest_resolved_workers")
+        v = m["samples"][0]["value"] if m and m.get("samples") else 0
+        workers_per_controller.append(max(1, int(v)))
     return ScanResult(
         metrics=metrics,
         duration_secs=duration_secs,
@@ -766,6 +868,7 @@ def run_scan(
         corrupt_partitions=corrupt,
         telemetry=telemetry,
         ingest_workers=used_workers,
+        ingest_workers_per_controller=workers_per_controller,
         superbatch_k=super_k,
         dispatch_depth=int(getattr(backend, "dispatch_depth", 1) or 1),
     )

@@ -60,8 +60,22 @@ DEGRADED_PARTITIONS = _REG.gauge(
 
 INGEST_QUEUE_DEPTH = _REG.gauge(
     "kta_ingest_queue_depth",
-    "Staged batches waiting in the parallel-ingest fan-in queues "
-    "(all workers; 0 when the merge loop keeps up)")
+    "Staged batches waiting in a parallel-ingest fan-in's queues "
+    "(all of the pool's workers; 0 when the merge loop keeps up). "
+    "'pool' is the fan-in's first worker id — sharded-mesh controllers "
+    "run one pool per data row, so pools are disjoint and the fleet "
+    "depth is the sum",
+    labelnames=("pool",),
+    # Disjoint pools per controller (and per data row): the cluster-wide
+    # queue depth is their sum, not the worst one.
+    merge="sum")
+INGEST_RESOLVED_WORKERS = _REG.gauge(
+    "kta_ingest_resolved_workers",
+    "Parallel-ingest worker threads the scan resolved for THIS "
+    "controller (after auto/partition-count clamping; 1 = sequential). "
+    "Controllers feed disjoint partition sets, so the cross-controller "
+    "merge (gather_telemetry) sums to the fleet-wide thread count",
+    merge="sum")
 INGEST_WORKER_RECORDS = _REG.counter(
     "kta_ingest_worker_records_total",
     "Valid records produced per parallel-ingest worker",

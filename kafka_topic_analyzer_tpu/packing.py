@@ -446,6 +446,52 @@ class SuperbatchStager:
         return slot
 
 
+def pack_chunks(
+    batch: RecordBatch,
+    chunk_config: AnalyzerConfig,
+    space_shards: int,
+    use_native: bool = True,
+    out: "np.ndarray | None" = None,
+) -> np.ndarray:
+    """One data row's batch packed into its ``space_shards`` contiguous
+    record chunks: ``uint8[S, chunk_nbytes]``, chunk ``s`` holding records
+    ``[s*C, (s+1)*C)`` of the row's batch (``C = chunk_config.batch_size``).
+
+    Contiguity is what makes the sharded backend's device-side ordered
+    application exact — source-chunk order equals record order
+    (backends/step.py) — so this function is the single chunking rule for
+    ``ShardedTpuBackend`` staging (prepare_shard, the superbatch ring, the
+    per-round path).
+
+    ``out`` packs each chunk straight into the caller's ``[S, nbytes]``
+    rows via ``pack_batch(out=)`` — the sharded superbatch stager hands
+    its ring-slot rows here, so an unstaged batch goes file/socket →
+    packed ring row with no intermediate stack-then-copy."""
+    c = chunk_config.batch_size
+    n = len(batch)
+    if n > c * space_shards:
+        raise ValueError(
+            f"batch of {n} exceeds batch_size {c * space_shards}"
+        )
+    nbytes = packed_nbytes(chunk_config, c)
+    if out is None:
+        out = np.empty((space_shards, nbytes), dtype=np.uint8)
+    elif out.shape != (space_shards, nbytes) or out.dtype != np.uint8:
+        raise ValueError(
+            f"pack_chunks out buffer must be uint8[{space_shards}, "
+            f"{nbytes}], got {out.dtype}{list(out.shape)}"
+        )
+    for s in range(space_shards):
+        lo = s * c
+        pack_batch(
+            batch.take(np.arange(lo, min(lo + c, n))),
+            chunk_config,
+            use_native=use_native,
+            out=out[s],
+        )
+    return out
+
+
 def unpack_numpy(buf: np.ndarray, config: AnalyzerConfig) -> Dict[str, np.ndarray]:
     """Host-side reference unpack (tests + the device self-check oracle)."""
     b = config.batch_size

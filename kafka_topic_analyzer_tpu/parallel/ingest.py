@@ -15,6 +15,13 @@ This module runs N of them inside one scan:
   assignment, so skew balancing matches parallel/mesh.py; cold sources
   whose catalogs know exact per-partition record counts pass ``weights``
   and get a deterministic greedy-LPT balance instead);
+- on a sharded mesh the same machinery runs PER CONTROLLER: each data
+  row this process feeds gets its own fan-in over that row's partitions
+  (``allocate_row_workers`` splits the controller's worker budget across
+  its rows), so host-parallel ingest multiplies with device-parallel
+  folding instead of replacing it (DESIGN.md §14).  ``wid_base``/
+  ``label_prefix`` keep worker telemetry labels disjoint across a
+  controller's pools and across controllers;
 - each group gets a private ``source.batches()`` stream on its own worker
   thread (the wire layer guarantees per-stream connection privacy, so
   workers never share a socket), which also stages decode→remap→pack so
@@ -108,6 +115,39 @@ def shard_partitions(
     return [g for g in assign_partitions(partitions, workers) if g]
 
 
+def allocate_row_workers(
+    budget: int, row_counts: "Dict[int, int]"
+) -> "Dict[int, int]":
+    """Split one controller's ingest-worker budget across its data rows.
+
+    ``row_counts`` maps data row -> partition count for the rows THIS
+    controller feeds.  Every non-empty row needs at least one stream (the
+    collective round loop pulls one batch per row per round), so each
+    gets 1 even when ``budget`` is smaller; the remaining budget goes one
+    worker at a time to the row with the most partitions per worker (ties
+    by row index), clamped at the row's partition count — a worker beyond
+    it would own an empty group.  Pure function of the inputs, so every
+    controller (and every rerun) allocates identically."""
+    if budget < 1:
+        raise ValueError("worker budget must be >= 1")
+    alloc = {r: (1 if n > 0 else 0) for r, n in row_counts.items()}
+    spent = sum(alloc.values())
+    while spent < budget:
+        best = None
+        for r in sorted(row_counts):
+            n, w = row_counts[r], alloc[r]
+            if w == 0 or w >= n:
+                continue
+            ratio = n / w
+            if best is None or ratio > best[0]:
+                best = (ratio, r)
+        if best is None:
+            break  # every row saturated at its partition count
+        alloc[best[1]] += 1
+        spent += 1
+    return alloc
+
+
 class _IngestWorker(threading.Thread):
     """One worker: a private ``source.batches()`` stream for one partition
     group, staged (pack + host→device transfer start) on this thread, fed
@@ -117,7 +157,7 @@ class _IngestWorker(threading.Thread):
 
     def __init__(
         self,
-        wid: int,
+        wid: "int | str",
         source: RecordSource,
         batch_size: int,
         group: List[int],
@@ -221,16 +261,30 @@ class ParallelIngest:
         start_at: "Optional[Dict[int, int]]" = None,
         stage: "Optional[Callable[[RecordBatch], object]]" = None,
         depth: int = 2,
+        wid_base: int = 0,
+        label_prefix: str = "",
     ):
+        """``wid_base``/``label_prefix`` exist for multi-pool scans: a
+        sharded-mesh controller runs ONE fan-in per data row it feeds
+        (engine.py), and worker telemetry labels must stay disjoint —
+        across that controller's pools (``wid_base`` continues the worker
+        numbering from the previous row's pool) and across controllers
+        (``label_prefix`` carries the controller index, e.g. ``"c1."``,
+        so the gather_telemetry merge unions instead of summing unrelated
+        workers into one sample)."""
         if not groups:
             raise ValueError("parallel ingest needs at least one group")
         self._cancel = threading.Event()
         self.workers = [
             _IngestWorker(
-                w, source, batch_size, g, start_at, stage, depth, self._cancel
+                f"{label_prefix}{wid_base + w}", source, batch_size, g,
+                start_at, stage, depth, self._cancel
             )
             for w, g in enumerate(groups)
         ]
+        self._depth_gauge = obs_metrics.INGEST_QUEUE_DEPTH.labels(
+            pool=f"{label_prefix}{wid_base}"
+        )
         #: Rotation position and per-worker liveness for the merge.
         self._rr = 0
         self._alive = [True] * len(self.workers)
@@ -271,7 +325,7 @@ class ParallelIngest:
             obs_metrics.INGEST_WORKER_RECORDS.labels(worker=w.wid).inc(
                 batch.num_valid
             )
-            obs_metrics.INGEST_QUEUE_DEPTH.set(self.queue_depth())
+            self._depth_gauge.set(self.queue_depth())
             return batch, staged
         raise StopIteration
 
@@ -305,7 +359,7 @@ class ParallelIngest:
                 # loop): close the generator from here — safe now that no
                 # thread is executing it.
                 w.close_source()
-        obs_metrics.INGEST_QUEUE_DEPTH.set(0)
+        self._depth_gauge.set(0)
 
 
 def iter_staged(

@@ -49,7 +49,7 @@ from kafka_topic_analyzer_tpu.backends.base import (
 from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
 from kafka_topic_analyzer_tpu.backends.step import analyzer_step, superbatch_fold
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig, DispatchConfig
-from kafka_topic_analyzer_tpu.packing import pack_batch, unpack_device
+from kafka_topic_analyzer_tpu.packing import pack_chunks, unpack_device
 from kafka_topic_analyzer_tpu.jax_support import jnp, lax, shard_map
 from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState
 from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState
@@ -375,30 +375,24 @@ class ShardedTpuBackend(MetricBackend):
 
     # -- update --------------------------------------------------------------
 
-    def _pack_chunks(self, batch: "Optional[RecordBatch]") -> np.ndarray:
+    def _pack_chunks(
+        self,
+        batch: "Optional[RecordBatch]",
+        out: "Optional[np.ndarray]" = None,
+    ) -> np.ndarray:
         """Contiguous 1/S record chunks of one data row's batch, packed
-        into ``[S, chunk_nbytes]``.
-
-        Contiguity is what makes the device-side ordered application
-        exact: chunk s holds records [s·C, (s+1)·C), so source-chunk
-        order equals record order (backends/step.py)."""
-        s = self.config.space_shards
-        c = self.config.chunk_size
+        into ``[S, chunk_nbytes]`` (packing.pack_chunks — the single
+        chunking rule).  ``out`` packs straight into a caller buffer (the
+        superbatch stager's ring rows) instead of allocating."""
         if batch is None:
             batch = RecordBatch.empty(0)
-        n = len(batch)
-        if n > c * s:
-            raise ValueError(
-                f"batch of {n} exceeds batch_size {self.config.batch_size}"
-            )
-        return np.stack([
-            pack_batch(
-                batch.take(np.arange(lo, min(lo + c, n))),
-                self._chunk_config,
-                use_native=self.use_native,
-            )
-            for lo in range(0, c * s, c)
-        ])
+        return pack_chunks(
+            batch,
+            self._chunk_config,
+            self.config.space_shards,
+            use_native=self.use_native,
+            out=out,
+        )
 
     def prepare_shard(self, batch: RecordBatch) -> "PackedShard":
         """Pack one data row's batch ahead of its collective step — safe on
@@ -460,11 +454,15 @@ class ShardedTpuBackend(MetricBackend):
         for i, batches in enumerate(rounds):
             for j, r in enumerate(self.local_rows):
                 b = batches[r]
-                np.copyto(
-                    stacked[i, j],
-                    b.chunks if isinstance(b, PackedShard)
-                    else self._pack_chunks(b),
-                )
+                if isinstance(b, PackedShard):
+                    # Worker-staged upstream (parallel ingest packs before
+                    # the fan-in order — and hence the ring row — is
+                    # known): one copy into the ring.
+                    np.copyto(stacked[i, j], b.chunks)
+                else:
+                    # Unstaged: pack straight into the ring row, no
+                    # intermediate [S, nbytes] stack.
+                    self._pack_chunks(b, out=stacked[i, j])
         if len(rounds) < k:
             if self._empty_chunks is None:
                 self._empty_chunks = np.stack(
@@ -595,9 +593,26 @@ class ShardedTpuBackend(MetricBackend):
             [batch.take(np.nonzero(shard_of == s)[0]) for s in range(d)]
         )
 
-    def block_until_ready(self) -> None:
+    def drain_dispatch(self) -> None:
+        """Retire every in-flight superbatch dispatch WITHOUT launching a
+        new collective — the engine's failure path calls this before its
+        final snapshot (DESIGN.md §14 lockstep flush protocol).
+
+        Lockstep-safe even when only THIS controller is stopping: the
+        queued completion tokens belong to scanned steps that every
+        controller already launched at a lockstep-agreed round (the
+        engine accumulates and flushes superbatches only after the
+        per-round ``global_any`` agreement), so blocking on them is a
+        local wait on collective programs that are already running
+        fleet-wide — never a one-sided collective that could deadlock a
+        peer.  Contrast with the partial-tail flush, which WOULD launch a
+        new collective and is therefore skipped on multi-controller fault
+        paths (engine.py ``fault_flush``)."""
         if self.superbatch_k > 1:
             self._queue.drain()
+
+    def block_until_ready(self) -> None:
+        self.drain_dispatch()
         jax.block_until_ready(self.state)
 
     # -- snapshot/resume (checkpoint.py) -------------------------------------
@@ -613,6 +628,14 @@ class ShardedTpuBackend(MetricBackend):
             host_state,
             self._specs,
         )
+
+    @property
+    def controller_index(self) -> int:
+        """This process's index in the fleet (0 single-controller) — the
+        engine prefixes per-worker ingest telemetry labels with it so the
+        cross-controller merge unions worker samples instead of summing
+        unrelated workers that happen to share an id."""
+        return jax.process_index() if self._multiprocess else 0
 
     @property
     def snapshot_scope(self):
@@ -673,10 +696,9 @@ class ShardedTpuBackend(MetricBackend):
     # -- finalize ------------------------------------------------------------
 
     def finalize(self) -> TopicMetrics:
-        if self.superbatch_k > 1:
-            # Complete the dispatch-latency histogram before the merge
-            # collective syncs the state anyway.
-            self._queue.drain()
+        # Complete the dispatch-latency histogram before the merge
+        # collective syncs the state anyway.
+        self.drain_dispatch()
         merged, alive_count, hll_regs, dd_counts = self._merge(self.state)
         merged = jax.tree.map(np.asarray, jax.device_get(merged))
         alive_count = int(alive_count)

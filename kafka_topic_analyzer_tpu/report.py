@@ -158,9 +158,22 @@ def _metric_total(snapshot: Dict, name: str) -> float:
     return sum(s.get("value", 0.0) for s in metric["samples"])
 
 
+def _worker_sort_key(label: str):
+    """Natural sort for worker labels: plain ints on single-controller
+    scans ('0', '1', ...), controller-prefixed under multi-controller
+    ('c0.0', 'c1.2', ...) — numeric runs compare numerically either way."""
+    import re
+
+    return [
+        int(tok) if tok.isdigit() else tok
+        for tok in re.split(r"(\d+)", label)
+    ]
+
+
 def render_telemetry_stats(
     snapshot: Optional[Dict],
     ingest_workers: int = 1,
+    ingest_workers_per_controller: "Optional[List[int]]" = None,
     superbatch_k: int = 1,
     dispatch_depth: int = 1,
 ) -> str:
@@ -225,14 +238,30 @@ def render_telemetry_stats(
     from kafka_topic_analyzer_tpu.results import IngestStats
 
     ingest = IngestStats.from_telemetry(snapshot)
-    line = f"  ingest: {ingest_workers} worker(s)"
+    per_ctrl = ingest_workers_per_controller or []
+    if len(per_ctrl) > 1:
+        # Multi-controller: the fleet total plus each controller's
+        # resolved count (they differ when shard partition counts or
+        # host core counts differ).
+        line = (
+            f"  ingest: {sum(per_ctrl)} worker(s) across "
+            f"{len(per_ctrl)} controller(s) "
+            f"({'+'.join(str(v) for v in per_ctrl)})"
+        )
+    else:
+        line = f"  ingest: {ingest_workers} worker(s)"
     if ingest.workers:
         per = ", ".join(
-            f"w{w} {n:,}" + (
+            # Plain integer labels read better with a 'w' prefix;
+            # controller-prefixed labels ("c0.3") already carry one.
+            (f"w{w}" if w.isdigit() else w) + f" {n:,}" + (
                 f" (stalled {ingest.stalls[w]:.1f}s)"
                 if ingest.stalls.get(w, 0) >= 0.05 else ""
             )
-            for w, n in sorted(ingest.workers.items(), key=lambda kv: int(kv[0]))
+            for w, n in sorted(
+                ingest.workers.items(),
+                key=lambda kv: _worker_sort_key(kv[0]),
+            )
         )
         line += f" — records {per}"
     lines.append(line)

@@ -64,7 +64,11 @@ class IngestStats:
     round-6 ingest benchmark; empty (``workers == {}``) for sequential
     scans, which never touch the per-worker instruments."""
 
-    #: worker label -> valid records that worker produced.
+    #: worker label -> valid records that worker produced.  Labels are
+    #: plain worker indices on single-controller scans ("0", "1", ...);
+    #: sharded multi-controller scans prefix the controller id ("c1.3")
+    #: so the gather_telemetry merge unions per-controller samples
+    #: instead of summing unrelated workers (parallel/ingest.py).
     workers: "Dict[str, int]"
     #: worker label -> seconds blocked on a full fan-in queue.
     stalls: "Dict[str, float]"

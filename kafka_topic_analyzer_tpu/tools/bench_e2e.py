@@ -409,11 +409,39 @@ def run(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--dispatch-depth", type=int, default=2,
                     help="superbatches allowed in flight while the device "
                          "folds (default 2)")
-    ap.add_argument("--ingest-workers", type=int, default=1,
+    ap.add_argument("--ingest-workers", default="1", metavar="N|auto",
                     help="partition-sharded parallel ingest workers for "
-                         "the scan (engine --ingest-workers)")
+                         "the scan (engine --ingest-workers; composes "
+                         "with --mesh: per-controller fan-in per data row)")
+    ap.add_argument("--mesh", default="1", metavar="DATA[,SPACE]",
+                    help="device mesh for the sharded backend (tpu only). "
+                         "On a CPU-platform bench this forces the needed "
+                         "virtual device count when jax is not yet "
+                         "imported — the mesh x workers sweep referee")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
+
+    from kafka_topic_analyzer_tpu.cli import parse_mesh
+
+    mesh_shape = parse_mesh(args.mesh)
+    if mesh_shape != (1, 1):
+        if args.backend != "tpu":
+            ap.error("--mesh requires --backend tpu")
+        # Virtual-device bring-up must precede the first jax import; when
+        # the bench runner already imported jax this is a no-op and the
+        # mesh constructor will reject a too-small device count itself.
+        import os as _os
+
+        need = mesh_shape[0] * mesh_shape[1]
+        flags = _os.environ.get("XLA_FLAGS", "")
+        if (
+            "jax" not in sys.modules
+            and "xla_force_host_platform_device_count" not in flags
+            and _os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        ):
+            _os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={need}"
+            ).strip()
 
     feats = {f.strip() for f in args.features.split(",") if f.strip()}
     R = args.records_per_batch
@@ -441,6 +469,7 @@ def run(argv: "list[str] | None" = None) -> int:
         alive_bitmap_bits=args.alive_bits,
         enable_hll="hll" in feats,
         enable_quantiles="quantiles" in feats,
+        mesh_shape=mesh_shape,
     )
     degraded = False
     if args.backend == "tpu":
@@ -454,13 +483,26 @@ def run(argv: "list[str] | None" = None) -> int:
     # --superbatch K>1 on the cpu backend is rejected, never silently
     # dropped — a published bench number must not claim a dispatch
     # configuration that never ran.
-    from kafka_topic_analyzer_tpu.cli import resolve_dispatch
+    from kafka_topic_analyzer_tpu.cli import (
+        resolve_dispatch,
+        resolve_ingest_workers,
+    )
 
     try:
         dispatch = resolve_dispatch(args)
+        ingest_workers = resolve_ingest_workers(
+            args, mesh_shape, args.partitions
+        )
     except ValueError as e:
         ap.error(str(e))
-    backend = make_backend(args.backend, config, dispatch=dispatch)
+    if mesh_shape != (1, 1):
+        from kafka_topic_analyzer_tpu.parallel.sharded import (
+            ShardedTpuBackend,
+        )
+
+        backend = ShardedTpuBackend(config, dispatch=dispatch)
+    else:
+        backend = make_backend(args.backend, config, dispatch=dispatch)
 
     with BrokerProcess(
         topic="bench-e2e", partitions=args.partitions, windows=windows,
@@ -476,7 +518,7 @@ def run(argv: "list[str] | None" = None) -> int:
             backend,
             batch_size=args.batch_size,
             spinner=Spinner(enabled=False),
-            ingest_workers=args.ingest_workers,
+            ingest_workers=ingest_workers,
         )
         if hasattr(backend, "block_until_ready"):
             backend.block_until_ready()
@@ -507,6 +549,8 @@ def run(argv: "list[str] | None" = None) -> int:
         "superbatch_k": result.superbatch_k,
         "dispatch_depth": result.dispatch_depth,
         "ingest_workers": result.ingest_workers,
+        "ingest_workers_per_controller": result.ingest_workers_per_controller,
+        "mesh": list(mesh_shape),
         "batch_size": args.batch_size,
     }
     if degraded:

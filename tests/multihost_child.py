@@ -71,8 +71,12 @@ def main() -> int:
     )
     from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
 
+    # The "workers" mode spreads 16 partitions over the 8 data rows (2
+    # per row) so a per-controller --ingest-workers 8 budget gives every
+    # row a real 2-worker fan-in; the other modes keep the original 6.
+    n_partitions = 16 if mode == "workers" else 6
     spec = SyntheticSpec(
-        num_partitions=6,
+        num_partitions=n_partitions,
         messages_per_partition=5000,
         keys_per_partition=500,
         key_null_permille=50,
@@ -80,7 +84,7 @@ def main() -> int:
         seed=42,
     )
     config = AnalyzerConfig(
-        num_partitions=6,
+        num_partitions=n_partitions,
         batch_size=2048,
         count_alive_keys=True,
         alive_bitmap_bits=16,
@@ -139,6 +143,26 @@ def main() -> int:
         assert any(
             s and any(v > 0 for v in s.values()) for s in captured
         ), f"resume did not advance start offsets: {captured}"
+    elif mode == "workers":
+        # PR-7 tentpole under real multi-controller: each process runs
+        # per-row ParallelIngest fan-ins over ITS shard partitions while
+        # the collective rounds stay in lockstep.
+        result = run_scan(
+            "mh-topic", SyntheticSource(spec), backend, batch_size=2048,
+            ingest_workers=8,
+        )
+        assert result.ingest_workers == 8, result.ingest_workers
+        assert result.ingest_workers_per_controller == [8, 8], (
+            result.ingest_workers_per_controller
+        )
+        # Controller-prefixed worker labels: the merged registry carries
+        # BOTH controllers' fan-in workers as a disjoint union.
+        recs = result.telemetry["kta_ingest_worker_records_total"]["samples"]
+        labels = sorted(s["labels"]["worker"] for s in recs)
+        assert labels == sorted(
+            f"c{c}.{w}" for c in range(2) for w in range(8)
+        ), labels
+        assert all(s["value"] > 0 for s in recs), recs
     else:
         result = run_scan(
             "mh-topic", SyntheticSource(spec), backend, batch_size=2048
@@ -151,15 +175,15 @@ def main() -> int:
     # process scanned).
     lag = result.telemetry["kta_partition_lag"]["samples"]
     parts = sorted(s["labels"]["partition"] for s in lag)
-    assert parts == sorted(str(p) for p in range(6)), parts
+    assert parts == sorted(str(p) for p in range(n_partitions)), parts
     assert all(s["value"] == 0 for s in lag), lag
-    if mode == "plain":
+    if mode in ("plain", "workers"):
         # The merged counter sums both processes' folds to the full topic.
         # (Not asserted under "resume": the interrupted scan's partial
         # counts share this process's registry with the resumed run's.)
         assert (
             result.telemetry["kta_scan_records_total"]["samples"][0]["value"]
-            == 6 * 5000
+            == n_partitions * 5000
         )
 
     if jax.process_index() == 0:

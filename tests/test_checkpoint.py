@@ -183,6 +183,185 @@ def test_sharded_resume_matches_uninterrupted(tmp_path):
     assert full.overall_count == result.metrics.overall_count
 
 
+MESHFREE_SPEC = SyntheticSpec(
+    num_partitions=5, messages_per_partition=1500,
+    keys_per_partition=31, tombstone_permille=120, seed=3,
+)
+MESHFREE_BASE = dict(
+    num_partitions=5, batch_size=256,
+    enable_hll=True, hll_p=10, enable_quantiles=True,
+)
+
+
+def test_cross_mesh_cross_config_resume(tmp_path):
+    """Any-config↔any-config resume (DESIGN.md §14): a snapshot taken
+    under (mesh 2, workers 2, K 2) resumes under (mesh 4, workers 3, K 4)
+    AND under the plain single device, reproducing the uninterrupted
+    metrics exactly.  Works because v4 snapshots store the canonical
+    mesh-free layout (checkpoint._canonicalize) and redistribute as
+    (canonical, identity, ...) rows on load — every fold associative and
+    commutative across device rows."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from kafka_topic_analyzer_tpu.config import DispatchConfig
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    full = run_scan(
+        "t", SyntheticSource(MESHFREE_SPEC),
+        TpuBackend(AnalyzerConfig(**MESHFREE_BASE), init_now_s=10**10), 256,
+    ).metrics
+
+    def interrupted(snap_dir):
+        be = ShardedTpuBackend(
+            AnalyzerConfig(**MESHFREE_BASE, mesh_shape=(2, 1)),
+            init_now_s=10**10,
+            dispatch=DispatchConfig(superbatch=2, depth=2),
+        )
+        with pytest.raises(_Interrupt):
+            run_scan(
+                "t", _InterruptingSource(MESHFREE_SPEC, limit=7), be, 256,
+                snapshot_dir=str(snap_dir), snapshot_every_s=0.0,
+                ingest_workers=2,
+            )
+
+    d1 = tmp_path / "to_mesh4"
+    interrupted(d1)
+    be2 = ShardedTpuBackend(
+        AnalyzerConfig(**MESHFREE_BASE, mesh_shape=(4, 1)),
+        init_now_s=0,
+        dispatch=DispatchConfig(superbatch=4, depth=1),
+    )
+    r = run_scan(
+        "t", SyntheticSource(MESHFREE_SPEC), be2, 256,
+        snapshot_dir=str(d1), resume=True, ingest_workers=3,
+    )
+    assert r.metrics.to_dict(r.start_offsets, r.end_offsets) == full.to_dict(
+        r.start_offsets, r.end_offsets
+    )
+    assert be2.init_now_s == 10**10  # restored across the mesh change
+
+    d2 = tmp_path / "to_single"
+    interrupted(d2)
+    be3 = TpuBackend(AnalyzerConfig(**MESHFREE_BASE), init_now_s=0)
+    r = run_scan(
+        "t", SyntheticSource(MESHFREE_SPEC), be3, 256,
+        snapshot_dir=str(d2), resume=True,
+    )
+    assert r.metrics.to_dict(r.start_offsets, r.end_offsets) == full.to_dict(
+        r.start_offsets, r.end_offsets
+    )
+
+
+def test_alive_bitmap_snapshots_stay_mesh_pinned(tmp_path):
+    """Alive-key scans keep the mesh in the fingerprint: last-writer-wins
+    bit CLEARS only resolve against the row that set the bit, and the
+    partition→row assignment changes with the mesh — resuming under a
+    different mesh must be a clean error, never a silent miscount."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    cfg2 = AnalyzerConfig(
+        num_partitions=3, batch_size=512, count_alive_keys=True,
+        alive_bitmap_bits=18, mesh_shape=(2, 1),
+    )
+    be = ShardedTpuBackend(cfg2, init_now_s=5)
+    save_snapshot(str(tmp_path), "t", cfg2, be.get_state(), {0: 1}, 1, 5)
+    cfg4 = AnalyzerConfig(
+        num_partitions=3, batch_size=512, count_alive_keys=True,
+        alive_bitmap_bits=18, mesh_shape=(4, 1),
+    )
+    be4 = ShardedTpuBackend(cfg4, init_now_s=5)
+    with pytest.raises(ValueError, match="fingerprint"):
+        load_snapshot(str(tmp_path), "t", cfg4, template=be4.get_state())
+
+
+def test_scoped_mesh_free_snapshot_canonicalizes_and_distributes(tmp_path):
+    """Multi-controller mesh-free snapshots take the same canonical path:
+    a PROCESS-LOCAL (scope'd) stacked state folds down at save and
+    redistributes into the local stacked template at load — row 0 of this
+    process's rows carries exactly its canonical fold, the other rows the
+    merge identities.  (The default path for every non-alive multi-host
+    resume; exercised here by slicing a single-process mesh state into
+    the rows 'process 0' would own.)"""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    cfg = AnalyzerConfig(**MESHFREE_BASE, mesh_shape=(4, 1))
+    be = ShardedTpuBackend(cfg, init_now_s=5)
+    run_scan("t", SyntheticSource(MESHFREE_SPEC), be, 256)  # non-trivial fold
+    host = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x)), be.get_state()
+    )
+    local = jax.tree.map(lambda x: x[:2].copy(), host)  # "process 0" rows
+    scope = (0, 2, [0, 1])
+    save_snapshot(str(tmp_path), "t", cfg, local, {0: 1}, 1, 5, scope=scope)
+    fresh_local = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x))[:2].copy(),
+        ShardedTpuBackend(cfg, init_now_s=5).get_state(),
+    )
+    snap = load_snapshot(
+        str(tmp_path), "t", cfg, template=fresh_local, scope=scope
+    )
+    assert snap is not None
+    state = snap[0]
+    m = state.metrics
+    # Row 0 = the canonical fold of THIS process's saved rows...
+    assert np.array_equal(
+        m.per_partition[0], host.metrics.per_partition[:2].sum(axis=0)
+    )
+    assert np.array_equal(
+        m.earliest_s[0], host.metrics.earliest_s[:2].min(axis=0)
+    )
+    # ...and row 1 the merge identities (a fresh state's values).
+    assert np.array_equal(m.per_partition[1], np.zeros_like(m.per_partition[1]))
+    assert np.array_equal(
+        m.earliest_s[1],
+        np.full_like(m.earliest_s[1], np.iinfo(np.int64).max),
+    )
+    assert np.array_equal(
+        state.hll.regs[0], host.hll.regs[:2].max(axis=0)
+    )
+    assert not state.hll.regs[1].any()
+
+
+def test_mesh_free_snapshot_is_canonical_on_disk(tmp_path):
+    """v4 snapshots store the single-device layout regardless of the mesh
+    that wrote them — that is WHY any mesh can adopt them."""
+    import json as _json
+
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    from kafka_topic_analyzer_tpu.checkpoint import (
+        SNAPSHOT_NAME,
+        config_fingerprint,
+    )
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    cfg = AnalyzerConfig(**MESHFREE_BASE, mesh_shape=(2, 1))
+    be = ShardedTpuBackend(cfg, init_now_s=5)
+    save_snapshot(str(tmp_path), "t", cfg, be.get_state(), {0: 1}, 1, 5)
+    with np.load(str(tmp_path / SNAPSHOT_NAME), allow_pickle=False) as z:
+        meta = _json.loads(str(z["__meta__"]))
+        per_part = z["state.metrics.per_partition"]
+        overall = z["state.metrics.overall_count"]
+    assert per_part.shape == (5, 7)  # canonical, not [dev, 5, 7]
+    assert overall.shape == ()
+    # Mesh-free stamp: the single-device config produces the SAME key.
+    assert meta["fingerprint"] == config_fingerprint(
+        AnalyzerConfig(**MESHFREE_BASE), "t"
+    )
+
+
 def test_pack_rejects_out_of_range_partition():
     from kafka_topic_analyzer_tpu.packing import pack_batch
     from kafka_topic_analyzer_tpu.records import RecordBatch

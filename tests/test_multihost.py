@@ -49,8 +49,10 @@ def _free_port() -> int:
     return port
 
 
-def _single_process_reference() -> dict:
-    """The same scan on this process's own 8-device mesh (conftest env)."""
+def _single_process_reference(n_partitions: int = 6) -> dict:
+    """The same scan on this process's own 8-device mesh (conftest env).
+    Sequential ingest — byte-identity across worker counts is exactly the
+    contract the fan-in composition tests lean on (DESIGN.md §11/§14)."""
     from kafka_topic_analyzer_tpu.config import AnalyzerConfig
     from kafka_topic_analyzer_tpu.engine import run_scan
     from kafka_topic_analyzer_tpu.io.synthetic import (
@@ -60,7 +62,7 @@ def _single_process_reference() -> dict:
     from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
 
     spec = SyntheticSpec(
-        num_partitions=6,
+        num_partitions=n_partitions,
         messages_per_partition=5000,
         keys_per_partition=500,
         key_null_permille=50,
@@ -68,7 +70,7 @@ def _single_process_reference() -> dict:
         seed=42,
     )
     config = AnalyzerConfig(
-        num_partitions=6,
+        num_partitions=n_partitions,
         batch_size=2048,
         count_alive_keys=True,
         alive_bitmap_bits=16,
@@ -119,6 +121,20 @@ def test_two_process_scan_matches_single_process(tmp_path):
     # Round-trip the reference through JSON too: quantile dict keys are
     # floats in-memory and strings on the wire.
     want = json.loads(json.dumps(_single_process_reference()))
+    assert got == want
+
+
+def test_two_process_scan_with_per_controller_fanin(tmp_path):
+    """The PR-7 tentpole under real multi-controller: each process runs
+    2-worker ParallelIngest fan-ins per data row it feeds (16 partitions,
+    8 workers per controller), and the merged metrics are byte-identical
+    to the sequential single-process sharded scan.  The child additionally
+    asserts the per-controller resolved counts and the c0./c1.-prefixed
+    worker telemetry union."""
+    out = tmp_path / "mh_fanin_metrics.json"
+    _run_children(out, ["workers"])
+    got = json.loads(out.read_text())
+    want = json.loads(json.dumps(_single_process_reference(n_partitions=16)))
     assert got == want
 
 

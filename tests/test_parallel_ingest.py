@@ -338,6 +338,186 @@ def test_snapshot_offsets_identical_across_worker_counts(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# composed parallelism: mesh x workers x superbatch in ONE scan (PR-7
+# tentpole).  The contract (DESIGN.md §14): for any (mesh, workers, K)
+# the ScanResult is byte-identical to the sequential single-device scan.
+
+MATRIX_SPEC = SyntheticSpec(
+    num_partitions=5, messages_per_partition=1000,
+    keys_per_partition=31, tombstone_permille=120, seed=3,
+)
+MATRIX_BASE = dict(
+    num_partitions=5, batch_size=256,
+    count_alive_keys=True, alive_bitmap_bits=16, enable_hll=True, hll_p=10,
+)
+
+
+def _composed_backend(mesh, k):
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.config import DispatchConfig
+
+    dispatch = DispatchConfig(superbatch=k, depth=2)
+    if mesh == 1:
+        return TpuBackend(
+            AnalyzerConfig(**MATRIX_BASE), init_now_s=10**10,
+            dispatch=dispatch,
+        )
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    return ShardedTpuBackend(
+        AnalyzerConfig(**MATRIX_BASE, mesh_shape=(mesh, 1)),
+        init_now_s=10**10, dispatch=dispatch,
+    )
+
+
+@pytest.fixture(scope="module")
+def composed_baseline():
+    """Sequential single-device scan — the matrix's byte-exact referee."""
+    r = run_scan("t", SyntheticSource(MATRIX_SPEC), _composed_backend(1, 1), 256)
+    return _full_doc(r)
+
+
+@pytest.mark.parametrize("k", [1, 4])
+@pytest.mark.parametrize("workers", [1, 2, 4])
+@pytest.mark.parametrize("mesh", [1, 2, 4])
+def test_composed_matrix_byte_identical(composed_baseline, mesh, workers, k):
+    if (mesh, workers, k) == (1, 1, 1):
+        return  # the referee itself
+    import jax
+
+    if mesh > len(jax.devices()):
+        pytest.skip("needs more virtual devices")
+    r = run_scan(
+        "t", SyntheticSource(MATRIX_SPEC), _composed_backend(mesh, k), 256,
+        ingest_workers=workers,
+    )
+    assert r.superbatch_k == k
+    assert _full_doc(r) == composed_baseline
+    # The resolved per-controller record always covers this process.
+    assert r.ingest_workers_per_controller == [r.ingest_workers]
+    if mesh == 1:
+        assert r.ingest_workers == min(workers, 5)
+    else:
+        # Sharded: every fed row needs >= 1 stream, extras go to the rows
+        # with the most partitions — never more than one per partition.
+        assert min(mesh, 5) <= r.ingest_workers <= 5
+
+
+def _sharded_wire_backend(k=1):
+    """A (2, 1) sharded-mesh backend for the wire tests below: 4
+    partitions split rows [0, 2] / [1, 3], so ingest_workers=4 gives each
+    row a 2-worker fan-in (the per-controller composition under test)."""
+    from kafka_topic_analyzer_tpu.config import DispatchConfig
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    cfg = AnalyzerConfig(
+        num_partitions=N_PARTS, batch_size=128,
+        count_alive_keys=True, alive_bitmap_bits=16, mesh_shape=(2, 1),
+    )
+    return ShardedTpuBackend(
+        cfg, init_now_s=10**10, dispatch=DispatchConfig(superbatch=k, depth=2)
+    )
+
+
+def test_composed_fault_in_one_worker_absorbed():
+    """A transport kill lands inside ONE worker's stream of ONE data
+    row's fan-in (mesh 2 x workers 4 x K 2); retry + recovery must keep
+    the composed result byte-identical to the sequential sharded scan."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+
+    def run(workers, chaos, k=1):
+        with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+            )
+            feed = src
+            if chaos:
+                feed = ChaosTrigger(
+                    src, 2,
+                    lambda: setattr(
+                        broker, "faults",
+                        FaultInjector().drop_connection(100, times=2),
+                    ),
+                )
+            result = run_scan(
+                TOPIC, feed, _sharded_wire_backend(k=k), 128,
+                ingest_workers=workers,
+            )
+            src.close()
+        return result
+
+    ref = run(1, chaos=False)
+    assert not ref.degraded_partitions
+    faulted = run(4, chaos=True, k=2)
+    assert not faulted.degraded_partitions
+    assert faulted.ingest_workers == 4
+    assert _full_doc(faulted) == _full_doc(ref)
+
+
+def test_composed_corruption_in_one_worker_matches_sequential(tmp_path):
+    """Deterministic poison in partition 1 — exactly one worker's group of
+    one row's fan-in under mesh 2 x workers 4 — with quarantine: metrics,
+    corrupt accounting, and the spool all match the sequential sharded
+    scan."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+
+    def poisoned():
+        inj = (
+            CorruptionInjector()
+            .flip_byte(1, chunk=1, offset=-1)
+            .flip_byte(1, chunk=3, offset=-3)
+        )
+        return FakeBroker(
+            TOPIC, RECORDS, max_records_per_fetch=50, corruption=inj,
+            honor_partition_max_bytes=True,
+        )
+
+    def run(workers, qdir, k=1):
+        with poisoned() as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC,
+                overrides=dict(FAST_RETRY, **{"check.crcs": "true"}),
+                corruption=CorruptionConfig(
+                    policy="quarantine", quarantine_dir=qdir
+                ),
+            )
+            result = run_scan(
+                TOPIC, src, _sharded_wire_backend(k=k), 128,
+                ingest_workers=workers,
+            )
+            src.close()
+        return result
+
+    seq = run(1, str(tmp_path / "q1"))
+    par = run(4, str(tmp_path / "q4"), k=2)
+    assert set(seq.corrupt_partitions) == {1}
+    assert _full_doc(par) == _full_doc(seq)
+    assert sorted(os.listdir(tmp_path / "q4")) == sorted(
+        os.listdir(tmp_path / "q1")
+    )
+
+
+def test_allocate_row_workers_deterministic():
+    from kafka_topic_analyzer_tpu.parallel.ingest import allocate_row_workers
+
+    # Floor: every non-empty row gets a stream even under a tiny budget.
+    assert allocate_row_workers(1, {0: 3, 1: 2}) == {0: 1, 1: 1}
+    # Extras chase the highest partitions-per-worker ratio, ties by row.
+    assert allocate_row_workers(4, {0: 3, 1: 2}) == {0: 2, 1: 2}
+    assert allocate_row_workers(5, {0: 3, 1: 2}) == {0: 3, 1: 2}
+    # Clamped at the row's partition count; empty rows get nothing.
+    assert allocate_row_workers(99, {0: 3, 1: 0, 2: 1}) == {0: 3, 1: 0, 2: 1}
+    with pytest.raises(ValueError):
+        allocate_row_workers(0, {0: 1})
+
+
+# ---------------------------------------------------------------------------
 # pool mechanics: error propagation, close-on-exit, metrics
 
 
@@ -420,32 +600,41 @@ def test_per_worker_telemetry_recorded():
 
 
 @pytest.mark.parametrize("mesh", ["2", "1,2"])
-def test_cli_rejects_workers_with_sharded_mesh(capsys, mesh):
-    """Both mesh axes route through the sharded scan path, which would
-    silently ignore the flag — data-only AND space-only meshes reject."""
+def test_cli_workers_compose_with_sharded_mesh(capsys, mesh):
+    """--ingest-workers composes with --mesh (the PR-7 tentpole): the
+    sharded scan runs a per-controller fan-in and the --json report
+    records the resolved per-controller counts."""
     from kafka_topic_analyzer_tpu import cli
 
     rc = cli.main([
         "-t", "t", "--source", "synthetic",
-        "--synthetic", "partitions=4,messages=100",
+        "--synthetic", "partitions=4,messages=2000",
         "--mesh", mesh, "--backend", "tpu",
-        "--ingest-workers", "2", "--quiet",
+        "--ingest-workers", "2", "--json", "--quiet",
     ])
-    assert rc == 1
-    assert "--mesh 1" in capsys.readouterr().err
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert doc["ingest_workers"] == 2
+    assert doc["ingest_workers_per_controller"] == [2]
 
 
-def test_cli_auto_workers_resolve_to_one_under_mesh():
-    """'auto' under a sharded mesh must resolve to 1 on ANY host — a
-    host-core-count-dependent hard error would pass CI and fail prod."""
+def test_cli_workers_resolve_passthrough_under_mesh():
+    """Under a sharded mesh the CLI hands the PARSED IngestConfig to the
+    engine unresolved: per-controller resolution needs each controller's
+    shard partition count (and its own core count for 'auto'), which the
+    CLI cannot know for remote hosts."""
     from kafka_topic_analyzer_tpu.cli import build_parser, resolve_ingest_workers
+    from kafka_topic_analyzer_tpu.config import IngestConfig
 
     args = build_parser().parse_args(
         ["-t", "t", "--ingest-workers", "auto"]
     )
-    assert resolve_ingest_workers(args, (2, 1), 64) == 1
-    assert resolve_ingest_workers(args, (1, 2), 64) == 1
+    assert resolve_ingest_workers(args, (2, 1), 64) == IngestConfig("auto")
+    assert resolve_ingest_workers(args, (1, 2), 64) == IngestConfig("auto")
     assert resolve_ingest_workers(args, (1, 1), 64) >= 1
+    args = build_parser().parse_args(["-t", "t", "--ingest-workers", "3"])
+    assert resolve_ingest_workers(args, (4, 1), 64) == IngestConfig(3)
+    assert resolve_ingest_workers(args, (1, 1), 64) == 3
 
 
 def test_cli_rejects_bad_worker_spec(capsys):

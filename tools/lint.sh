@@ -310,3 +310,103 @@ if failures:
     sys.exit(1)
 print("lint: OK (drive loops bound staged superbatches by dispatch depth)")
 EOF
+
+# Fifth rule: lockstep safety on the sharded mesh.  Every collective call
+# site in the sharded superbatch/drain path must be reachable by ALL
+# controllers: a collective launched under a condition that can DIFFER
+# between controllers (process-local rows, process index, per-row
+# liveness, locally-observed degradation/corruption) is a deadlock — one
+# controller enters the collective, its peers never do.  AST rule over
+# parallel/sharded.py and engine.py: calls to the collective entry points
+# must not sit lexically under an `if`/`while` whose condition (or a
+# `for` whose iterable) references a per-controller-varying name.
+# Uniform guards (feature flags, superbatch config, `_multiprocess` —
+# process_count is the same everywhere) stay legal.
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PKG = pathlib.Path("kafka_topic_analyzer_tpu")
+FILES = [PKG / "parallel" / "sharded.py", PKG / "engine.py"]
+#: Host-level collective entry points (methods that launch a program every
+#: controller must join).  Traced-code collectives (lax.psum etc.) compile
+#: uniformly and are exempt — only runtime call sites can diverge.
+COLLECTIVE_ATTRS = {
+    "_step", "_superstep", "_any_fn", "_merge", "_pmax_fn",
+    "update_shards", "update_shards_superbatch", "global_any",
+    "gather_telemetry",
+}
+COLLECTIVE_NAMES = {"lockstep", "dispatch_fn"}
+#: Names whose value varies per controller: a collective under a test of
+#: one of these is one-sided.
+VARYING = {
+    "local_rows", "process_index", "addressable_shards", "feed_rows",
+    "alive", "degraded", "corrupt", "local_flag", "step_valid",
+    "fed_partitions", "row_workers",
+}
+
+failures = []
+for path in FILES:
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    # Parent links for ancestor walks.
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def names_in(expr):
+        return {
+            n.id for n in ast.walk(expr) if isinstance(n, ast.Name)
+        } | {
+            n.attr for n in ast.walk(expr) if isinstance(n, ast.Attribute)
+        }
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_collective = (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in COLLECTIVE_ATTRS
+        ) or (
+            isinstance(node.func, ast.Name)
+            and node.func.id in COLLECTIVE_NAMES
+        )
+        if not is_collective:
+            continue
+        cur = node
+        while cur in parents:
+            parent = parents[cur]
+            bad = None
+            if isinstance(parent, (ast.If, ast.While)) and cur in (
+                parent.body + parent.orelse
+            ):
+                # Only the guarded blocks — not the test expression itself.
+                bad = names_in(parent.test) & VARYING
+            elif isinstance(parent, ast.For) and cur in parent.body:
+                bad = names_in(parent.iter) & VARYING
+            elif isinstance(parent, ast.IfExp):
+                bad = names_in(parent.test) & VARYING
+            if bad:
+                label = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else node.func.id
+                )
+                failures.append(
+                    f"{path}:{node.lineno}: collective {label!r} guarded by "
+                    f"per-controller-varying name(s) {sorted(bad)} — "
+                    "unreachable on peers, would deadlock the fleet"
+                )
+                break
+            cur = parent
+
+if failures:
+    print("lint: collective call sites must be reachable by ALL")
+    print("lint: controllers (no collective under a per-controller")
+    print("lint: early-return or varying condition — DESIGN.md §14):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (sharded collectives sit on lockstep-reachable paths)")
+EOF
