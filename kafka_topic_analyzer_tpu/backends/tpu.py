@@ -183,6 +183,23 @@ class TpuBackend(MetricBackend):
             return StagedBatch(buf)
         return StagedBatch(jax.device_put(buf, self.device))
 
+    def make_fused_sink(self, dense_of):
+        """A packing.FusedPackSink staged for this backend: fused rows
+        come out exactly like ``prepare``'s output (async ``device_put``
+        on the producing thread at K=1; host buffer at K>1, copied into
+        its superbatch stager row at fan-in time).  One sink per ingest
+        stream — sinks are single-threaded state."""
+        from kafka_topic_analyzer_tpu.packing import FusedPackSink
+
+        def stage(buf):
+            if self.superbatch_k > 1:
+                return StagedBatch(buf)
+            return StagedBatch(jax.device_put(buf, self.device))
+
+        return FusedPackSink(
+            self.config, self.config.batch_size, dense_of, stage=stage
+        )
+
     def update(self, batch: "RecordBatch | StagedBatch") -> None:
         if isinstance(batch, StagedBatch):
             self.state = self._step(self.state, batch.buf)

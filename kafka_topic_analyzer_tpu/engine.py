@@ -134,6 +134,16 @@ class _ProgressTracker:
             for p, c in zip(parts.tolist(), counts.tolist()):
                 self.next_offsets[p] = self.next_offsets.get(p, 0) + int(c)
 
+    def observe_packed(self, row) -> None:
+        """Fused-path twin of ``observe``: a packing.PackedRow carries the
+        per-partition bookkeeping pre-aggregated (offset-exact partitions
+        in ``next_offsets``, offset-less ones as ``counts``) instead of
+        per-record columns."""
+        for p, o in row.next_offsets.items():
+            self.next_offsets[p] = max(self.next_offsets.get(p, 0), o)
+        for p, c in row.counts.items():
+            self.next_offsets[p] = self.next_offsets.get(p, 0) + c
+
 
 def run_scan(
     topic: str,
@@ -366,6 +376,57 @@ def run_scan(
             return b  # nothing to rewrite; safe to alias
         return dataclasses.replace(b, partition=pindex.to_dense(b.partition))
 
+    # Fused ingest (DESIGN.md §15): when the backend can stage packed rows
+    # (make_fused_sink), the source can feed a FusedPackSink
+    # (supports_fused_sink), and the native shim is up, each ingest stream
+    # gets a PRIVATE sink and yields packing.PackedRow items — wire bytes
+    # decoded→packed (and backend-staged) in one GIL-released native pass
+    # on the producing thread, no decoded-column intermediate.  Any closed
+    # gate falls back to the decoded-batch chain and is booked on
+    # kta_fused_fallback_total — a bypass is never silent.
+    from kafka_topic_analyzer_tpu.packing import (
+        PackedRow,
+        fused_ingest_enabled,
+    )
+
+    _make_sink = getattr(backend, "make_fused_sink", None)
+    # The attribute declares intent; the signature check confirms no
+    # wrapper in between (TeeSource, test shims that __getattr__-forward
+    # to a fused-capable inner source) dropped the ``sink=`` parameter
+    # from its own batches() override.
+    import inspect
+
+    try:
+        _accepts_sink = "sink" in inspect.signature(source.batches).parameters
+    except (TypeError, ValueError):
+        _accepts_sink = False
+    _declares_fused = getattr(source, "supports_fused_sink", False)
+    _fusable_source = _declares_fused and _accepts_sink
+    fused = (
+        _make_sink is not None
+        and _fusable_source
+        and getattr(backend, "use_native", True)
+        and fused_ingest_enabled()
+    )
+    if _make_sink is not None and _declares_fused and not fused:
+        # Book every closed gate — a bypass is never silent, including a
+        # wrapper that forwards the capability flag but dropped sink=.
+        if not _accepts_sink:
+            reason = "source-unfusable"
+        elif not getattr(backend, "use_native", True):
+            reason = "native-off"
+        else:
+            from kafka_topic_analyzer_tpu.io.native import native_status
+
+            ok, why = native_status()
+            reason = "fused-disabled" if ok else f"native-{why}"
+        obs_metrics.FUSED_FALLBACK.labels(reason=reason).inc()
+    _dense_map = {p: i for i, p in enumerate(pindex.ids)}
+
+    def make_sink():
+        """A fresh per-stream sink (sinks are single-threaded state)."""
+        return _make_sink(_dense_map.__getitem__)
+
     used_workers = 1
     # Superbatch dispatch (config.DispatchConfig, resolved by the backend):
     # accumulate K staged batches and fold them in ONE scanned device
@@ -474,9 +535,13 @@ def run_scan(
             prepare_shard = getattr(backend, "prepare_shard", None)
 
             def _stage_row(it):
-                if prepare_shard is None:
-                    return ((b, None) for b in it)
-                return ((b, prepare_shard(_dense_copy(b))) for b in it)
+                for b in it:
+                    if isinstance(b, PackedRow):
+                        yield b, b.staged  # fused: packed AND staged already
+                    elif prepare_shard is None:
+                        yield b, None
+                    else:
+                        yield b, prepare_shard(_dense_copy(b))
 
             stage_shard = (
                 (lambda b: prepare_shard(_dense_copy(b)))
@@ -529,6 +594,7 @@ def run_scan(
                             depth=max(prefetch_depth, 1),
                             wid_base=wid_base,
                             label_prefix=label_prefix,
+                            sink_factory=make_sink if fused else None,
                         )
                     )
                 else:
@@ -539,6 +605,7 @@ def run_scan(
                                     batch_size,
                                     partitions=shard_parts[r],
                                     start_at=start_at,
+                                    **({"sink": make_sink()} if fused else {}),
                                 )
                             ),
                             prefetch_depth,
@@ -571,11 +638,15 @@ def run_scan(
                         b, staged = item
                         step_valid += b.num_valid
                         step_bytes += b.nbytes
-                        tracker.observe(b, b.partition)
-                        shard_batches[r] = (
-                            staged if staged is not None
-                            else pindex.remap_batch(b)
-                        )
+                        if isinstance(b, PackedRow):
+                            tracker.observe_packed(b)
+                            shard_batches[r] = staged
+                        else:
+                            tracker.observe(b, b.partition)
+                            shard_batches[r] = (
+                                staged if staged is not None
+                                else pindex.remap_batch(b)
+                            )
                 have_data = step_valid > 0
                 if multiproc:
                     have_data = lockstep(have_data)
@@ -652,6 +723,7 @@ def run_scan(
                         start_at=start_at,
                         stage=stage,
                         depth=max(prefetch_depth, 1),
+                        sink_factory=make_sink if fused else None,
                     )
                 )
             else:
@@ -662,7 +734,11 @@ def run_scan(
                 batches = _closing(
                     prefetch(
                         iter_staged(
-                            source.batches(batch_size, start_at=start_at),
+                            source.batches(
+                                batch_size,
+                                start_at=start_at,
+                                **({"sink": make_sink()} if fused else {}),
+                            ),
                             stage,
                         ),
                         prefetch_depth,
@@ -686,16 +762,28 @@ def run_scan(
                     break
                 batch, staged = item
                 nvalid = batch.num_valid
-                last = len(batch) - 1
-                last_partition = int(batch.partition[last])  # true id, pre-remap
-                last_offset = (
-                    str(int(batch.offsets[last]))
-                    if batch.offsets is not None
-                    else "~"  # gapless sources don't carry offsets
-                )
-                tracker.observe(batch, batch.partition)
-                if staged is None:
-                    staged = pindex.remap_batch(batch)
+                if isinstance(batch, PackedRow):
+                    last_partition = batch.last_partition
+                    last_offset = (
+                        str(batch.last_offset)
+                        if batch.last_offset >= 0 else "~"
+                    )
+                    last_ts = batch.last_ts_s
+                    tracker.observe_packed(batch)
+                    if staged is None:
+                        staged = batch.staged
+                else:
+                    last = len(batch) - 1
+                    last_partition = int(batch.partition[last])  # true id, pre-remap
+                    last_offset = (
+                        str(int(batch.offsets[last]))
+                        if batch.offsets is not None
+                        else "~"  # gapless sources don't carry offsets
+                    )
+                    last_ts = int(batch.ts_s[last])
+                    tracker.observe(batch, batch.partition)
+                    if staged is None:
+                        staged = pindex.remap_batch(batch)
                 if add_batch is not None:
                     add_batch(staged, nvalid, batch.nbytes)
                 else:
@@ -717,7 +805,7 @@ def run_scan(
                 # indicatif-template message like src/kafka.rs:111-113.
                 spinner.set_message(
                     f"[Sq: {seq} | T: {topic} | P: {last_partition} | "
-                    f"O: {last_offset} | Ts: {format_utc_seconds(int(batch.ts_s[last]))}]"
+                    f"O: {last_offset} | Ts: {format_utc_seconds(last_ts)}]"
                 )
             if flush_pending is not None:
                 flush_pending()  # partial superbatch tail at stream end

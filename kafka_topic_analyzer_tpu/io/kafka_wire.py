@@ -1007,11 +1007,17 @@ class KafkaWireSource(RecordSource):
 
     # -- the read loop (src/kafka.rs:74-137, batched) ------------------------
 
+    #: The engine may hand this source a packing.FusedPackSink: accepted
+    #: record sets then decode→pack straight into wire-v4 rows (yielded as
+    #: packing.PackedRow) instead of materializing RecordBatch columns.
+    supports_fused_sink = True
+
     def batches(
         self,
         batch_size: int,
         partitions: Optional[List[int]] = None,
         start_at: Optional[Dict[int, int]] = None,
+        sink=None,
     ) -> Iterator[RecordBatch]:
         # Fetch connections are private to this iterator: sharded scans
         # and parallel ingest (parallel/ingest.py) run one batches()
@@ -1025,7 +1031,7 @@ class KafkaWireSource(RecordSource):
         pools: "list" = []
         try:
             yield from self._batches_impl(
-                batch_size, partitions, start_at, own_conns, pools
+                batch_size, partitions, start_at, own_conns, pools, sink
             )
         finally:
             # Drain worker threads BEFORE closing their sockets: a close
@@ -1044,6 +1050,7 @@ class KafkaWireSource(RecordSource):
         start_at: Optional[Dict[int, int]],
         own_conns: "Dict[int, BrokerConnection]",
         pools: "list",
+        sink=None,
     ) -> Iterator[RecordBatch]:
         start, end = self.watermarks()
         parts = sorted(partitions) if partitions is not None else self.partitions()
@@ -1058,11 +1065,26 @@ class KafkaWireSource(RecordSource):
         # re-split to batch_size at flush; offsets ride along for snapshot
         # resume.  Chunks come from the native frame decoder when available
         # (the Python per-record generator is ~100x slower).
+        #
+        # With a fused ``sink`` installed (and the native shim loaded) the
+        # pend/resplit chain is replaced wholesale: accepted record sets
+        # decode→pack straight into the sink's wire-v4 rows
+        # (sink.append_record_set — no SoA columns, no re-batching copy),
+        # fallback chunks (compressed/legacy/salvaged/python-decoded
+        # frames) enter the SAME rows through sink.append_batch so the
+        # greedy batch_size boundaries — and therefore the packed bytes —
+        # stay byte-identical to the chained path, and ``flush`` yields
+        # completed packing.PackedRow items instead of RecordBatches.
         pend: List[RecordBatch] = []
         pend_count = 0
 
-        def flush(force: bool) -> Iterator[RecordBatch]:
+        def flush(force: bool):
             nonlocal pend, pend_count
+            if sink is not None:
+                if force:
+                    sink.flush()
+                yield from sink.take_completed()
+                return
             if not (pend_count >= batch_size or (force and pend_count)):
                 return
             out, pend, pend_count = RecordBatch.resplit(
@@ -1070,11 +1092,15 @@ class KafkaWireSource(RecordSource):
             )
             yield from out
 
-        def push_chunk(chunk: RecordBatch) -> None:
+        def push_chunk(chunk: RecordBatch, reason: str = "frame-fallback") -> None:
             nonlocal pend_count
-            if len(chunk):
-                pend.append(chunk)
-                pend_count += len(chunk)
+            if not len(chunk):
+                return
+            if sink is not None:
+                sink.append_batch(chunk, reason)
+                return
+            pend.append(chunk)
+            pend_count += len(chunk)
 
         def accept_records(soa: "dict[str, np.ndarray]", p: int) -> int:
             """Push the records of a decoded SoA chunk that fall in
@@ -1119,6 +1145,22 @@ class KafkaWireSource(RecordSource):
                 use_native_decode = native_available()
             except ImportError:
                 use_native_decode = False
+        if sink is not None and not use_native_decode:
+            # Fused sink requested but the native decoder is off: the whole
+            # stream degrades to the decoded-batch python chain.  Book the
+            # bypass ONCE with the cached load reason — never silently.
+            if self.use_native_hashing:
+                from kafka_topic_analyzer_tpu.io.native import native_status
+
+                reason = f"native-{native_status()[1]}"
+            else:
+                reason = "native-off"
+            obs_metrics.FUSED_FALLBACK.labels(reason=reason).inc()
+            log.warning(
+                "fused decode→pack unavailable (%s); falling back to the "
+                "python decode chain", reason,
+            )
+            sink = None
 
         import time
 
@@ -1330,9 +1372,11 @@ class KafkaWireSource(RecordSource):
                         spec_sent = True
             # Pre-decode the clean full-prefix record sets here (the
             # expensive, GIL-releasing half); masking and state updates
-            # stay in phase 2.
+            # stay in phase 2.  Fused-sink streams skip this: their decode
+            # IS the pack, and sink appends must run serially in phase-2
+            # order (the scan above still powers the send-ahead).
             soas: "Dict[int, tuple]" = {}
-            if scans:
+            if scans and sink is None:
                 with obs_trace.maybe_span("decode", cat="io"):
                     for fp in fps:
                         p = fp.partition
@@ -1495,7 +1539,27 @@ class KafkaWireSource(RecordSource):
                     max_frame_end = -1
                     data = fp.records
                     pre = soas.get(p)
-                    if pre is not None or (use_native_decode and data):
+                    if sink is not None and use_native_decode and data:
+                        # Fused fast path: the record set's native prefix
+                        # decodes→packs straight into the sink's wire-v4
+                        # rows in ONE GIL-released C++ pass — the same
+                        # acceptance window and next_offset rule as
+                        # accept_records, with no SoA intermediate.  The
+                        # remainder (compressed/legacy/truncated/
+                        # malformed) takes the per-frame chain below,
+                        # entering the same rows via push_chunk.
+                        n_acc, used, covered, last = sink.append_record_set(
+                            data, next_offset[p], end[p], p,
+                            self.verify_crc, prescan=scans.get(p),
+                        )
+                        if used:
+                            max_frame_end = max(max_frame_end, covered)
+                            if n_acc:
+                                next_offset[p] = last + 1
+                                consumed += n_acc
+                                progressed = True
+                            data = data[used:] if used < len(data) else b""
+                    elif pre is not None or (use_native_decode and data):
                         # Whole-response fast path: every leading complete
                         # uncompressed v2 frame decoded in ONE native call
                         # (already done in phase 1 for clean prefixes);
@@ -1622,7 +1686,7 @@ class KafkaWireSource(RecordSource):
                                 rows, use_native=self.use_native_hashing
                             )
                             batch.offsets = np.array(row_offs, dtype=np.int64)
-                            push_chunk(batch)
+                            push_chunk(batch, reason="python-decode")
                             next_offset[p] = frame_next
                             consumed += len(rows)
                             progressed = True

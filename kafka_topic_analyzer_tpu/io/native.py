@@ -27,8 +27,14 @@ _NATIVE_DIR = os.path.join(
 )
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 11
+_ABI = 12
 _SO_NAME = f"libkta_ingest.v{_ABI}.so"
+
+#: Env knob that disables the native shim entirely (pure-Python chain
+#: everywhere, including the fused decode→pack path).  Tier-1 must pass
+#: with it set — every native call site keeps a reachable Python fallback
+#: (tools/lint.sh rule 6).
+_DISABLE_ENV = "KTA_DISABLE_NATIVE"
 
 
 def _build_dir() -> str:
@@ -86,8 +92,10 @@ def _build(build_dir: str) -> None:
 def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
     """Load (building if needed) the native shim; raises on failure.
 
-    A failed build/load is cached: hot paths (per-batch key hashing) probe
-    via `native_available` without re-running `make` every time.
+    A failed build/load is cached ONCE, with its reason: hot paths probe
+    via `native_available` without re-running `make` every time, and the
+    fused-fallback telemetry / ``--stats`` digest surface the cached
+    reason class (`native_status`) instead of each call site re-probing.
     """
     global _lib, _load_error
     with _lock:
@@ -96,6 +104,10 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
         if _load_error is not None:
             raise _load_error
         try:
+            if os.environ.get(_DISABLE_ENV):
+                raise RuntimeError(
+                    f"native shim disabled via {_DISABLE_ENV}"
+                )
             so_path = os.path.join(_build_dir(), _SO_NAME)
             if not os.path.exists(so_path):
                 if not build_if_missing:
@@ -116,6 +128,10 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
             lib.kta_scan_record_set.restype = ctypes.c_int64
             lib.kta_decode_record_set.restype = ctypes.c_int64
             lib.kta_crc32c.restype = ctypes.c_uint32
+            lib.kta_pack_scratch_len.restype = ctypes.c_int64
+            lib.kta_pack_row_init.restype = ctypes.c_int64
+            lib.kta_decode_pack_record_set.restype = ctypes.c_int64
+            lib.kta_pack_append_columns.restype = ctypes.c_int64
         except Exception as e:  # remember the failure
             _load_error = e
             raise
@@ -129,6 +145,27 @@ def native_available() -> bool:
         return True
     except Exception:
         return False
+
+
+def native_status() -> "tuple[bool, str]":
+    """(available, reason) — the cached load outcome in one probe.
+
+    ``reason`` is a short, bounded label suitable for a metric label or a
+    ``--stats`` line: ``""`` when the shim loaded, else one of
+    ``disabled`` (KTA_DISABLE_NATIVE), ``build-failed`` (make error),
+    ``abi-mismatch``, or ``load-failed`` (missing/undloadable .so).  The
+    negative result is cached by `load_library` — probing here never
+    re-runs the build."""
+    if native_available():
+        return True, ""
+    err = _load_error
+    if isinstance(err, RuntimeError) and _DISABLE_ENV in str(err):
+        return False, "disabled"
+    if isinstance(err, subprocess.CalledProcessError):
+        return False, "build-failed"
+    if isinstance(err, RuntimeError) and "ABI mismatch" in str(err):
+        return False, "abi-mismatch"
+    return False, "load-failed"
 
 
 def _as_ptr(arr: np.ndarray, ctype):
@@ -446,6 +483,198 @@ def pack_batch_native(
         return None
     assert nbytes == out.nbytes, (nbytes, out.nbytes)
     return out
+
+
+# ---------------------------------------------------------------------------
+# fused decode→pack (native/ingest.cpp fused entry points)
+#
+# One GIL-released C++ pass from raw record-set bytes (or already-decoded
+# SoA columns on the fallback half) straight into a wire-v4 packed row —
+# the SoA materialization between kta_decode_record_set and kta_pack_batch
+# never happens.  packing.FusedPackSink owns row/scratch lifecycle; these
+# are the thin ctypes wrappers.  Every caller keeps a reachable
+# python-chain fallback (lint rule 6): a missing shim degrades to the
+# decode→RecordBatch→pack_batch chain, never to an error.
+
+
+def _fused_pack_params(config, batch_size: int) -> "tuple[int, ...]":
+    """The (b, P, with_alive, alive_bits, with_hll, hll_p, hll_rows, vcap)
+    tail shared by the fused entry points — derived through the same
+    packing.py rules as pack_batch_native, so the fused row layout can
+    never skew from the chained one."""
+    from kafka_topic_analyzer_tpu.packing import MAX_VALUE_LEN, hll_table_rows
+
+    hll_rows = hll_table_rows(config, batch_size)
+    return (
+        batch_size,
+        config.num_partitions,
+        1 if config.count_alive_keys else 0,
+        config.alive_bitmap_bits,
+        0 if not config.enable_hll else (2 if hll_rows else 1),
+        config.hll_p,
+        hll_rows,
+        MAX_VALUE_LEN if config.use_pallas_counters else 0,
+    )
+
+
+def _fused_ctail(params) -> "list":
+    b, P, wa, ab, wh, hp, hr, vc = params
+    return [
+        ctypes.c_int64(b), ctypes.c_int32(P), ctypes.c_int32(wa),
+        ctypes.c_int32(ab), ctypes.c_int32(wh), ctypes.c_int32(hp),
+        ctypes.c_int32(hr), ctypes.c_int32(vc),
+    ]
+
+
+def _raise_pack_range(field: int, value: int) -> None:
+    """Map the fused pass's pack-range error detail onto the SAME
+    ValueError messages packing.pack_batch raises, so a scan aborts
+    identically whichever path met the out-of-range record."""
+    from kafka_topic_analyzer_tpu.packing import MAX_KEY_LEN, MAX_VALUE_LEN
+
+    if value < 0:
+        raise ValueError("negative key/value length in record batch")
+    if field == 0:
+        raise ValueError(
+            f"key length {int(value)} exceeds the packed "
+            f"transfer limit of {MAX_KEY_LEN} bytes"
+        )
+    raise ValueError(
+        f"value length {int(value)} exceeds the Pallas "
+        f"counter kernel's limit of {MAX_VALUE_LEN} bytes — disable "
+        f"use_pallas_counters for such topics"
+    )
+
+
+def pack_scratch_len(config, batch_size: int) -> int:
+    """int64 elements of append scratch one fused row needs."""
+    lib = load_library()
+    n = lib.kta_pack_scratch_len(
+        ctypes.c_int64(batch_size),
+        ctypes.c_int32(1 if config.count_alive_keys else 0),
+        ctypes.c_int32(config.alive_bitmap_bits),
+    )
+    if n < 0:
+        raise RuntimeError("kta_pack_scratch_len rejected batch_size")
+    return int(n)
+
+
+def pack_row_init(out: np.ndarray, scratch: np.ndarray, config,
+                  batch_size: int) -> None:
+    """Initialize a wire-v4 row for incremental fused appends.  The
+    initialized row is byte-identical to a packed empty batch, so it
+    doubles as the partial-row / superbatch identity pad."""
+    lib = load_library()
+    need = lib.kta_pack_row_init(
+        _as_ptr(out, ctypes.c_uint8),
+        ctypes.c_int64(out.nbytes),
+        _as_ptr(scratch, ctypes.c_int64),
+        ctypes.c_int64(len(scratch)),
+        *_fused_ctail(_fused_pack_params(config, batch_size)),
+    )
+    if need != out.nbytes:
+        raise RuntimeError(
+            f"kta_pack_row_init layout mismatch: need={need}, "
+            f"buffer={out.nbytes}"
+        )
+
+
+def decode_pack_record_set_native(
+    data: np.ndarray,
+    out: np.ndarray,
+    scratch: np.ndarray,
+    config,
+    batch_size: int,
+    dense_partition: int,
+    min_off: int,
+    max_off: int,
+    verify_crc: bool = False,
+    start_pos: int = 0,
+    skip: int = 0,
+) -> "tuple[int, int, int, int, int, bool, int]":
+    """Fused decode→pack over a record set's native-decodable prefix.
+
+    Returns ``(appended, consumed, covered_end, last_off, last_ts_s,
+    row_full, resume_skip)`` — on ``row_full`` the caller rotates rows and
+    re-calls with ``start_pos=consumed, skip=resume_skip``.  A malformed
+    frame ends the walk at its boundary (the per-frame python chain
+    classifies it from ``consumed``); a record the wire-v4 layout cannot
+    carry raises the same ValueError the numpy packer would."""
+    lib = load_library()
+    st = np.zeros(8, dtype=np.int64)
+    st[4] = skip
+    rc = lib.kta_decode_pack_record_set(
+        _as_ptr(data, ctypes.c_uint8),
+        ctypes.c_int64(len(data)),
+        ctypes.c_int32(1 if verify_crc else 0),
+        ctypes.c_int64(start_pos),
+        ctypes.c_int64(min_off),
+        ctypes.c_int64(max_off),
+        ctypes.c_int32(dense_partition),
+        *_fused_ctail(_fused_pack_params(config, batch_size)),
+        _as_ptr(out, ctypes.c_uint8),
+        ctypes.c_int64(out.nbytes),
+        _as_ptr(scratch, ctypes.c_int64),
+        _as_ptr(st, ctypes.c_int64),
+    )
+    if rc == -2:
+        _raise_pack_range(int(st[6]), int(st[7]))
+    if rc < 0:
+        raise RuntimeError(f"kta_decode_pack_record_set failed rc={rc}")
+    return (
+        int(rc), int(st[0]), int(st[1]), int(st[2]), int(st[3]),
+        bool(st[5]), int(st[4]),
+    )
+
+
+def pack_append_columns_native(
+    out: np.ndarray,
+    scratch: np.ndarray,
+    config,
+    batch_size: int,
+    dense_partition: int,
+    key_len: np.ndarray,
+    value_len: np.ndarray,
+    key_null: np.ndarray,
+    value_null: np.ndarray,
+    ts: np.ndarray,
+    key_hash32: np.ndarray,
+    key_hash64: np.ndarray,
+    start: int,
+    n: int,
+    ts_mode: int = 0,
+) -> int:
+    """Append records ``[start, n)`` — ``n`` is the EXCLUSIVE end index
+    into the columns, not a count — of single-partition SoA columns into a
+    fused row (stops at row capacity; returns appended count).
+    ``ts_mode``: 0 = ts[] already seconds, 1 = ms floor-divided (segment
+    reader rule), 2 = ms clamped at 0 then divided (wire decoder rule)."""
+    lib = load_library()
+    c = np.ascontiguousarray
+    detail = np.zeros(2, dtype=np.int64)
+    rc = lib.kta_pack_append_columns(
+        _as_ptr(out, ctypes.c_uint8),
+        ctypes.c_int64(out.nbytes),
+        _as_ptr(scratch, ctypes.c_int64),
+        ctypes.c_int32(dense_partition),
+        _as_ptr(c(key_len, dtype=np.int32), ctypes.c_int32),
+        _as_ptr(c(value_len, dtype=np.int32), ctypes.c_int32),
+        _as_ptr(c(key_null).view(np.uint8), ctypes.c_uint8),
+        _as_ptr(c(value_null).view(np.uint8), ctypes.c_uint8),
+        _as_ptr(c(ts, dtype=np.int64), ctypes.c_int64),
+        ctypes.c_int32(ts_mode),
+        _as_ptr(c(key_hash32, dtype=np.uint32), ctypes.c_uint32),
+        _as_ptr(c(key_hash64, dtype=np.uint64), ctypes.c_uint64),
+        ctypes.c_int64(start),
+        ctypes.c_int64(n),
+        *_fused_ctail(_fused_pack_params(config, batch_size)),
+        _as_ptr(detail, ctypes.c_int64),
+    )
+    if rc == -2:
+        _raise_pack_range(int(detail[0]), int(detail[1]))
+    if rc < 0:
+        raise RuntimeError(f"kta_pack_append_columns failed rc={rc}")
+    return int(rc)
 
 
 class NativeSyntheticSource(SyntheticSource):

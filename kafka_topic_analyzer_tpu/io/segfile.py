@@ -448,11 +448,18 @@ class SegmentFileSource(RecordSource):
         partition count, since cold catalogs know their sizes up front."""
         return self.catalog.record_counts()
 
+    #: Cold chunks can feed the fused decode→pack sink: the memmap column
+    #: views go straight into wire-v4 rows (sink.append_columns — the
+    #: ms→s divide happens inside the native appender), skipping both the
+    #: RecordBatch view layer and the separate pack pass.
+    supports_fused_sink = True
+
     def batches(
         self,
         batch_size: int,
         partitions: Optional[List[int]] = None,
         start_at: Optional[Dict[int, int]] = None,
+        sink=None,
     ) -> Iterator[RecordBatch]:
         parts = sorted(partitions) if partitions is not None else self.partitions()
         # Sequential per-partition chunks: fastest IO pattern, and the order
@@ -469,8 +476,44 @@ class SegmentFileSource(RecordSource):
                         first = int(np.searchsorted(offs, resume))
                     else:
                         first = min(max(resume - seg.start_offset, 0), seg.count)
+                if sink is not None:
+                    # Fused cold path: the whole chunk's memmap views in
+                    # one native append (file page → packed row; the sink
+                    # cuts batch_size rows itself).  ts_mode=1 is the
+                    # reader's ``ts_ms // 1000`` rule.  Batches book at
+                    # the batch_size-cut count the chained loop below
+                    # would have reported, so kta_segment_batches_total
+                    # stays comparable whichever path engaged.
+                    n = seg.count - first
+                    if n <= 0:
+                        continue
+                    obs_metrics.SEGMENT_RECORDS.inc(n)
+                    obs_metrics.SEGMENT_BATCHES.inc(
+                        -(-n // batch_size)
+                    )
+                    sink.append_columns(
+                        seg.partition,
+                        seg.column("key_len", first),
+                        seg.column("value_len", first),
+                        seg.column("key_null", first),
+                        seg.column("value_null", first),
+                        seg.column("ts_ms", first),
+                        seg.column("key_hash32", first),
+                        seg.column("key_hash64", first),
+                        n,
+                        ts_mode=1,
+                        offsets=(
+                            seg.column("offsets", first)
+                            if seg.has_offsets else None
+                        ),
+                    )
+                    yield from sink.take_completed()
+                    continue
                 for lo in range(first, seg.count, batch_size):
                     hi = min(lo + batch_size, seg.count)
                     obs_metrics.SEGMENT_RECORDS.inc(hi - lo)
                     obs_metrics.SEGMENT_BATCHES.inc()
                     yield seg.read_batch(lo, hi)
+        if sink is not None:
+            sink.flush()
+            yield from sink.take_completed()

@@ -49,6 +49,23 @@ inline uint64_t fnv1a64(const uint8_t* p, int64_t n) {
   return h;
 }
 
+// Both key hashes in ONE pass: the two dependent xor-multiply chains are
+// independent of each other, so interleaving them overlaps their
+// latencies — per-key hash time approaches max(h32, h64) instead of the
+// sum.  Used by every record decoder (per-frame, whole-set, fused).
+inline void fnv1a_both(const uint8_t* p, int64_t n, uint32_t* h32,
+                       uint64_t* h64) {
+  uint32_t a = kFnv32Offset;
+  uint64_t b = kFnv64Offset;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t c = p[i];
+    a = (a ^ c) * kFnv32Mult;
+    b = (b ^ c) * kFnv64Prime;
+  }
+  *h32 = a;
+  *h64 = b;
+}
+
 // Parallel-for over [0, n) in contiguous chunks.
 template <typename F>
 void parallel_for(int64_t n, int threads, F&& body) {
@@ -86,7 +103,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 11; }
+int32_t kta_version() { return 12; }
 
 // CRC32-C (Castagnoli) over a byte buffer — Kafka's record-batch checksum.
 // Table-driven; the Python fallback (kafka_codec._crc32c) is a per-byte
@@ -318,8 +335,7 @@ extern "C" int64_t kta_decode_records(
       if (klen > rec_end - pos || klen > 0x7fffffff) return -1;
       key_null_out[i] = 0;
       key_len_out[i] = static_cast<int32_t>(klen);
-      h32_out[i] = fnv1a32_ref(payload + pos, klen);
-      h64_out[i] = fnv1a64(payload + pos, klen);
+      fnv1a_both(payload + pos, klen, h32_out + i, h64_out + i);
       pos += klen;
     }
     if (!read_zigzag(payload, rec_end, pos, vlen)) return -1;
@@ -674,6 +690,800 @@ extern "C" int64_t kta_pack_batch(
   std::memcpy(out + 4, &hp, 4);
   return need;
 }
+
+// ---------------------------------------------------------------------------
+// Fused decode→pack: one pass from raw fetch bytes to wire-v4 packed rows.
+//
+// The chained hot path is kta_decode_record_set (wire bytes → eight SoA
+// columns) followed by kta_pack_batch (columns → wire-v4 buffer): every
+// record's metadata is written to memory once and read back once purely to
+// move between the two calls.  The fused entry points below append records
+// STRAIGHT into a caller-supplied wire-v4 row as they are decoded — the SoA
+// intermediate never exists.  Because a row outlives a single call (record
+// sets are smaller or larger than one batch), append state persists in a
+// caller-owned int64 scratch:
+//
+//   scratch[0] = n        records appended to the row so far (the cursor;
+//                         becomes header n_valid)
+//   scratch[1] = n_pairs  alive-dedupe pairs emitted (header n_pairs)
+//   scratch[2] = cap      dedupe table capacity (0 when alive is off)
+//   scratch[3..3+cap)     open-addressing LWW table (pair index + 1; 0 empty)
+//
+// The dedupe table persisting across appends is what makes incremental
+// packing exact: output pair ORDER is first-occurrence record order —
+// independent of table capacity — so a row built by many appends is
+// byte-identical to kta_pack_batch over the same records (asserted by
+// tests/test_fused.py).
+//
+// Error contract (mirrors the taxonomy io/native.py documents):
+//   >= 0  records appended
+//   -1    bad arguments / layout mismatch
+//   -2    pack-range violation (a decoded value the wire-v4 layout cannot
+//         carry: key_len > u16, value_len > cap, partition out of range) —
+//         detail[0] = field code (0 klen / 1 vlen / 2 partition),
+//         detail[1] = offending value.  The Python wrapper re-raises the
+//         same ValueError the numpy packer would.
+// A *malformed frame* is NOT an error here: the walk stops at the frame
+// boundary exactly like kta_scan_record_set, and the caller's per-frame
+// Python chain classifies it precisely (CorruptFrameError taxonomy).
+// Frames are validated in a store-free pre-pass before any append, so a
+// frame either appends completely or not at all — corruption can never
+// leave half a frame committed to a row.
+
+namespace {
+
+struct PackRowLayout {
+  int64_t b;
+  int64_t P;
+  int32_t with_alive;
+  int32_t alive_bits;
+  int32_t with_hll;  // 0 off, 1 per-record pairs, 2 register table
+  int32_t hll_p;
+  int32_t hll_rows;
+  int32_t vcap;
+  int64_t need;
+  // Section base pointers (uint8_t*: sections are only naturally aligned
+  // when batch_size is a multiple of 8 — all element access via memcpy).
+  uint8_t *p16, *kl16, *vl32, *fl8, *tsmm, *szmm;
+  uint8_t *slot32, *alive8;
+  uint8_t *hll_a, *hll_b;  // idx/rho (mode 1) or regs/- (mode 2)
+};
+
+inline bool pack_row_layout(uint8_t* out, int64_t out_cap, int64_t b,
+                            int32_t P, int32_t with_alive, int32_t alive_bits,
+                            int32_t with_hll, int32_t hll_p, int32_t hll_rows,
+                            int32_t value_len_cap, PackRowLayout* r) {
+  if (!out || b < 0 || P <= 0 || P > 0x7fff) return false;
+  if (with_alive && (alive_bits < 1 || alive_bits > 32)) return false;
+  int64_t need = 16 + b * (2 + 2 + 4 + 1) + 2 * (2 * int64_t(P) * 8);
+  if (with_alive) need += b * 5;
+  if (with_hll == 1) need += b * 3;
+  if (with_hll == 2) {
+    if (hll_rows < 1 || (hll_rows > 1 && hll_rows < P)) return false;
+    need += int64_t(hll_rows) << hll_p;
+  }
+  if (need > out_cap) return false;
+  r->b = b;
+  r->P = P;
+  r->with_alive = with_alive;
+  r->alive_bits = alive_bits;
+  r->with_hll = with_hll;
+  r->hll_p = hll_p;
+  r->hll_rows = hll_rows;
+  r->vcap = value_len_cap > 0 ? value_len_cap : 0x7fffffff;
+  r->need = need;
+  int64_t pos = 16;
+  r->p16 = out + pos;
+  pos += b * 2;
+  r->kl16 = out + pos;
+  pos += b * 2;
+  r->vl32 = out + pos;
+  pos += b * 4;
+  r->fl8 = out + pos;
+  pos += b;
+  r->tsmm = out + pos;
+  pos += 2 * P * 8;
+  r->szmm = out + pos;
+  pos += 2 * P * 8;
+  r->slot32 = r->alive8 = nullptr;
+  if (with_alive) {
+    r->slot32 = out + pos;
+    pos += b * 4;
+    r->alive8 = out + pos;
+    pos += b;
+  }
+  r->hll_a = r->hll_b = nullptr;
+  if (with_hll == 1) {
+    r->hll_a = out + pos;  // idx u16[B]
+    pos += b * 2;
+    r->hll_b = out + pos;  // rho u8[B]
+    pos += b;
+  } else if (with_hll == 2) {
+    r->hll_a = out + pos;  // regs u8[rows << p]
+    pos += int64_t(hll_rows) << hll_p;
+  }
+  return true;
+}
+
+inline int64_t pack_scratch_cap(int64_t b, int32_t with_alive,
+                                int32_t alive_bits) {
+  if (!with_alive) return 0;
+  // The table can only ever hold min(b, 2^bits) distinct slots; sizing
+  // by that instead of b keeps it cache-resident for practical bitmap
+  // sizes (capacity changes probe POSITIONS, never the first-occurrence
+  // output order, so rows stay byte-identical to kta_dedupe_slots).
+  int64_t distinct = b;
+  if (alive_bits < 62 && (int64_t(1) << alive_bits) < distinct)
+    distinct = int64_t(1) << alive_bits;
+  int64_t cap = 16;
+  while (cap < distinct * 2) cap <<= 1;
+  return cap;
+}
+
+template <typename T>
+inline void store_at(uint8_t* base, int64_t idx, T v) {
+  std::memcpy(base + idx * int64_t(sizeof(T)), &v, sizeof(T));
+}
+template <typename T>
+inline T load_at(const uint8_t* base, int64_t idx) {
+  T v;
+  std::memcpy(&v, base + idx * int64_t(sizeof(T)), sizeof(T));
+  return v;
+}
+
+// Batched append core.  The per-record interleaved form (decode one
+// record, probe the dedupe table, RMW the extreme tables, repeat) stalls
+// on a dependent random cache miss per record; the passes below keep the
+// chained packer's memory-level parallelism — decode writes the
+// per-record sections in one tight loop while stashing the reduction
+// inputs compactly, then dedupe/HLL/extremes each run as a dedicated
+// tight pass per frame.
+
+// Compact per-frame stash of the reduction inputs for ACTIVE (non-null
+// key) records, carved out of the caller scratch after the dedupe table.
+struct FrameStash {
+  uint64_t* h64;
+  uint32_t* h32;
+  uint8_t* alive;
+  int64_t n;
+};
+
+inline FrameStash stash_of(int64_t* scr, int64_t b, int64_t cap_alloc) {
+  // cap_alloc is the ALLOCATED table capacity (pack_scratch_cap), not
+  // scr[2]: the active capacity starts small and grows, but the stash
+  // lives past the full allocation.
+  FrameStash s;
+  uint8_t* base = reinterpret_cast<uint8_t*>(scr + 3 + cap_alloc);
+  s.h64 = reinterpret_cast<uint64_t*>(base);
+  s.h32 = reinterpret_cast<uint32_t*>(base + 8 * b);
+  s.alive = base + 12 * b;
+  s.n = 0;
+  return s;
+}
+
+inline int64_t pack_stash_len64(int64_t b, int32_t with_alive,
+                                int32_t with_hll) {
+  if (!with_alive && with_hll != 2) return 0;
+  return (13 * b + 7) / 8;
+}
+
+// Grow the active dedupe table (doubling, bounded by the allocated max)
+// once the load factor reaches 1/2, re-inserting the existing pairs from
+// the row's slot section.  Capacity and rehashing change probe POSITIONS
+// only — pair output order stays first-occurrence record order — so rows
+// remain byte-identical to kta_dedupe_slots while a low-cardinality
+// batch keeps its table cache-resident instead of paying the worst-case
+// 2·batch_size table from the first record.
+inline void dedupe_maybe_grow(const PackRowLayout& r, int64_t* scr,
+                              int64_t cap_max) {
+  int64_t cap = scr[2];
+  if (cap >= cap_max || scr[1] * 2 < cap) return;
+  while (cap < cap_max && scr[1] * 2 >= cap) cap <<= 1;
+  int64_t* table = scr + 3;
+  std::memset(table, 0, size_t(cap) * 8);
+  const int64_t cap_mask = cap - 1;
+  for (int64_t j = 0; j < scr[1]; ++j) {
+    const uint32_t slot = load_at<uint32_t>(r.slot32, j);
+    int64_t pos = int64_t(splitmix64(slot) & uint64_t(cap_mask));
+    while (table[pos] != 0) pos = (pos + 1) & cap_mask;
+    table[pos] = j + 1;
+  }
+  scr[2] = cap;
+}
+
+// Dedicated LWW dedupe pass: insert the stash's (slot, alive) pairs into
+// the row's persistent open-addressing table — same algorithm (and same
+// first-occurrence output order) as kta_dedupe_slots, but incremental
+// across appends because the table lives in the caller scratch.
+inline void dedupe_pass(const PackRowLayout& r, int64_t* scr,
+                        const uint32_t* h32, const uint8_t* alive,
+                        int64_t n) {
+  const uint32_t mask =
+      r.alive_bits == 32 ? 0xffffffffu : ((1u << r.alive_bits) - 1u);
+  const int64_t cap_max = pack_scratch_cap(r.b, 1, r.alive_bits);
+  int64_t* table = scr + 3;
+  int64_t np = scr[1];
+  for (int64_t j = 0; j < n; ++j) {
+    scr[1] = np;
+    dedupe_maybe_grow(r, scr, cap_max);
+    const int64_t cap_mask = scr[2] - 1;
+    const uint32_t slot = h32[j] & mask;
+    int64_t pos = int64_t(splitmix64(slot) & uint64_t(cap_mask));
+    for (;;) {
+      const int64_t entry = table[pos];
+      if (entry == 0) {
+        table[pos] = np + 1;
+        store_at<uint32_t>(r.slot32, np, slot);
+        r.alive8[np] = alive[j];
+        ++np;
+        break;
+      }
+      if (load_at<uint32_t>(r.slot32, entry - 1) == slot) {
+        r.alive8[entry - 1] = alive[j];  // later record wins
+        break;
+      }
+      pos = (pos + 1) & cap_mask;
+    }
+  }
+  scr[1] = np;
+}
+
+// Dedicated HLL register-table pass (mode 2) over the stash's h64 values.
+inline void hll_table_pass(const PackRowLayout& r, int32_t dense_p,
+                           const uint64_t* h64, int64_t n) {
+  const int64_t row = r.hll_rows > 1 ? dense_p : 0;
+  uint8_t* tbl = r.hll_a;
+  for (int64_t j = 0; j < n; ++j) {
+    const uint64_t h = splitmix64(h64[j]);
+    const int64_t idx = (row << r.hll_p) | int64_t(h >> (64 - r.hll_p));
+    const uint64_t rest = h << r.hll_p;
+    const uint8_t rho =
+        rest == 0 ? static_cast<uint8_t>(64 - r.hll_p + 1)
+                  : static_cast<uint8_t>(__builtin_clzll(rest) + 1);
+    if (rho > tbl[idx]) tbl[idx] = rho;
+  }
+}
+
+// One table RMW per frame/append instead of four per record.
+inline void commit_extremes(const PackRowLayout& r, int32_t dense_p,
+                            int64_t ts_min, int64_t ts_max, int64_t sz_min,
+                            int64_t sz_max, bool any_ts, bool any_sz) {
+  if (any_ts) {
+    if (ts_min < load_at<int64_t>(r.tsmm, dense_p))
+      store_at<int64_t>(r.tsmm, dense_p, ts_min);
+    if (ts_max > load_at<int64_t>(r.tsmm, r.P + dense_p))
+      store_at<int64_t>(r.tsmm, r.P + dense_p, ts_max);
+  }
+  if (any_sz) {
+    if (sz_min < load_at<int64_t>(r.szmm, dense_p))
+      store_at<int64_t>(r.szmm, dense_p, sz_min);
+    if (sz_max > load_at<int64_t>(r.szmm, r.P + dense_p))
+      store_at<int64_t>(r.szmm, r.P + dense_p, sz_max);
+  }
+}
+
+// Rewind a failed frame's partial appends: reset the cursor and re-zero
+// the per-record section spans it touched, so the row stays byte-
+// identical to one that never saw the frame (the reductions were not
+// committed — they only run after a frame parses completely).
+inline void rewind_appends(const PackRowLayout& r, int64_t* scr,
+                           int64_t cursor0) {
+  const int64_t n = scr[0];
+  if (n <= cursor0) return;
+  const int64_t c = n - cursor0;
+  std::memset(r.p16 + 2 * cursor0, 0, size_t(2 * c));
+  std::memset(r.kl16 + 2 * cursor0, 0, size_t(2 * c));
+  std::memset(r.vl32 + 4 * cursor0, 0, size_t(4 * c));
+  std::memset(r.fl8 + cursor0, 0, size_t(c));
+  if (r.with_hll == 1) {
+    std::memset(r.hll_a + 2 * cursor0, 0, size_t(2 * c));
+    std::memset(r.hll_b + cursor0, 0, size_t(c));
+  }
+  scr[0] = cursor0;
+}
+
+// Store-free validation of one v2 frame's records: every record must parse
+// inside its bounds, and every record IN the acceptance window must fit
+// the wire-v4 ranges, so the append pass can never fail mid-frame.
+// (Out-of-window records are never packed — the chained path filters them
+// before pack_batch ever sees them, so a range violation there must not
+// abort the fused scan either.)  Returns 0 ok, 1 malformed (caller stops
+// the walk at this frame for the Python chain to classify), 2 pack-range
+// violation (detail filled; the whole call errors like the numpy packer
+// would).
+inline int validate_frame_records(const uint8_t* payload, int64_t plen,
+                                  int32_t nrec, int32_t vcap,
+                                  int64_t base_offset, int64_t min_off,
+                                  int64_t max_off, int64_t* detail) {
+  int64_t pos = 0;
+  for (int32_t i = 0; i < nrec; ++i) {
+    int64_t length;
+    if (!read_zigzag(payload, plen, pos, length)) return 1;
+    if (length < 0 || length > plen - pos) return 1;
+    const int64_t rec_end = pos + length;
+    if (pos >= rec_end) return 1;
+    ++pos;  // attributes
+    int64_t ts_delta, off_delta, klen, vlen;
+    if (!read_zigzag(payload, rec_end, pos, ts_delta)) return 1;
+    if (!read_zigzag(payload, rec_end, pos, off_delta)) return 1;
+    if (!read_zigzag(payload, rec_end, pos, klen)) return 1;
+    if (klen >= 0) {
+      if (klen > rec_end - pos || klen > 0x7fffffff) return 1;
+      pos += klen;
+    }
+    if (!read_zigzag(payload, rec_end, pos, vlen)) return 1;
+    if (vlen >= 0) {
+      if (vlen > rec_end - pos || vlen > 0x7fffffff) return 1;
+      pos += vlen;
+    }
+    const int64_t off = base_offset + off_delta;
+    if (off >= min_off && off < max_off) {
+      if (klen > 0xffff) {
+        detail[0] = 0;
+        detail[1] = klen;
+        return 2;
+      }
+      if (vlen > vcap) {
+        detail[0] = 1;
+        detail[1] = vlen;
+        return 2;
+      }
+    }
+    int64_t nheaders;
+    if (!read_zigzag(payload, rec_end, pos, nheaders)) return 1;
+    if (nheaders < 0) return 1;
+    for (int64_t h = 0; h < nheaders; ++h) {
+      int64_t hk, hv;
+      if (!read_zigzag(payload, rec_end, pos, hk)) return 1;
+      if (hk < 0 || hk > rec_end - pos) return 1;
+      pos += hk;
+      if (!read_zigzag(payload, rec_end, pos, hv)) return 1;
+      if (hv > 0) {
+        if (hv > rec_end - pos) return 1;
+        pos += hv;
+      }
+    }
+    pos = rec_end;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scratch length (int64 elements) a pack row needs: counters + the
+// persistent dedupe table + the per-frame reduction stash.
+int64_t kta_pack_scratch_len(int64_t batch_size, int32_t with_alive,
+                             int32_t alive_bits) {
+  if (batch_size < 0) return -1;
+  // The stash region is sized unconditionally (it also serves HLL table
+  // mode with alive off) — a few MB at worst, allocated once per sink.
+  return 3 + pack_scratch_cap(batch_size, with_alive, alive_bits) +
+         pack_stash_len64(batch_size, 1, 2);
+}
+
+// Initialize one wire-v4 row for incremental appends: zero the buffer,
+// identity-fill the extreme tables, reset the scratch.  An initialized,
+// never-appended row is byte-identical to a packed EMPTY batch (the
+// superbatch identity pad), so partial-row padding is just init.
+// Returns the row's total bytes (== packing.packed_nbytes) or -1.
+int64_t kta_pack_row_init(uint8_t* out, int64_t out_cap, int64_t* scratch,
+                          int64_t scratch_len, int64_t batch_size,
+                          int32_t num_partitions, int32_t with_alive,
+                          int32_t alive_bits, int32_t with_hll,
+                          int32_t hll_p, int32_t hll_rows,
+                          int32_t value_len_cap) {
+  PackRowLayout r;
+  if (!scratch ||
+      !pack_row_layout(out, out_cap, batch_size, num_partitions, with_alive,
+                       alive_bits, with_hll, hll_p, hll_rows, value_len_cap,
+                       &r))
+    return -1;
+  const int64_t cap = pack_scratch_cap(batch_size, with_alive, alive_bits);
+  if (scratch_len < 3 + cap + pack_stash_len64(batch_size, with_alive,
+                                               with_hll))
+    return -1;
+  std::memset(out, 0, r.need);
+  for (int64_t p = 0; p < r.P; ++p) {
+    store_at<int64_t>(r.tsmm, p, INT64_MAX);
+    store_at<int64_t>(r.tsmm, r.P + p, INT64_MIN);
+    store_at<int64_t>(r.szmm, p, INT64_MAX);
+    store_at<int64_t>(r.szmm, r.P + p, 0);
+  }
+  scratch[0] = 0;
+  scratch[1] = 0;
+  // Active table capacity starts small and grows with distinct slots
+  // (dedupe_maybe_grow) — low-cardinality rows keep it cache-resident.
+  scratch[2] = cap < 4096 ? cap : 4096;
+  std::memset(scratch + 3, 0, size_t(scratch[2]) * 8);
+  return r.need;
+}
+
+// Fused decode→pack over a record set's native-decodable prefix, starting
+// at byte `start_pos` (0, or a previous call's resume position).  Records
+// with min_off <= offset < max_off append to the row; the walk stops at
+// the first non-native frame (compressed / legacy / truncated / malformed
+// — Python chain takes over from `consumed`) or when the row fills
+// mid-frame (st[5] = 1; resume with start_pos = st[0], skip = st[4] after
+// rotating rows).  Frame atomicity: a frame that might span the row
+// boundary is pre-validated store-free; any other frame that turns out
+// malformed mid-parse has its partial appends rewound (reductions only
+// commit after a frame parses completely) — either way a frame appends
+// all of its in-range records or none.  st is int64[8]:
+//   in:  st[4] = records of the frame at start_pos already processed
+//   out: st[0] consumed (bytes of fully-processed frames)
+//        st[1] covered_end (max base+last_offset_delta+1; -1 none)
+//        st[2] last appended offset (-1 none this call)
+//        st[3] last appended ts_s
+//        st[4] resume skip count   st[5] row-full flag
+//        st[6]/st[7] pack-range error detail (rc == -2)
+// Returns records appended this call, -1 bad args, -2 pack-range.
+int64_t kta_decode_pack_record_set(
+    const uint8_t* buf, int64_t len, int32_t verify_crc, int64_t start_pos,
+    int64_t min_off, int64_t max_off, int32_t dense_partition,
+    int64_t batch_size, int32_t num_partitions, int32_t with_alive,
+    int32_t alive_bits, int32_t with_hll, int32_t hll_p, int32_t hll_rows,
+    int32_t value_len_cap, uint8_t* out, int64_t out_cap, int64_t* scratch,
+    int64_t* st) {
+  PackRowLayout r;
+  if (!buf || len < 0 || !st || !scratch || start_pos < 0 ||
+      start_pos > len || dense_partition < 0 ||
+      dense_partition >= num_partitions ||
+      !pack_row_layout(out, out_cap, batch_size, num_partitions, with_alive,
+                       alive_bits, with_hll, hll_p, hll_rows, value_len_cap,
+                       &r))
+    return -1;
+  const bool need_stash = with_alive || with_hll == 2;
+  FrameStash stash = stash_of(
+      scratch, r.b, pack_scratch_cap(r.b, with_alive, alive_bits));
+  int64_t skip = st[4];
+  int64_t pos = start_pos, covered = -1, appended = 0;
+  int64_t last_off = -1, last_ts = 0;
+  st[5] = 0;
+  FrameHeader fh;
+  while (native_frame_at(buf, len, pos, verify_crc, &fh)) {
+    if (fh.control) {
+      if (fh.covered_end > covered) covered = fh.covered_end;
+      pos = fh.end;
+      skip = 0;
+      continue;
+    }
+    const uint8_t* payload = buf + fh.payload_pos;
+    const int64_t plen = fh.end - fh.payload_pos;
+    const int64_t space = r.b - scratch[0];
+    if (fh.num_records - skip > space) {
+      // This frame may outlive the current row: pre-validate it store-
+      // free so a malformation found AFTER the row rotates can never
+      // leave a committed partial frame behind.  Boundary-only cost —
+      // at most one frame per row takes this double walk.
+      const int v = validate_frame_records(payload, plen, fh.num_records,
+                                           r.vcap, fh.base_offset, min_off,
+                                           max_off, st + 6);
+      if (v == 2) return -2;
+      if (v != 0) break;
+    }
+    // Decode pass: tight per-record parse + section stores at the
+    // cursor, reduction inputs stashed compactly; dedupe/HLL/extreme
+    // commits run as dedicated passes after the frame parses.
+    const int64_t cursor0 = scratch[0];
+    stash.n = 0;
+    int64_t ts_min = INT64_MAX, ts_max = INT64_MIN;
+    int64_t sz_min = INT64_MAX, sz_max = 0;
+    int64_t f_last_off = -1, f_last_ts = 0, f_appended = 0;
+    int64_t rpos = 0;
+    int32_t i = 0;
+    bool full = false, malformed = false;
+    for (; i < fh.num_records; ++i) {
+      int64_t length = 0, ts_delta = 0, off_delta = 0, klen = 0, vlen = 0;
+      if (!read_zigzag(payload, plen, rpos, length) || length < 0 ||
+          length > plen - rpos) {
+        malformed = true;
+        break;
+      }
+      const int64_t rec_end = rpos + length;
+      if (rpos >= rec_end) {
+        malformed = true;
+        break;
+      }
+      ++rpos;  // attributes
+      if (!read_zigzag(payload, rec_end, rpos, ts_delta) ||
+          !read_zigzag(payload, rec_end, rpos, off_delta) ||
+          !read_zigzag(payload, rec_end, rpos, klen)) {
+        malformed = true;
+        break;
+      }
+      const uint8_t* kp = payload + rpos;
+      if (klen >= 0) {
+        if (klen > rec_end - rpos || klen > 0x7fffffff) {
+          malformed = true;
+          break;
+        }
+        rpos += klen;
+      }
+      if (!read_zigzag(payload, rec_end, rpos, vlen)) {
+        malformed = true;
+        break;
+      }
+      if (vlen >= 0) {
+        if (vlen > rec_end - rpos || vlen > 0x7fffffff) {
+          malformed = true;
+          break;
+        }
+        rpos += vlen;
+      }
+      int64_t nheaders = 0;
+      if (!read_zigzag(payload, rec_end, rpos, nheaders) || nheaders < 0) {
+        malformed = true;
+        break;
+      }
+      for (int64_t h = 0; h < nheaders; ++h) {
+        int64_t hk = 0, hv = 0;
+        if (!read_zigzag(payload, rec_end, rpos, hk) || hk < 0 ||
+            hk > rec_end - rpos) {
+          malformed = true;
+          break;
+        }
+        rpos += hk;
+        if (!read_zigzag(payload, rec_end, rpos, hv)) {
+          malformed = true;
+          break;
+        }
+        if (hv > 0) {
+          if (hv > rec_end - rpos) {
+            malformed = true;
+            break;
+          }
+          rpos += hv;
+        }
+      }
+      if (malformed) break;
+      rpos = rec_end;  // tolerate unknown trailing record fields
+      if (i < skip) continue;  // already appended into a previous row
+      const int64_t off = fh.base_offset + off_delta;
+      if (off < min_off || off >= max_off) continue;
+      // Pack-range checks only for records the scan ACCEPTS — the
+      // chained path filters out-of-window records before pack_batch
+      // ever sees them, so an oversized record past the watermark must
+      // not abort the fused scan either.
+      if (klen > 0xffff) {
+        rewind_appends(r, scratch, cursor0);
+        st[6] = 0;
+        st[7] = klen;
+        return -2;
+      }
+      if (vlen > r.vcap) {
+        rewind_appends(r, scratch, cursor0);
+        st[6] = 1;
+        st[7] = vlen;
+        return -2;
+      }
+      if (scratch[0] >= r.b) {
+        full = true;
+        break;
+      }
+      const bool key_null = klen < 0;
+      const bool value_null = vlen < 0;
+      const int64_t n = scratch[0];
+      store_at<int16_t>(r.p16, n, static_cast<int16_t>(dense_partition));
+      store_at<uint16_t>(r.kl16, n,
+                         static_cast<uint16_t>(key_null ? 0 : klen));
+      store_at<uint32_t>(r.vl32, n,
+                         static_cast<uint32_t>(value_null ? 0 : vlen));
+      r.fl8[n] = (key_null ? 1 : 0) | (value_null ? 2 : 0);
+      const int64_t ts_ms = fh.first_ts + ts_delta;
+      const int64_t ts_s = ts_ms < 0 ? 0 : ts_ms / 1000;
+      if (ts_s < ts_min) ts_min = ts_s;
+      if (ts_s > ts_max) ts_max = ts_s;
+      if (!value_null) {
+        const int64_t size = (key_null ? 0 : klen) + vlen;
+        if (size < sz_min) sz_min = size;
+        if (size > sz_max) sz_max = size;
+      }
+      uint32_t h32 = 0;
+      uint64_t h64 = 0;
+      if (!key_null) {
+        fnv1a_both(kp, klen, &h32, &h64);
+        if (need_stash) {
+          stash.h32[stash.n] = h32;
+          stash.h64[stash.n] = h64;
+          stash.alive[stash.n] = value_null ? 0 : 1;
+          ++stash.n;
+        }
+      }
+      if (r.with_hll == 1) {
+        if (key_null) {
+          store_at<uint16_t>(r.hll_a, n, 0);
+          r.hll_b[n] = 0;
+        } else {
+          const uint64_t h = splitmix64(h64);
+          store_at<uint16_t>(r.hll_a, n,
+                             static_cast<uint16_t>(h >> (64 - r.hll_p)));
+          const uint64_t rest = h << r.hll_p;
+          r.hll_b[n] =
+              rest == 0 ? static_cast<uint8_t>(64 - r.hll_p + 1)
+                        : static_cast<uint8_t>(__builtin_clzll(rest) + 1);
+        }
+      }
+      scratch[0] = n + 1;
+      ++f_appended;
+      f_last_off = off;
+      f_last_ts = ts_s;
+    }
+    if (malformed) {
+      // A spanning frame was pre-validated, so this is a non-spanning
+      // frame's first touch: rewind its partial appends and hand the
+      // frame to the Python chain for the precise classification.
+      rewind_appends(r, scratch, cursor0);
+      break;
+    }
+    // Commit the frame's (possibly partial, on row-full) reductions —
+    // these records stay in this row either way.
+    if (f_appended) {
+      commit_extremes(r, dense_partition, ts_min, ts_max, sz_min, sz_max,
+                      true, sz_min != INT64_MAX || sz_max != 0);
+      if (with_alive) dedupe_pass(r, scratch, stash.h32, stash.alive,
+                                  stash.n);
+      if (r.with_hll == 2) hll_table_pass(r, dense_partition, stash.h64,
+                                          stash.n);
+      appended += f_appended;
+      last_off = f_last_off;
+      last_ts = f_last_ts;
+    }
+    if (full) {
+      st[4] = i;  // resume: skip the records already processed
+      st[5] = 1;
+      break;
+    }
+    if (fh.covered_end > covered) covered = fh.covered_end;
+    pos = fh.end;
+    skip = 0;
+  }
+  st[0] = pos;
+  st[1] = covered;
+  st[2] = last_off;
+  st[3] = last_ts;
+  if (!st[5]) st[4] = 0;
+  // Live header: the row is a valid packed batch after every call.
+  const int32_t hv = static_cast<int32_t>(scratch[0]);
+  const int32_t hp = static_cast<int32_t>(scratch[1]);
+  std::memcpy(out, &hv, 4);
+  std::memcpy(out + 4, &hp, 4);
+  return appended;
+}
+
+// Column-append fallback half of the fused path: records [start, n) — n
+// is the exclusive END INDEX into the columns, not a count —
+// of already-decoded SoA columns (a salvaged frame, a segment chunk's
+// memmap views) append into the row through the SAME batched passes, so
+// fused rows mixing decoded and fallback records stay byte-identical to
+// the chained pack.  All records belong to ONE (dense) partition.
+// ts semantics: ts_mode = 0 takes ts[] as seconds verbatim; 1 floor-
+// divides milliseconds by 1000 (the segment reader's rule); 2 clamps
+// negatives to 0 then divides (the wire decoder's rule).
+// Returns records appended (stops at row capacity), -1 bad args, -2
+// pack-range violation (detail[0] field / detail[1] value).
+int64_t kta_pack_append_columns(
+    uint8_t* out, int64_t out_cap, int64_t* scratch, int32_t dense_partition,
+    const int32_t* key_len, const int32_t* value_len, const uint8_t* key_null,
+    const uint8_t* value_null, const int64_t* ts, int32_t ts_mode,
+    const uint32_t* h32, const uint64_t* h64, int64_t start, int64_t n,
+    int64_t batch_size, int32_t num_partitions, int32_t with_alive,
+    int32_t alive_bits, int32_t with_hll, int32_t hll_p, int32_t hll_rows,
+    int32_t value_len_cap, int64_t* detail) {
+  PackRowLayout r;
+  if (!key_len || !value_len || !key_null || !value_null || !ts || !h32 ||
+      !h64 || !scratch || !detail || start < 0 || n < 0 || start > n ||
+      dense_partition < 0 || dense_partition >= num_partitions ||
+      dense_partition > 0x7fff || ts_mode < 0 || ts_mode > 2 ||
+      !pack_row_layout(out, out_cap, batch_size, num_partitions, with_alive,
+                       alive_bits, with_hll, hll_p, hll_rows, value_len_cap,
+                       &r))
+    return -1;
+  int64_t take = n - start;
+  const int64_t space = r.b - scratch[0];
+  if (space < 0) return -1;
+  if (take > space) take = space;
+  const int64_t lo = start, hi = start + take;
+  // Validate before any append — same atomicity rule as the decode path,
+  // and the same UNCONDITIONAL column checks as kta_pack_batch (range
+  // violations reject even on null-key/tombstone records).
+  for (int64_t i = lo; i < hi; ++i) {
+    if (key_len[i] < 0 || key_len[i] > 0xffff) {
+      detail[0] = 0;
+      detail[1] = key_len[i];
+      return -2;
+    }
+    if (value_len[i] < 0 || value_len[i] > r.vcap) {
+      detail[0] = 1;
+      detail[1] = value_len[i];
+      return -2;
+    }
+  }
+  const int64_t c0 = scratch[0];
+  // Columnar section stores (klen/vlen stored VERBATIM, like
+  // kta_pack_batch — sources write 0 for null keys/tombstones but the
+  // layout carries whatever the column said).
+  for (int64_t i = lo; i < hi; ++i)
+    store_at<int16_t>(r.p16, c0 + (i - lo),
+                      static_cast<int16_t>(dense_partition));
+  for (int64_t i = lo; i < hi; ++i)
+    store_at<uint16_t>(r.kl16, c0 + (i - lo),
+                       static_cast<uint16_t>(key_len[i]));
+  for (int64_t i = lo; i < hi; ++i)
+    store_at<uint32_t>(r.vl32, c0 + (i - lo),
+                       static_cast<uint32_t>(value_len[i]));
+  for (int64_t i = lo; i < hi; ++i)
+    r.fl8[c0 + (i - lo)] =
+        (key_null[i] ? 1 : 0) | (value_null[i] ? 2 : 0);
+  // Extremes: scalar reduction, ONE table RMW.
+  int64_t ts_min = INT64_MAX, ts_max = INT64_MIN;
+  int64_t sz_min = INT64_MAX, sz_max = 0;
+  for (int64_t i = lo; i < hi; ++i) {
+    int64_t ts_s = ts[i];
+    if (ts_mode == 1)
+      ts_s = ts_s >= 0 ? ts_s / 1000 : -((-ts_s + 999) / 1000);
+    else if (ts_mode == 2)
+      ts_s = ts_s < 0 ? 0 : ts_s / 1000;
+    if (ts_s < ts_min) ts_min = ts_s;
+    if (ts_s > ts_max) ts_max = ts_s;
+    if (!value_null[i]) {
+      const int64_t size =
+          (key_null[i] ? 0 : int64_t(key_len[i])) + int64_t(value_len[i]);
+      if (size < sz_min) sz_min = size;
+      if (size > sz_max) sz_max = size;
+    }
+  }
+  if (take)
+    commit_extremes(r, dense_partition, ts_min, ts_max, sz_min, sz_max,
+                    true, sz_min != INT64_MAX || sz_max != 0);
+  // Dedupe + HLL as dedicated passes straight off the input columns.
+  if (with_alive) {
+    FrameStash stash = stash_of(
+        scratch, r.b, pack_scratch_cap(r.b, with_alive, alive_bits));
+    for (int64_t i = lo; i < hi; ++i) {
+      if (key_null[i]) continue;
+      stash.h32[stash.n] = h32[i];
+      stash.alive[stash.n] = value_null[i] ? 0 : 1;
+      ++stash.n;
+    }
+    dedupe_pass(r, scratch, stash.h32, stash.alive, stash.n);
+  }
+  if (r.with_hll == 1) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const int64_t pos = c0 + (i - lo);
+      if (key_null[i]) {
+        store_at<uint16_t>(r.hll_a, pos, 0);
+        r.hll_b[pos] = 0;
+      } else {
+        const uint64_t h = splitmix64(h64[i]);
+        store_at<uint16_t>(r.hll_a, pos,
+                           static_cast<uint16_t>(h >> (64 - r.hll_p)));
+        const uint64_t rest = h << r.hll_p;
+        r.hll_b[pos] =
+            rest == 0 ? static_cast<uint8_t>(64 - r.hll_p + 1)
+                      : static_cast<uint8_t>(__builtin_clzll(rest) + 1);
+      }
+    }
+  } else if (r.with_hll == 2) {
+    FrameStash stash = stash_of(
+        scratch, r.b, pack_scratch_cap(r.b, with_alive, alive_bits));
+    for (int64_t i = lo; i < hi; ++i) {
+      if (key_null[i]) continue;
+      stash.h64[stash.n] = h64[i];
+      ++stash.n;
+    }
+    hll_table_pass(r, dense_partition, stash.h64, stash.n);
+  }
+  scratch[0] = c0 + take;
+  const int32_t hv = static_cast<int32_t>(scratch[0]);
+  const int32_t hp = static_cast<int32_t>(scratch[1]);
+  std::memcpy(out, &hv, 4);
+  std::memcpy(out + 4, &hp, 4);
+  return take;
+}
+
+}  // extern "C"
 
 // ---------------------------------------------------------------------------
 // Decompressors for Kafka record batches (kafka_codec.py): snappy raw blocks

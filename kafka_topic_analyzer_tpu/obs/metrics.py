@@ -101,6 +101,23 @@ SEGMENT_BATCHES = _REG.counter(
     "kta_segment_batches_total",
     "Batches cut from memory-mapped segment chunks")
 
+# -- fused ingest (packing.FusedPackSink + io/kafka_wire + io/segfile) --------
+
+FUSED_BATCHES = _REG.counter(
+    "kta_fused_batches_total",
+    "Wire-v4 rows completed by the fused native decode→pack path")
+FUSED_RECORDS = _REG.counter(
+    "kta_fused_records_total",
+    "Records packed by the fused path without a decoded-column "
+    "intermediate")
+FUSED_FALLBACK = _REG.counter(
+    "kta_fused_fallback_total",
+    "Records that bypassed the fused decode and entered rows through the "
+    "python chain (reason: compressed/legacy frames, per-frame salvage, "
+    "python-decoded rows) or skipped fused packing entirely (native shim "
+    "disabled/failed, source or backend without fused support)",
+    labelnames=("reason",))
+
 # -- io/kafka_wire ------------------------------------------------------------
 
 FETCH_REQUESTS = _REG.counter(

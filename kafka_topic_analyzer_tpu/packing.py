@@ -401,6 +401,347 @@ def pack_batch(
     return out
 
 
+# ---------------------------------------------------------------------------
+# fused decode→pack sink (DESIGN.md §15)
+#
+# The seam between the wire/segment readers and the packed device backends:
+# a sink accepts raw record-set bytes (fused native decode→pack, no SoA
+# intermediate) or already-decoded columns (the python-chain fallback for
+# compressed/legacy/salvaged frames), fills wire-v4 rows incrementally, and
+# hands completed rows — staged for the backend — back to the stream.
+
+
+#: Decoded bytes per record (the RecordBatch column widths) — PackedRow
+#: reports the same per-record nbytes as the decoded batch it replaces so
+#: throughput stats stay comparable across the fused and chained paths.
+_RECORD_NBYTES = sum(np.dtype(dt).itemsize for _, dt in RecordBatch.FIELDS)
+
+
+def fused_ingest_enabled() -> bool:
+    """Master gate for the fused ingest path: the native shim must load
+    and ``KTA_DISABLE_FUSED`` must be unset.  Callers that get False keep
+    the python decode→RecordBatch→pack chain — the fused path is an
+    optimization with a reachable fallback everywhere (lint rule 6)."""
+    import os
+
+    if os.environ.get("KTA_DISABLE_FUSED"):
+        return False
+    from kafka_topic_analyzer_tpu.io.native import native_available
+
+    return native_available()
+
+
+class PackedRow:
+    """One completed wire-v4 row from the fused ingest path, plus the
+    bookkeeping the engine would otherwise read off the decoded batch:
+    per-partition progress (offsets or counts), the last record's
+    identity for the spinner, and the decoded-equivalent byte size for
+    throughput stats.  ``staged`` carries the backend-staged form
+    (StagedBatch / PackedShard) when the sink was given a stage callback —
+    it runs on the producing (worker) thread, exactly like
+    ``backend.prepare`` does on the chained path."""
+
+    __slots__ = (
+        "buf", "staged", "n_valid", "next_offsets", "counts",
+        "last_partition", "last_offset", "last_ts_s",
+    )
+
+    def __init__(self, buf, staged, n_valid, next_offsets, counts,
+                 last_partition, last_offset, last_ts_s):
+        self.buf = buf
+        self.staged = staged
+        self.n_valid = n_valid
+        #: true partition id -> one past the last appended offset (sources
+        #: that carry offsets); exact-resume bookkeeping.
+        self.next_offsets = next_offsets
+        #: true partition id -> records appended (offset-less sources).
+        self.counts = counts
+        self.last_partition = last_partition
+        self.last_offset = last_offset
+        self.last_ts_s = last_ts_s
+
+    @property
+    def num_valid(self) -> int:
+        return self.n_valid
+
+    @property
+    def nbytes(self) -> int:
+        return self.n_valid * _RECORD_NBYTES
+
+
+class FusedPackSink:
+    """Incremental wire-v4 row assembly for one ingest stream.
+
+    Single-device form (``space_shards=1``): rows are flat
+    ``uint8[packed_nbytes]`` buffers of ``chunk_records`` records — the
+    same greedy ``batch_size`` boundaries the wire layer's pend/resplit
+    chain produces, so a fused row is byte-identical to
+    ``pack_batch`` over the corresponding chained batch.
+
+    Sharded form (``space_shards=S`` with the backend's chunk config):
+    rows are ``uint8[S, chunk_nbytes]`` — records fill chunk 0..S-1
+    sequentially at ``chunk_records`` each, the exact ``pack_chunks``
+    rule, so a fused row is what ``prepare_shard`` would have staged.
+
+    NOT thread-safe; each ingest stream owns a private sink (parallel
+    ingest builds one per worker, the sharded engine one per fed row).
+    Appends must preserve per-partition record order — the stream
+    contract all byte-identity arguments rest on (DESIGN.md §11).
+    """
+
+    def __init__(
+        self,
+        pack_config: AnalyzerConfig,
+        chunk_records: int,
+        dense_of,
+        stage=None,
+        space_shards: int = 1,
+        chunk_rows: "bool | None" = None,
+    ):
+        from kafka_topic_analyzer_tpu.io import native as _native
+
+        self._native = _native
+        self.pack_config = pack_config
+        self.chunk_records = int(chunk_records)
+        self.space_shards = int(space_shards)
+        #: Sharded backends consume ``[S, chunk_nbytes]`` rows even at
+        #: S=1 (PackedShard's shape contract); single-device rows are
+        #: flat.
+        self._chunked = (
+            self.space_shards > 1 if chunk_rows is None else chunk_rows
+        )
+        self.capacity = self.chunk_records * self.space_shards
+        self._dense_of = dense_of
+        self._stage = stage
+        self._nbytes = packed_nbytes(pack_config, self.chunk_records)
+        self._scratch = np.zeros(
+            _native.pack_scratch_len(pack_config, self.chunk_records),
+            dtype=np.int64,
+        )
+        self._row: "np.ndarray | None" = None
+        self._chunk = 0
+        self._count = 0
+        self._next_offsets: "dict[int, int]" = {}
+        self._counts: "dict[int, int]" = {}
+        self._last = (-1, -1, 0)
+        self._done: "list[PackedRow]" = []
+
+    # -- row lifecycle -------------------------------------------------------
+
+    def _out_chunk(self) -> np.ndarray:
+        return self._row[self._chunk] if self._chunked else self._row
+
+    def _ensure_row(self) -> None:
+        if self._row is None:
+            self._row = np.empty(
+                (self.space_shards, self._nbytes)
+                if self._chunked
+                else self._nbytes,
+                dtype=np.uint8,
+            )
+            self._chunk = 0
+            self._count = 0
+            self._next_offsets = {}
+            self._counts = {}
+            self._native.pack_row_init(
+                self._out_chunk(), self._scratch, self.pack_config,
+                self.chunk_records,
+            )
+
+    def _advance_full_chunks(self) -> None:
+        """Eagerly rotate past filled chunks: completing the row when the
+        last chunk fills (full rows emit as soon as they exist — the same
+        moment the chained flush would yield the corresponding batch)."""
+        while self._row is not None and int(self._scratch[0]) == self.chunk_records:
+            self._chunk += 1
+            if self._chunk >= self.space_shards:
+                self._complete_row()
+                return  # next append re-allocates lazily
+            self._native.pack_row_init(
+                self._out_chunk(), self._scratch, self.pack_config,
+                self.chunk_records,
+            )
+
+    def _complete_row(self) -> None:
+        row = self._row
+        self._row = None
+        from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.FUSED_BATCHES.inc()
+        obs_metrics.FUSED_RECORDS.inc(self._count)
+        self._done.append(
+            PackedRow(
+                row,
+                self._stage(row) if self._stage is not None else None,
+                self._count,
+                self._next_offsets,
+                self._counts,
+                *self._last,
+            )
+        )
+
+    def _note(self, partition: int, count: int, last_off: "int | None",
+              last_ts: int) -> None:
+        if last_off is not None and last_off >= 0:
+            self._next_offsets[partition] = last_off + 1
+            self._last = (partition, last_off, last_ts)
+        else:
+            self._counts[partition] = self._counts.get(partition, 0) + count
+            self._last = (partition, -1, last_ts)
+
+    # -- appends -------------------------------------------------------------
+
+    def append_record_set(
+        self,
+        data,
+        min_off: int,
+        max_off: int,
+        partition: int,
+        verify_crc: bool = False,
+        prescan: "tuple[int, int, int] | None" = None,
+    ) -> "tuple[int, int, int, int]":
+        """Fused decode→pack of a record set's native-decodable prefix:
+        records of ``partition`` with ``min_off <= offset < max_off``
+        append straight into the current row (rows rotate as they fill).
+        Returns ``(accepted, consumed_bytes, covered_end, last_offset)``
+        — the same contract the chained whole-set decode + accept_records
+        pair implements.  Raises the packer's ValueError on records the
+        wire-v4 layout cannot carry; a malformed frame just ends the
+        prefix (the caller's per-frame chain classifies it)."""
+        buf = np.frombuffer(data, dtype=np.uint8)
+        dense = self._dense_of(partition)
+        # A prescan only waives CRC verification when it provably covered
+        # the ENTIRE buffer (consumed == len): the walk below is not
+        # bounded by the prescan, so a partial prescan (possible from a
+        # future caller; the wire layer today only stores full-set scans)
+        # must not let unverified frames past the checksummed prefix
+        # decode — re-verifying the prefix is wasted CPU, never a hole.
+        verify = verify_crc and (prescan is None or prescan[1] != len(buf))
+        pos = 0
+        skip = 0
+        total = 0
+        covered = -1
+        last_off_all = -1
+        while True:
+            self._ensure_row()
+            (appended, pos, cov, last_off, last_ts, full, skip) = (
+                self._native.decode_pack_record_set_native(
+                    buf, self._out_chunk(), self._scratch,
+                    self.pack_config, self.chunk_records, dense,
+                    min_off, max_off, verify, start_pos=pos, skip=skip,
+                )
+            )
+            if appended:
+                total += appended
+                self._count += appended
+                last_off_all = last_off
+                self._note(partition, appended, last_off, last_ts)
+            if cov > covered:
+                covered = cov
+            self._advance_full_chunks()
+            if not full:
+                break
+        return total, pos, covered, last_off_all
+
+    def append_columns(
+        self,
+        partition: int,
+        key_len,
+        value_len,
+        key_null,
+        value_null,
+        ts,
+        key_hash32,
+        key_hash64,
+        n: int,
+        ts_mode: int = 0,
+        offsets=None,
+        reason: "str | None" = None,
+    ) -> int:
+        """Chain-fallback append: ``n`` already-decoded single-partition
+        records enter the row through the same native incremental core,
+        so rows mixing fused and fallback records stay byte-identical to
+        the chained pack.  ``reason`` books the fallback (never silent)."""
+        if n == 0:
+            return 0
+        if reason is not None:
+            from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.FUSED_FALLBACK.labels(reason=reason).inc(n)
+        dense = self._dense_of(partition)
+        start = 0
+        while start < n:
+            self._ensure_row()
+            took = self._native.pack_append_columns_native(
+                self._out_chunk(), self._scratch, self.pack_config,
+                self.chunk_records, dense, key_len, value_len, key_null,
+                value_null, ts, key_hash32, key_hash64, start, n,
+                ts_mode=ts_mode,
+            )
+            if took:
+                self._count += took
+                start += took
+                last_off = (
+                    int(offsets[start - 1]) if offsets is not None else None
+                )
+                last_ts = int(ts[start - 1])
+                if ts_mode == 1:
+                    last_ts //= 1000
+                elif ts_mode == 2:
+                    last_ts = max(last_ts, 0) // 1000
+                self._note(partition, took, last_off, last_ts)
+            self._advance_full_chunks()
+            if not took and int(self._scratch[0]) < self.chunk_records:
+                raise RuntimeError("fused append made no progress")
+        return n
+
+    def append_batch(self, batch: RecordBatch, reason: str) -> int:
+        """RecordBatch form of the fallback append (salvaged frames,
+        python-decoded rows).  Single-partition by the stream contract —
+        every caller hands per-frame / per-partition chunks."""
+        n = len(batch)
+        if n == 0:
+            return 0
+        p = int(batch.partition[0])
+        if n > 1 and not bool((batch.partition == p).all()):
+            raise ValueError(
+                "fused sink chunks must be single-partition"
+            )
+        return self.append_columns(
+            p, batch.key_len, batch.value_len, batch.key_null,
+            batch.value_null, batch.ts_s, batch.key_hash32,
+            batch.key_hash64, n, ts_mode=0, offsets=batch.offsets,
+            reason=reason,
+        )
+
+    # -- draining ------------------------------------------------------------
+
+    def pending_records(self) -> int:
+        """Records staged in the (incomplete) current row."""
+        return self._count if self._row is not None else 0
+
+    def flush(self) -> None:
+        """Complete the partial row (stream end).  Chunks never reached
+        stay as initialized — an initialized chunk IS a packed empty
+        batch, the superbatch identity pad — so a sharded partial row is
+        exactly what ``pack_chunks`` does with a short tail batch."""
+        if self._row is None:
+            return
+        if self._count == 0:
+            self._row = None  # nothing appended: emit nothing (chain parity)
+            return
+        for s in range(self._chunk + 1, self.space_shards):
+            self._native.pack_row_init(
+                self._row[s], self._scratch, self.pack_config,
+                self.chunk_records,
+            )
+        self._complete_row()
+
+    def take_completed(self) -> "list[PackedRow]":
+        done, self._done = self._done, []
+        return done
+
+
 class SuperbatchStager:
     """Reusable host staging for stacked superbatch dispatch.
 

@@ -60,6 +60,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from kafka_topic_analyzer_tpu.io.source import RecordSource
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.packing import PackedRow
 from kafka_topic_analyzer_tpu.records import RecordBatch
 
 _SENTINEL = object()
@@ -165,6 +166,7 @@ class _IngestWorker(threading.Thread):
         stage: "Optional[Callable[[RecordBatch], object]]",
         depth: int,
         cancel: threading.Event,
+        sink=None,
     ):
         super().__init__(daemon=True, name=f"kta-ingest-{wid}")
         self.wid = wid
@@ -175,8 +177,12 @@ class _IngestWorker(threading.Thread):
         # The generator object is created here (cheap — the body only runs
         # on first next()) so close() can reach it even if the thread never
         # gets scheduled; only this thread ever *advances* it.
+        # A fused sink (private to this worker — sinks are single-threaded
+        # state) makes the stream yield pre-packed, pre-staged PackedRow
+        # items; `stage` then never runs for them.
         self._it = source.batches(
-            batch_size, partitions=self.group, start_at=start_at
+            batch_size, partitions=self.group, start_at=start_at,
+            **({"sink": sink} if sink is not None else {}),
         )
         self._source_closed = False
         self._stall = obs_metrics.INGEST_WORKER_STALL_SECONDS.labels(
@@ -214,7 +220,12 @@ class _IngestWorker(threading.Thread):
     def run(self) -> None:
         try:
             for batch in self._it:
-                staged = self._stage(batch) if self._stage is not None else None
+                if isinstance(batch, PackedRow):
+                    staged = batch.staged  # fused: staged by the sink
+                else:
+                    staged = (
+                        self._stage(batch) if self._stage is not None else None
+                    )
                 if not self._put((batch, staged)):
                     return  # cancelled; finally closes the source stream
         except BaseException as e:
@@ -263,6 +274,7 @@ class ParallelIngest:
         depth: int = 2,
         wid_base: int = 0,
         label_prefix: str = "",
+        sink_factory: "Optional[Callable[[], object]]" = None,
     ):
         """``wid_base``/``label_prefix`` exist for multi-pool scans: a
         sharded-mesh controller runs ONE fan-in per data row it feeds
@@ -278,7 +290,8 @@ class ParallelIngest:
         self.workers = [
             _IngestWorker(
                 f"{label_prefix}{wid_base + w}", source, batch_size, g,
-                start_at, stage, depth, self._cancel
+                start_at, stage, depth, self._cancel,
+                sink=sink_factory() if sink_factory is not None else None,
             )
             for w, g in enumerate(groups)
         ]
@@ -367,7 +380,11 @@ def iter_staged(
     stage: "Optional[Callable[[RecordBatch], object]]",
 ) -> "Iterator[Tuple[RecordBatch, object]]":
     """Single-worker staging adapter: the same (batch, staged) item shape
-    ParallelIngest yields, for the N=1 path's prefetch worker."""
-    if stage is None:
-        return ((b, None) for b in it)
-    return ((b, stage(b)) for b in it)
+    ParallelIngest yields, for the N=1 path's prefetch worker.  Fused
+    PackedRow items arrive pre-staged by their sink; `stage` never runs
+    for them."""
+    for b in it:
+        if isinstance(b, PackedRow):
+            yield b, b.staged
+        else:
+            yield b, (stage(b) if stage is not None else None)

@@ -401,6 +401,23 @@ class ShardedTpuBackend(MetricBackend):
         serializing in front of update_shards (engine staging)."""
         return PackedShard(self._pack_chunks(batch))
 
+    def make_fused_sink(self, dense_of):
+        """A packing.FusedPackSink whose rows are this backend's
+        ``[S, chunk_nbytes]`` chunk stacks — records fill chunk 0..S-1 at
+        chunk_size each, the exact ``pack_chunks`` rule, so a fused row
+        is byte-for-byte what ``prepare_shard`` would have staged.  One
+        sink per fed data row's ingest stream (engine.run_scan)."""
+        from kafka_topic_analyzer_tpu.packing import FusedPackSink
+
+        return FusedPackSink(
+            self._chunk_config,
+            self.config.chunk_size,
+            dense_of,
+            stage=PackedShard,
+            space_shards=self.config.space_shards,
+            chunk_rows=True,
+        )
+
     def update_shards(
         self, batches: "List[RecordBatch | PackedShard | None]"
     ) -> None:

@@ -232,6 +232,24 @@ def render_telemetry_stats(
             f"({seg.bytes_mapped / 1e6:,.1f} MB mapped), "
             f"{seg.records:,.0f} records in {seg.batches:,.0f} batches"
         )
+    # Fused ingest digest: rows/records through the one-pass native
+    # decode→pack, and — never silently — everything that bypassed it,
+    # by reason (compressed/legacy frames, salvage, missing shim).
+    from kafka_topic_analyzer_tpu.results import FusedStats
+
+    fused = FusedStats.from_telemetry(snapshot)
+    if fused.rows or fused.fallbacks:
+        line = (
+            f"  fused: {fused.records:,.0f} records in {fused.rows:,} "
+            f"row(s) via native decode→pack"
+        )
+        if fused.fallbacks:
+            per = ", ".join(
+                f"{r} {int(n):,}"
+                for r, n in sorted(fused.fallbacks.items())
+            )
+            line += f" — fallbacks: {per}"
+        lines.append(line)
     # Parallelism context for every throughput number above: worker count
     # always, the per-worker split when the scan actually ran parallel
     # (sequential scans never touch the per-worker instruments).

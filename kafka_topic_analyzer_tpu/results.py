@@ -97,6 +97,40 @@ class IngestStats:
 
 
 @dataclasses.dataclass
+class FusedStats:
+    """Fused decode→pack accounting from a telemetry snapshot: rows and
+    records that took the one-pass native path, plus per-reason records
+    that fell back to the python chain (compressed/legacy frames, salvage,
+    missing native shim...).  The ``--stats`` digest renders it so a
+    bypassed fused path is never silent; empty for chained scans."""
+
+    rows: int
+    records: int
+    #: fallback reason label -> records (or stream-level bypass events).
+    fallbacks: "Dict[str, int]"
+
+    @classmethod
+    def from_telemetry(cls, snapshot: "Optional[dict]") -> "FusedStats":
+        snap = snapshot or {}
+
+        def total(name: str) -> int:
+            metric = snap.get(name)
+            if not metric:
+                return 0
+            return int(sum(s["value"] for s in metric["samples"]))
+
+        fb = snap.get("kta_fused_fallback_total")
+        return cls(
+            rows=total("kta_fused_batches_total"),
+            records=total("kta_fused_records_total"),
+            fallbacks={
+                s["labels"].get("reason", "?"): int(s["value"])
+                for s in (fb["samples"] if fb else [])
+            },
+        )
+
+
+@dataclasses.dataclass
 class SegmentStats:
     """Cold-path accounting extracted from a telemetry snapshot
     (`ScanResult.telemetry`): segment chunks the catalog opened, bytes it

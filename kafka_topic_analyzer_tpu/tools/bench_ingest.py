@@ -154,6 +154,84 @@ def measure_pipeline(record_sets: "list[bytes]", total_records: int,
     return n_out, time.perf_counter() - t0
 
 
+def _bench_pack_config(partitions: int, batch_size: int):
+    """The representative full-featured pack config both decode→pack
+    referees share (alive bitmap + HLL — the default heavy path)."""
+    from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+
+    return AnalyzerConfig(
+        num_partitions=partitions, batch_size=batch_size,
+        count_alive_keys=True, enable_hll=True,
+    )
+
+
+def measure_pipeline_chained(record_sets: "list[bytes]", total_records: int,
+                             batch_size: int, verify_crc: bool,
+                             config) -> "tuple[int, float]":
+    """The CHAINED decode→pack referee: the measure_pipeline hot path plus
+    pack_batch over every re-batched buffer — byte bytes leave the decode
+    as SoA columns, get re-batched, and are read back by the packer."""
+    from kafka_topic_analyzer_tpu.io.kafka_wire import _chunk_to_batch
+    from kafka_topic_analyzer_tpu.io.native import (
+        decode_record_set_native,
+        scan_record_set_native,
+    )
+    from kafka_topic_analyzer_tpu.packing import pack_batch
+    from kafka_topic_analyzer_tpu.records import RecordBatch
+
+    total = total_records
+    pend: "list[RecordBatch]" = []
+    pend_count = 0
+    n_out = 0
+    t0 = time.perf_counter()
+    for rs in record_sets:
+        prescan = scan_record_set_native(rs, verify_crc)
+        soa, used, covered = decode_record_set_native(
+            rs, verify_crc, prescan=prescan
+        )
+        offs = soa["offsets"]
+        hi = int(np.searchsorted(offs, total, "left"))
+        pend.append(_chunk_to_batch(soa, slice(0, hi), 0))
+        pend_count += hi
+        if pend_count >= batch_size:
+            out, pend, pend_count = RecordBatch.resplit(
+                pend, batch_size, force=False
+            )
+            for b in out:
+                pack_batch(b, config)
+                n_out += len(b)
+    if pend:
+        out, pend, pend_count = RecordBatch.resplit(pend, batch_size, True)
+        for b in out:
+            pack_batch(b, config)  # partial tail packs with n_valid < B
+            n_out += len(b)
+    return n_out, time.perf_counter() - t0
+
+
+def measure_pipeline_fused(record_sets: "list[bytes]", total_records: int,
+                           batch_size: int, verify_crc: bool,
+                           config) -> "tuple[int, float]":
+    """The FUSED referee: the same record sets through
+    FusedPackSink.append_record_set — one native pass from set bytes to
+    wire-v4 rows, no SoA columns, no re-batching copy."""
+    from kafka_topic_analyzer_tpu.io.native import scan_record_set_native
+    from kafka_topic_analyzer_tpu.packing import FusedPackSink
+
+    sink = FusedPackSink(config, batch_size, dense_of=lambda p: p)
+    n_out = 0
+    t0 = time.perf_counter()
+    for rs in record_sets:
+        prescan = scan_record_set_native(rs, verify_crc)
+        n, _, _, _ = sink.append_record_set(
+            rs, 0, total_records, 0, verify_crc, prescan=prescan
+        )
+        n_out += n
+        sink.take_completed()
+    sink.flush()
+    sink.take_completed()
+    return n_out, time.perf_counter() - t0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--records", type=int, default=20_000_000)
@@ -164,6 +242,12 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--vmin", type=int, default=100)
     ap.add_argument("--vmax", type=int, default=420)
     ap.add_argument("--check-crcs", action="store_true")
+    ap.add_argument("--fused", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="also referee the fused decode→pack pass against "
+                         "the chained decode→SoA→pack path (single-thread "
+                         "always; per-thread when --pipeline-threads is "
+                         "set).  --no-fused skips both packed referees")
     ap.add_argument("--repeat", type=int, default=3,
                     help="pipeline passes; the best is the headline "
                          "(capacity is a max — interference on a shared box "
@@ -207,6 +291,19 @@ def main(argv: "list[str] | None" = None) -> int:
 
     doc: "dict[str, object]" = {"metric": "ingest", "nproc": os.cpu_count()}
 
+    # The socket-free pipeline (and its fused/chained referee) measure the
+    # NATIVE decode path; without the shim there is nothing to referee —
+    # note it and keep the drain/worker sections (python-chain) running.
+    from kafka_topic_analyzer_tpu.io.native import native_status
+
+    native_ok, native_why = native_status()
+    if not native_ok:
+        doc["pipeline_skipped"] = f"native-{native_why}"
+        print(
+            f"bench_ingest: native shim unavailable ({native_why}); "
+            "skipping the pipeline/referee sections", file=sys.stderr,
+        )
+
     # --- 3: socket-free pipeline capacity --------------------------------
     templates = build_templates(
         args.records_per_batch, args.templates, args.vmin, args.vmax
@@ -216,7 +313,7 @@ def main(argv: "list[str] | None" = None) -> int:
         templates, windows, args.records_per_batch
     )
     rates = []
-    for _ in range(max(args.repeat, 1)):
+    for _ in range(max(args.repeat, 1) if native_ok else 0):
         n, dt = measure_pipeline(
             record_sets, windows * args.records_per_batch, args.batch_size,
             args.check_crcs,
@@ -226,16 +323,47 @@ def main(argv: "list[str] | None" = None) -> int:
     # only subtracts), but the median and full run list ship alongside so a
     # lucky draw over a wide spread cannot read as the typical rate
     # (VERDICT r3 weak #5).
-    doc["pipeline_msgs_per_sec"] = round(max(rates))
-    doc["pipeline_msgs_per_sec_median"] = round(
-        float(np.median(np.asarray(rates)))
-    )
-    doc["pipeline_runs"] = [round(r) for r in rates]
-    print(
-        f"bench_ingest: pipeline {n} records, best of {len(rates)}: "
-        f"{max(rates):,.0f}/s, median {doc['pipeline_msgs_per_sec_median']:,}/s "
-        "(socket-free)", file=sys.stderr,
-    )
+    if rates:
+        doc["pipeline_msgs_per_sec"] = round(max(rates))
+        doc["pipeline_msgs_per_sec_median"] = round(
+            float(np.median(np.asarray(rates)))
+        )
+        doc["pipeline_runs"] = [round(r) for r in rates]
+        print(
+            f"bench_ingest: pipeline {n} records, best of {len(rates)}: "
+            f"{max(rates):,.0f}/s, median {doc['pipeline_msgs_per_sec_median']:,}/s "
+            "(socket-free)", file=sys.stderr,
+        )
+
+    # --- 3a: fused vs chained decode→pack referee ------------------------
+    # The ISSUE-8 headline: one native pass from record-set bytes to
+    # wire-v4 rows vs decode→SoA columns→re-batch→pack.  Same buffers,
+    # same acceptance window, same pack config (alive bitmap + HLL).
+    if args.fused and native_ok:
+        pcfg = _bench_pack_config(args.partitions, args.batch_size)
+        chained_rates, fused_rates = [], []
+        for _ in range(max(args.repeat, 1)):
+            n, dt = measure_pipeline_chained(
+                record_sets, windows * args.records_per_batch,
+                args.batch_size, args.check_crcs, pcfg,
+            )
+            chained_rates.append(n / dt)
+            n2, dt2 = measure_pipeline_fused(
+                record_sets, windows * args.records_per_batch,
+                args.batch_size, args.check_crcs, pcfg,
+            )
+            assert n2 == n, (n2, n)
+            fused_rates.append(n2 / dt2)
+        doc["pipeline_chained_pack_msgs_per_sec"] = round(max(chained_rates))
+        doc["pipeline_fused_pack_msgs_per_sec"] = round(max(fused_rates))
+        doc["fused_speedup"] = round(max(fused_rates) / max(chained_rates), 3)
+        doc["pipeline_chained_pack_runs"] = [round(r) for r in chained_rates]
+        doc["pipeline_fused_pack_runs"] = [round(r) for r in fused_rates]
+        print(
+            f"bench_ingest: decode+pack chained best {max(chained_rates):,.0f}/s, "
+            f"fused best {max(fused_rates):,.0f}/s "
+            f"({doc['fused_speedup']}x)", file=sys.stderr,
+        )
 
     # --- 3b: socket-free pipeline, N concurrent threads ------------------
     # Referee for the parallel-ingest design claim (BENCH_NOTES r5/r6):
@@ -243,7 +371,7 @@ def main(argv: "list[str] | None" = None) -> int:
     # because the native path releases the GIL.  Measured WITHOUT sockets,
     # so loopback-TCP kernel time (which inflates the --workers scan's sys
     # CPU on a shared box) cannot blur the picture.
-    if args.pipeline_threads:
+    if args.pipeline_threads and native_ok:
         import threading as _threading
         import time as _time
 
@@ -297,6 +425,72 @@ def main(argv: "list[str] | None" = None) -> int:
             f"wall={wall:.2f}s cpu={cpu:.2f}s ({got / wall:,.0f}/s)",
             file=sys.stderr,
         )
+
+        # Fused twin of the referee: does removing the SoA share (the
+        # GIL-held numpy slice/concat in _chunk_to_batch + resplit) close
+        # the 4+ thread droop?  Private buffers AND private sinks per
+        # thread.
+        if args.fused:
+            pcfg = _bench_pack_config(args.partitions, args.batch_size)
+            for fn, key in (
+                (measure_pipeline_chained, "chained"),
+                (measure_pipeline_fused, "fused"),
+            ):
+                sets = [record_sets] + [
+                    _patched_record_sets(
+                        templates, windows, args.records_per_batch
+                    )
+                    for _ in range(n_thr - 1)
+                ]
+                out = [None] * n_thr
+                barrier = _threading.Barrier(n_thr + 1)
+
+                def _thr_packed(i: int) -> None:
+                    barrier.wait(timeout=120)
+                    try:
+                        out[i] = fn(
+                            sets[i], total, args.batch_size,
+                            args.check_crcs, pcfg,
+                        )
+                    except BaseException as e:
+                        out[i] = e
+
+                threads = [
+                    _threading.Thread(
+                        target=_thr_packed, args=(i,), daemon=True
+                    )
+                    for i in range(n_thr)
+                ]
+                for t in threads:
+                    t.start()
+                barrier.wait(timeout=120)
+                c0 = os.times()
+                t0 = _time.perf_counter()
+                for t in threads:
+                    t.join()
+                wall = _time.perf_counter() - t0
+                c1 = os.times()
+                del sets
+                failed = [
+                    o for o in out
+                    if isinstance(o, BaseException) or o is None
+                ]
+                if failed:
+                    raise RuntimeError(
+                        f"{len(failed)} {key} pack thread(s) failed: "
+                        f"{failed[0]!r}"
+                    )
+                got = sum(o[0] for o in out)
+                cpu = (c1.user - c0.user) + (c1.system - c0.system)
+                doc[f"pipeline_mt_{key}_pack_msgs_per_sec"] = round(got / wall)
+                doc[f"pipeline_mt_{key}_pack_cpu_msgs_per_sec"] = (
+                    round(got / cpu) if cpu else None
+                )
+                print(
+                    f"bench_ingest: decode+pack {key} x{n_thr} threads "
+                    f"{got} records wall={wall:.2f}s cpu={cpu:.2f}s "
+                    f"({got / wall:,.0f}/s)", file=sys.stderr,
+                )
 
     # --- 1+2: loopback TCP drain + client-CPU rate -----------------------
     del record_sets, templates  # ~6 GB at default size; the drain phase

@@ -68,6 +68,13 @@ DECODE_SURFACE = re.compile(
     r"|_resync|_plausible|scan_record|_read_uvarint|_output_size"
     r"|_output_bound|_snappy_raw|_lz4_block|_decode_legacy"
     r"|SegmentFile|SegmentCatalog|SegmentStore"
+    # The fused decode→pack entry points consume the same untrusted wire
+    # bytes (io/native.py bindings; decode_pack* is caught by "decode").
+    # _raise_pack_range is NOT decode surface: it mirrors the packer's
+    # caller-config ValueError (packing.pack_batch), not a wire
+    # classification — the wire taxonomy for fused streams still comes
+    # from the per-frame chain the walk falls back to.
+    r"|pack_append_columns|pack_row_init|append_record_set"
 )
 ENCODE_SIDE = re.compile(
     r"encode|compress_xerial|compress_frame|_compress\b"
@@ -409,4 +416,106 @@ if failures:
         print(f"  {f}")
     sys.exit(1)
 print("lint: OK (sharded collectives sit on lockstep-reachable paths)")
+EOF
+
+# Sixth rule: the fused decode→pack path is an OPTIMIZATION, never a
+# dependency.  (a) Every fused call site (sink.append_*, sink draining,
+# make_sink invocation) must sit under a guard that can turn it off —
+# tier-1 passes with the native build disabled via KTA_DISABLE_NATIVE, so
+# each site needs a reachable python-chain fallback branch.  (b) The
+# kill-switch env knobs must exist where the gates read them.
+# packing.py (the sink implementation itself) is exempt: it is only
+# reachable through gated call sites, by this very rule.
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PKG = pathlib.Path("kafka_topic_analyzer_tpu")
+FILES = [
+    PKG / "engine.py",
+    PKG / "io" / "kafka_wire.py",
+    PKG / "io" / "segfile.py",
+    PKG / "parallel" / "ingest.py",
+]
+#: Calls that enter the fused path.
+FUSED_ATTRS = {
+    "append_record_set", "append_columns", "append_batch",
+    "take_completed",
+}
+FUSED_NAMES = {"make_sink"}
+#: Names whose truthiness gates the fused path off.
+GUARDS = {
+    "sink", "fused", "sink_factory", "use_native_decode",
+    "native_available", "fused_ingest_enabled", "supports_fused_sink",
+}
+
+failures = []
+for path in FILES:
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def names_in(expr):
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        label = None
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in FUSED_ATTRS
+        ):
+            # Only sink-ish receivers; batch.take()/writer.append() etc.
+            # share method names but different receivers.
+            root = node.func.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if not (isinstance(root, ast.Name) and "sink" in root.id.lower()):
+                continue
+            label = node.func.attr
+        elif isinstance(node.func, ast.Name) and node.func.id in FUSED_NAMES:
+            label = node.func.id
+        if label is None:
+            continue
+        guarded = False
+        cur = node
+        while cur in parents and not guarded:
+            parent = parents[cur]
+            test = None
+            if isinstance(parent, (ast.If, ast.While)) and cur is not parent.test:
+                test = parent.test
+            elif isinstance(parent, ast.IfExp) and cur is not parent.test:
+                test = parent.test
+            if test is not None and names_in(test) & GUARDS:
+                guarded = True
+            cur = parent
+        if not guarded:
+            failures.append(
+                f"{path}:{node.lineno}: fused call {label!r} has no "
+                "reachable python-chain fallback guard (sink/fused gate)"
+            )
+
+# (b) kill-switch knobs live where the gates read them.
+if "KTA_DISABLE_NATIVE" not in (PKG / "io" / "native.py").read_text():
+    failures.append(
+        "io/native.py: KTA_DISABLE_NATIVE env knob missing (tier-1 must "
+        "be runnable with the native build disabled)"
+    )
+if "KTA_DISABLE_FUSED" not in (PKG / "packing.py").read_text():
+    failures.append(
+        "packing.py: KTA_DISABLE_FUSED env knob missing from "
+        "fused_ingest_enabled"
+    )
+
+if failures:
+    print("lint: fused decode→pack call sites must be gated so the")
+    print("lint: python chain stays reachable (no hard native dependency):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (fused call sites keep a reachable python-chain fallback)")
 EOF
