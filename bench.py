@@ -188,6 +188,11 @@ def main() -> int:
     ap.add_argument("--vmax", type=int, default=420)
     ap.add_argument("--pallas", action="store_true",
                     help="use the Pallas MXU counter kernel")
+    ap.add_argument("--wire-format", choices=["v4", "v5"], default="v5",
+                    help="Packed wire format referee: v5 combiner rows "
+                         "(host pre-reduced fold tables, default) vs v4 "
+                         "per-record columns — byte-identical results, "
+                         "different device fold cost (BENCH round 11)")
     ap.add_argument("--superbatch", default="1", metavar="K|auto",
                     help="stack K packed batches per jitted scan dispatch "
                          "(state donated once per superbatch; 'auto' "
@@ -266,6 +271,7 @@ def main() -> int:
         enable_hll="hll" in feats,
         enable_quantiles="quantiles" in feats,
         use_pallas_counters=args.pallas,
+        wire_format={"v4": 4, "v5": 5}[args.wire_format],
     )
     spec = SyntheticSpec(
         num_partitions=args.partitions,

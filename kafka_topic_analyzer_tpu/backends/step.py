@@ -66,6 +66,145 @@ def superbatch_fold(
     return lax.scan(body, state, bufs)
 
 
+def _apply_alive(
+    alive_state,
+    arrays: Dict[str, "jnp.ndarray"],
+    config: AnalyzerConfig,
+    space_index,
+    space_axis: "str | None",
+):
+    """Alive-bitmap pair application shared by both wire formats (the
+    pairs are already host-pre-reduced in v4 AND v5, so the step is
+    identical).  Returns the new AliveBitmapState."""
+    if space_axis is not None and config.space_shards > 1:
+        from kafka_topic_analyzer_tpu.jax_support import lax
+
+        # Route over ICI: gather every space shard's pair chunk, then
+        # apply them in source order (chunk s holds records
+        # [s*C, (s+1)*C) of the data row's batch, and all_gather
+        # stacks by axis index, so gathered order == record order).
+        #
+        # Documented trade-off (ADVICE r2): the unrolled loop applies
+        # all S chunks on EVERY space shard, so per-step bitmap work
+        # (and trace size) is replicated S-fold instead of scaling
+        # down with the space axis.  Acceptable at the small S this
+        # targets (2-4 on one slice); if large space meshes become a
+        # target, switch to a fori_loop over a stacked pair array or
+        # pre-route pairs by slot range so each shard applies only
+        # its own slots.
+        slots = lax.all_gather(arrays["alive_slot"], space_axis)
+        flags = lax.all_gather(arrays["alive_flag"], space_axis)
+        counts = lax.all_gather(arrays["n_pairs"], space_axis)
+        words = alive_state.words
+        for s in range(config.space_shards):
+            words = bitmap_apply_pairs(
+                words,
+                slots[s],
+                flags[s],
+                counts[s],
+                bits=config.alive_bitmap_bits,
+                space_index=space_index,
+                space_shards=config.space_shards,
+            )
+    else:
+        words = bitmap_apply_pairs(
+            alive_state.words,
+            arrays["alive_slot"],
+            arrays["alive_flag"],
+            arrays["n_pairs"],
+            bits=config.alive_bitmap_bits,
+            space_index=space_index,
+            space_shards=config.space_shards,
+        )
+    return AliveBitmapState(words=words)
+
+
+def _analyzer_step_v5(
+    state: AnalyzerState,
+    arrays: Dict[str, "jnp.ndarray"],
+    config: AnalyzerConfig,
+    space_index=0,
+    space_axis: "str | None" = None,
+) -> AnalyzerState:
+    """Wire-v5 fold: the batch arrives as per-partition partial-fold
+    TABLES (packing.py module docstring), so every reduction here is an
+    elementwise table merge — integer adds for counters and DDSketch
+    buckets, min/max for extremes, max for HLL registers — O(P·H) work
+    per dispatch where the v4 step scattered O(B) records.  Associativity
+    and commutativity of those integer merges (DESIGN.md §2/§16) is what
+    makes the result byte-identical to the v4 fold; the superbatch scan
+    and sharded chunk paths carry over untouched for the same reason."""
+    m = state.metrics
+    delta = arrays["counts"]  # int64[P, 7], COUNTER_CHANNELS order
+    if config.use_pallas_counters:
+        from kafka_topic_analyzer_tpu.ops.pallas_counters import (
+            pallas_counters_merge,
+        )
+
+        per_partition = pallas_counters_merge(m.per_partition, delta)
+    else:
+        per_partition = m.per_partition + delta
+    earliest, latest, smallest, largest = extremes_update(
+        m.earliest_s,
+        m.latest_s,
+        m.smallest,
+        m.largest,
+        arrays["ts_min"],
+        arrays["ts_max"],
+        arrays["sz_min"],
+        arrays["sz_max"],
+    )
+    metrics = MessageMetricsState(
+        per_partition=per_partition,
+        earliest_s=earliest,
+        latest_s=latest,
+        smallest=smallest,
+        largest=largest,
+        # Global sums are the column sums of the delta table: channels 5/6
+        # are the key/value byte sums, channel 0 the record count.
+        overall_size=m.overall_size + jnp.sum(delta[:, 5] + delta[:, 6]),
+        overall_count=m.overall_count + jnp.sum(delta[:, 0]),
+    )
+
+    alive_state = state.alive
+    if alive_state is not None:
+        alive_state = _apply_alive(
+            alive_state, arrays, config, space_index, space_axis
+        )
+
+    hll_state = state.hll
+    if hll_state is not None:
+        if "hll_regs" in arrays:
+            regs = jnp.maximum(
+                hll_state.regs,
+                arrays["hll_regs"].astype(jnp.int32).reshape(
+                    -1, hll_state.regs.shape[1]
+                ),
+            )
+        elif "hll_idx32" in arrays:
+            # v5 flat pairs: the index already encodes (row << p | bucket),
+            # so the scatter-max lands on the flattened register file.
+            from kafka_topic_analyzer_tpu.ops.hll import hll_apply_flat
+
+            regs = hll_apply_flat(
+                hll_state.regs, arrays["hll_idx32"], arrays["hll_rho"]
+            )
+        else:
+            regs = hll_apply(
+                hll_state.regs, arrays["hll_idx"], arrays["hll_rho"],
+                partition=None,
+            )
+        hll_state = HLLState(regs=regs)
+
+    q_state = state.quantiles
+    if q_state is not None:
+        q_state = DDSketchState(counts=q_state.counts + arrays["qcounts"])
+
+    return AnalyzerState(
+        metrics=metrics, alive=alive_state, hll=hll_state, quantiles=q_state
+    )
+
+
 def analyzer_step(
     state: AnalyzerState,
     arrays: Dict[str, "jnp.ndarray"],
@@ -83,7 +222,15 @@ def analyzer_step(
     exact last-writer-wins semantics even when one key's updates straddle
     chunk boundaries (host dedupe is per chunk, so cross-chunk duplicates
     are resolved here by application order).  All other reductions stay
-    chunk-local; the space axis is reduced once at finalize."""
+    chunk-local; the space axis is reduced once at finalize.
+
+    Wire-v5 buffers (the per-partition combiner tables — ``counts``
+    present in ``arrays``) take the table-merge fold instead; the
+    per-record path below is the v4 layout's."""
+    if "counts" in arrays:
+        return _analyzer_step_v5(
+            state, arrays, config, space_index, space_axis
+        )
     valid = arrays["valid"]
     key_null = arrays["key_null"]
     value_null = arrays["value_null"]
@@ -133,47 +280,9 @@ def analyzer_step(
 
     alive_state = state.alive
     if alive_state is not None:
-        if space_axis is not None and config.space_shards > 1:
-            from kafka_topic_analyzer_tpu.jax_support import lax
-
-            # Route over ICI: gather every space shard's pair chunk, then
-            # apply them in source order (chunk s holds records
-            # [s*C, (s+1)*C) of the data row's batch, and all_gather
-            # stacks by axis index, so gathered order == record order).
-            #
-            # Documented trade-off (ADVICE r2): the unrolled loop applies
-            # all S chunks on EVERY space shard, so per-step bitmap work
-            # (and trace size) is replicated S-fold instead of scaling
-            # down with the space axis.  Acceptable at the small S this
-            # targets (2-4 on one slice); if large space meshes become a
-            # target, switch to a fori_loop over a stacked pair array or
-            # pre-route pairs by slot range so each shard applies only
-            # its own slots.
-            slots = lax.all_gather(arrays["alive_slot"], space_axis)
-            flags = lax.all_gather(arrays["alive_flag"], space_axis)
-            counts = lax.all_gather(arrays["n_pairs"], space_axis)
-            words = alive_state.words
-            for s in range(config.space_shards):
-                words = bitmap_apply_pairs(
-                    words,
-                    slots[s],
-                    flags[s],
-                    counts[s],
-                    bits=config.alive_bitmap_bits,
-                    space_index=space_index,
-                    space_shards=config.space_shards,
-                )
-        else:
-            words = bitmap_apply_pairs(
-                alive_state.words,
-                arrays["alive_slot"],
-                arrays["alive_flag"],
-                arrays["n_pairs"],
-                bits=config.alive_bitmap_bits,
-                space_index=space_index,
-                space_shards=config.space_shards,
-            )
-        alive_state = AliveBitmapState(words=words)
+        alive_state = _apply_alive(
+            alive_state, arrays, config, space_index, space_axis
+        )
 
     hll_state = state.hll
     if hll_state is not None:

@@ -37,6 +37,7 @@ from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
 from kafka_topic_analyzer_tpu.backends.step import analyzer_step, superbatch_fold
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig, DispatchConfig
 from kafka_topic_analyzer_tpu.models.state import AnalyzerState
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.packing import (
     SuperbatchStager,
     pack_batch,
@@ -100,32 +101,38 @@ class StagedBatch:
 def self_check_unpack(device=None) -> None:
     """One-time guard: pack a known batch on the host, unpack it on the
     device, and compare — catches any bitcast/byte-order mismatch before it
-    could corrupt results."""
+    could corrupt results.  Runs BOTH wire formats: v4's per-record
+    columns and v5's combiner tables (including the quantile section)
+    cross the same bitcast boundary."""
     from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
 
-    config = AnalyzerConfig(
-        num_partitions=3,
-        batch_size=128,
-        count_alive_keys=True,
-        alive_bitmap_bits=16,
-        enable_hll=True,
-        hll_p=8,
-    )
     spec = SyntheticSpec(
         num_partitions=3, messages_per_partition=40, keys_per_partition=16, seed=11
     )
     batch = next(SyntheticSource(spec).batches(100))
-    buf = pack_batch(batch, config, use_native=False)
-    expected = unpack_numpy(buf, config)
-    unpack = jax.jit(lambda b: unpack_device(b, config))
-    got = unpack(jax.device_put(buf, device))
-    for name, exp in expected.items():
-        g = np.asarray(got[name])
-        if not np.array_equal(g, np.asarray(exp)):
-            raise RuntimeError(
-                f"packed-transfer self-check failed on field {name!r}: "
-                f"device unpack disagrees with host layout (byte order?)"
-            )
+    for wire_format in (4, 5):
+        config = AnalyzerConfig(
+            num_partitions=3,
+            batch_size=128,
+            count_alive_keys=True,
+            alive_bitmap_bits=16,
+            enable_hll=True,
+            hll_p=8,
+            enable_quantiles=True,
+            wire_format=wire_format,
+        )
+        buf = pack_batch(batch, config, use_native=False)
+        expected = unpack_numpy(buf, config)
+        unpack = jax.jit(lambda b, c=config: unpack_device(b, c))
+        got = unpack(jax.device_put(buf, device))
+        for name, exp in expected.items():
+            g = np.asarray(got[name])
+            if not np.array_equal(g, np.asarray(exp)):
+                raise RuntimeError(
+                    f"packed-transfer self-check failed on wire-v"
+                    f"{wire_format} field {name!r}: device unpack disagrees "
+                    f"with host layout (byte order?)"
+                )
 
 
 _checked_devices: "set[str]" = set()
@@ -202,9 +209,11 @@ class TpuBackend(MetricBackend):
 
     def update(self, batch: "RecordBatch | StagedBatch") -> None:
         if isinstance(batch, StagedBatch):
+            obs_metrics.WIRE_BYTES.inc(int(batch.buf.nbytes))
             self.state = self._step(self.state, batch.buf)
             return
         buf = pack_batch(batch, self.config, use_native=self.use_native)
+        obs_metrics.WIRE_BYTES.inc(int(buf.nbytes))
         self.state = self._step(self.state, jax.device_put(buf, self.device))
 
     def _empty_packed(self) -> np.ndarray:
@@ -238,6 +247,7 @@ class TpuBackend(MetricBackend):
                 )
         for i in range(len(staged), k):
             np.copyto(rows[i], self._empty_packed())
+        obs_metrics.WIRE_BYTES.inc(int(rows.nbytes))
         bufs = jax.device_put(rows, self.device)
         self.state, token = self._superstep(self.state, bufs)
         self._queue.launched(token, len(staged))

@@ -37,8 +37,11 @@ SNAPSHOT_NAME = "scan_snapshot.npz"
 
 #: Config fields that change neither state shapes nor fold semantics —
 #: pure execution strategy, safe to flip across a resume (the pallas and
-#: lax counter paths are bit-identical, tests/test_pallas_counters.py).
-_EXECUTION_ONLY_FIELDS = ("use_pallas_counters",)
+#: lax counter paths are bit-identical, tests/test_pallas_counters.py;
+#: wire v4 and v5 fold to byte-identical state, tests/test_wire_v5.py —
+#: a v4 snapshot resumes under v5 and vice versa).  Excluding wire_format
+#: also keeps pre-v5 snapshots' fingerprints valid unchanged.
+_EXECUTION_ONLY_FIELDS = ("use_pallas_counters", "wire_format")
 
 
 def _fingerprint_at(
@@ -52,6 +55,15 @@ def _fingerprint_at(
         # state, which every mesh can adopt — so the mesh shape is pure
         # execution strategy for them and must not pin the fingerprint.
         fields.pop("mesh_shape", None)
+    if config.enable_quantiles:
+        # PR 9 changed the DDSketch bucket rule (float32 log → the shared
+        # integer edge table, ops/ddsketch.ddsketch_edges): borderline
+        # sizes can land one bucket over vs the old rule, so a pre-change
+        # quantile snapshot's accumulated buckets must NOT merge with
+        # new-rule buckets — stamp the rule so those snapshots are
+        # cleanly rejected instead.  Quantile-free configs keep their
+        # pre-change fingerprints (no bucket state to skew).
+        fields["ddsketch_bucket_rule"] = "edges-v1"
     payload = json.dumps(
         {"topic": topic, "state_version": version, **fields},
         sort_keys=True,

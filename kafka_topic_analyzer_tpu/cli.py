@@ -190,6 +190,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "given coordinator address, process id and process "
                         "count before building the mesh (collectives then "
                         "span hosts over DCN)")
+    p.add_argument("--wire-format", choices=["auto", "v4", "v5"],
+                   default="auto", metavar="auto|v4|v5",
+                   help="Packed host→device wire format: v5 (combiner rows "
+                        "— host pre-reduced per-partition fold tables, the "
+                        "default) or v4 (per-record columns). 'auto' "
+                        "resolves to v5 unless KTA_WIRE_V4 is set. Results "
+                        "are byte-identical either way; snapshots resume "
+                        "across formats")
     p.add_argument("--native", choices=["auto", "on", "off"], default="auto",
                    help="Use the native C++ ingest shim when available")
     p.add_argument("--profile-dir", metavar="DIR",
@@ -412,6 +420,22 @@ def resolve_dispatch(args):
     return cfg
 
 
+def resolve_wire_format(args) -> int:
+    """--wire-format → AnalyzerConfig.wire_format (shared by the single-
+    and multi-topic paths): 'auto' = 0 (config resolves to v5 unless the
+    KTA_WIRE_V4 kill switch is set), 'v4'/'v5' pin the format.  Results
+    are byte-identical either way (DESIGN.md §16) and the format is
+    outside the checkpoint fingerprint, so snapshots resume across it."""
+    return {"auto": 0, "v4": 4, "v5": 5}[getattr(args, "wire_format", "auto")]
+
+
+def _attach_wire_digest(doc: dict, result) -> None:
+    """--json wire block: format + byte split of the packed transfer
+    (results.WireStats) — absent for backends without one (cpu oracle)."""
+    if getattr(result, "wire", None) is not None:
+        doc["wire"] = result.wire.as_dict()
+
+
 def _attach_segment_digest(doc: dict, result) -> None:
     """--json cold-path digest: when the scan read from a segment store,
     surface what the catalog opened and how much came off the mapped
@@ -443,6 +467,7 @@ def _print_stats(args, result) -> None:
             ),
             superbatch_k=result.superbatch_k,
             dispatch_depth=result.dispatch_depth,
+            wire=result.wire,
         )
     )
 
@@ -554,6 +579,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             quantiles_per_partition=args.quantiles_per_partition,
             mesh_shape=mesh_shape,
             use_pallas_counters=args.pallas,
+            wire_format=resolve_wire_format(args),
         )
         ingest_workers = resolve_ingest_workers(
             args, mesh_shape, len(multi.partitions())
@@ -626,6 +652,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         doc["union"] = union_doc
         doc["telemetry"] = result.telemetry
         _attach_segment_digest(doc, result)
+        _attach_wire_digest(doc, result)
         # Degraded keys are dense fan-in rows; reasons carry topic/partition.
         rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))
@@ -740,6 +767,7 @@ def _run(args) -> int:
             quantiles_per_partition=args.quantiles_per_partition,
             mesh_shape=mesh_shape,
             use_pallas_counters=args.pallas,
+            wire_format=resolve_wire_format(args),
         )
         ingest_workers = resolve_ingest_workers(
             args, mesh_shape, len(source.partitions())
@@ -792,6 +820,7 @@ def _run(args) -> int:
         doc["dispatch_depth"] = result.dispatch_depth
         doc["telemetry"] = result.telemetry
         _attach_segment_digest(doc, result)
+        _attach_wire_digest(doc, result)
         rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))
         return rc

@@ -314,8 +314,23 @@ class AnalyzerConfig:
     #: Requires batch_size a multiple of 1024 (validated in __post_init__)
     #: and value lengths < 16 MiB (pack time); partitions beyond 128 tile
     #: the kernel grid.  Off by default until benchmarked faster on the
-    #: target hardware.
+    #: target hardware.  (Under wire v5 the counter fold arrives as a
+    #: pre-reduced table and this flag routes the merge through
+    #: ops/pallas_counters.pallas_counters_merge instead.)
     use_pallas_counters: bool = False
+
+    #: Packed host→device wire format (packing.py): ``0`` = auto (resolved
+    #: at construction — v5 unless the ``KTA_WIRE_V4`` kill switch is set),
+    #: ``4`` = per-record columns + host-pre-reduced extreme/alive/HLL
+    #: sections, ``5`` = the combiner format: the remaining per-record
+    #: columns are replaced by per-partition partial-fold tables (counter
+    #: deltas, DDSketch bucket counts), so the device merges O(P·H) table
+    #: rows instead of scattering O(B) records.  Results are byte-identical
+    #: across formats (every fold is an associative integer reduction —
+    #: DESIGN.md §2/§16), so this is pure execution strategy: it is
+    #: excluded from the checkpoint fingerprint and snapshots resume across
+    #: formats (checkpoint.py).
+    wire_format: int = 0
 
     # --- host→device transfer ----------------------------------------------
     #: Pre-reduce bitmap updates on the host: last-writer-wins dedupe of
@@ -352,7 +367,35 @@ class AnalyzerConfig:
             raise ValueError("hll_p must be in [4, 16]")
         if self.quantile_buckets < 8:
             raise ValueError("quantile_buckets must be >= 8")
-        if self.use_pallas_counters and self.batch_size % 1024:
+        if self.wire_format == 0:
+            import os
+
+            # Resolved (and the reason recorded) ONCE, here: the booking
+            # property below must describe how this config actually chose
+            # v4, not whatever the env says when the engine reads it.
+            forced = bool(os.environ.get("KTA_WIRE_V4"))
+            object.__setattr__(self, "wire_format", 4 if forced else 5)
+            object.__setattr__(
+                self, "_wire_v4_reason", "env-kill-switch" if forced else None
+            )
+        elif self.wire_format in (4, 5):
+            object.__setattr__(
+                self,
+                "_wire_v4_reason",
+                "explicit" if self.wire_format == 4 else None,
+            )
+        else:
+            raise ValueError(
+                f"wire_format {self.wire_format!r} invalid (0=auto, 4, or 5)"
+            )
+        if (
+            self.use_pallas_counters
+            and self.wire_format == 4
+            and self.batch_size % 1024
+        ):
+            # A constraint of the v4 MXU one-hot-matmul kernel's 1024-record
+            # blocks only: under wire v5 the counter fold arrives as a
+            # pre-reduced table and pallas_counters_merge pads any shape.
             raise ValueError(
                 "use_pallas_counters requires batch_size % 1024 == 0"
             )
@@ -360,6 +403,19 @@ class AnalyzerConfig:
     @property
     def hll_m(self) -> int:
         return 1 << self.hll_p
+
+    @property
+    def wire_v4_reason(self) -> "str | None":
+        """Why this config runs wire v4 (None when it runs v5):
+        ``env-kill-switch`` (KTA_WIRE_V4 forced the fallback at
+        construction) or ``explicit`` (the caller pinned v4).  Recorded
+        AT RESOLUTION TIME in ``__post_init__`` — not re-read from the
+        env — so the engine's ``kta_wire_v4_fallback_total`` booking
+        describes the decision actually taken (a bypassed combiner format
+        is never silent, same discipline as ``kta_fused_fallback_total``;
+        a ``dataclasses.replace`` of an env-forced config re-labels as
+        ``explicit``, which is what the copy's pinned field now is)."""
+        return self._wire_v4_reason
 
     @property
     def quantile_gamma(self) -> float:

@@ -107,6 +107,10 @@ class ScanResult:
     superbatch_k: int = 1
     #: Bound on in-flight superbatch dispatches (``--dispatch-depth``).
     dispatch_depth: int = 1
+    #: Packed wire-format accounting (results.WireStats): format (v4/v5),
+    #: per-record vs fold-table byte split, and the scan's actual wire
+    #: bytes — None for backends without a packed transfer (cpu oracle).
+    wire: "Optional[object]" = None
 
 
 class _ProgressTracker:
@@ -426,6 +430,36 @@ def run_scan(
     def make_sink():
         """A fresh per-stream sink (sinks are single-threaded state)."""
         return _make_sink(_dense_map.__getitem__)
+
+    # Wire-format accounting + the v4 fallback booking (a bypassed v5
+    # combiner is never silent — same discipline as the fused gate above).
+    # Only packed backends have a wire; the cpu oracle folds decoded
+    # batches directly.
+    wire_stats = None
+    wire_bytes0 = 0.0
+    if _make_sink is not None or hasattr(backend, "update_shards"):
+        from kafka_topic_analyzer_tpu.packing import section_byte_split
+        from kafka_topic_analyzer_tpu.results import WireStats
+
+        wire_cfg = backend.config
+        # Sharded backends pack per-chunk buffers; the split is the same
+        # layout rule at that granularity (packing._sections).
+        wire_b = (
+            wire_cfg.chunk_size
+            if hasattr(backend, "update_shards")
+            else wire_cfg.batch_size
+        )
+        per_rec, table = section_byte_split(wire_cfg, wire_b)
+        wire_stats = WireStats(
+            format=wire_cfg.wire_format,
+            batch_size=wire_b,
+            per_record_bytes=per_rec,
+            table_bytes=table,
+        )
+        v4_reason = wire_cfg.wire_v4_reason
+        if v4_reason is not None:
+            obs_metrics.WIRE_V4_FALLBACK.labels(reason=v4_reason).inc()
+        wire_bytes0 = obs_metrics.WIRE_BYTES.value
 
     used_workers = 1
     # Superbatch dispatch (config.DispatchConfig, resolved by the backend):
@@ -932,6 +966,16 @@ def run_scan(
             d.get("frames", 0) for p, d in corrupt.items() if p >= 0
         ),
     )
+    # Close out the wire accounting before the registry gathers, so the
+    # bytes/record gauge lands in every snapshot the merge sees.
+    if wire_stats is not None:
+        wire_stats.bytes_total = int(
+            obs_metrics.WIRE_BYTES.value - wire_bytes0
+        )
+        wire_stats.records = seq - seq_base
+        obs_metrics.WIRE_BYTES_PER_RECORD.set(
+            round(wire_stats.bytes_per_record, 2)
+        )
     # Cluster-wide registry view.  gather_telemetry is a lockstep
     # collective, so it runs here — a point every process reaches — never
     # from the report-only branch of the CLI.
@@ -959,4 +1003,5 @@ def run_scan(
         ingest_workers_per_controller=workers_per_controller,
         superbatch_k=super_k,
         dispatch_depth=int(getattr(backend, "dispatch_depth", 1) or 1),
+        wire=wire_stats,
     )

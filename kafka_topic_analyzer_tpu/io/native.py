@@ -27,7 +27,7 @@ _NATIVE_DIR = os.path.join(
 )
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 12
+_ABI = 13
 _SO_NAME = f"libkta_ingest.v{_ABI}.so"
 
 #: Env knob that disables the native shim entirely (pure-Python chain
@@ -420,19 +420,56 @@ def decode_record_set_native(
     return out, int(consumed.value), int(covered.value)
 
 
+def _pallas_value_cap(config) -> int:
+    """The 16 MiB value-length cap exists for the v4 MXU kernel's 12-bit
+    digit decomposition only; under wire v5 no per-record value length
+    reaches a pallas kernel (the counter fold ships pre-reduced), so the
+    cap must not reject v5 scans."""
+    from kafka_topic_analyzer_tpu.packing import MAX_VALUE_LEN
+
+    return (
+        MAX_VALUE_LEN
+        if config.use_pallas_counters and config.wire_format == 4
+        else 0
+    )
+
+
+def _quant_section(config) -> "tuple[int, int, np.ndarray | None]":
+    """(q_rows, q_nbuckets, edges) for the wire-v5 DDSketch section —
+    (0, 0, None) when the config ships no quantile table.  The edge array
+    is the ddsketch_edges lru-cached singleton, so the pointer handed to
+    C++ stays alive for the process lifetime."""
+    if config.wire_format != 5 or not config.enable_quantiles:
+        return 0, 0, None
+    from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_edges
+
+    q_rows = config.num_partitions if config.quantiles_per_partition else 1
+    return (
+        q_rows,
+        config.quantile_buckets,
+        ddsketch_edges(config.quantile_gamma, config.quantile_buckets),
+    )
+
+
+def _edges_ptr(edges: "np.ndarray | None"):
+    if edges is None:
+        return ctypes.POINTER(ctypes.c_int64)()
+    return _as_ptr(edges, ctypes.c_int64)
+
+
 def pack_batch_native(
     batch, config, out: "np.ndarray | None" = None
 ) -> "np.ndarray | None":
-    """Fused SoA→wire-format-v4 packing in C++ (see packing.py for the
-    layout contract).  Returns None when the shim rejects the batch (out of
-    range values) so the numpy path can raise its descriptive error.
+    """Fused SoA→wire-format packing in C++ (see packing.py for the v4/v5
+    layout contracts).  Returns None when the shim rejects the batch (out
+    of range values) so the numpy path can raise its descriptive error.
     ``out`` packs into a caller-provided contiguous ``uint8[packed_nbytes]``
     buffer (e.g. a SuperbatchStager row) instead of allocating one — note
     that a rejected batch may leave partial bytes in it (the numpy
     fallback overwrites every byte before raising or returning)."""
     from kafka_topic_analyzer_tpu.packing import (
-        MAX_VALUE_LEN,
         hll_table_rows,
+        hll_wire_mode,
         packed_nbytes,
     )
 
@@ -442,6 +479,7 @@ def pack_batch_native(
     if n > b:
         raise ValueError(f"batch of {n} exceeds batch_size {b}")
     hll_rows = hll_table_rows(config, b)
+    q_rows, q_nb, edges = _quant_section(config)
     if out is None:
         out = np.empty(packed_nbytes(config, b), dtype=np.uint8)
     elif (
@@ -467,15 +505,14 @@ def pack_batch_native(
         ctypes.c_int32(config.num_partitions),
         ctypes.c_int32(1 if config.count_alive_keys else 0),
         ctypes.c_int32(config.alive_bitmap_bits),
-        # 0 = off, 1 = per-record pairs, 2 = host-reduced register table
-        # (wire v3); the mode/rows decision is packing.hll_table_rows so
-        # the numpy path, this call, and the layout can never disagree.
-        ctypes.c_int32(
-            0 if not config.enable_hll else (2 if hll_rows else 1)
-        ),
+        ctypes.c_int32(hll_wire_mode(config, b)),
         ctypes.c_int32(config.hll_p),
         ctypes.c_int32(hll_rows),
-        ctypes.c_int32(MAX_VALUE_LEN if config.use_pallas_counters else 0),
+        ctypes.c_int32(_pallas_value_cap(config)),
+        ctypes.c_int32(1 if config.wire_format == 5 else 0),
+        ctypes.c_int32(q_rows),
+        ctypes.c_int32(q_nb),
+        _edges_ptr(edges),
         _as_ptr(out, ctypes.c_uint8),
         ctypes.c_int64(out.nbytes),
     )
@@ -497,32 +534,38 @@ def pack_batch_native(
 # decode→RecordBatch→pack_batch chain, never to an error.
 
 
-def _fused_pack_params(config, batch_size: int) -> "tuple[int, ...]":
-    """The (b, P, with_alive, alive_bits, with_hll, hll_p, hll_rows, vcap)
-    tail shared by the fused entry points — derived through the same
-    packing.py rules as pack_batch_native, so the fused row layout can
-    never skew from the chained one."""
-    from kafka_topic_analyzer_tpu.packing import MAX_VALUE_LEN, hll_table_rows
+def _fused_pack_params(config, batch_size: int) -> "tuple":
+    """The (b, P, with_alive, alive_bits, with_hll, hll_p, hll_rows, vcap,
+    wire_v5, q_rows, q_nbuckets, edges) tail shared by the fused entry
+    points — derived through the same packing.py rules as
+    pack_batch_native, so the fused row layout can never skew from the
+    chained one."""
+    from kafka_topic_analyzer_tpu.packing import hll_table_rows, hll_wire_mode
 
-    hll_rows = hll_table_rows(config, batch_size)
+    q_rows, q_nb, edges = _quant_section(config)
     return (
         batch_size,
         config.num_partitions,
         1 if config.count_alive_keys else 0,
         config.alive_bitmap_bits,
-        0 if not config.enable_hll else (2 if hll_rows else 1),
+        hll_wire_mode(config, batch_size),
         config.hll_p,
-        hll_rows,
-        MAX_VALUE_LEN if config.use_pallas_counters else 0,
+        hll_table_rows(config, batch_size),
+        _pallas_value_cap(config),
+        1 if config.wire_format == 5 else 0,
+        q_rows,
+        q_nb,
+        edges,
     )
 
 
 def _fused_ctail(params) -> "list":
-    b, P, wa, ab, wh, hp, hr, vc = params
+    b, P, wa, ab, wh, hp, hr, vc, v5, qr, qn, edges = params
     return [
         ctypes.c_int64(b), ctypes.c_int32(P), ctypes.c_int32(wa),
         ctypes.c_int32(ab), ctypes.c_int32(wh), ctypes.c_int32(hp),
-        ctypes.c_int32(hr), ctypes.c_int32(vc),
+        ctypes.c_int32(hr), ctypes.c_int32(vc), ctypes.c_int32(v5),
+        ctypes.c_int32(qr), ctypes.c_int32(qn), _edges_ptr(edges),
     ]
 
 

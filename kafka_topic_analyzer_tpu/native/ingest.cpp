@@ -15,6 +15,7 @@
 //
 // Build: `make -C native` → libkta_ingest.so (g++ -O3, pthreads).
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstring>
@@ -103,7 +104,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 12; }
+int32_t kta_version() { return 13; }
 
 // CRC32-C (Castagnoli) over a byte buffer — Kafka's record-batch checksum.
 // Table-driven; the Python fallback (kafka_codec._crc32c) is a per-byte
@@ -497,6 +498,20 @@ extern "C" int64_t kta_decode_record_set(
   return n;
 }
 
+namespace {
+// Wire-v5 full-batch packer (combiner rows) — defined after the fused
+// row-layout machinery it shares with the incremental packers.
+int64_t pack_batch_v5(
+    const int32_t* partition, const int32_t* key_len, const int32_t* value_len,
+    const uint8_t* key_null, const uint8_t* value_null, const int64_t* ts_s,
+    const uint32_t* h32, const uint64_t* h64,
+    int64_t n_valid, int64_t batch_size, int32_t num_partitions,
+    int32_t with_alive, int32_t alive_bits, int32_t with_hll, int32_t hll_p,
+    int32_t hll_rows, int32_t value_len_cap, int32_t q_rows,
+    int32_t q_nbuckets, const int64_t* q_edges, uint8_t* out,
+    int64_t out_cap);
+}  // namespace
+
 // Fused batch packing: RecordBatch SoA columns -> wire-format-v4 buffer
 // (kafka_topic_analyzer_tpu/packing.py), including the host pre-reductions
 // (per-partition ts min/max table, last-writer-wins bitmap dedupe via
@@ -507,6 +522,11 @@ extern "C" int64_t kta_decode_record_set(
 // p i16[B] | klen u16[B] | vlen u32[B] | flags u8[B] | ts_minmax i64[2P] |
 // sz_minmax i64[2P] | [slot u32[B] | alive u8[B]] |
 // [hll: regs u8[rows << p] (mode 2) OR idx u16[B] | rho u8[B] (mode 1)]).
+// wire_v5 selects the combiner layout instead (packing.py wire v5): the
+// four per-record columns are replaced by a per-partition counter-delta
+// table i64[P*7] (+ an optional DDSketch bucket table i64[q_rows*(nb+2)]
+// keyed by the shared integer edge table q_edges), with_hll gains mode 3
+// (flat u32 idx = partition << p | bucket, v5's per-partition pair form).
 // Returns total bytes written, or -1 on error (including key_len > u16 /
 // partition out of i16/num_partitions range — mirrors pack_batch's
 // validation).
@@ -518,9 +538,17 @@ extern "C" int64_t kta_pack_batch(
     int32_t with_alive, int32_t alive_bits, int32_t with_hll, int32_t hll_p,
     int32_t hll_rows,
     int32_t value_len_cap,
+    int32_t wire_v5, int32_t q_rows, int32_t q_nbuckets,
+    const int64_t* q_edges,
     uint8_t* out, int64_t out_cap) {
   if (n_valid < 0 || n_valid > batch_size) return -1;
   if (num_partitions <= 0) return -1;
+  if (wire_v5)
+    return pack_batch_v5(
+        partition, key_len, value_len, key_null, value_null, ts_s, h32, h64,
+        n_valid, batch_size, num_partitions, with_alive, alive_bits, with_hll,
+        hll_p, hll_rows, value_len_cap, q_rows, q_nbuckets, q_edges, out,
+        out_cap);
   const int64_t b = batch_size;
   const int64_t P = num_partitions;
   // Wire format v4: the per-record i64 ts column is replaced by TWO [2P]
@@ -737,31 +765,49 @@ struct PackRowLayout {
   int64_t P;
   int32_t with_alive;
   int32_t alive_bits;
-  int32_t with_hll;  // 0 off, 1 per-record pairs, 2 register table
+  int32_t with_hll;  // 0 off, 1 u16 pairs, 2 register table, 3 u32 flat
+                     // pairs (v5: partition << p | bucket)
   int32_t hll_p;
   int32_t hll_rows;
   int32_t vcap;
+  int32_t wire_v5;   // combiner layout: counts table replaces the columns
+  int32_t q_rows;    // DDSketch rows (0 = no quant section; v5 only)
+  int32_t q_nbuckets;            // log buckets (section adds +2)
+  const int64_t* q_edges;        // shared integer bucket edge table
   int64_t need;
   // Section base pointers (uint8_t*: sections are only naturally aligned
   // when batch_size is a multiple of 8 — all element access via memcpy).
   uint8_t *p16, *kl16, *vl32, *fl8, *tsmm, *szmm;
+  uint8_t *cnt64;          // v5: i64[P * 7] counter deltas
   uint8_t *slot32, *alive8;
-  uint8_t *hll_a, *hll_b;  // idx/rho (mode 1) or regs/- (mode 2)
+  uint8_t *hll_a, *hll_b;  // idx/rho (modes 1/3) or regs/- (mode 2)
+  uint8_t *q64;            // v5: i64[q_rows * (q_nbuckets + 2)]
 };
 
 inline bool pack_row_layout(uint8_t* out, int64_t out_cap, int64_t b,
                             int32_t P, int32_t with_alive, int32_t alive_bits,
                             int32_t with_hll, int32_t hll_p, int32_t hll_rows,
-                            int32_t value_len_cap, PackRowLayout* r) {
+                            int32_t value_len_cap, int32_t wire_v5,
+                            int32_t q_rows, int32_t q_nbuckets,
+                            const int64_t* q_edges, PackRowLayout* r) {
   if (!out || b < 0 || P <= 0 || P > 0x7fff) return false;
   if (with_alive && (alive_bits < 1 || alive_bits > 32)) return false;
-  int64_t need = 16 + b * (2 + 2 + 4 + 1) + 2 * (2 * int64_t(P) * 8);
+  if (with_hll == 3 && !wire_v5) return false;  // flat pairs are v5-only
+  if (q_rows > 0 && (!wire_v5 || !q_edges || q_nbuckets < 1)) return false;
+  if (q_rows > 1 && q_rows < P) return false;  // rows index by partition
+  int64_t need = 16 + 2 * (2 * int64_t(P) * 8);
+  if (wire_v5)
+    need += int64_t(P) * 7 * 8;
+  else
+    need += b * (2 + 2 + 4 + 1);
   if (with_alive) need += b * 5;
   if (with_hll == 1) need += b * 3;
+  if (with_hll == 3) need += b * 5;
   if (with_hll == 2) {
     if (hll_rows < 1 || (hll_rows > 1 && hll_rows < P)) return false;
     need += int64_t(hll_rows) << hll_p;
   }
+  if (q_rows > 0) need += int64_t(q_rows) * (int64_t(q_nbuckets) + 2) * 8;
   if (need > out_cap) return false;
   r->b = b;
   r->P = P;
@@ -771,16 +817,26 @@ inline bool pack_row_layout(uint8_t* out, int64_t out_cap, int64_t b,
   r->hll_p = hll_p;
   r->hll_rows = hll_rows;
   r->vcap = value_len_cap > 0 ? value_len_cap : 0x7fffffff;
+  r->wire_v5 = wire_v5;
+  r->q_rows = q_rows;
+  r->q_nbuckets = q_nbuckets;
+  r->q_edges = q_edges;
   r->need = need;
   int64_t pos = 16;
-  r->p16 = out + pos;
-  pos += b * 2;
-  r->kl16 = out + pos;
-  pos += b * 2;
-  r->vl32 = out + pos;
-  pos += b * 4;
-  r->fl8 = out + pos;
-  pos += b;
+  r->p16 = r->kl16 = r->vl32 = r->fl8 = r->cnt64 = nullptr;
+  if (wire_v5) {
+    r->cnt64 = out + pos;
+    pos += int64_t(P) * 7 * 8;
+  } else {
+    r->p16 = out + pos;
+    pos += b * 2;
+    r->kl16 = out + pos;
+    pos += b * 2;
+    r->vl32 = out + pos;
+    pos += b * 4;
+    r->fl8 = out + pos;
+    pos += b;
+  }
   r->tsmm = out + pos;
   pos += 2 * P * 8;
   r->szmm = out + pos;
@@ -798,9 +854,19 @@ inline bool pack_row_layout(uint8_t* out, int64_t out_cap, int64_t b,
     pos += b * 2;
     r->hll_b = out + pos;  // rho u8[B]
     pos += b;
+  } else if (with_hll == 3) {
+    r->hll_a = out + pos;  // idx u32[B] (row << p | bucket)
+    pos += b * 4;
+    r->hll_b = out + pos;  // rho u8[B]
+    pos += b;
   } else if (with_hll == 2) {
     r->hll_a = out + pos;  // regs u8[rows << p]
     pos += int64_t(hll_rows) << hll_p;
+  }
+  r->q64 = nullptr;
+  if (q_rows > 0) {
+    r->q64 = out + pos;
+    pos += int64_t(q_rows) * (int64_t(q_nbuckets) + 2) * 8;
   }
   return true;
 }
@@ -839,32 +905,40 @@ inline T load_at(const uint8_t* base, int64_t idx) {
 // inputs compactly, then dedupe/HLL/extremes each run as a dedicated
 // tight pass per frame.
 
-// Compact per-frame stash of the reduction inputs for ACTIVE (non-null
-// key) records, carved out of the caller scratch after the dedupe table.
+// Compact per-frame stash of the reduction inputs, carved out of the
+// caller scratch after the dedupe table: hashes + aliveness for ACTIVE
+// (non-null key) records, and — wire v5 with quantiles — the message
+// sizes of SIZED (non-tombstone) records for the DDSketch bucket pass.
 struct FrameStash {
   uint64_t* h64;
   uint32_t* h32;
+  int64_t* size;
   uint8_t* alive;
-  int64_t n;
+  int64_t n;    // active records stashed (h64/h32/alive)
+  int64_t nsz;  // sized records stashed (size)
 };
 
 inline FrameStash stash_of(int64_t* scr, int64_t b, int64_t cap_alloc) {
   // cap_alloc is the ALLOCATED table capacity (pack_scratch_cap), not
   // scr[2]: the active capacity starts small and grows, but the stash
-  // lives past the full allocation.
+  // lives past the full allocation.  Region order keeps every 8-byte
+  // field 8-aligned for any b (base is int64-aligned; 8b and 16b are
+  // multiples of 8).
   FrameStash s;
   uint8_t* base = reinterpret_cast<uint8_t*>(scr + 3 + cap_alloc);
   s.h64 = reinterpret_cast<uint64_t*>(base);
-  s.h32 = reinterpret_cast<uint32_t*>(base + 8 * b);
-  s.alive = base + 12 * b;
+  s.size = reinterpret_cast<int64_t*>(base + 8 * b);
+  s.h32 = reinterpret_cast<uint32_t*>(base + 16 * b);
+  s.alive = base + 20 * b;
   s.n = 0;
+  s.nsz = 0;
   return s;
 }
 
 inline int64_t pack_stash_len64(int64_t b, int32_t with_alive,
-                                int32_t with_hll) {
-  if (!with_alive && with_hll != 2) return 0;
-  return (13 * b + 7) / 8;
+                                int32_t with_hll, int32_t q_rows) {
+  if (!with_alive && with_hll != 2 && q_rows <= 0) return 0;
+  return (21 * b + 7) / 8;
 }
 
 // Grow the active dedupe table (doubling, bounded by the allocated max)
@@ -944,6 +1018,40 @@ inline void hll_table_pass(const PackRowLayout& r, int32_t dense_p,
   }
 }
 
+// Wire v5: fold one single-partition frame's counter registers into the
+// row's i64[P, 7] delta table — ONE 7-entry RMW per frame/append, the
+// combiner's whole per-frame cost for the channels that used to ship as
+// four per-record columns.  Channel order = results.COUNTER_CHANNELS.
+inline void commit_counts(const PackRowLayout& r, int32_t dense_p,
+                          int64_t total, int64_t tomb, int64_t knull,
+                          int64_t ksum, int64_t vsum) {
+  const int64_t base = int64_t(dense_p) * 7;
+  const int64_t vals[7] = {total,         tomb,  total - tomb, knull,
+                           total - knull, ksum,  vsum};
+  for (int c = 0; c < 7; ++c)
+    store_at<int64_t>(r.cnt64, base + c,
+                      load_at<int64_t>(r.cnt64, base + c) + vals[c]);
+}
+
+// Wire v5 DDSketch pass: bucket the stashed message sizes through the
+// shared integer edge table (ops/ddsketch.py::ddsketch_edges — binary
+// search == numpy searchsorted side='left') into the row's per-row
+// bucket-count table.  Runs after the frame parses, like every reduction.
+inline void quant_pass(const PackRowLayout& r, int32_t dense_p,
+                       const int64_t* sizes, int64_t n) {
+  const int64_t nb = int64_t(r.q_nbuckets) + 2;
+  const int64_t base = (r.q_rows > 1 ? int64_t(dense_p) : 0) * nb;
+  for (int64_t j = 0; j < n; ++j) {
+    const int64_t s = sizes[j];
+    int64_t idx = 0;
+    if (s != 0)
+      idx = (std::lower_bound(r.q_edges, r.q_edges + r.q_nbuckets, s) -
+             r.q_edges) + 1;
+    store_at<int64_t>(r.q64, base + idx,
+                      load_at<int64_t>(r.q64, base + idx) + 1);
+  }
+}
+
 // One table RMW per frame/append instead of four per record.
 inline void commit_extremes(const PackRowLayout& r, int32_t dense_p,
                             int64_t ts_min, int64_t ts_max, int64_t sz_min,
@@ -971,12 +1079,20 @@ inline void rewind_appends(const PackRowLayout& r, int64_t* scr,
   const int64_t n = scr[0];
   if (n <= cursor0) return;
   const int64_t c = n - cursor0;
-  std::memset(r.p16 + 2 * cursor0, 0, size_t(2 * c));
-  std::memset(r.kl16 + 2 * cursor0, 0, size_t(2 * c));
-  std::memset(r.vl32 + 4 * cursor0, 0, size_t(4 * c));
-  std::memset(r.fl8 + cursor0, 0, size_t(c));
+  if (!r.wire_v5) {
+    // v5 has no per-record column sections — its counter/quantile
+    // reductions only commit after the frame parses, so the cursor reset
+    // below is the whole rewind for them.
+    std::memset(r.p16 + 2 * cursor0, 0, size_t(2 * c));
+    std::memset(r.kl16 + 2 * cursor0, 0, size_t(2 * c));
+    std::memset(r.vl32 + 4 * cursor0, 0, size_t(4 * c));
+    std::memset(r.fl8 + cursor0, 0, size_t(c));
+  }
   if (r.with_hll == 1) {
     std::memset(r.hll_a + 2 * cursor0, 0, size_t(2 * c));
+    std::memset(r.hll_b + cursor0, 0, size_t(c));
+  } else if (r.with_hll == 3) {
+    std::memset(r.hll_a + 4 * cursor0, 0, size_t(4 * c));
     std::memset(r.hll_b + cursor0, 0, size_t(c));
   }
   scr[0] = cursor0;
@@ -1048,6 +1164,141 @@ inline int validate_frame_records(const uint8_t* payload, int64_t plen,
   return 0;
 }
 
+// Wire-v5 full-batch packer (the chained path's combiner form): one
+// sequential pass folds the SoA columns into the per-partition tables —
+// counter deltas, ts/size extremes, DDSketch buckets — with the same
+// validation kta_pack_batch's v4 branch applies.  Multi-partition batches
+// are fine here (unlike the fused single-partition appends): every table
+// indexes by the record's own partition.
+int64_t pack_batch_v5(
+    const int32_t* partition, const int32_t* key_len, const int32_t* value_len,
+    const uint8_t* key_null, const uint8_t* value_null, const int64_t* ts_s,
+    const uint32_t* h32, const uint64_t* h64,
+    int64_t n_valid, int64_t batch_size, int32_t num_partitions,
+    int32_t with_alive, int32_t alive_bits, int32_t with_hll, int32_t hll_p,
+    int32_t hll_rows, int32_t value_len_cap, int32_t q_rows,
+    int32_t q_nbuckets, const int64_t* q_edges, uint8_t* out,
+    int64_t out_cap) {
+  PackRowLayout r;
+  if (!pack_row_layout(out, out_cap, batch_size, num_partitions, with_alive,
+                       alive_bits, with_hll, hll_p, hll_rows, value_len_cap,
+                       1, q_rows, q_nbuckets, q_edges, &r))
+    return -1;
+  const int64_t P = num_partitions;
+  std::memset(out, 0, r.need);
+
+  std::vector<int64_t> cnt(size_t(P) * 7, 0);
+  std::vector<int64_t> mm(2 * P), sz(2 * P);
+  for (int64_t p = 0; p < P; ++p) {
+    mm[p] = INT64_MAX;
+    mm[P + p] = INT64_MIN;
+    sz[p] = INT64_MAX;
+    sz[P + p] = 0;
+  }
+  const int64_t nb = int64_t(q_nbuckets) + 2;
+  std::vector<int64_t> qt(
+      q_rows > 0 ? size_t(q_rows) * size_t(nb) : size_t(0), 0);
+  for (int64_t i = 0; i < n_valid; ++i) {
+    const int32_t p = partition[i];
+    if (p < 0 || p > 0x7fff || p >= num_partitions ||
+        key_len[i] < 0 || key_len[i] > 0xffff ||
+        value_len[i] < 0 || value_len[i] > r.vcap)
+      return -1;
+    const bool kn = !key_null[i];
+    const bool vn = !value_null[i];
+    int64_t* row = cnt.data() + int64_t(p) * 7;
+    row[0] += 1;
+    row[1] += vn ? 0 : 1;
+    row[2] += vn ? 1 : 0;
+    row[3] += kn ? 0 : 1;
+    row[4] += kn ? 1 : 0;
+    if (kn) row[5] += key_len[i];
+    if (vn) row[6] += value_len[i];
+    const int64_t t = ts_s[i];
+    if (t < mm[p]) mm[p] = t;
+    if (t > mm[P + p]) mm[P + p] = t;
+    if (vn) {
+      const int64_t size =
+          (kn ? int64_t(key_len[i]) : 0) + int64_t(value_len[i]);
+      if (size < sz[p]) sz[p] = size;
+      if (size > sz[P + p]) sz[P + p] = size;
+      if (q_rows > 0) {
+        int64_t idx = 0;
+        if (size != 0)
+          idx = (std::lower_bound(q_edges, q_edges + q_nbuckets, size) -
+                 q_edges) + 1;
+        qt[size_t((q_rows > 1 ? int64_t(p) : 0) * nb + idx)] += 1;
+      }
+    }
+  }
+  std::memcpy(r.cnt64, cnt.data(), size_t(P) * 7 * 8);
+  std::memcpy(r.tsmm, mm.data(), size_t(2 * P) * 8);
+  std::memcpy(r.szmm, sz.data(), size_t(2 * P) * 8);
+  if (q_rows > 0) std::memcpy(r.q64, qt.data(), qt.size() * 8);
+
+  int64_t n_pairs = 0;
+  if (with_alive && n_valid > 0) {
+    // Same pre-reduction as the v4 branch: LWW dedupe into aligned
+    // temporaries, then memcpy into the (possibly unaligned) section.
+    std::vector<uint8_t> active(n_valid), alive(n_valid);
+    for (int64_t i = 0; i < n_valid; ++i) {
+      active[i] = key_null[i] ? 0 : 1;
+      alive[i] = value_null[i] ? 0 : 1;
+    }
+    std::vector<uint32_t> slots(n_valid);
+    std::vector<uint8_t> flags(n_valid);
+    n_pairs = kta_dedupe_slots(h32, active.data(), alive.data(), n_valid,
+                               alive_bits, slots.data(), flags.data());
+    if (n_pairs < 0) return -1;
+    std::memcpy(r.slot32, slots.data(), size_t(n_pairs) * 4);
+    std::memcpy(r.alive8, flags.data(), size_t(n_pairs));
+  }
+  if (with_hll == 1 || with_hll == 3) {
+    for (int64_t i = 0; i < n_valid; ++i) {
+      if (key_null[i]) {
+        if (with_hll == 1)
+          store_at<uint16_t>(r.hll_a, i, 0);
+        else
+          store_at<uint32_t>(r.hll_a, i, 0);
+        r.hll_b[i] = 0;
+        continue;
+      }
+      const uint64_t h = splitmix64(h64[i]);
+      const uint32_t bucket = static_cast<uint32_t>(h >> (64 - hll_p));
+      if (with_hll == 1)
+        store_at<uint16_t>(r.hll_a, i, static_cast<uint16_t>(bucket));
+      else
+        store_at<uint32_t>(
+            r.hll_a, i,
+            (static_cast<uint32_t>(partition[i]) << hll_p) | bucket);
+      const uint64_t rest = h << hll_p;
+      r.hll_b[i] = rest == 0
+                       ? static_cast<uint8_t>(64 - hll_p + 1)
+                       : static_cast<uint8_t>(__builtin_clzll(rest) + 1);
+    }
+  } else if (with_hll == 2) {
+    uint8_t* tbl = r.hll_a;
+    const bool per_row = hll_rows > 1;
+    for (int64_t i = 0; i < n_valid; ++i) {
+      if (key_null[i]) continue;
+      const uint64_t h = splitmix64(h64[i]);
+      const int64_t row = per_row ? partition[i] : 0;
+      const int64_t idx = (row << hll_p) | int64_t(h >> (64 - hll_p));
+      const uint64_t rest = h << hll_p;
+      const uint8_t rho =
+          rest == 0 ? static_cast<uint8_t>(64 - hll_p + 1)
+                    : static_cast<uint8_t>(__builtin_clzll(rest) + 1);
+      if (rho > tbl[idx]) tbl[idx] = rho;
+    }
+  }
+
+  const int32_t hv = static_cast<int32_t>(n_valid);
+  const int32_t hp = static_cast<int32_t>(n_pairs);
+  std::memcpy(out, &hv, 4);
+  std::memcpy(out + 4, &hp, 4);
+  return r.need;
+}
+
 }  // namespace
 
 extern "C" {
@@ -1058,31 +1309,36 @@ int64_t kta_pack_scratch_len(int64_t batch_size, int32_t with_alive,
                              int32_t alive_bits) {
   if (batch_size < 0) return -1;
   // The stash region is sized unconditionally (it also serves HLL table
-  // mode with alive off) — a few MB at worst, allocated once per sink.
+  // mode with alive off, and wire v5's size stash) — a few MB at worst,
+  // allocated once per sink.
   return 3 + pack_scratch_cap(batch_size, with_alive, alive_bits) +
-         pack_stash_len64(batch_size, 1, 2);
+         pack_stash_len64(batch_size, 1, 2, 1);
 }
 
-// Initialize one wire-v4 row for incremental appends: zero the buffer,
-// identity-fill the extreme tables, reset the scratch.  An initialized,
-// never-appended row is byte-identical to a packed EMPTY batch (the
-// superbatch identity pad), so partial-row padding is just init.
-// Returns the row's total bytes (== packing.packed_nbytes) or -1.
+// Initialize one wire row (v4 or v5) for incremental appends: zero the
+// buffer, identity-fill the extreme tables, reset the scratch.  An
+// initialized, never-appended row is byte-identical to a packed EMPTY
+// batch (the superbatch identity pad), so partial-row padding is just
+// init — under v5 the zeroed counter/quantile tables ARE the fold
+// identity.  Returns the row's total bytes (== packing.packed_nbytes)
+// or -1.
 int64_t kta_pack_row_init(uint8_t* out, int64_t out_cap, int64_t* scratch,
                           int64_t scratch_len, int64_t batch_size,
                           int32_t num_partitions, int32_t with_alive,
                           int32_t alive_bits, int32_t with_hll,
                           int32_t hll_p, int32_t hll_rows,
-                          int32_t value_len_cap) {
+                          int32_t value_len_cap, int32_t wire_v5,
+                          int32_t q_rows, int32_t q_nbuckets,
+                          const int64_t* q_edges) {
   PackRowLayout r;
   if (!scratch ||
       !pack_row_layout(out, out_cap, batch_size, num_partitions, with_alive,
                        alive_bits, with_hll, hll_p, hll_rows, value_len_cap,
-                       &r))
+                       wire_v5, q_rows, q_nbuckets, q_edges, &r))
     return -1;
   const int64_t cap = pack_scratch_cap(batch_size, with_alive, alive_bits);
   if (scratch_len < 3 + cap + pack_stash_len64(batch_size, with_alive,
-                                               with_hll))
+                                               with_hll, q_rows))
     return -1;
   std::memset(out, 0, r.need);
   for (int64_t p = 0; p < r.P; ++p) {
@@ -1124,15 +1380,16 @@ int64_t kta_decode_pack_record_set(
     int64_t min_off, int64_t max_off, int32_t dense_partition,
     int64_t batch_size, int32_t num_partitions, int32_t with_alive,
     int32_t alive_bits, int32_t with_hll, int32_t hll_p, int32_t hll_rows,
-    int32_t value_len_cap, uint8_t* out, int64_t out_cap, int64_t* scratch,
-    int64_t* st) {
+    int32_t value_len_cap, int32_t wire_v5, int32_t q_rows,
+    int32_t q_nbuckets, const int64_t* q_edges, uint8_t* out,
+    int64_t out_cap, int64_t* scratch, int64_t* st) {
   PackRowLayout r;
   if (!buf || len < 0 || !st || !scratch || start_pos < 0 ||
       start_pos > len || dense_partition < 0 ||
       dense_partition >= num_partitions ||
       !pack_row_layout(out, out_cap, batch_size, num_partitions, with_alive,
                        alive_bits, with_hll, hll_p, hll_rows, value_len_cap,
-                       &r))
+                       wire_v5, q_rows, q_nbuckets, q_edges, &r))
     return -1;
   const bool need_stash = with_alive || with_hll == 2;
   FrameStash stash = stash_of(
@@ -1168,8 +1425,12 @@ int64_t kta_decode_pack_record_set(
     // commits run as dedicated passes after the frame parses.
     const int64_t cursor0 = scratch[0];
     stash.n = 0;
+    stash.nsz = 0;
     int64_t ts_min = INT64_MAX, ts_max = INT64_MIN;
     int64_t sz_min = INT64_MAX, sz_max = 0;
+    // Wire v5: per-frame counter registers (single-partition frames fold
+    // to ONE 7-entry table commit — commit_counts).
+    int64_t f_tomb = 0, f_knull = 0, f_ksum = 0, f_vsum = 0;
     int64_t f_last_off = -1, f_last_ts = 0, f_appended = 0;
     int64_t rpos = 0;
     int32_t i = 0;
@@ -1265,12 +1526,21 @@ int64_t kta_decode_pack_record_set(
       const bool key_null = klen < 0;
       const bool value_null = vlen < 0;
       const int64_t n = scratch[0];
-      store_at<int16_t>(r.p16, n, static_cast<int16_t>(dense_partition));
-      store_at<uint16_t>(r.kl16, n,
-                         static_cast<uint16_t>(key_null ? 0 : klen));
-      store_at<uint32_t>(r.vl32, n,
-                         static_cast<uint32_t>(value_null ? 0 : vlen));
-      r.fl8[n] = (key_null ? 1 : 0) | (value_null ? 2 : 0);
+      if (r.wire_v5) {
+        // Combiner rows: no per-record columns — accumulate the frame's
+        // counter registers instead (committed once per frame below).
+        if (value_null) ++f_tomb;
+        if (key_null) ++f_knull;
+        if (!key_null) f_ksum += klen;
+        if (!value_null) f_vsum += vlen;
+      } else {
+        store_at<int16_t>(r.p16, n, static_cast<int16_t>(dense_partition));
+        store_at<uint16_t>(r.kl16, n,
+                           static_cast<uint16_t>(key_null ? 0 : klen));
+        store_at<uint32_t>(r.vl32, n,
+                           static_cast<uint32_t>(value_null ? 0 : vlen));
+        r.fl8[n] = (key_null ? 1 : 0) | (value_null ? 2 : 0);
+      }
       const int64_t ts_ms = fh.first_ts + ts_delta;
       const int64_t ts_s = ts_ms < 0 ? 0 : ts_ms / 1000;
       if (ts_s < ts_min) ts_min = ts_s;
@@ -1279,6 +1549,7 @@ int64_t kta_decode_pack_record_set(
         const int64_t size = (key_null ? 0 : klen) + vlen;
         if (size < sz_min) sz_min = size;
         if (size > sz_max) sz_max = size;
+        if (r.q64) stash.size[stash.nsz++] = size;
       }
       uint32_t h32 = 0;
       uint64_t h64 = 0;
@@ -1291,14 +1562,24 @@ int64_t kta_decode_pack_record_set(
           ++stash.n;
         }
       }
-      if (r.with_hll == 1) {
+      if (r.with_hll == 1 || r.with_hll == 3) {
         if (key_null) {
-          store_at<uint16_t>(r.hll_a, n, 0);
+          if (r.with_hll == 1)
+            store_at<uint16_t>(r.hll_a, n, 0);
+          else
+            store_at<uint32_t>(r.hll_a, n, 0);
           r.hll_b[n] = 0;
         } else {
           const uint64_t h = splitmix64(h64);
-          store_at<uint16_t>(r.hll_a, n,
-                             static_cast<uint16_t>(h >> (64 - r.hll_p)));
+          const uint32_t bucket =
+              static_cast<uint32_t>(h >> (64 - r.hll_p));
+          if (r.with_hll == 1)
+            store_at<uint16_t>(r.hll_a, n, static_cast<uint16_t>(bucket));
+          else
+            // v5 flat pairs: the register row rides inside the index.
+            store_at<uint32_t>(
+                r.hll_a, n,
+                (static_cast<uint32_t>(dense_partition) << r.hll_p) | bucket);
           const uint64_t rest = h << r.hll_p;
           r.hll_b[n] =
               rest == 0 ? static_cast<uint8_t>(64 - r.hll_p + 1)
@@ -1326,6 +1607,11 @@ int64_t kta_decode_pack_record_set(
                                   stash.n);
       if (r.with_hll == 2) hll_table_pass(r, dense_partition, stash.h64,
                                           stash.n);
+      if (r.wire_v5) {
+        commit_counts(r, dense_partition, f_appended, f_tomb, f_knull,
+                      f_ksum, f_vsum);
+        if (r.q64) quant_pass(r, dense_partition, stash.size, stash.nsz);
+      }
       appended += f_appended;
       last_off = f_last_off;
       last_ts = f_last_ts;
@@ -1370,7 +1656,8 @@ int64_t kta_pack_append_columns(
     const uint32_t* h32, const uint64_t* h64, int64_t start, int64_t n,
     int64_t batch_size, int32_t num_partitions, int32_t with_alive,
     int32_t alive_bits, int32_t with_hll, int32_t hll_p, int32_t hll_rows,
-    int32_t value_len_cap, int64_t* detail) {
+    int32_t value_len_cap, int32_t wire_v5, int32_t q_rows,
+    int32_t q_nbuckets, const int64_t* q_edges, int64_t* detail) {
   PackRowLayout r;
   if (!key_len || !value_len || !key_null || !value_null || !ts || !h32 ||
       !h64 || !scratch || !detail || start < 0 || n < 0 || start > n ||
@@ -1378,7 +1665,7 @@ int64_t kta_pack_append_columns(
       dense_partition > 0x7fff || ts_mode < 0 || ts_mode > 2 ||
       !pack_row_layout(out, out_cap, batch_size, num_partitions, with_alive,
                        alive_bits, with_hll, hll_p, hll_rows, value_len_cap,
-                       &r))
+                       wire_v5, q_rows, q_nbuckets, q_edges, &r))
     return -1;
   int64_t take = n - start;
   const int64_t space = r.b - scratch[0];
@@ -1401,22 +1688,40 @@ int64_t kta_pack_append_columns(
     }
   }
   const int64_t c0 = scratch[0];
-  // Columnar section stores (klen/vlen stored VERBATIM, like
-  // kta_pack_batch — sources write 0 for null keys/tombstones but the
-  // layout carries whatever the column said).
-  for (int64_t i = lo; i < hi; ++i)
-    store_at<int16_t>(r.p16, c0 + (i - lo),
-                      static_cast<int16_t>(dense_partition));
-  for (int64_t i = lo; i < hi; ++i)
-    store_at<uint16_t>(r.kl16, c0 + (i - lo),
-                       static_cast<uint16_t>(key_len[i]));
-  for (int64_t i = lo; i < hi; ++i)
-    store_at<uint32_t>(r.vl32, c0 + (i - lo),
-                       static_cast<uint32_t>(value_len[i]));
-  for (int64_t i = lo; i < hi; ++i)
-    r.fl8[c0 + (i - lo)] =
-        (key_null[i] ? 1 : 0) | (value_null[i] ? 2 : 0);
-  // Extremes: scalar reduction, ONE table RMW.
+  if (r.wire_v5) {
+    // Combiner rows: fold the columns straight into the frame registers
+    // (one commit_counts below) — no per-record column sections exist.
+    int64_t f_tomb = 0, f_knull = 0, f_ksum = 0, f_vsum = 0;
+    for (int64_t i = lo; i < hi; ++i) {
+      if (value_null[i]) ++f_tomb;
+      if (key_null[i]) ++f_knull;
+      if (!key_null[i]) f_ksum += key_len[i];
+      if (!value_null[i]) f_vsum += value_len[i];
+    }
+    if (take)
+      commit_counts(r, dense_partition, take, f_tomb, f_knull, f_ksum,
+                    f_vsum);
+  } else {
+    // Columnar section stores (klen/vlen stored VERBATIM, like
+    // kta_pack_batch — sources write 0 for null keys/tombstones but the
+    // layout carries whatever the column said).
+    for (int64_t i = lo; i < hi; ++i)
+      store_at<int16_t>(r.p16, c0 + (i - lo),
+                        static_cast<int16_t>(dense_partition));
+    for (int64_t i = lo; i < hi; ++i)
+      store_at<uint16_t>(r.kl16, c0 + (i - lo),
+                         static_cast<uint16_t>(key_len[i]));
+    for (int64_t i = lo; i < hi; ++i)
+      store_at<uint32_t>(r.vl32, c0 + (i - lo),
+                         static_cast<uint32_t>(value_len[i]));
+    for (int64_t i = lo; i < hi; ++i)
+      r.fl8[c0 + (i - lo)] =
+          (key_null[i] ? 1 : 0) | (value_null[i] ? 2 : 0);
+  }
+  // Extremes: scalar reduction, ONE table RMW.  The wire-v5 quantile
+  // pass stashes the same tombstone-excluded sizes this loop derives.
+  FrameStash qstash = stash_of(
+      scratch, r.b, pack_scratch_cap(r.b, with_alive, alive_bits));
   int64_t ts_min = INT64_MAX, ts_max = INT64_MIN;
   int64_t sz_min = INT64_MAX, sz_max = 0;
   for (int64_t i = lo; i < hi; ++i) {
@@ -1432,11 +1737,14 @@ int64_t kta_pack_append_columns(
           (key_null[i] ? 0 : int64_t(key_len[i])) + int64_t(value_len[i]);
       if (size < sz_min) sz_min = size;
       if (size > sz_max) sz_max = size;
+      if (r.q64) qstash.size[qstash.nsz++] = size;
     }
   }
   if (take)
     commit_extremes(r, dense_partition, ts_min, ts_max, sz_min, sz_max,
                     true, sz_min != INT64_MAX || sz_max != 0);
+  if (take && r.q64)
+    quant_pass(r, dense_partition, qstash.size, qstash.nsz);
   // Dedupe + HLL as dedicated passes straight off the input columns.
   if (with_alive) {
     FrameStash stash = stash_of(
@@ -1449,16 +1757,24 @@ int64_t kta_pack_append_columns(
     }
     dedupe_pass(r, scratch, stash.h32, stash.alive, stash.n);
   }
-  if (r.with_hll == 1) {
+  if (r.with_hll == 1 || r.with_hll == 3) {
     for (int64_t i = lo; i < hi; ++i) {
       const int64_t pos = c0 + (i - lo);
       if (key_null[i]) {
-        store_at<uint16_t>(r.hll_a, pos, 0);
+        if (r.with_hll == 1)
+          store_at<uint16_t>(r.hll_a, pos, 0);
+        else
+          store_at<uint32_t>(r.hll_a, pos, 0);
         r.hll_b[pos] = 0;
       } else {
         const uint64_t h = splitmix64(h64[i]);
-        store_at<uint16_t>(r.hll_a, pos,
-                           static_cast<uint16_t>(h >> (64 - r.hll_p)));
+        const uint32_t bucket = static_cast<uint32_t>(h >> (64 - r.hll_p));
+        if (r.with_hll == 1)
+          store_at<uint16_t>(r.hll_a, pos, static_cast<uint16_t>(bucket));
+        else
+          store_at<uint32_t>(
+              r.hll_a, pos,
+              (static_cast<uint32_t>(dense_partition) << r.hll_p) | bucket);
         const uint64_t rest = h << r.hll_p;
         r.hll_b[pos] =
             rest == 0 ? static_cast<uint8_t>(64 - r.hll_p + 1)

@@ -118,6 +118,25 @@ FUSED_FALLBACK = _REG.counter(
     "disabled/failed, source or backend without fused support)",
     labelnames=("reason",))
 
+# -- packed wire format (packing.py v4/v5; backends book the transfers) -------
+
+WIRE_BYTES = _REG.counter(
+    "kta_wire_bytes_total",
+    "Packed host→device wire bytes dispatched (buffers as transferred, "
+    "superbatch identity padding included)")
+WIRE_BYTES_PER_RECORD = _REG.gauge(
+    "kta_wire_packed_bytes_per_record",
+    "Packed wire bytes per scanned record for the finished scan "
+    "(kta_wire_bytes_total delta / records) — the observable v4→v5 "
+    "wire saving",
+    merge="max")
+WIRE_V4_FALLBACK = _REG.counter(
+    "kta_wire_v4_fallback_total",
+    "Scans that ran the v4 per-record wire format instead of the v5 "
+    "combiner rows (reason: env-kill-switch = KTA_WIRE_V4, explicit = "
+    "caller pinned v4) — a bypassed combiner is never silent",
+    labelnames=("reason",))
+
 # -- io/kafka_wire ------------------------------------------------------------
 
 FETCH_REQUESTS = _REG.counter(

@@ -20,6 +20,8 @@ Quantile answers carry relative error ≤ α (= ``quantile_alpha``).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from kafka_topic_analyzer_tpu.jax_support import jnp
@@ -27,6 +29,44 @@ from kafka_topic_analyzer_tpu.jax_support import jnp
 
 def ddsketch_num_buckets(nbuckets: int) -> int:
     return nbuckets + 2  # zero bucket + log buckets + overflow
+
+
+@functools.lru_cache(maxsize=8)
+def ddsketch_edges(gamma: float, nbuckets: int) -> np.ndarray:
+    """Integer bucket boundaries: ``edges[i]`` is the largest integer size
+    assigned to log bucket ``i + 1``, i.e. ``floor(gamma^i)``.
+
+    The bucket of an integer size ``s >= 1`` is
+    ``searchsorted(edges, s, side='left') + 1`` — exactly the closed-form
+    ``min k such that s <= gamma^(k-1)`` (``s <= gamma^i`` iff
+    ``s <= floor(gamma^i)`` for integer ``s``), saturating naturally at
+    the overflow bucket ``nbuckets + 1``.  This table is the ONE bucket
+    rule shared by the device update below, the numpy wire-v5 packer, and
+    the native C++ packers (packing.py / native/ingest.cpp): an integer
+    comparison is exact on every backend, where the previous float32
+    ``ceil(log(s)/log(gamma))`` could round differently between numpy's
+    libm and XLA's vectorized log — a one-ULP disagreement the v4↔v5
+    byte-identity bar cannot tolerate.  Cached per (gamma, nbuckets); the
+    array is frozen because the native packers hold raw pointers into it.
+    """
+    powers = np.power(np.float64(gamma), np.arange(nbuckets, dtype=np.float64))
+    # Clip before the int cast: an operator-supplied (alpha, nbuckets) pair
+    # can push gamma^i past 2^63 (float inf → undefined int64 cast).  Any
+    # edge above 2^62 is unreachable anyway (sizes are <= u16 + u32 bytes).
+    edges = np.floor(np.minimum(powers, 2.0**62)).astype(np.int64)
+    edges.setflags(write=False)
+    return edges
+
+
+def ddsketch_bucket_numpy(
+    sizes: np.ndarray, gamma: float, nbuckets: int
+) -> np.ndarray:
+    """Host-side bucket index per size (the wire-v5 packer's reduction):
+    0 for size 0, the shared edge-table bucket otherwise."""
+    idx = np.searchsorted(
+        ddsketch_edges(gamma, nbuckets), sizes, side="left"
+    ).astype(np.int64) + 1
+    return np.where(sizes == 0, 0, idx)
 
 
 def ddsketch_update(
@@ -38,13 +78,19 @@ def ddsketch_update(
     per-partition histograms are enabled (``partition`` given), else a
     single row.  Rows merge by addition, so global quantiles over any row
     subset are exact.
+
+    Buckets come from the shared integer edge table (``ddsketch_edges``),
+    not a per-record float log: integer ``searchsorted`` is bit-exact
+    across numpy and every XLA backend, which is what lets wire v5
+    pre-reduce this histogram on the host byte-identically.
     """
     nb = nbuckets + 2
     rows = counts.shape[0]
-    x = sizes.astype(jnp.float32)
-    log_gamma = np.float32(np.log(gamma))
-    idx = jnp.ceil(jnp.log(jnp.maximum(x, 1.0)) / log_gamma).astype(jnp.int32) + 1
-    idx = jnp.clip(idx, 1, nbuckets + 1)
+    edges = jnp.asarray(ddsketch_edges(gamma, nbuckets))
+    idx = (
+        jnp.searchsorted(edges, sizes.astype(jnp.int64), side="left")
+        .astype(jnp.int32) + 1
+    )
     idx = jnp.where(sizes == 0, 0, idx)
     row = partition if partition is not None else jnp.int32(0)
     flat = row * nb + idx

@@ -67,6 +67,21 @@ def hll_apply(regs, idx, rho, partition=None):
     )
 
 
+def hll_apply_flat(regs, idx32, rho):
+    """Apply wire-v5 flat HLL pairs: ``idx32`` already encodes
+    ``row << p | bucket`` (packing.py's v5 flat pair mode — the partition
+    column no longer ships, so the register row rides inside the index).
+    One scatter-max into the flattened register file; masked records
+    carry (0, 0), a no-op under max."""
+    rows, m = regs.shape
+    return (
+        regs.reshape(-1)
+        .at[idx32.astype(jnp.int64)]
+        .max(rho.astype(jnp.int32))
+        .reshape(rows, m)
+    )
+
+
 def hll_merge(regs_a, regs_b):
     return jnp.maximum(regs_a, regs_b)
 

@@ -38,9 +38,40 @@ Layout (sections in order; B = static batch size, P = num_partitions):
              pairs — one size rule, ``hll_table_rows``, decides for the
              packers and (via section presence) the device step
 
+**Wire format v5 — the combiner** (``AnalyzerConfig.wire_format == 5``,
+the default; DESIGN.md §16).  Every metric is an associative per-partition
+fold, so the third lever (host pre-reduction) extends to the LAST
+per-record columns: the four columns above exist only so the device can
+scatter-add them, and v5 replaces them with the scatter's *result* — the
+MapReduce-combiner move.  Sections in order:
+
+    header    u8[16]      n_valid i32 | n_pairs i32 | reserved
+    counts    i64[7P]     per-partition counter deltas, row-major [P, 7]
+                          in results.COUNTER_CHANNELS order (total,
+                          tombstones, alive, key_null, key_non_null,
+                          key_size_sum, value_size_sum)
+    ts_minmax i64[2P]     unchanged from v4
+    sz_minmax i64[2P]     unchanged from v4
+    [alive]   slot u32[B] + alive u8[B]          unchanged from v4
+    [hll]     regs u8[R << p] table mode unchanged; PAIR mode ships
+              idx u16[B] + rho u8[B] globally, but idx32 u32[B]
+              (= partition << p | bucket) + rho u8[B] when per-partition
+              registers need the row — the one sub-case that cannot ride
+              unchanged because the partition column is gone
+    [quant]   i64[R·(nbuckets+2)]  iff enable_quantiles: per-row DDSketch
+              bucket-count deltas (R = P per-partition else 1), buckets
+              from the shared integer edge table (ops/ddsketch.py)
+
+The device fold becomes an elementwise table merge — O(P·H) per dispatch
+instead of an O(B) scatter — and wire bytes per record collapse when
+P ≪ B (the counts table is 56 B/partition vs 9 B/record).  v4 and v5 scan
+results are byte-identical: every replaced fold is an integer sum or
+min/max, associative and commutative, and the DDSketch bucket rule is the
+same integer edge table on host and device (no float reassociation).
+
 Device-side unpacking is pure ``lax.bitcast_convert_type`` on reshaped slices
 (both host and TPU are little-endian; the TPU backend runs a one-time
-pack→unpack self-check at init to guarantee it).
+pack→unpack self-check at init — both formats — to guarantee it).
 """
 
 from __future__ import annotations
@@ -84,39 +115,99 @@ def _sections(config: AnalyzerConfig, batch_size: int):
     a net INCREASE only when 2*P*S > B, i.e. partition counts within ~2x
     of MAX_PARTITIONS combined with small chunked batches; every realistic
     config (P ≤ thousands, B ≥ 2^17) is a large net win.
+
+    v5 (the combiner format — module docstring) drops the four per-record
+    columns for a per-partition counter-delta table and, with quantiles
+    on, a DDSketch bucket-count table: the same trade-off taken to its
+    end state, O(P·H) table bytes replacing O(B) column bytes.
     """
     b = batch_size
-    sec = [
-        ("partition", np.int16, b),
-        ("key_len", np.uint16, b),
-        ("value_len", np.uint32, b),
-        ("flags", np.uint8, b),
-        ("ts_minmax", np.int64, 2 * config.num_partitions),
-        # v4: per-partition message-size min/max (tombstone-excluded,
-        # src/metric.rs:249-251) — integer min/max is associative, so the
-        # host pre-reduces it exactly like the ts table and the device
-        # drops its last extremes scatter.  Sizes still ship per record
-        # (the counter sums need them), so this adds 16 B/partition and
-        # removes a B-record scatter-min + scatter-max from the step.
-        ("sz_minmax", np.int64, 2 * config.num_partitions),
-    ]
+    p = config.num_partitions
+    if config.wire_format == 5:
+        sec = [
+            # Pre-reduced counter deltas in results.COUNTER_CHANNELS
+            # order: what counters_update's scatter-add would have
+            # produced from the four dropped columns.
+            ("counts", np.int64, 7 * p),
+            ("ts_minmax", np.int64, 2 * p),
+            ("sz_minmax", np.int64, 2 * p),
+        ]
+    else:
+        sec = [
+            ("partition", np.int16, b),
+            ("key_len", np.uint16, b),
+            ("value_len", np.uint32, b),
+            ("flags", np.uint8, b),
+            ("ts_minmax", np.int64, 2 * p),
+            # v4: per-partition message-size min/max (tombstone-excluded,
+            # src/metric.rs:249-251) — integer min/max is associative, so the
+            # host pre-reduces it exactly like the ts table and the device
+            # drops its last extremes scatter.  Sizes still ship per record
+            # (the counter sums need them), so this adds 16 B/partition and
+            # removes a B-record scatter-min + scatter-max from the step.
+            ("sz_minmax", np.int64, 2 * p),
+        ]
     if config.count_alive_keys:
         sec.append(("alive_slot", np.uint32, b))
         sec.append(("alive_flag", np.uint8, b))
-    if config.enable_hll:
-        rows = hll_table_rows(config, b)
-        if rows:
-            # Table mode (v3): register max is fully commutative, so the
-            # host pre-reduces the whole batch to a u8[R, 2^p] register
-            # table (R = 1 global, R = P per-partition) and the device
-            # merges it ELEMENTWISE — no scatter on the hot path.
-            sec.append(("hll_regs", np.uint8, rows << config.hll_p))
-        else:
-            # Pair mode: per-record (register index, rho) — cheaper on
-            # the wire than a table whenever R·2^p > 3·B.
-            sec.append(("hll_idx", np.uint16, b))
-            sec.append(("hll_rho", np.uint8, b))
+    mode = hll_wire_mode(config, b)
+    if mode == 2:
+        # Table mode (v3): register max is fully commutative, so the
+        # host pre-reduces the whole batch to a u8[R, 2^p] register
+        # table (R = 1 global, R = P per-partition) and the device
+        # merges it ELEMENTWISE — no scatter on the hot path.
+        sec.append(
+            ("hll_regs", np.uint8, hll_table_rows(config, b) << config.hll_p)
+        )
+    elif mode == 3:
+        # v5 flat pair mode: the partition column is gone, so the
+        # register ROW rides inside the index — idx32 = partition <<
+        # p | bucket (15 + 16 bits fit u32).  Costs 2 B/record over
+        # v4's u16 pairs, only in the rare huge-P-small-B regime
+        # where pair mode wins the table-size rule at all.
+        sec.append(("hll_idx32", np.uint32, b))
+        sec.append(("hll_rho", np.uint8, b))
+    elif mode == 1:
+        # Pair mode: per-record (register index, rho) — cheaper on
+        # the wire than a table whenever R·2^p > 3·B.
+        sec.append(("hll_idx", np.uint16, b))
+        sec.append(("hll_rho", np.uint8, b))
+    if config.wire_format == 5 and config.enable_quantiles:
+        from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_num_buckets
+
+        q_rows = p if config.quantiles_per_partition else 1
+        sec.append(
+            ("qcounts", np.int64,
+             q_rows * ddsketch_num_buckets(config.quantile_buckets))
+        )
     return sec
+
+
+#: Sections whose byte count scales with the batch size — the per-record
+#: share of a packed buffer.  Everything else (header included) is a
+#: fold-table constant per batch.  Drives ``section_byte_split`` and the
+#: ``--stats`` wire line, so the v4→v5 saving is observable, not inferred.
+PER_RECORD_SECTIONS = frozenset(
+    {"partition", "key_len", "value_len", "flags",
+     "alive_slot", "alive_flag", "hll_idx", "hll_idx32", "hll_rho"}
+)
+
+
+def section_byte_split(
+    config: AnalyzerConfig, batch_size: int
+) -> "Tuple[int, int]":
+    """(per_record_bytes, fold_table_bytes) of one packed buffer — the
+    fold-table share includes the header.  Derived from ``_sections`` (the
+    single layout source, lint rule 7), summing to ``packed_nbytes``."""
+    per_record = 0
+    table = HEADER_BYTES
+    for name, dtype, count in _sections(config, batch_size):
+        nbytes = np.dtype(dtype).itemsize * count
+        if name in PER_RECORD_SECTIONS:
+            per_record += nbytes
+        else:
+            table += nbytes
+    return per_record, table
 
 
 def hll_table_rows(config: AnalyzerConfig, batch_size: int) -> int:
@@ -130,6 +221,28 @@ def hll_table_rows(config: AnalyzerConfig, batch_size: int) -> int:
         config.num_partitions if config.distinct_keys_per_partition else 1
     )
     return rows if (rows << config.hll_p) <= 3 * batch_size else 0
+
+
+def hll_wire_mode(config: AnalyzerConfig, batch_size: int) -> int:
+    """The HLL section mode every packer and the layout derive from — ONE
+    function so the numpy path, the native calls, and ``_sections`` can
+    never disagree (the same discipline as ``hll_table_rows``, which
+    decides the table half of this rule):
+
+    - ``0`` — HLL off;
+    - ``1`` — u16 (bucket, rho) pairs;
+    - ``2`` — host-reduced register table (``hll_table_rows`` rows);
+    - ``3`` — wire-v5 flat u32 pairs (``partition << p | bucket``): the
+      per-partition pair form, which cannot ship a bare bucket index once
+      the v5 layout drops the partition column.
+    """
+    if not config.enable_hll:
+        return 0
+    if hll_table_rows(config, batch_size):
+        return 2
+    if config.wire_format == 5 and config.distinct_keys_per_partition:
+        return 3
+    return 1
 
 
 def packed_nbytes(config: AnalyzerConfig, batch_size: int) -> int:
@@ -296,10 +409,12 @@ def pack_batch(
         raise ValueError("negative key/value length in record batch")
     if (
         config.use_pallas_counters
+        and config.wire_format == 4
         and batch.value_len.max(initial=0) > MAX_VALUE_LEN
     ):
-        # Only the MXU kernel's 12-bit digit decomposition needs this cap;
-        # the default scatter path handles full u32 lengths exactly.
+        # Only the v4 MXU kernel's 12-bit digit decomposition needs this
+        # cap; the default scatter path handles full u32 lengths exactly,
+        # and the v5 table merge never sees a per-record length at all.
         raise ValueError(
             f"value length {int(batch.value_len.max())} exceeds the Pallas "
             f"counter kernel's limit of {MAX_VALUE_LEN} bytes — disable "
@@ -338,18 +453,73 @@ def pack_batch(
     # minus one intermediate array per column (range checks above already
     # guarantee the narrowing is lossless).
     fields: Dict[str, np.ndarray] = {
-        "partition": batch.partition,
-        "key_len": batch.key_len,
-        "value_len": batch.value_len,
-        "flags": (
-            batch.key_null.astype(np.uint8) | (batch.value_null.astype(np.uint8) << 1)
-        ),
         "ts_minmax": ts_minmax_table(
             batch.partition[:n_valid], batch.ts_s[:n_valid],
             config.num_partitions,
         ),
         "sz_minmax": sz_minmax_table(batch, n_valid, config.num_partitions),
     }
+    if config.wire_format == 5:
+        # The combiner reduction: fold the four per-record columns down to
+        # the per-partition delta tables the device would have scattered
+        # them into — the exact contrib stack of ops/counters.py (and the
+        # CPU oracle), pre-added by partition on the host.
+        part = batch.partition[:n_valid]
+        kn = ~batch.key_null[:n_valid]
+        vn = ~batch.value_null[:n_valid]
+        k_bytes = np.where(kn, batch.key_len[:n_valid], 0).astype(np.int64)
+        v_bytes = np.where(vn, batch.value_len[:n_valid], 0).astype(np.int64)
+        counts = np.zeros((config.num_partitions, 7), dtype=np.int64)
+        if n_valid:
+            contrib = np.stack(
+                [
+                    np.ones(n_valid, dtype=np.int64),
+                    (~vn).astype(np.int64),  # tombstones
+                    vn.astype(np.int64),     # alive
+                    (~kn).astype(np.int64),  # key_null
+                    kn.astype(np.int64),     # key_non_null
+                    k_bytes,
+                    v_bytes,
+                ],
+                axis=1,
+            )
+            np.add.at(counts, part, contrib)
+        fields["counts"] = counts.reshape(-1)
+        if config.enable_quantiles:
+            from kafka_topic_analyzer_tpu.ops.ddsketch import (
+                ddsketch_bucket_numpy,
+                ddsketch_num_buckets,
+            )
+
+            nb = ddsketch_num_buckets(config.quantile_buckets)
+            q_rows = (
+                config.num_partitions if config.quantiles_per_partition else 1
+            )
+            qtable = np.zeros(q_rows * nb, dtype=np.int64)
+            if n_valid and vn.any():
+                # Quantiles run over sized (non-tombstone) messages, like
+                # the size extremes; buckets come from the shared integer
+                # edge table so host and device can never disagree.
+                sizes = (k_bytes + v_bytes)[vn]
+                idx = ddsketch_bucket_numpy(
+                    sizes, config.quantile_gamma, config.quantile_buckets
+                )
+                if q_rows > 1:
+                    idx = part[vn].astype(np.int64) * nb + idx
+                np.add.at(qtable, idx, 1)
+            fields["qcounts"] = qtable
+    else:
+        fields.update(
+            {
+                "partition": batch.partition,
+                "key_len": batch.key_len,
+                "value_len": batch.value_len,
+                "flags": (
+                    batch.key_null.astype(np.uint8)
+                    | (batch.value_null.astype(np.uint8) << 1)
+                ),
+            }
+        )
     if config.count_alive_keys:
         active = batch.valid & ~batch.key_null
         alive = batch.valid & ~batch.value_null
@@ -369,8 +539,9 @@ def pack_batch(
     if config.enable_hll:
         active = batch.valid & ~batch.key_null
         idx, rho = hll_idx_rho_numpy(batch.key_hash64, active, config.hll_p)
-        rows = hll_table_rows(config, b)
-        if rows:
+        mode = hll_wire_mode(config, b)
+        if mode == 2:
+            rows = hll_table_rows(config, b)
             table = np.zeros(rows << config.hll_p, dtype=np.uint8)
             if n_valid:
                 # rho is 0 for masked/null-key records — a no-op under max.
@@ -382,6 +553,19 @@ def pack_batch(
                     )
                 np.maximum.at(table, flat, rho[:n_valid])
             fields["hll_regs"] = table
+        elif mode == 3:
+            # v5 flat pairs: the register row travels inside the index
+            # (partition << p | bucket) because the partition column no
+            # longer ships.  Inactive records stay (0, 0) — a no-op
+            # under the flat scatter-max exactly like v4's pair rule.
+            idx32 = np.where(
+                active,
+                (batch.partition.astype(np.int64) << config.hll_p)
+                | idx.astype(np.int64),
+                0,
+            ).astype(np.uint32)
+            fields["hll_idx32"] = idx32
+            fields["hll_rho"] = rho
         else:
             fields["hll_idx"] = idx
             fields["hll_rho"] = rho
@@ -846,13 +1030,24 @@ def unpack_numpy(buf: np.ndarray, config: AnalyzerConfig) -> Dict[str, np.ndarra
         nbytes = np.dtype(dtype).itemsize * count
         out[name] = buf[pos : pos + nbytes].view(dtype)
         pos += nbytes
-    flags = out.pop("flags")
-    out["key_null"] = (flags & 1).astype(bool)
-    out["value_null"] = (flags & 2).astype(bool)
-    out["valid"] = np.arange(b, dtype=np.int32) < out["n_valid"]
-    out["partition"] = out["partition"].astype(np.int32)
-    out["key_len"] = out["key_len"].astype(np.int32)
-    out["value_len"] = out["value_len"].astype(np.int32)
+    if config.wire_format == 5:
+        out["counts"] = out["counts"].reshape(config.num_partitions, 7)
+        if "qcounts" in out:
+            from kafka_topic_analyzer_tpu.ops.ddsketch import (
+                ddsketch_num_buckets,
+            )
+
+            out["qcounts"] = out["qcounts"].reshape(
+                -1, ddsketch_num_buckets(config.quantile_buckets)
+            )
+    else:
+        flags = out.pop("flags")
+        out["key_null"] = (flags & 1).astype(bool)
+        out["value_null"] = (flags & 2).astype(bool)
+        out["valid"] = np.arange(b, dtype=np.int32) < out["n_valid"]
+        out["partition"] = out["partition"].astype(np.int32)
+        out["key_len"] = out["key_len"].astype(np.int32)
+        out["value_len"] = out["value_len"].astype(np.int32)
     tm = out.pop("ts_minmax")
     out["ts_min"] = tm[: config.num_partitions]
     out["ts_max"] = tm[config.num_partitions :]
@@ -891,6 +1086,24 @@ def unpack_device(buf, config: AnalyzerConfig):
         nbytes = np.dtype(dtype).itemsize * count
         out[name] = cast(buf[pos : pos + nbytes], dtype)
         pos += nbytes
+
+    if config.wire_format == 5:
+        out["counts"] = out["counts"].reshape(config.num_partitions, 7)
+        if "qcounts" in out:
+            from kafka_topic_analyzer_tpu.ops.ddsketch import (
+                ddsketch_num_buckets,
+            )
+
+            out["qcounts"] = out["qcounts"].reshape(
+                -1, ddsketch_num_buckets(config.quantile_buckets)
+            )
+        tm = out.pop("ts_minmax")
+        out["ts_min"] = tm[: config.num_partitions]
+        out["ts_max"] = tm[config.num_partitions :]
+        sm = out.pop("sz_minmax")
+        out["sz_min"] = sm[: config.num_partitions]
+        out["sz_max"] = sm[config.num_partitions :]
+        return out
 
     iota = jnp.arange(b, dtype=jnp.int32)
     valid = iota < out["n_valid"]

@@ -54,6 +54,7 @@ from kafka_topic_analyzer_tpu.jax_support import jnp, lax, shard_map
 from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState
 from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState
 from kafka_topic_analyzer_tpu.models.state import AnalyzerState
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.ops.bitmap import bitmap_num_words
 from kafka_topic_analyzer_tpu.parallel.mesh import DATA_AXIS, SPACE_AXIS, make_mesh
 from kafka_topic_analyzer_tpu.records import RecordBatch
@@ -196,7 +197,13 @@ class ShardedTpuBackend(MetricBackend):
             raise ValueError(
                 "batch_size must divide evenly into space_shards chunks"
             )
-        if config.use_pallas_counters and config.chunk_size % 1024:
+        if (
+            config.use_pallas_counters
+            and config.wire_format == 4
+            and config.chunk_size % 1024
+        ):
+            # v4 MXU-kernel block constraint only; the v5 table merge
+            # (pallas_counters_merge) pads any shape internally.
             raise ValueError(
                 "use_pallas_counters requires a per-space-shard chunk "
                 "(batch_size / space_shards) that is a multiple of 1024"
@@ -436,6 +443,7 @@ class ShardedTpuBackend(MetricBackend):
             b.chunks if isinstance(b, PackedShard) else self._pack_chunks(b)
             for b in (batches[r] for r in self.local_rows)
         ])  # [local_rows, S, chunk_nbytes]
+        obs_metrics.WIRE_BYTES.inc(int(per_shard.nbytes))  # this process's rows
         if self._multiprocess:
             bufs = jax.make_array_from_process_local_data(
                 self._buf_sharding,
@@ -487,6 +495,7 @@ class ShardedTpuBackend(MetricBackend):
                 )
             for i in range(len(rounds), k):
                 np.copyto(stacked[i], self._empty_chunks)
+        obs_metrics.WIRE_BYTES.inc(int(stacked.nbytes))  # this process's rows
         if self._multiprocess:
             bufs = jax.make_array_from_process_local_data(
                 self._superbuf_sharding,

@@ -176,6 +176,7 @@ def render_telemetry_stats(
     ingest_workers_per_controller: "Optional[List[int]]" = None,
     superbatch_k: int = 1,
     dispatch_depth: int = 1,
+    wire=None,
 ) -> str:
     """``--stats`` telemetry section from a registry snapshot (cluster-wide
     under multi-controller: the engine merges every process's registry
@@ -231,6 +232,19 @@ def render_telemetry_stats(
             f"  segments: {seg.files:,} chunk(s) "
             f"({seg.bytes_mapped / 1e6:,.1f} MB mapped), "
             f"{seg.records:,.0f} records in {seg.batches:,.0f} batches"
+        )
+    # Packed wire-format digest (results.WireStats, engine-built): which
+    # format the scan's device buffers used, the actual bytes/record, and
+    # the fold-table vs per-record split — the v4→v5 combiner saving as a
+    # measured number, not a layout inference.
+    if wire is not None:
+        lines.append(
+            f"  wire-format: v{wire.format}, "
+            f"{wire.bytes_total / 1e6:,.1f} MB packed "
+            f"({wire.bytes_per_record:,.1f} B/record), buffer split "
+            f"{wire.per_record_bytes:,} B per-record + "
+            f"{wire.table_bytes:,} B fold-table per {wire.batch_size:,}"
+            f"-record buffer"
         )
     # Fused ingest digest: rows/records through the one-pass native
     # decode→pack, and — never silently — everything that bypassed it,

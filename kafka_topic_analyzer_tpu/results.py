@@ -131,6 +131,51 @@ class FusedStats:
 
 
 @dataclasses.dataclass
+class WireStats:
+    """Packed host→device wire accounting for one scan (the ``--stats``
+    wire line and the ``--json`` ``wire`` block).  Built by the engine
+    from the backend's config (``packing.section_byte_split`` — the byte
+    split derives from the one layout source, lint rule 7) plus the scan's
+    ``kta_wire_bytes_total`` delta, so the v4→v5 saving is observable per
+    scan, not inferred from the layout."""
+
+    #: Wire format the scan's packed buffers used (4 or 5).
+    format: int
+    #: Records per packed buffer (batch or chunk size).
+    batch_size: int
+    #: Bytes of one packed buffer in per-record sections (scale with B).
+    per_record_bytes: int
+    #: Bytes of one packed buffer in fold-table sections + header
+    #: (constant per buffer — the combiner share).
+    table_bytes: int
+    #: Actual packed bytes this scan dispatched (this process).
+    bytes_total: int = 0
+    #: Valid records the scan folded (denominator for bytes/record).
+    records: int = 0
+
+    @property
+    def packed_nbytes(self) -> int:
+        return self.per_record_bytes + self.table_bytes
+
+    @property
+    def bytes_per_record(self) -> float:
+        if not self.records:
+            return 0.0
+        return self.bytes_total / self.records
+
+    def as_dict(self) -> dict:
+        return {
+            "format": self.format,
+            "batch_size": self.batch_size,
+            "per_record_bytes": self.per_record_bytes,
+            "table_bytes": self.table_bytes,
+            "packed_nbytes": self.packed_nbytes,
+            "bytes_total": self.bytes_total,
+            "bytes_per_record": round(self.bytes_per_record, 2),
+        }
+
+
+@dataclasses.dataclass
 class SegmentStats:
     """Cold-path accounting extracted from a telemetry snapshot
     (`ScanResult.telemetry`): segment chunks the catalog opened, bytes it

@@ -1,5 +1,9 @@
 """Packed transfer (wire format v4 — the layout contract is packing.py's
-module docstring): layout roundtrip, host pre-reductions."""
+module docstring): layout roundtrip, host pre-reductions.
+
+Pinned to ``wire_format=4``: these tests assert the v4 per-record column
+layout specifically.  The v5 combiner layout has its own contract suite
+(tests/test_wire_v5.py), including v4↔v5 scan byte-identity."""
 
 import jax
 import numpy as np
@@ -32,6 +36,7 @@ CFG = AnalyzerConfig(
     alive_bitmap_bits=18,
     enable_hll=True,
     hll_p=10,
+    wire_format=4,
 )
 
 
@@ -244,17 +249,25 @@ def test_pack_rejects_oversize_keys():
 
 
 def test_pack_rejects_oversize_values_only_for_pallas():
-    # The 16 MiB cap exists for the MXU kernel's digit decomposition; the
-    # default scatter path accepts full u32 lengths.  (Exercised directly:
-    # the synthetic generator can only draw 24-bit value lengths.)
+    # The 16 MiB cap exists for the v4 MXU kernel's digit decomposition;
+    # the default scatter path accepts full u32 lengths, and under wire v5
+    # no per-record value length ever reaches a pallas kernel (the counter
+    # fold ships pre-reduced), so only v4+pallas rejects.  (Exercised
+    # directly: the synthetic generator can only draw 24-bit lengths.)
     batch = _batch()
     batch.value_len[3] = 1 << 25
     pack_batch(batch, CFG, use_native=False)  # default path: fine
     pallas_cfg = AnalyzerConfig(
-        num_partitions=5, batch_size=1024, use_pallas_counters=True
+        num_partitions=5, batch_size=1024, use_pallas_counters=True,
+        wire_format=4,
     )
     with pytest.raises(ValueError, match="value length"):
         pack_batch(batch.pad_to(1024), pallas_cfg, use_native=False)
+    v5_cfg = AnalyzerConfig(
+        num_partitions=5, batch_size=1024, use_pallas_counters=True,
+        wire_format=5,
+    )
+    pack_batch(batch.pad_to(1024), v5_cfg, use_native=False)  # v5: fine
 
 
 def test_pack_rejects_non_prefix_valid():

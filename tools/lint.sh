@@ -519,3 +519,76 @@ if failures:
     sys.exit(1)
 print("lint: OK (fused call sites keep a reachable python-chain fallback)")
 EOF
+
+# Seventh rule: wire-layout offsets and dtypes may only be derived from
+# packing._sections (the single layout source for BOTH wire formats).
+# Backends, the native shim glue, the parallel layer, and tests must not
+# hand-carve a packed buffer — a literal-offset slice-and-view would pin
+# one format's layout and silently skew when the section list changes
+# (v4→v5 moved every offset).  AST rule: in the scoped files, (a) no
+# references to packing.HEADER_BYTES (offset arithmetic belongs next to
+# the section list), and (b) no `.view(dtype)` / `frombuffer`-style
+# retyping of a subscript whose slice bounds are integer literals >= 16
+# (the header size — i.e. a hard-coded section offset).
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PKG = pathlib.Path("kafka_topic_analyzer_tpu")
+SCOPE = (
+    sorted((PKG / "backends").glob("*.py"))
+    + sorted((PKG / "parallel").glob("*.py"))
+    + [PKG / "io" / "native.py"]
+    + sorted(pathlib.Path("tests").glob("*.py"))
+)
+
+failures = []
+for path in SCOPE:
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    for node in ast.walk(tree):
+        # (a) HEADER_BYTES belongs to packing.py.
+        if isinstance(node, ast.Name) and node.id == "HEADER_BYTES":
+            failures.append(
+                f"{path}:{node.lineno}: HEADER_BYTES referenced outside "
+                "packing.py — derive section positions from "
+                "packing._sections"
+            )
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "HEADER_BYTES"
+        ):
+            failures.append(
+                f"{path}:{node.lineno}: packing.HEADER_BYTES referenced — "
+                "derive section positions from packing._sections"
+            )
+        # (b) literal-offset slice retyped in place: buf[123:456].view(...)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "view"
+            and isinstance(node.func.value, ast.Subscript)
+            and isinstance(node.func.value.slice, ast.Slice)
+        ):
+            sl = node.func.value.slice
+            bounds = [
+                b.value
+                for b in (sl.lower, sl.upper)
+                if isinstance(b, ast.Constant) and isinstance(b.value, int)
+            ]
+            if any(b >= 16 for b in bounds):
+                failures.append(
+                    f"{path}:{node.lineno}: hard-coded wire offset "
+                    "(literal slice + .view) — derive offsets from "
+                    "packing._sections / unpack_numpy"
+                )
+
+if failures:
+    print("lint: wire-layout offsets hard-coded outside packing._sections")
+    print("lint: (the section list is the single layout source — wire v4")
+    print("lint: AND v5; see packing.py module docstring / DESIGN.md §16):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (wire offsets derive only from packing._sections)")
+EOF
