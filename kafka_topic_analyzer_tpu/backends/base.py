@@ -43,10 +43,25 @@ class DispatchQueue:
 
     def throttle(self) -> None:
         """Block until fewer than ``depth`` dispatches are in flight —
-        call BEFORE staging the device transfer of the next superbatch."""
+        call BEFORE staging the device transfer of the next superbatch.
+
+        Time spent blocked here is booked on
+        ``kta_dispatch_throttle_seconds_total`` UNCONDITIONALLY (flight
+        recorder on or off): this wait is the backpressure at the launch
+        site, and the one signal that directly decides dispatch-bound vs
+        ingest-bound (obs/doctor.py) — an unbooked stall here made every
+        manual bench ledger reconstruct it from residuals."""
         self._reap()
-        while len(self._inflight) >= self.depth:
-            self._retire(block=True)
+        if len(self._inflight) < self.depth:
+            return
+        t0 = time.perf_counter()
+        try:
+            while len(self._inflight) >= self.depth:
+                self._retire(block=True)
+        finally:
+            obs_metrics.DISPATCH_THROTTLE_SECONDS.inc(
+                time.perf_counter() - t0
+            )
 
     def launched(self, token, batches: int) -> None:
         """Record a dispatch just launched.  ``token`` must be a device

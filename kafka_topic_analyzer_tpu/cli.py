@@ -237,6 +237,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Write a Chrome trace-event JSON of host-side scan "
                         "spans (fetch/decode/stages) to FILE; combine with "
                         "--profile-dir for the XLA timeline")
+    p.add_argument("--flight-record", action="store_true",
+                   help="Run the pipeline flight recorder: a low-overhead "
+                        "sampler records per-stage occupancy time series "
+                        "(ingest/dispatch/snapshot occupancy, worker "
+                        "stalls, queue depths, throttle waits) while the "
+                        "scan runs. Adds windowed verdicts to the --stats "
+                        "BOTTLENECK digest, counter tracks to --trace-json, "
+                        "and serves the ring-buffered series at /flight on "
+                        "--metrics-port. The bottleneck verdict itself is "
+                        "always computed — the recorder adds the timeline")
     p.add_argument("--check-crcs", action="store_true",
                    help="Verify record-batch checksums (CRC32-C) while "
                         "decoding, like librdkafka's check.crcs. Without it, "
@@ -436,6 +446,16 @@ def _attach_wire_digest(doc: dict, result) -> None:
         doc["wire"] = result.wire.as_dict()
 
 
+def _attach_flight_digest(doc: dict, diagnosis) -> None:
+    """--json flight block: the doctor's verdict, per-stage occupancy,
+    evidence, and windowed timeline (obs.doctor.Diagnosis).  Always
+    attached — the verdict derives from always-booked counters; the
+    window fields are empty unless --flight-record sampled the scan.
+    The raw ring series is deliberately NOT embedded (it can run to
+    thousands of samples); /flight on --metrics-port serves it."""
+    doc["flight"] = diagnosis.as_dict()
+
+
 def _attach_segment_digest(doc: dict, result) -> None:
     """--json cold-path digest: when the scan read from a segment store,
     surface what the catalog opened and how much came off the mapped
@@ -449,15 +469,42 @@ def _attach_segment_digest(doc: dict, result) -> None:
         doc["segments"] = seg.as_dict()
 
 
-def _print_stats(args, result) -> None:
-    """--stats stderr dump: per-stage profile + the telemetry counter
-    digest (cluster-wide under multi-controller)."""
+def _diagnose(result):
+    """Scan-doctor attribution for a finished scan: computed from the
+    SAME merged snapshot ``--json`` embeds (fleet-wide under
+    multi-controller), plus the flight recorder's series when one ran."""
+    from kafka_topic_analyzer_tpu.obs import doctor, flight
+
+    rec = flight.active()
+    if rec is not None:
+        # Close the timeline before reading it: the session-owned
+        # recorder is still sampling here (teardown stops it later), and
+        # a scan shorter than the sampling interval would otherwise
+        # diagnose from an empty series.
+        rec.sample_once()
+    return doctor.diagnose(
+        result.telemetry,
+        controllers=max(1, len(result.ingest_workers_per_controller)),
+        dispatch_depth=result.dispatch_depth,
+        flight=rec.series() if rec is not None else None,
+    )
+
+
+def _print_stats(args, result, diagnosis=None) -> None:
+    """--stats stderr dump: per-stage digest + telemetry counters + the
+    doctor's BOTTLENECK attribution (cluster-wide under multi-controller).
+    Stage timings render ONCE, from the registry snapshot — the same
+    source the doctor attributes from — not from the in-process profile
+    (which under multi-controller only knew this process's stages)."""
     if not args.stats:
         return
-    from kafka_topic_analyzer_tpu.report import render_telemetry_stats
+    from kafka_topic_analyzer_tpu.report import (
+        render_bottleneck,
+        render_stage_stats,
+        render_telemetry_stats,
+    )
 
-    print("scan stages:", file=sys.stderr)
-    print(result.profile.summary(), file=sys.stderr)
+    sys.stderr.write(render_stage_stats(result.telemetry))
     sys.stderr.write(
         render_telemetry_stats(
             result.telemetry,
@@ -468,6 +515,11 @@ def _print_stats(args, result) -> None:
             superbatch_k=result.superbatch_k,
             dispatch_depth=result.dispatch_depth,
             wire=result.wire,
+        )
+    )
+    sys.stderr.write(
+        render_bottleneck(
+            diagnosis if diagnosis is not None else _diagnose(result)
         )
     )
 
@@ -604,7 +656,10 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             start_at=start_at,
             ingest_workers=ingest_workers,
         )
-    _print_stats(args, result)
+    # Only the --stats digest and the --json flight block consume the
+    # diagnosis; the plain report path skips the doctor pass entirely.
+    diagnosis = _diagnose(result) if (args.stats or args.json) else None
+    _print_stats(args, result, diagnosis)
     multi.close()  # flush per-topic segment dumps, release connections
     if _not_report_process(args):
         return _scan_issue_exit(result)  # multi-host: one report, from process 0
@@ -653,6 +708,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         doc["telemetry"] = result.telemetry
         _attach_segment_digest(doc, result)
         _attach_wire_digest(doc, result)
+        _attach_flight_digest(doc, diagnosis)
         # Degraded keys are dense fan-in rows; reasons carry topic/partition.
         rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))
@@ -711,6 +767,7 @@ def main(argv: "list[str] | None" = None) -> int:
             metrics_port=args.metrics_port,
             events_jsonl=args.events_jsonl,
             trace_json=args.trace_json,
+            flight_record=args.flight_record,
         ):
             return _run(args)
     except (OSError, KafkaProtocolError) as e:
@@ -797,7 +854,10 @@ def _run(args) -> int:
             start_at=start_at,
             ingest_workers=ingest_workers,
         )
-    _print_stats(args, result)
+    # Only the --stats digest and the --json flight block consume the
+    # diagnosis; the plain report path skips the doctor pass entirely.
+    diagnosis = _diagnose(result) if (args.stats or args.json) else None
+    _print_stats(args, result, diagnosis)
     if hasattr(source, "close"):
         source.close()  # flush segment dumps, release broker connections
     if _not_report_process(args):
@@ -821,6 +881,7 @@ def _run(args) -> int:
         doc["telemetry"] = result.telemetry
         _attach_segment_digest(doc, result)
         _attach_wire_digest(doc, result)
+        _attach_flight_digest(doc, diagnosis)
         rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))
         return rc

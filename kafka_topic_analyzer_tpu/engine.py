@@ -500,6 +500,9 @@ def run_scan(
             seq += nvalid
             pend["offsets"] = dict(tracker.next_offsets)
             pend["seq"] = seq
+            # Staging fill level (0..K) — the flight recorder's stager
+            # track: how far the next superbatch has accumulated.
+            obs_metrics.SUPERBATCH_FILL.set(len(pend["items"]))
             if len(pend["items"]) == super_k:
                 flush()
 
@@ -520,6 +523,7 @@ def run_scan(
             pend["items"] = []
             pend["valid"] = 0
             pend["nbytes"] = 0
+            obs_metrics.SUPERBATCH_FILL.set(0)
             committed_offsets = pend["offsets"]
             committed_seq = pend["seq"]
             maybe_snapshot(
@@ -955,7 +959,10 @@ def run_scan(
     # it here would double it under the gauge's merge="sum" policy.
     local_degraded = sum(1 for p in degraded if p >= 0)
     obs_metrics.DEGRADED_PARTITIONS.set(local_degraded)
-    obs_metrics.record_profile(profile)
+    # (Stage seconds/records/bytes are already in the registry: the
+    # profile books them live at every stage window exit, so the flight
+    # recorder and the gather below see the same totals — no end-of-scan
+    # record_profile fold.)
     obs_events.emit(
         "scan_end",
         topic=topic,

@@ -55,6 +55,7 @@ import logging
 import socket
 import struct
 import threading
+from time import perf_counter as _perf_counter
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -1298,8 +1299,12 @@ class KafkaWireSource(RecordSource):
                     pmax_sent,
                 )
             conn, corr, sent_offsets, order, pmax_sent = fl
+            _t_fetch = _perf_counter()
             with obs_trace.maybe_span("fetch", cat="io"):
                 r = conn.read_response(corr)
+            # Same window as the span, booked per fetch round — the
+            # flight recorder's source-wait track (obs/doctor.py).
+            obs_metrics.FETCH_SECONDS.inc(_perf_counter() - _t_fetch)
             fps = kc.decode_fetch_response(r, self._version(conn, kc.API_FETCH))
             obs_metrics.FETCH_REQUESTS.inc()
             obs_metrics.FETCH_BYTES.inc(
@@ -1377,6 +1382,7 @@ class KafkaWireSource(RecordSource):
             # order (the scan above still powers the send-ahead).
             soas: "Dict[int, tuple]" = {}
             if scans and sink is None:
+                _t_dec = _perf_counter()
                 with obs_trace.maybe_span("decode", cat="io"):
                     for fp in fps:
                         p = fp.partition
@@ -1384,6 +1390,7 @@ class KafkaWireSource(RecordSource):
                             soas[p] = decode_record_set_native(
                                 fp.records, self.verify_crc, prescan=scans[p]
                             )
+                obs_metrics.DECODE_SECONDS.inc(_perf_counter() - _t_dec)
             return (leader, fps, scans, soas, spec_sent, order, pmax_sent)
 
         def fetch_leader_guarded(leader: int, lparts: List[int], fetch_round: int):
@@ -1548,9 +1555,15 @@ class KafkaWireSource(RecordSource):
                         # remainder (compressed/legacy/truncated/
                         # malformed) takes the per-frame chain below,
                         # entering the same rows via push_chunk.
+                        _t_dec = _perf_counter()
                         n_acc, used, covered, last = sink.append_record_set(
                             data, next_offset[p], end[p], p,
                             self.verify_crc, prescan=scans.get(p),
+                        )
+                        # Fused streams skip the phase-1 pre-decode; their
+                        # decode IS this pack, booked on the same counter.
+                        obs_metrics.DECODE_SECONDS.inc(
+                            _perf_counter() - _t_dec
                         )
                         if used:
                             max_frame_end = max(max_frame_end, covered)
@@ -1565,13 +1578,20 @@ class KafkaWireSource(RecordSource):
                         # (already done in phase 1 for clean prefixes);
                         # only the remainder (compressed/legacy/truncated)
                         # takes the per-frame loop below.
-                        soa, used, covered = (
-                            pre
-                            if pre is not None
-                            else decode_record_set_native(
+                        if pre is not None:
+                            soa, used, covered = pre
+                        else:
+                            # Lazy whole-response decode (no phase-1
+                            # prescan): booked on the same counter as the
+                            # pre-decode pass — the doctor's decode
+                            # evidence must see this path too.
+                            _t_dec = _perf_counter()
+                            soa, used, covered = decode_record_set_native(
                                 data, self.verify_crc, prescan=scans.get(p)
                             )
-                        )
+                            obs_metrics.DECODE_SECONDS.inc(
+                                _perf_counter() - _t_dec
+                            )
                         if used:
                             max_frame_end = max(max_frame_end, covered)
                             cnt = accept_records(soa, p)

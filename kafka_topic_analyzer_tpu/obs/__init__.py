@@ -41,6 +41,7 @@ def telemetry_session(
     metrics_port: "Optional[int]" = None,
     events_jsonl: "Optional[str]" = None,
     trace_json: "Optional[str]" = None,
+    flight_record: bool = False,
 ) -> "Iterator[Optional[SpanTracer]]":
     """Wire up the flag-selected telemetry outputs around a scan.
 
@@ -48,6 +49,15 @@ def telemetry_session(
     ``run_scan``'s profile to mirror stages into.  On exit the trace file
     is written, the event log closed, and the scrape endpoint shut down —
     the endpoint therefore serves while the scan runs.
+
+    ``flight_record`` starts the occupancy sampler (obs/flight.py) as the
+    process-wide active recorder for the session: the ``/flight``
+    endpoint, the Chrome counter tracks, and the ``--stats`` windowed
+    verdict lines all read it.  The recorder keeps sampling until
+    teardown (so readers inside the session — the report code — see a
+    LIVE series and take their own closing ``sample_once()`` if they
+    need the final state; cli._diagnose does); teardown then stops the
+    thread and clears ``active()``.
 
     Output paths are opened (and truncated, for the trace) at setup so a
     bad ``--trace-json``/``--events-jsonl`` path fails before the scan,
@@ -57,11 +67,13 @@ def telemetry_session(
     import sys
 
     from kafka_topic_analyzer_tpu.obs import events as _events
+    from kafka_topic_analyzer_tpu.obs import flight as _flight
     from kafka_topic_analyzer_tpu.obs import trace as _trace
 
     exporter = None
     sink = None
     tracer = None
+    recorder = None
     try:
         if metrics_port is not None:
             from kafka_topic_analyzer_tpu.obs.exporters import (
@@ -84,8 +96,19 @@ def telemetry_session(
                 pass  # fail fast on an unwritable path; write() re-opens
             tracer = SpanTracer()
             _trace.set_active(tracer)
+        if flight_record:
+            # After the tracer: the recorder mirrors its instantaneous
+            # tracks onto the active tracer as Chrome counter events.
+            recorder = _flight.FlightRecorder()
+            _flight.set_active(recorder)
+            recorder.start()
         yield tracer
     finally:
+        if recorder is not None:
+            try:
+                recorder.stop()  # final sample; series stays readable
+            finally:
+                _flight.set_active(None)
         if tracer is not None:
             _trace.set_active(None)
         try:

@@ -5,6 +5,12 @@ v0.0.4) while the scan runs — scrapes render a fresh registry snapshot
 per request, so a dashboard pointed at ``--metrics-port`` watches
 throughput, retries, and per-partition lag live.  Port 0 binds an
 ephemeral port (``.port`` reports the bound one — tests use this).
+
+``/flight`` serves the flight recorder's ring-buffered occupancy time
+series as JSON while ``--flight-record`` is active (404 otherwise):
+Prometheus scrapes sample the *instant*; the flight series carries the
+whole scan's per-stage history at the recorder's resolution, which is
+what the doctor's windowed verdicts and any post-hoc notebook need.
 """
 
 from __future__ import annotations
@@ -26,16 +32,35 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
-    def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
-        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
-            self.send_error(404, "try /metrics")
-            return
-        body = render_prometheus(self.server.registry.snapshot()).encode()
+    def _respond(self, body: bytes, content_type: str) -> None:
         self.send_response(200)
-        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
+        path = self.path.split("?", 1)[0]
+        if path == "/flight":
+            import json
+
+            from kafka_topic_analyzer_tpu.obs import flight as _flight
+
+            rec = _flight.active()
+            if rec is None:
+                self.send_error(
+                    404, "no flight recorder (run with --flight-record)"
+                )
+                return
+            self._respond(
+                json.dumps(rec.series()).encode(), "application/json"
+            )
+            return
+        if path not in ("/metrics", "/"):
+            self.send_error(404, "try /metrics or /flight")
+            return
+        body = render_prometheus(self.server.registry.snapshot()).encode()
+        self._respond(body, CONTENT_TYPE)
 
     def log_message(self, format: str, *args) -> None:
         log.debug("metrics scrape: " + format, *args)

@@ -33,20 +33,34 @@ BATCH_RECORDS = _REG.histogram(
     buckets=BATCH_SIZE_BUCKETS)
 STAGE_SECONDS = _REG.counter(
     "kta_stage_seconds_total",
-    "Cumulative wall seconds per scan stage (ScanProfile)",
+    "Cumulative wall seconds per scan stage, booked LIVE at every stage "
+    "window exit (utils/profiling.ScanProfile) so the flight recorder can "
+    "sample per-stage occupancy mid-scan",
     labelnames=("stage",))
 STAGE_RECORDS = _REG.counter(
     "kta_stage_records_total",
-    "Records attributed per scan stage (ScanProfile)",
+    "Records attributed per scan stage (ScanProfile, booked live)",
+    labelnames=("stage",))
+STAGE_BYTES = _REG.counter(
+    "kta_stage_bytes_total",
+    "Decoded bytes attributed per scan stage (ScanProfile, booked live) — "
+    "what makes the snapshot-sourced --stats stage digest carry the same "
+    "MB/s the old in-process profile summary did",
     labelnames=("stage",))
 PARTITION_LAG = _REG.gauge(
     "kta_partition_lag",
     "Records between the scan position and the end watermark",
-    labelnames=("partition",))
+    labelnames=("partition",),
+    # Each process feeds (and therefore lags on) a disjoint partition set,
+    # so the cross-process merge is a label union; max is the no-op policy
+    # for the union case and the honest one if labels ever collide.
+    merge="max")
 PARTITION_ETA_SECONDS = _REG.gauge(
     "kta_partition_eta_seconds",
     "Projected seconds to drain the partition at the current scan rate",
-    labelnames=("partition",))
+    labelnames=("partition",),
+    # Disjoint per-process label sets (see kta_partition_lag).
+    merge="max")
 SNAPSHOTS_SAVED = _REG.counter(
     "kta_snapshots_saved_total", "Resumable scan snapshots written")
 DEGRADED_PARTITIONS = _REG.gauge(
@@ -84,6 +98,14 @@ INGEST_WORKER_STALL_SECONDS = _REG.counter(
     "kta_ingest_worker_stall_seconds_total",
     "Seconds each parallel-ingest worker spent blocked on its full "
     "fan-in queue (backpressure from the merge loop/device)",
+    labelnames=("worker",))
+INGEST_WORKER_ACTIVE_SECONDS = _REG.counter(
+    "kta_ingest_worker_active_seconds_total",
+    "Thread-lifetime seconds per parallel-ingest worker (stream open to "
+    "stream exhausted/cancelled).  The denominator for a worker's busy "
+    "fraction: busy = (active - stall) / active — a worker whose "
+    "partitions drained early must not read as 'stalled' for the rest "
+    "of the scan (obs/doctor.py)",
     labelnames=("worker",))
 
 # -- cold segment path (io/segfile.py + io/segstore.py) -----------------------
@@ -143,6 +165,17 @@ FETCH_REQUESTS = _REG.counter(
     "kta_fetch_requests_total", "Fetch responses read from brokers")
 FETCH_BYTES = _REG.counter(
     "kta_fetch_bytes_total", "Record-set bytes carried by fetch responses")
+FETCH_SECONDS = _REG.counter(
+    "kta_fetch_seconds_total",
+    "Seconds spent blocked reading fetch responses off broker sockets "
+    "(the wire scan's source-wait side — booked per fetch round, on the "
+    "fetching thread, mirroring the 'fetch' trace span)")
+DECODE_SECONDS = _REG.counter(
+    "kta_decode_seconds_total",
+    "Seconds spent in record-set decode: the native whole-response "
+    "pre-decode pass and the fused decode→pack appends (booked per fetch "
+    "round; python per-frame fallback decoding is not timed — it shares "
+    "the round with masking/state bookkeeping)")
 FETCH_ERRORS = _REG.counter(
     "kta_fetch_errors_total",
     "Per-partition Kafka protocol errors in fetch responses")
@@ -189,7 +222,30 @@ RETRY_BUDGET_EXHAUSTIONS = _REG.counter(
 DISPATCH_INFLIGHT = _REG.gauge(
     "kta_dispatch_inflight",
     "Superbatch dispatches launched but not yet retired (bounded by "
-    "--dispatch-depth; 0 when the device keeps up)")
+    "--dispatch-depth; 0 when the device keeps up)",
+    # Each process runs its own dispatch queue over its own device rows;
+    # the fleet's in-flight figure is their sum, not the worst one.
+    merge="sum")
+DISPATCH_THROTTLE_SECONDS = _REG.counter(
+    "kta_dispatch_throttle_seconds_total",
+    "Seconds the drive loop spent blocked in DispatchQueue.throttle "
+    "waiting for an in-flight superbatch to retire — the backpressure "
+    "wait at the launch site, and the one signal that directly separates "
+    "dispatch-bound from ingest-bound scans (booked unconditionally, "
+    "flight recorder on or off)")
+SUPERBATCH_FILL = _REG.gauge(
+    "kta_superbatch_fill",
+    "Packed batches accumulated toward the next superbatch dispatch "
+    "(0..K; the staging fill level of the current stager ring slot)",
+    # Same-quantity gauge across processes (every controller fills its
+    # rows in lockstep rounds): report the fleet's fullest pending stack.
+    merge="max")
+STAGER_SLOTS = _REG.counter(
+    "kta_stager_slots_total",
+    "Superbatch stager ring slots handed out for assembly "
+    "(packing.SuperbatchStager.next_slot) — with kta_dispatch_inflight, "
+    "the ring-occupancy signal: slots in use = in-flight dispatches + "
+    "the slot being assembled")
 SUPERBATCH_SIZE = _REG.histogram(
     "kta_superbatch_size",
     "Packed batches folded per device dispatch (K, or the partial tail)",
@@ -211,11 +267,9 @@ BACKEND_FINALIZE_SECONDS = _REG.histogram(
     "Backend finalize (device sync + collective merge) latency",
     buckets=LATENCY_BUCKETS_S)
 
+# -- flight recorder (obs/flight.py) ------------------------------------------
 
-def record_profile(profile) -> None:
-    """Fold a finished ScanProfile into the stage counters, so the
-    Prometheus/JSON view carries the same per-stage seconds as --stats."""
-    for name, st in profile.stages.items():
-        STAGE_SECONDS.labels(stage=name).inc(st.seconds)
-        if st.items:
-            STAGE_RECORDS.labels(stage=name).inc(st.items)
+FLIGHT_SAMPLES = _REG.counter(
+    "kta_flight_samples_total",
+    "Occupancy samples the flight recorder took (--flight-record) — the "
+    "recorder's own cost stays auditable in the data it records")

@@ -56,6 +56,28 @@ class SpanTracer:
         with self._lock:
             self._events.append(ev)
 
+    def add_counter(
+        self, name: str, values: "dict", t_s: "Optional[float]" = None
+    ) -> None:
+        """Record one counter-track sample (``ph: "C"``): the flight
+        recorder's occupancy gauges render as stacked numeric lanes under
+        the stage spans in chrome://tracing / Perfetto.  ``t_s`` is in
+        this tracer's clock domain, like ``add_complete``; None stamps
+        the sample "now" (callers on a different clock — the flight
+        recorder's injectable monotonic — must not translate domains).
+        ``values`` maps series name -> number (one lane per key)."""
+        ev = {
+            "name": name,
+            "cat": "flight",
+            "ph": "C",
+            "ts": ((self._clock() if t_s is None else t_s) - self._t0) * 1e6,
+            "pid": os.getpid(),
+            "tid": 0,  # counter tracks live on one lane, not per-thread
+            "args": {k: float(v) for k, v in values.items()},
+        }
+        with self._lock:
+            self._events.append(ev)
+
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "span", **args) -> Iterator[None]:
         t0 = self._clock()

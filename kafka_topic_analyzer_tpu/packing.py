@@ -966,8 +966,14 @@ class SuperbatchStager:
         """The ``uint8[(K,) + row_shape]`` host array to assemble the next
         superbatch into.  Rotates the ring; see the class docstring for
         why the returned memory is quiescent."""
+        from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+
         slot = self._ring[self._next]
         self._next = (self._next + 1) % len(self._ring)
+        # Ring-activity booking for the flight recorder: slots in use at
+        # any instant = kta_dispatch_inflight + 1 (this one), and the
+        # slot hand-out rate is the superbatch assembly rate.
+        obs_metrics.STAGER_SLOTS.inc()
         return slot
 
 

@@ -188,6 +188,9 @@ class _IngestWorker(threading.Thread):
         self._stall = obs_metrics.INGEST_WORKER_STALL_SECONDS.labels(
             worker=wid
         )
+        self._active = obs_metrics.INGEST_WORKER_ACTIVE_SECONDS.labels(
+            worker=wid
+        )
 
     def _put(self, item: object) -> bool:
         """Bounded put; gives up when the consumer cancelled.  Time spent
@@ -218,6 +221,10 @@ class _IngestWorker(threading.Thread):
             self._stall.inc(time.perf_counter() - t0)
 
     def run(self) -> None:
+        # Lifetime booking brackets the whole stream: busy fraction =
+        # (active - stall) / active stays honest for workers whose
+        # partitions drain early (obs/doctor.py reads both counters).
+        t_run = time.perf_counter()
         try:
             for batch in self._it:
                 if isinstance(batch, PackedRow):
@@ -232,6 +239,7 @@ class _IngestWorker(threading.Thread):
             self._put(_Error(e))
             return
         finally:
+            self._active.inc(time.perf_counter() - t_run)
             if self._cancel.is_set():
                 self.close_source()
         self._put(_SENTINEL)

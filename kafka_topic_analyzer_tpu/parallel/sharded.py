@@ -551,7 +551,14 @@ class ShardedTpuBackend(MetricBackend):
         data rows contributes R identical copies).  Collective — every
         process must call it at the same point (the engine does, in
         ``run_scan``'s tail); the report process then folds the list with
-        ``obs.registry.merge_snapshots`` into the cluster-wide view."""
+        ``obs.registry.merge_snapshots`` into the cluster-wide view.
+
+        This same merge is what gives mesh scans a FLEET-WIDE bottleneck
+        verdict: every occupancy signal the scan doctor attributes from
+        (live stage seconds, throttle waits, worker stall/active seconds,
+        fetch/decode seconds — obs/doctor.py) is a counter, and counters
+        sum across this gather, so process 0's digest attributes the
+        whole fleet without shipping any flight-recorder series."""
         import json
 
         from kafka_topic_analyzer_tpu.obs.registry import default_registry

@@ -170,6 +170,69 @@ def _worker_sort_key(label: str):
     ]
 
 
+def render_stage_stats(snapshot: Optional[Dict]) -> str:
+    """``--stats`` per-stage digest from the registry snapshot — the SAME
+    source the scan doctor attributes from (results.StageDigest), so the
+    stage timings a human reads and the verdict's inputs can never drift.
+    Replaces the old in-process ``ScanProfile.summary()`` print: under
+    multi-controller these are fleet totals from the gathered merge."""
+    from kafka_topic_analyzer_tpu.results import StageDigest
+
+    digest = StageDigest.from_telemetry(snapshot)
+    if not digest.stages:
+        return ""
+    lines = ["scan stages:"]
+    for name, (secs, items, nbytes) in digest.stages.items():
+        line = f"  {name}: {secs:.3f}s, {items} records"
+        if items and secs > 0:
+            line += f" ({items / secs:,.0f}/s)"
+        if nbytes:
+            line += f", {nbytes / 1e6:,.1f} MB"
+            if secs > 0:
+                line += f" ({nbytes / secs / 1e6:,.1f} MB/s)"
+        lines.append(line)
+    return "\n".join(lines) + "\n"
+
+
+def render_bottleneck(diagnosis) -> str:
+    """``--stats`` BOTTLENECK digest from an obs.doctor.Diagnosis: the
+    ranked verdict, the per-stage occupancy it was computed from, the
+    queue-theory evidence, and (when a flight recorder ran) the windowed
+    verdict timeline — the shipped replacement for the hand-built
+    BENCH_NOTES ledger procedure."""
+    if diagnosis is None:
+        return ""
+    pct = lambda v: f"{v * 100.0:.0f}%"  # noqa: E731
+    lines = [f"BOTTLENECK: {diagnosis.verdict} — {diagnosis.rationale}"]
+    if diagnosis.stages:
+        lines.append(
+            "  occupancy: "
+            + " | ".join(
+                f"{s} {pct(v)}" for s, v in diagnosis.stages.items()
+            )
+        )
+    if diagnosis.evidence:
+        lines.append(
+            "  evidence: "
+            + " | ".join(
+                f"{k.replace('_', '-')} {pct(v)}"
+                for k, v in sorted(diagnosis.evidence.items())
+            )
+        )
+    if diagnosis.window_share:
+        lines.append(
+            "  windows: "
+            + " | ".join(
+                f"{v} {pct(share)}"
+                for v, share in sorted(
+                    diagnosis.window_share.items(),
+                    key=lambda kv: -kv[1],
+                )
+            )
+        )
+    return "\n".join(lines) + "\n"
+
+
 def render_telemetry_stats(
     snapshot: Optional[Dict],
     ingest_workers: int = 1,

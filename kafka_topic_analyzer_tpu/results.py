@@ -261,6 +261,50 @@ class DispatchStats:
 
 
 @dataclasses.dataclass
+class StageDigest:
+    """Per-stage drive-loop accounting extracted from a telemetry
+    snapshot: the live-booked ``kta_stage_{seconds,records,bytes}_total``
+    counters (utils/profiling.ScanProfile books them at every stage
+    window exit).  This is the ONE stage-timings source for the
+    ``--stats`` digest AND the scan doctor (obs/doctor.py) — under
+    multi-controller it renders fleet totals, which the old in-process
+    ``ScanProfile.summary()`` line never could."""
+
+    #: stage -> (seconds, records, bytes), canonical pipeline order.
+    stages: "Dict[str, tuple]"
+
+    @classmethod
+    def from_telemetry(cls, snapshot: "Optional[dict]") -> "StageDigest":
+        from kafka_topic_analyzer_tpu.utils.profiling import STAGE_ORDER
+        def by_stage(name: str) -> "Dict[str, float]":
+            metric = (snapshot or {}).get(name)
+            if not metric:
+                return {}
+            return {
+                s["labels"]["stage"]: s["value"]
+                for s in metric["samples"]
+                if "stage" in s.get("labels", {})
+            }
+
+        secs = by_stage("kta_stage_seconds_total")
+        recs = by_stage("kta_stage_records_total")
+        byts = by_stage("kta_stage_bytes_total")
+        rank = {name: i for i, name in enumerate(STAGE_ORDER)}
+        ordered = sorted(
+            secs, key=lambda s: (rank.get(s, len(STAGE_ORDER)), s)
+        )
+        return cls(
+            stages={
+                s: (secs[s], int(recs.get(s, 0)), int(byts.get(s, 0)))
+                for s in ordered
+                # The flight recorder creates zero-valued stage children
+                # eagerly; an all-zero stage never ran — don't render it.
+                if secs[s] or recs.get(s) or byts.get(s)
+            }
+        )
+
+
+@dataclasses.dataclass
 class TopicMetrics:
     """Finalized topic metrics.
 

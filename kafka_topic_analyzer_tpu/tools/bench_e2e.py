@@ -421,6 +421,13 @@ def run(argv: "list[str] | None" = None) -> int:
                          "On a CPU-platform bench this forces the needed "
                          "virtual device count when jax is not yet "
                          "imported — the mesh x workers sweep referee")
+    ap.add_argument("--flight-record", action="store_true",
+                    help="run the pipeline flight recorder during the "
+                         "scan and print the doctor's BOTTLENECK verdict "
+                         "— the shipped replacement for the manual "
+                         "BENCH_NOTES ledger procedure. Also the overhead "
+                         "referee: an A/B against a run without this flag "
+                         "must stay within 2%% (DESIGN.md §17)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -515,18 +522,33 @@ def run(argv: "list[str] | None" = None) -> int:
         brokers=args.brokers,
     ) as port:
         source = KafkaWireSource(f"127.0.0.1:{port}", "bench-e2e")
-        t0 = time.perf_counter()
-        result = run_scan(
-            "bench-e2e",
-            source,
-            backend,
-            batch_size=args.batch_size,
-            spinner=Spinner(enabled=False),
-            ingest_workers=ingest_workers,
-        )
-        if hasattr(backend, "block_until_ready"):
-            backend.block_until_ready()
-        elapsed = time.perf_counter() - t0
+        recorder = None
+        if args.flight_record:
+            from kafka_topic_analyzer_tpu.obs import flight as obs_flight
+
+            recorder = obs_flight.FlightRecorder()
+            obs_flight.set_active(recorder)
+            recorder.start()
+        try:
+            t0 = time.perf_counter()
+            result = run_scan(
+                "bench-e2e",
+                source,
+                backend,
+                batch_size=args.batch_size,
+                spinner=Spinner(enabled=False),
+                ingest_workers=ingest_workers,
+            )
+            if hasattr(backend, "block_until_ready"):
+                backend.block_until_ready()
+            elapsed = time.perf_counter() - t0
+        finally:
+            # A failing scan (or the count-mismatch early return below)
+            # must not leak a live sampler thread as the process-wide
+            # active recorder; the stopped series stays readable.
+            if recorder is not None:
+                recorder.stop()
+                obs_flight.set_active(None)
         source.close()
 
     got = int(result.metrics.overall_count)
@@ -537,6 +559,16 @@ def run(argv: "list[str] | None" = None) -> int:
         )
         return 1
     value = total / elapsed
+    diagnosis = None
+    if recorder is not None:
+        from kafka_topic_analyzer_tpu.obs import doctor
+
+        diagnosis = doctor.diagnose(
+            result.telemetry,
+            controllers=max(1, len(result.ingest_workers_per_controller)),
+            dispatch_depth=result.dispatch_depth,
+            flight=recorder.series(),
+        )
     if not args.quiet:
         print(
             f"# e2e: {total} records, {args.partitions} partitions, "
@@ -545,6 +577,10 @@ def run(argv: "list[str] | None" = None) -> int:
             file=sys.stderr,
         )
         print(result.profile.summary(), file=sys.stderr)
+        if diagnosis is not None:
+            from kafka_topic_analyzer_tpu.report import render_bottleneck
+
+            sys.stderr.write(render_bottleneck(diagnosis))
     doc = {
         "metric": "e2e_msgs_per_sec",
         "value": round(value),
@@ -557,6 +593,17 @@ def run(argv: "list[str] | None" = None) -> int:
         "mesh": list(mesh_shape),
         "batch_size": args.batch_size,
     }
+    if diagnosis is not None:
+        doc["flight"] = {
+            "verdict": diagnosis.verdict,
+            "stages": {
+                k: round(v, 4) for k, v in diagnosis.stages.items()
+            },
+            "window_share": {
+                k: round(v, 4)
+                for k, v in diagnosis.window_share.items()
+            },
+        }
     if degraded:
         # Same honesty rule as bench.py; --backend cpu runs are deliberate
         # host pipeline measurements and keep their ratio.

@@ -269,6 +269,14 @@ def main(argv: "list[str] | None" = None) -> int:
                          "aggregate wall rate, records/client-CPU-second, "
                          "per-worker rates, and the GIL-stall percentiles "
                          "(scan_gil_stall_*).  0 = skip")
+    ap.add_argument("--flight-record", action="store_true",
+                    help="sample the --workers fan-in drain with the "
+                         "pipeline flight recorder (obs/flight.py) and "
+                         "report the doctor's occupancy evidence (worker "
+                         "busy fraction, queue-empty share).  This bench "
+                         "has no engine drive loop, so there is no stage "
+                         "verdict — the evidence quantifies the ingest "
+                         "ceiling the manual ledger used to eyeball")
     ap.add_argument("--streams", type=int, default=1,
                     help="concurrent loopback drains in ONE process (each "
                          "stream gets its own broker child + wire client + "
@@ -580,6 +588,14 @@ def main(argv: "list[str] | None" = None) -> int:
         )
         runs = []
         for _ in range(max(args.repeat, 1)):
+            if args.flight_record:
+                # The doctor's worker busy/stall evidence reads the
+                # process-global CUMULATIVE counters; without a reset,
+                # repeat N's evidence would blend repeats 1..N-1 (worker
+                # labels recur across runs).  Per-run isolation — the
+                # per_worker accounting below deltas against `before`
+                # either way, so it is reset-agnostic.
+                default_registry().reset()
             with BrokerProcess(
                 topic="bench-ingest-w", partitions=args.partitions,
                 windows=wwindows, R=args.records_per_batch,
@@ -592,6 +608,15 @@ def main(argv: "list[str] | None" = None) -> int:
                 before = IngestStats.from_telemetry(
                     default_registry().snapshot()
                 )
+                recorder = None
+                if args.flight_record:
+                    from kafka_topic_analyzer_tpu.obs import (
+                        flight as obs_flight,
+                    )
+
+                    recorder = obs_flight.FlightRecorder(interval_s=0.05)
+                    obs_flight.set_active(recorder)
+                    recorder.start()
                 sampler = _StallSampler()
                 sampler.start()
                 c0 = os.times()
@@ -607,9 +632,31 @@ def main(argv: "list[str] | None" = None) -> int:
                 finally:
                     pool.close()
                     src.close()
+                    # A failing drain must not leak a live sampler as the
+                    # process-wide active recorder (same rule as
+                    # bench_e2e); the stopped series stays readable.
+                    if recorder is not None:
+                        from kafka_topic_analyzer_tpu.obs import (
+                            flight as obs_flight,
+                        )
+
+                        recorder.stop()
+                        obs_flight.set_active(None)
                 stalls = sampler.finish()
+                flight_evidence = None
+                if recorder is not None:
+                    from kafka_topic_analyzer_tpu.obs import doctor
+
+                    d = doctor.diagnose(
+                        default_registry().snapshot(),
+                        flight=recorder.series(),
+                    )
+                    flight_evidence = {
+                        k: round(v, 4) for k, v in d.evidence.items()
+                    }
             after = IngestStats.from_telemetry(default_registry().snapshot())
             runs.append({
+                "flight": flight_evidence,
                 "got": got, "wall": wall,
                 "user": c1.user - c0.user, "sys": c1.system - c0.system,
                 # Delta vs the pre-run snapshot, restricted to THIS pool's
@@ -639,6 +686,13 @@ def main(argv: "list[str] | None" = None) -> int:
             w: round(n / wall) for w, n in best["per_worker"].items()
         }
         doc.update({f"scan_{k}": v for k, v in best["stalls"].items()})
+        if best.get("flight") is not None:
+            doc["scan_flight_evidence"] = best["flight"]
+            per = ", ".join(
+                f"{k.replace('_', '-')} {v * 100:.0f}%"
+                for k, v in sorted(best["flight"].items())
+            )
+            print(f"bench_ingest: flight evidence: {per}", file=sys.stderr)
         print(
             f"bench_ingest: single scan x{args.workers} workers drained "
             f"{got} records, best of {len(runs)}: {got / wall:,.0f}/s "

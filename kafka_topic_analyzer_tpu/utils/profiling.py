@@ -19,10 +19,16 @@ import dataclasses
 import time
 from typing import Dict, Iterator, Optional
 
-#: Canonical stage order for summaries: pipeline position, not insertion
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+
+#: Canonical drive-loop stage order: pipeline position, not insertion
 #: order (insertion order varies with which stage fires first — e.g. a
-#: resumed scan snapshots before its first dispatch).
-_STAGE_ORDER = ("ingest", "dispatch", "snapshot", "finalize")
+#: resumed scan snapshots before its first dispatch).  THE one list —
+#: the --stats stage digest (results.StageDigest), the flight recorder's
+#: stage tracks (obs/flight.py), and the scan doctor's occupancy model
+#: (obs/doctor.py) all import it, so adding a stage here propagates to
+#: every surface instead of silently dropping out of one.
+STAGE_ORDER = ("ingest", "dispatch", "snapshot", "finalize")
 
 
 @dataclasses.dataclass
@@ -64,6 +70,16 @@ class ScanProfile:
                 # Same t0/dt as the stat above: the trace and --stats can
                 # never drift apart.
                 self.tracer.add_complete(name, t0, dt, cat="stage")
+            # Book the SAME measurement into the live stage counters at
+            # every window exit (not once at scan end): the flight
+            # recorder samples these mid-scan for per-stage occupancy,
+            # and the --stats stage digest renders from the registry
+            # snapshot — one measurement, every surface (DESIGN.md §17).
+            obs_metrics.STAGE_SECONDS.labels(stage=name).inc(dt)
+            if items:
+                obs_metrics.STAGE_RECORDS.labels(stage=name).inc(items)
+            if nbytes:
+                obs_metrics.STAGE_BYTES.labels(stage=name).inc(nbytes)
 
     @property
     def wall_seconds(self) -> float:
@@ -72,10 +88,10 @@ class ScanProfile:
     def ordered_stages(self) -> "list[tuple[str, StageStats]]":
         """Stages in canonical pipeline order, then alphabetical for any
         stage outside the canon — deterministic across runs."""
-        rank = {name: i for i, name in enumerate(_STAGE_ORDER)}
+        rank = {name: i for i, name in enumerate(STAGE_ORDER)}
         return sorted(
             self.stages.items(),
-            key=lambda kv: (rank.get(kv[0], len(_STAGE_ORDER)), kv[0]),
+            key=lambda kv: (rank.get(kv[0], len(STAGE_ORDER)), kv[0]),
         )
 
     def summary(self) -> str:

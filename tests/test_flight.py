@@ -1,0 +1,561 @@
+"""Pipeline flight recorder + scan doctor (ISSUE 10).
+
+Four layers of coverage:
+
+- sampler units: clock-injectable ticks, ring decimation, thread
+  start/stop, Chrome counter tracks, the /flight endpoint;
+- attribution scenarios: a throttled DispatchQueue (D=1, slow fake
+  device) must read dispatch-bound; a starved pipeline (slow fake
+  source) must read ingest-bound; verdicts must aggregate over the
+  registry merge (mesh-2 scan + synthetic two-controller snapshots);
+- byte-identity: scans sampled by a live recorder produce reports
+  byte-identical to recorder-off, across wire × segfile × workers ×
+  K × mesh (the DESIGN §9/§17 non-perturbation bar);
+- CLI surfaces: --stats BOTTLENECK digest (stage timings rendered once,
+  from the snapshot), --json flight block, --flight-record windows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.base import DispatchQueue
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+from kafka_topic_analyzer_tpu.obs import doctor
+from kafka_topic_analyzer_tpu.obs import flight as obs_flight
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.obs import trace as obs_trace
+from kafka_topic_analyzer_tpu.obs.flight import FlightRecorder
+from kafka_topic_analyzer_tpu.obs.registry import (
+    default_registry,
+    merge_snapshots,
+)
+
+pytestmark = pytest.mark.flight
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    default_registry().reset()
+    yield
+    default_registry().reset()
+    obs_flight.set_active(None)
+
+
+# ---------------------------------------------------------------------------
+# sampler units
+
+
+def test_recorder_samples_synchronized_tracks():
+    clk = {"t": 100.0}
+    rec = FlightRecorder(interval_s=0.5, clock=lambda: clk["t"])
+    rec.sample_once()
+    clk["t"] = 101.0
+    obs_metrics.STAGE_SECONDS.labels(stage="ingest").inc(0.7)
+    obs_metrics.DISPATCH_INFLIGHT.set(2)
+    rec.sample_once()
+    s = rec.series()
+    assert s["t"] == [0.0, 1.0]
+    assert s["tracks"]["stage_ingest_s"] == [0.0, 0.7]
+    assert s["tracks"]["dispatch_inflight"] == [0.0, 2.0]
+    assert s["kinds"]["stage_ingest_s"] == "cum"
+    assert s["kinds"]["dispatch_inflight"] == "inst"
+    # Every track shares the one timestamp list.
+    assert all(len(v) == 2 for v in s["tracks"].values())
+    assert obs_metrics.FLIGHT_SAMPLES.value == 2
+    json.dumps(s)  # the /flight endpoint serves exactly this
+
+
+def test_recorder_ring_decimates_and_doubles_interval():
+    clk = {"t": 0.0}
+    rec = FlightRecorder(interval_s=1.0, max_samples=16,
+                         clock=lambda: clk["t"])
+    for i in range(17):
+        clk["t"] = float(i)
+        rec.sample_once()
+    s = rec.series()
+    # 17th sample tripped the 2:1 decimation: every other sample kept,
+    # interval doubled — bounded memory with full-scan coverage.
+    assert len(s["t"]) == 9
+    assert s["t"] == [0.0, 2.0, 4.0, 6.0, 8.0, 10.0, 12.0, 14.0, 16.0]
+    assert s["interval_s"] == 2.0
+    assert all(len(v) == 9 for v in s["tracks"].values())
+
+
+def test_recorder_thread_start_stop():
+    rec = FlightRecorder(interval_s=0.01)
+    rec.start()
+    with pytest.raises(RuntimeError):
+        rec.start()
+    time.sleep(0.08)
+    rec.stop()  # takes the closing sample
+    n = len(rec.series()["t"])
+    assert n >= 2
+    time.sleep(0.03)
+    assert len(rec.series()["t"]) == n  # sampler actually stopped
+    rec.stop()  # idempotent (one more closing sample, no thread)
+
+
+def test_recorder_emits_chrome_counter_tracks():
+    tracer = obs_trace.SpanTracer()
+    obs_trace.set_active(tracer)
+    try:
+        rec = FlightRecorder(interval_s=0.5, clock=lambda: 0.0)
+        obs_metrics.DISPATCH_INFLIGHT.set(1)
+        rec.sample_once()
+    finally:
+        obs_trace.set_active(None)
+    counters = [e for e in tracer.events() if e["ph"] == "C"]
+    assert len(counters) == 1
+    ev = counters[0]
+    assert ev["name"] == "flight"
+    # Instantaneous lanes only — cumulative ramps stay in /flight.
+    assert ev["args"]["dispatch_inflight"] == 1.0
+    assert "stage_ingest_s" not in ev["args"]
+    # Counter events must coexist with spans in one valid trace doc.
+    json.dumps(tracer.chrome_trace())
+
+
+def test_flight_endpoint_serves_active_series():
+    from kafka_topic_analyzer_tpu.obs.exporters import PrometheusExporter
+
+    exporter = PrometheusExporter(0)
+    try:
+        url = f"http://127.0.0.1:{exporter.port}/flight"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=5)
+        assert ei.value.code == 404  # no recorder active
+        rec = FlightRecorder(interval_s=0.5, clock=lambda: 0.0)
+        rec.sample_once()
+        obs_flight.set_active(rec)
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["t"] == [0.0]
+        assert "stage_ingest_s" in doc["tracks"]
+        # /metrics still serves, now including the recorder's own counter.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics", timeout=5
+        ) as resp:
+            text = resp.read().decode()
+        assert "kta_flight_samples_total 1" in text
+    finally:
+        obs_flight.set_active(None)
+        exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# throttle-wait booking (satellite: booked with the recorder OFF)
+
+
+class _SlowToken:
+    """Fake device-completion token: jax.block_until_ready calls the
+    leaf's block_until_ready method, which is where a real device queue
+    would wait."""
+
+    def __init__(self, dt: float):
+        self._dt = dt
+        self._done = False
+
+    def is_ready(self) -> bool:
+        return self._done
+
+    def block_until_ready(self) -> "._SlowToken":
+        time.sleep(self._dt)
+        self._done = True
+        return self
+
+
+def test_throttle_wait_booked_without_recorder():
+    q = DispatchQueue(1)
+    q.throttle()  # empty queue: no wait, no booking
+    assert obs_metrics.DISPATCH_THROTTLE_SECONDS.value == 0.0
+    q.launched(_SlowToken(0.05), batches=1)
+    q.throttle()  # full at depth 1: must retire the slow token first
+    waited = obs_metrics.DISPATCH_THROTTLE_SECONDS.value
+    assert waited >= 0.04
+    q.launched(_SlowToken(0.0), batches=1)
+    q.drain()
+    # drain() is not a launch-site throttle; it books nothing more.
+    assert obs_metrics.DISPATCH_THROTTLE_SECONDS.value == waited
+
+
+# ---------------------------------------------------------------------------
+# attribution scenarios (acceptance: known-bound configurations)
+
+
+def _spec(n=400, parts=2):
+    return SyntheticSpec(
+        num_partitions=parts, messages_per_partition=n,
+        keys_per_partition=50,
+    )
+
+
+def _cfg(parts=2, **kw):
+    return AnalyzerConfig(num_partitions=parts, batch_size=128, **kw)
+
+
+class _SlowDeviceBackend(CpuExactBackend):
+    """Superbatch-capable oracle whose 'device' retires slowly: D=1 means
+    every second flush blocks in DispatchQueue.throttle — the canonical
+    dispatch-bound shape."""
+
+    superbatch_k = 2
+
+    def __init__(self, config, device_dt=0.02, **kw):
+        super().__init__(config, **kw)
+        self._dq = DispatchQueue(1)
+        self._device_dt = device_dt
+
+    def update_superbatch(self, items) -> None:
+        self._dq.throttle()
+        for b in items:
+            self.update(b)
+        self._dq.launched(_SlowToken(self._device_dt), len(items))
+
+    def drain_dispatch(self) -> None:
+        self._dq.drain()
+
+
+class _SlowSource:
+    """Source wrapper that starves the pipeline: every yielded batch
+    costs a sleep on the producing thread — the canonical ingest-bound
+    shape.  Forwards the full RecordSource surface."""
+
+    def __init__(self, inner, dt=0.01):
+        self._inner = inner
+        self._dt = dt
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def batches(self, batch_size, partitions=None, start_at=None):
+        for b in self._inner.batches(
+            batch_size, partitions=partitions, start_at=start_at
+        ):
+            time.sleep(self._dt)
+            yield b
+
+
+def test_dispatch_bound_scenario_yields_dispatch_bound():
+    result = run_scan(
+        "synth", SyntheticSource(_spec(n=600)),
+        _SlowDeviceBackend(_cfg(), init_now_s=10**10), 128,
+    )
+    d = doctor.diagnose(result.telemetry,
+                        dispatch_depth=1)
+    assert d.verdict == "dispatch-bound"
+    # The decisive signal: real backpressure wait at the launch site.
+    assert d.evidence["throttle_wait"] > 0.2
+    assert d.stages["dispatch"] > 0.5
+
+
+def test_ingest_bound_scenario_yields_ingest_bound():
+    result = run_scan(
+        "synth", _SlowSource(SyntheticSource(_spec(n=600))),
+        CpuExactBackend(_cfg(), init_now_s=10**10), 128,
+    )
+    d = doctor.diagnose(result.telemetry)
+    assert d.verdict == "ingest-bound"
+    assert d.stages["ingest"] > 0.5
+    assert d.evidence["throttle_wait"] == 0.0
+
+
+def test_ingest_bound_evidence_with_recorder_and_workers():
+    """Parallel ingest + a live recorder: the workers stay busy (not
+    stalled), the fan-in queues sample empty, and the windowed verdicts
+    agree with the headline."""
+    rec = FlightRecorder(interval_s=0.005)
+    obs_flight.set_active(rec)
+    rec.start()
+    try:
+        result = run_scan(
+            "synth",
+            _SlowSource(SyntheticSource(_spec(n=600, parts=4)), dt=0.005),
+            CpuExactBackend(_cfg(parts=4), init_now_s=10**10), 128,
+            ingest_workers=2,
+        )
+    finally:
+        rec.stop()
+        obs_flight.set_active(None)
+    d = doctor.diagnose(result.telemetry, flight=rec.series())
+    assert d.verdict == "ingest-bound"
+    assert d.evidence["worker_busy"] > 0.5
+    assert d.evidence["queue_empty"] > 0.5
+    assert d.window_share.get("ingest-bound", 0) > 0.5
+    assert d.windows  # the timeline rode along
+
+
+def test_verdict_aggregates_across_controller_snapshots():
+    """The fleet verdict is computed from merge_snapshots output: two
+    controllers, both ingest-heavy, one with a busier dispatch — counters
+    sum, so the merged occupancy is the fleet occupancy."""
+
+    def snap(ingest_s, dispatch_s, throttle_s=0.0):
+        return {
+            "kta_stage_seconds_total": {
+                "type": "counter", "help": "",
+                "samples": [
+                    {"labels": {"stage": "ingest"}, "value": ingest_s},
+                    {"labels": {"stage": "dispatch"}, "value": dispatch_s},
+                ],
+            },
+            "kta_dispatch_throttle_seconds_total": {
+                "type": "counter", "help": "",
+                "samples": [{"labels": {}, "value": throttle_s}],
+            },
+        }
+
+    merged = merge_snapshots([snap(8.0, 1.0), snap(6.0, 3.0)])
+    d = doctor.diagnose(merged, controllers=2)
+    assert d.controllers == 2
+    assert d.verdict == "ingest-bound"
+    assert d.stage_seconds == {"ingest": 14.0, "dispatch": 4.0}
+    assert abs(d.stages["ingest"] - 14.0 / 18.0) < 1e-9
+    # Flip controller 1 to a throttled dispatch regime: the fleet verdict
+    # follows the summed seconds, not either process alone.
+    merged2 = merge_snapshots([snap(1.0, 9.0, 6.0), snap(2.0, 8.0, 5.0)])
+    d2 = doctor.diagnose(merged2, controllers=2)
+    assert d2.verdict == "dispatch-bound"
+    assert d2.evidence["throttle_wait"] > 0.5
+
+
+def test_mesh2_scan_verdict_aggregates():
+    """Acceptance: verdicts aggregate correctly on a mesh-2 scan — the
+    sharded backend's gather_telemetry feeds the doctor the same counter
+    algebra, and a starved mesh still reads ingest-bound."""
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    cfg = _cfg(parts=4, mesh_shape=(2, 1))
+    # dt must outweigh the sharded step's jit compile (which honestly
+    # books to dispatch on this virtual-CPU mesh, ~0.3s): at 0.1s per
+    # batch x ~10 rounds the source starves the scan decisively.
+    result = run_scan(
+        "synth",
+        _SlowSource(SyntheticSource(_spec(n=600, parts=4)), dt=0.1),
+        ShardedTpuBackend(cfg, init_now_s=10**10), 128,
+    )
+    d = doctor.diagnose(
+        result.telemetry,
+        controllers=max(1, len(result.ingest_workers_per_controller)),
+    )
+    assert d.verdict == "ingest-bound"
+    assert d.stages["ingest"] > 0.5
+
+
+def test_doctor_no_signal_on_empty_snapshot():
+    d = doctor.diagnose({})
+    assert d.verdict == "no-signal"
+    assert d.windows == [] and d.window_share == {}
+    json.dumps(d.as_dict())
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: recorder on/off (wire × segfile × workers × K × mesh)
+
+
+def _full_doc(result) -> dict:
+    return {
+        "metrics": result.metrics.to_dict(
+            result.start_offsets, result.end_offsets
+        ),
+        "start": result.start_offsets,
+        "end": result.end_offsets,
+        "degraded": result.degraded_partitions,
+        "corrupt": result.corrupt_partitions,
+    }
+
+
+def _mk_records(partition: int, n: int):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 29}".encode() if i % 5 else None,
+            bytes(20 + (i % 13)) if i % 7 else None,
+        )
+        for i in range(n)
+    ]
+
+
+N_PARTS, N_REC = 4, 300
+WIRE_CFG = AnalyzerConfig(
+    num_partitions=N_PARTS, batch_size=128,
+    count_alive_keys=True, alive_bitmap_bits=16,
+    enable_hll=True, hll_p=8,
+)
+
+
+def _wire_scan(recorder: bool, workers=1, superbatch=1, mesh=None):
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.config import DispatchConfig
+    from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+    from fake_broker import FakeBroker
+
+    records = {p: _mk_records(p, N_REC) for p in range(N_PARTS)}
+    cfg = WIRE_CFG
+    backend_cls = TpuBackend
+    if mesh is not None:
+        from kafka_topic_analyzer_tpu.parallel.sharded import (
+            ShardedTpuBackend,
+        )
+
+        cfg = dataclasses.replace(WIRE_CFG, mesh_shape=mesh)
+        backend_cls = ShardedTpuBackend
+    rec = None
+    if recorder:
+        rec = FlightRecorder(interval_s=0.002)
+        obs_flight.set_active(rec)
+        rec.start()
+    try:
+        with FakeBroker("flight.topic", records,
+                        max_records_per_fetch=60) as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", "flight.topic",
+                overrides={"retry.backoff.ms": "5"},
+            )
+            result = run_scan(
+                "flight.topic", src,
+                backend_cls(cfg, init_now_s=10**10,
+                            dispatch=DispatchConfig(superbatch=superbatch)),
+                cfg.batch_size, ingest_workers=workers,
+            )
+            src.close()
+    finally:
+        if rec is not None:
+            rec.stop()
+            obs_flight.set_active(None)
+    if rec is not None:
+        assert len(rec.series()["t"]) >= 1
+    return _full_doc(result)
+
+
+@pytest.fixture(scope="module")
+def wire_baseline():
+    default_registry().reset()
+    return _wire_scan(recorder=False)
+
+
+@pytest.mark.parametrize("workers,superbatch", [
+    (1, 1), (4, 1), (1, 4), (4, 4),
+])
+def test_recorder_scan_identity_wire(wire_baseline, workers, superbatch):
+    got = _wire_scan(recorder=True, workers=workers, superbatch=superbatch)
+    assert got == wire_baseline
+
+
+@pytest.mark.parametrize("mesh,workers,superbatch", [
+    ((2, 1), 1, 1), ((2, 1), 2, 4),
+])
+def test_recorder_scan_identity_mesh(wire_baseline, mesh, workers,
+                                     superbatch):
+    got = _wire_scan(recorder=True, workers=workers,
+                     superbatch=superbatch, mesh=mesh)
+    assert got == wire_baseline
+
+
+@pytest.mark.parametrize("workers,superbatch", [(1, 1), (2, 4)])
+def test_recorder_scan_identity_segfile(tmp_path, workers, superbatch):
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.config import DispatchConfig
+    from kafka_topic_analyzer_tpu.io.segfile import (
+        SegmentDumpWriter,
+        SegmentFileSource,
+    )
+
+    spec = SyntheticSpec(
+        num_partitions=3, messages_per_partition=700,
+        keys_per_partition=40, seed=5, key_null_permille=60,
+        tombstone_permille=90,
+    )
+    d = str(tmp_path / "segs")
+    writer = SegmentDumpWriter(d, "seg.topic", records_per_chunk=256)
+    src = SyntheticSource(spec)
+    writer.set_base_offsets(src.watermarks()[0])
+    for b in src.batches(180):
+        writer.append(b)
+    writer.close()
+    cfg = AnalyzerConfig(
+        num_partitions=3, batch_size=128, count_alive_keys=True,
+        alive_bitmap_bits=14,
+    )
+
+    def scan(recorder: bool):
+        rec = None
+        if recorder:
+            rec = FlightRecorder(interval_s=0.002)
+            obs_flight.set_active(rec)
+            rec.start()
+        try:
+            s = SegmentFileSource(d, "seg.topic")
+            r = run_scan(
+                "seg.topic", s,
+                TpuBackend(cfg, init_now_s=10**10,
+                           dispatch=DispatchConfig(superbatch=superbatch)),
+                128, ingest_workers=workers,
+            )
+            return _full_doc(r)
+        finally:
+            if rec is not None:
+                rec.stop()
+                obs_flight.set_active(None)
+
+    assert scan(recorder=True) == scan(recorder=False)
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+
+
+def _cli(capsys, extra):
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "flight.synth", "--source", "synthetic",
+        "--synthetic", "partitions=2,messages=400,keys=40",
+        "--quiet", *extra,
+    ])
+    assert rc == 0
+    return capsys.readouterr()
+
+
+def test_cli_stats_bottleneck_digest_and_single_stage_block(capsys):
+    cap = _cli(capsys, ["--stats"])
+    # The doctor's digest renders even without --flight-record (the
+    # attribution inputs are always-booked counters) ...
+    assert "BOTTLENECK: " in cap.err
+    assert "occupancy: " in cap.err
+    # ... and stage timings appear exactly ONCE, rendered from the same
+    # registry snapshot the doctor used (the old duplicate in-process
+    # profile print is gone).
+    assert cap.err.count("scan stages:") == 1
+    assert "ingest:" in cap.err
+    # No recorder -> no windowed timeline line.
+    assert "windows: " not in cap.err
+
+
+def test_cli_flight_record_windows_and_json_block(capsys):
+    cap = _cli(capsys, ["--stats", "--flight-record", "--json"])
+    assert "BOTTLENECK: " in cap.err
+    doc = json.loads(cap.out.splitlines()[-1])
+    flight = doc["flight"]
+    assert flight["verdict"]
+    assert isinstance(flight["stages"], dict)
+    assert isinstance(flight["windows"], list)
+    # The raw ring series stays on /flight, never in --json.
+    assert "series" not in flight
+    json.dumps(flight)
+
+
+def test_cli_json_flight_block_without_recorder(capsys):
+    cap = _cli(capsys, ["--json"])
+    doc = json.loads(cap.out.splitlines()[-1])
+    assert doc["flight"]["verdict"]
+    assert doc["flight"]["windows"] == []

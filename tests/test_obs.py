@@ -452,3 +452,95 @@ def test_registry_hammer_no_lost_updates():
     assert h["count"] == N_THREADS * N_OPS
     assert sum(h["counts"]) == N_THREADS * N_OPS
     assert children[0] is children[2]  # fast path: one child per label set
+
+
+@pytest.mark.ingest
+def test_concurrent_scrape_during_writer_hammer():
+    """The EXPOSITION path racing live registry writes — satellite of
+    ISSUE 10: the 8-thread hammer above covers instrument mutation, but a
+    Prometheus scrape walks instruments(), samples() and render while N
+    ingest workers are concurrently inc-ing AND creating new labeled
+    children (new workers appear mid-scan on sharded pools).  Every
+    scrape must return 200 with parseable, internally-consistent text —
+    no torn lines, no KeyError from a half-registered child, no lost
+    bucket rows."""
+    import re
+    import threading
+
+    reg = MetricsRegistry()
+    counter = reg.counter("s_total", "scrape-raced counter")
+    labeled = reg.counter("s_by_worker_total", "per-worker",
+                          labelnames=("w",))
+    hist = reg.histogram("s_hist", "scrape-raced histogram",
+                         buckets=(1.0, 10.0))
+    exporter = PrometheusExporter(0, registry=reg)
+    url = f"http://127.0.0.1:{exporter.port}/metrics"
+
+    N_WRITERS, N_OPS = 8, 2_000
+    stop = threading.Event()
+    errors: "list[BaseException]" = []
+    start = threading.Barrier(N_WRITERS + 3)
+
+    def writer(t: int) -> None:
+        start.wait()
+        try:
+            for i in range(N_OPS):
+                counter.inc()
+                # Fresh label values appear DURING scrapes: child
+                # creation races the exposition walk.
+                labeled.labels(w=f"{t}.{i % 50}").inc()
+                hist.observe(float(i % 20))
+        except BaseException as e:  # pragma: no cover - failure capture
+            errors.append(e)
+        finally:
+            stop.set()  # first finisher lets scrapers wind down
+
+    scrapes: "list[str]" = []
+
+    def scraper() -> None:
+        start.wait()
+        try:
+            while not stop.is_set() or len(scrapes) < 5:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    assert resp.status == 200
+                    scrapes.append(resp.read().decode())
+                if len(scrapes) > 200:
+                    break
+        except BaseException as e:  # pragma: no cover - failure capture
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(t,))
+        for t in range(N_WRITERS)
+    ] + [threading.Thread(target=scraper) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        exporter.close()
+
+    assert not errors, errors
+    assert len(scrapes) >= 5
+    line_re = re.compile(
+        r"^(# (HELP|TYPE) \S.*|[a-zA-Z_:][a-zA-Z0-9_:]*"
+        r'(\{[^{}]*\})? -?[0-9.e+Inf]+)$'
+    )
+    for body in scrapes:
+        lines = body.rstrip("\n").split("\n")
+        for ln in lines:
+            assert line_re.match(ln), f"torn exposition line: {ln!r}"
+        # Histogram internal consistency per scrape: the +Inf cumulative
+        # bucket equals the count line that follows it.
+        m_inf = re.search(r's_hist_bucket{le="\+Inf"} (\d+)', body)
+        m_count = re.search(r"s_hist_count (\d+)", body)
+        assert m_inf and m_count
+        assert m_inf.group(1) == m_count.group(1)
+    # Nothing lost under concurrent exposition: the post-join snapshot
+    # carries every write.
+    final = reg.snapshot()
+    assert final["s_total"]["samples"][0]["value"] == N_WRITERS * N_OPS
+    assert sum(
+        s["value"] for s in final["s_by_worker_total"]["samples"]
+    ) == N_WRITERS * N_OPS

@@ -592,3 +592,72 @@ if failures:
     sys.exit(1)
 print("lint: OK (wire offsets derive only from packing._sections)")
 EOF
+
+# Eighth rule: every instrument in obs/metrics.py (the one catalog module)
+# must carry a cross-process MERGE POLICY and a README catalog row.
+# Counters and histograms are additive by construction (obs/registry.py's
+# merge algebra — the only sound policy for monotone series), so their
+# policy is the type itself; gauges are ambiguous (fleet-max vs
+# disjoint-local-sum) and MUST pass an explicit merge= keyword — a gauge
+# added without one silently gets max-merged, which undercounts every
+# disjoint-per-process quantity the moment a mesh scan gathers telemetry.
+# And every constructed metric name must have a row in the README metric
+# catalog, so the documented surface can never lag the shipped one.
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+METRICS = pathlib.Path("kafka_topic_analyzer_tpu") / "obs" / "metrics.py"
+README = pathlib.Path("README.md").read_text(encoding="utf-8")
+
+failures = []
+names = []
+tree = ast.parse(METRICS.read_text(encoding="utf-8"), filename=str(METRICS))
+for node in ast.walk(tree):
+    if not (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("counter", "gauge", "histogram")
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "_REG"
+    ):
+        continue
+    if not (
+        node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)
+    ):
+        failures.append(
+            f"{METRICS}:{node.lineno}: instrument name must be a string "
+            "literal (the catalog is audited statically)"
+        )
+        continue
+    name = node.args[0].value
+    names.append((node.lineno, name))
+    if node.func.attr == "gauge":
+        kws = {kw.arg for kw in node.keywords}
+        if "merge" not in kws:
+            failures.append(
+                f"{METRICS}:{node.lineno}: gauge {name!r} does not declare "
+                "an explicit merge= policy (max for same-quantity gauges, "
+                "sum for disjoint per-process counts)"
+            )
+
+for lineno, name in names:
+    if name not in README:
+        failures.append(
+            f"{METRICS}:{lineno}: instrument {name!r} has no README "
+            "metric-catalog row"
+        )
+
+if failures:
+    print("lint: obs/metrics.py instruments must declare a merge policy")
+    print("lint: (explicit merge= on every gauge) and carry a README")
+    print("lint: metric-catalog row:")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print(f"lint: OK ({len(names)} instruments: merge policies declared, "
+      "README catalog rows present)")
+EOF
