@@ -335,6 +335,22 @@ def load_snapshot(
     return state, offsets, int(meta["records_seen"]), int(meta["init_now_s"])
 
 
+def snapshot_info(directory: str, scope=None) -> "Optional[dict]":
+    """Snapshot METADATA (fingerprint, topic, per-partition next offsets,
+    records_seen, degraded/corrupt annotations) without loading the state
+    arrays — or None when no snapshot exists.  The follow service's
+    startup banner reads this to report where a ``--resume`` will pick up
+    (serve/follow.py), and operator tooling can answer "how far did the
+    dead service get" from the file alone, before paying the .npz load.
+    Works on any snapshot — batch- or follow-written; the format never
+    learned the difference."""
+    path = _snapshot_path(directory, scope)
+    if not os.path.exists(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        return json.loads(str(z["__meta__"]))
+
+
 def load_corrupt_spans(directory: str, scope=None) -> list:
     """The ``corrupt_spans`` metadata of a snapshot, or [] when the
     snapshot (or the list) is absent.  Split from `load_snapshot` so the

@@ -35,25 +35,22 @@ EXIT_CORRUPT = 4
 
 def _scan_issue_exit(result, doc=None, render=False) -> int:
     """Shared tail of every report path: surface corrupt and degraded
-    partitions — into ``doc`` as str-keyed maps (``--json``) and/or as the
-    post-table warning blocks (``render``) — and pick the exit code."""
+    partitions — into ``doc`` as str-keyed maps (``--json``; the one
+    block builder report.attach_issue_blocks) and/or as the post-table
+    warning blocks (``render``) — and pick the exit code."""
     rc = 0
     corrupt = getattr(result, "corrupt_partitions", None) or {}
+    if doc is not None:
+        from kafka_topic_analyzer_tpu.report import attach_issue_blocks
+
+        attach_issue_blocks(doc, result)
     if corrupt:
-        if doc is not None:
-            doc["corrupt_partitions"] = {
-                str(p): d for p, d in corrupt.items()
-            }
         if render:
             from kafka_topic_analyzer_tpu.report import render_corrupt_block
 
             sys.stdout.write(render_corrupt_block(corrupt))
         rc = EXIT_CORRUPT
     if result.degraded_partitions:
-        if doc is not None:
-            doc["degraded_partitions"] = {
-                str(p): r for p, r in result.degraded_partitions.items()
-            }
         if render:
             from kafka_topic_analyzer_tpu.report import render_degraded_block
 
@@ -247,6 +244,41 @@ def build_parser() -> argparse.ArgumentParser:
                         "and serves the ring-buffered series at /flight on "
                         "--metrics-port. The bottleneck verdict itself is "
                         "always computed — the recorder adds the timeline")
+    p.add_argument("--follow", action="store_true",
+                   help="Run as a long-lived analyzer service: after the "
+                        "initial earliest→latest pass, keep re-polling "
+                        "watermarks and fold new records incrementally "
+                        "(superbatch/parallel-ingest/mesh composition "
+                        "unchanged), serving the evolving report at "
+                        "/report.json on --metrics-port. SIGINT/SIGTERM "
+                        "stop at the next poll boundary: final "
+                        "checkpoint, final report, clean exit. Resumes "
+                        "from any --snapshot-dir snapshot, including one "
+                        "a batch scan wrote")
+    p.add_argument("--poll-interval", type=float, default=1.0,
+                   metavar="SECONDS",
+                   help="Follow-mode watermark poll cadence; consecutive "
+                        "empty polls back off exponentially from here to "
+                        "10s. Default: 1.0")
+    p.add_argument("--checkpoint-interval", type=float, default=None,
+                   metavar="SECONDS",
+                   help="Follow-mode checkpoint cadence (committed only "
+                        "at superbatch boundaries; requires "
+                        "--snapshot-dir). Defaults to --snapshot-every")
+    p.add_argument("--follow-idle-exit", type=float, default=None,
+                   metavar="SECONDS",
+                   help="Exit the follow service cleanly after this long "
+                        "at the head with no new records (drain mode); "
+                        "default: follow forever")
+    p.add_argument("--window-secs", type=float, default=60.0,
+                   metavar="SECONDS",
+                   help="Width of one follow-mode report window (the "
+                        "time-windowed per-partition rate/cardinality/"
+                        "size folds served in /report.json). Default: 60")
+    p.add_argument("--window-count", type=int, default=8, metavar="N",
+                   help="Window states kept in the follow-mode ring "
+                        "(merged associatively for the whole-ring view); "
+                        "0 disables windowed folds. Default: 8")
     p.add_argument("--check-crcs", action="store_true",
                    help="Verify record-batch checksums (CRC32-C) while "
                         "decoding, like librdkafka's check.crcs. Without it, "
@@ -439,55 +471,15 @@ def resolve_wire_format(args) -> int:
     return {"auto": 0, "v4": 4, "v5": 5}[getattr(args, "wire_format", "auto")]
 
 
-def _attach_wire_digest(doc: dict, result) -> None:
-    """--json wire block: format + byte split of the packed transfer
-    (results.WireStats) — absent for backends without one (cpu oracle)."""
-    if getattr(result, "wire", None) is not None:
-        doc["wire"] = result.wire.as_dict()
-
-
-def _attach_flight_digest(doc: dict, diagnosis) -> None:
-    """--json flight block: the doctor's verdict, per-stage occupancy,
-    evidence, and windowed timeline (obs.doctor.Diagnosis).  Always
-    attached — the verdict derives from always-booked counters; the
-    window fields are empty unless --flight-record sampled the scan.
-    The raw ring series is deliberately NOT embedded (it can run to
-    thousands of samples); /flight on --metrics-port serves it."""
-    doc["flight"] = diagnosis.as_dict()
-
-
-def _attach_segment_digest(doc: dict, result) -> None:
-    """--json cold-path digest: when the scan read from a segment store,
-    surface what the catalog opened and how much came off the mapped
-    chunks as a first-class ``segments`` block (the raw counters also ride
-    in ``telemetry``, but automation should not need to know instrument
-    names to see cold-path coverage)."""
-    from kafka_topic_analyzer_tpu.results import SegmentStats
-
-    seg = SegmentStats.from_telemetry(result.telemetry)
-    if seg.files:
-        doc["segments"] = seg.as_dict()
-
-
 def _diagnose(result):
     """Scan-doctor attribution for a finished scan: computed from the
     SAME merged snapshot ``--json`` embeds (fleet-wide under
-    multi-controller), plus the flight recorder's series when one ran."""
-    from kafka_topic_analyzer_tpu.obs import doctor, flight
+    multi-controller), plus the flight recorder's series when one ran.
+    Shared with the follow service's /report.json publisher
+    (obs/doctor.diagnose_scan) so every surface attributes identically."""
+    from kafka_topic_analyzer_tpu.obs.doctor import diagnose_scan
 
-    rec = flight.active()
-    if rec is not None:
-        # Close the timeline before reading it: the session-owned
-        # recorder is still sampling here (teardown stops it later), and
-        # a scan shorter than the sampling interval would otherwise
-        # diagnose from an empty series.
-        rec.sample_once()
-    return doctor.diagnose(
-        result.telemetry,
-        controllers=max(1, len(result.ingest_workers_per_controller)),
-        dispatch_depth=result.dispatch_depth,
-        flight=rec.series() if rec is not None else None,
-    )
+    return diagnose_scan(result)
 
 
 def _print_stats(args, result, diagnosis=None) -> None:
@@ -706,9 +698,9 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             union_doc["size_quantiles"] = union.quantiles.as_dict()
         doc["union"] = union_doc
         doc["telemetry"] = result.telemetry
-        _attach_segment_digest(doc, result)
-        _attach_wire_digest(doc, result)
-        _attach_flight_digest(doc, diagnosis)
+        from kafka_topic_analyzer_tpu.report import attach_scan_digests
+
+        attach_scan_digests(doc, result, diagnosis)
         # Degraded keys are dense fan-in rows; reasons carry topic/partition.
         rc = _scan_issue_exit(result, doc=doc)
         print(json.dumps(doc))
@@ -791,6 +783,11 @@ def _run(args) -> int:
     # Kafka topic names cannot contain commas, so "-t a,b,c" unambiguously
     # selects multi-topic fan-in (new capability; BASELINE.json config 5).
     if "," in args.topic:
+        if args.follow:
+            raise UserInputError(
+                "--follow does not support multi-topic fan-in yet "
+                "(ROADMAP item 2: the fleet scheduler is its second tenant)"
+            )
         return run_multi_topic(args, [t for t in args.topic.split(",") if t])
     with user_input_phase():
         # Cheap flag validation first — before any broker handshake or dump
@@ -803,8 +800,10 @@ def _run(args) -> int:
         if exhausted:
             return 0
 
-    # Empty-topic guard: exit(-2) like src/main.rs:98-101.
-    if source.is_empty():
+    # Empty-topic guard: exit(-2) like src/main.rs:98-101.  A follow
+    # service deliberately skips it — sitting on a still-empty topic and
+    # waiting for the first record IS the job.
+    if source.is_empty() and not args.follow:
         print(
             "Given topic has no content, no analysis possible. Exiting.",
             file=sys.stderr,
@@ -841,19 +840,58 @@ def _run(args) -> int:
     banner_out = sys.stderr if args.json else sys.stdout
     print(f"Subscribing to {args.topic}", file=banner_out)
     print("Starting message consumption...", file=banner_out)
+    follow_service = None
     with maybe_jax_trace(args.profile_dir):
-        result = run_scan(
-            args.topic,
-            source,
-            backend,
-            batch_size=args.batch_size,
-            spinner=Spinner(enabled=not args.quiet),
-            snapshot_dir=args.snapshot_dir,
-            snapshot_every_s=args.snapshot_every,
-            resume=args.resume,
-            start_at=start_at,
-            ingest_workers=ingest_workers,
-        )
+        if args.follow:
+            from kafka_topic_analyzer_tpu.config import FollowConfig
+            from kafka_topic_analyzer_tpu.serve.follow import FollowService
+
+            with user_input_phase():
+                follow_cfg = FollowConfig(
+                    poll_interval_s=args.poll_interval,
+                    checkpoint_every_s=(
+                        args.checkpoint_interval
+                        if args.checkpoint_interval is not None
+                        else args.snapshot_every
+                    ),
+                    idle_exit_s=args.follow_idle_exit,
+                    window_secs=args.window_secs,
+                    window_count=args.window_count,
+                )
+            with user_input_phase():
+                follow_service = FollowService(
+                    args.topic,
+                    source,
+                    backend,
+                    batch_size=args.batch_size,
+                    follow=follow_cfg,
+                    spinner=Spinner(enabled=not args.quiet),
+                    snapshot_dir=args.snapshot_dir,
+                    resume=args.resume,
+                    start_at=start_at,
+                    ingest_workers=ingest_workers,
+                    # /report.json assembly is pure waste when no HTTP
+                    # server exists to serve it.
+                    publish_reports=args.metrics_port is not None,
+                )
+            restore_signals = follow_service.install_signal_handlers()
+            try:
+                result = follow_service.run()
+            finally:
+                restore_signals()
+        else:
+            result = run_scan(
+                args.topic,
+                source,
+                backend,
+                batch_size=args.batch_size,
+                spinner=Spinner(enabled=not args.quiet),
+                snapshot_dir=args.snapshot_dir,
+                snapshot_every_s=args.snapshot_every,
+                resume=args.resume,
+                start_at=start_at,
+                ingest_workers=ingest_workers,
+            )
     # Only the --stats digest and the --json flight block consume the
     # diagnosis; the plain report path skips the doctor pass entirely.
     diagnosis = _diagnose(result) if (args.stats or args.json) else None
@@ -869,20 +907,24 @@ def _run(args) -> int:
     if args.json:
         import json
 
-        doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)
-        doc["topic"] = args.topic
-        doc["duration_secs"] = result.duration_secs
-        doc["ingest_workers"] = result.ingest_workers
-        doc["ingest_workers_per_controller"] = (
-            result.ingest_workers_per_controller
+        from kafka_topic_analyzer_tpu.report import build_json_doc
+
+        doc = build_json_doc(
+            args.topic,
+            result,
+            diagnosis=diagnosis,
+            follow=(
+                follow_service.follow_block()
+                if follow_service is not None
+                else None
+            ),
+            windows=(
+                follow_service.windows_report()
+                if follow_service is not None
+                else None
+            ),
         )
-        doc["superbatch_k"] = result.superbatch_k
-        doc["dispatch_depth"] = result.dispatch_depth
-        doc["telemetry"] = result.telemetry
-        _attach_segment_digest(doc, result)
-        _attach_wire_digest(doc, result)
-        _attach_flight_digest(doc, diagnosis)
-        rc = _scan_issue_exit(result, doc=doc)
+        rc = _scan_issue_exit(result)
         print(json.dumps(doc))
         return rc
     sys.stdout.write(

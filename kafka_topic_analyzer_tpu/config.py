@@ -217,6 +217,69 @@ class DispatchConfig:
         return int(self.superbatch)
 
 
+@dataclasses.dataclass(frozen=True)
+class FollowConfig:
+    """Follow-mode service knobs (``--follow`` and friends; serve/follow.py).
+
+    Like `IngestConfig`, deliberately NOT part of `AnalyzerConfig`: how
+    often the service re-polls watermarks, checkpoints, or rotates report
+    windows changes neither state shapes nor fold semantics — a follow
+    run's cumulative metrics are byte-identical to a batch scan stopped at
+    the same offsets (DESIGN.md §18) — so none of it may churn the
+    checkpoint fingerprint.  A snapshot taken by a batch scan resumes
+    under ``--follow`` and vice versa.
+    """
+
+    #: Watermark re-poll cadence at the head (seconds).  Also the FLOOR of
+    #: the idle backoff schedule: consecutive empty polls back off
+    #: exponentially from here up to ``idle_backoff_max_s`` (reusing
+    #: io/retry.Backoff), so a quiet topic costs metadata queries, not
+    #: fetch spin.
+    poll_interval_s: float = 1.0
+    #: Idle backoff ceiling (seconds) — the longest the service sleeps
+    #: between polls of a quiet topic.  Any new data resets the schedule
+    #: to ``poll_interval_s``.
+    idle_backoff_max_s: float = 10.0
+    #: Checkpoint cadence (seconds, ``--checkpoint-interval``).  Commits
+    #: happen only at superbatch boundaries (the engine's long-standing
+    #: fold-consistency rule), so this is a floor, not an exact period.
+    checkpoint_every_s: float = 60.0
+    #: Exit cleanly after this long at the head with no new records
+    #: (``--follow-idle-exit``); None = follow forever.  The "drain and
+    #: stop" mode: catch up, wait out the idle window, report, exit 0.
+    idle_exit_s: "float | None" = None
+    #: Width of one report window (seconds) for the time-windowed folds
+    #: served at /report.json (serve/windows.py).
+    window_secs: float = 60.0
+    #: Number of window states kept in the ring (0 disables windowed
+    #: folds).  "What changed in the last 5 minutes" is the associative
+    #: merge of the last ceil(300/window_secs) states.
+    window_count: int = 8
+    #: HLL precision for the per-window per-partition cardinality fold
+    #: (2^p one-byte registers per partition per window — deliberately
+    #: smaller than the scan's cumulative sketch: window memory is
+    #: P * 2^p * window_count bytes).
+    window_hll_p: int = 10
+
+    def __post_init__(self) -> None:
+        if self.poll_interval_s <= 0:
+            raise ValueError("--poll-interval must be > 0 seconds")
+        if self.idle_backoff_max_s < self.poll_interval_s:
+            raise ValueError(
+                "idle backoff ceiling must be >= the poll interval"
+            )
+        if self.checkpoint_every_s < 0:
+            raise ValueError("--checkpoint-interval must be >= 0 seconds")
+        if self.idle_exit_s is not None and self.idle_exit_s < 0:
+            raise ValueError("--follow-idle-exit must be >= 0 seconds")
+        if self.window_secs <= 0:
+            raise ValueError("--window-secs must be > 0 seconds")
+        if self.window_count < 0:
+            raise ValueError("--window-count must be >= 0")
+        if not (4 <= self.window_hll_p <= 16):
+            raise ValueError("window hll precision must be in [4, 16]")
+
+
 #: Valid --on-corruption policies, in escalation order.
 CORRUPTION_POLICIES = ("fail", "skip", "quarantine")
 

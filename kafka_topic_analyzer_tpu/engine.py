@@ -111,6 +111,12 @@ class ScanResult:
     #: per-record vs fold-table byte split, and the scan's actual wire
     #: bytes — None for backends without a packed transfer (cpu oracle).
     wire: "Optional[object]" = None
+    #: Per-partition next-unread offsets at scan end (the progress
+    #: tracker's final positions).  For a clean batch scan these equal
+    #: ``end_offsets``; degraded partitions stop early.  Follow mode
+    #: (serve/follow.py) chains passes on this cursor: pass N+1 starts
+    #: exactly where pass N's fold committed.
+    next_offsets: "dict[int, int]" = dataclasses.field(default_factory=dict)
 
 
 class _ProgressTracker:
@@ -163,6 +169,11 @@ def run_scan(
     tracer=None,
     heartbeat_every_s: float = 10.0,
     ingest_workers: "int | str | IngestConfig" = 1,
+    initial_seq: int = 0,
+    heartbeat: "Optional[obs_events.Heartbeat]" = None,
+    emit_lifecycle: bool = True,
+    book_once: bool = True,
+    final_snapshot: bool = False,
 ) -> ScanResult:
     """Full earliest→latest scan of the topic through the backend.
 
@@ -186,7 +197,23 @@ def run_scan(
     resolves PER CONTROLLER against this process's shard partition count
     and splits across its data rows, composing host-parallel ingest with
     the device-parallel collective scan (DESIGN.md §14); single-device
-    backends clamp to the topic's partition count as before."""
+    backends clamp to the topic's partition count as before.
+
+    Follow-mode pass hooks (serve/follow.py — the follow service reruns
+    this function per poll on the SAME backend, so state accumulates):
+    ``initial_seq`` seeds the record sequence so pass N+1's spinner/
+    heartbeat/snapshot counts continue pass N's; ``heartbeat`` shares one
+    rate limiter across passes (a fresh limiter per pass would fire on
+    every poll at the head — event flood); ``emit_lifecycle=False``
+    suppresses the per-pass scan_start/scan_end events and the per-pass
+    spinner finish line — the service emits ONE lifecycle pair for its
+    whole run; ``book_once=False`` suppresses the once-per-scan fallback
+    bookings (wire-v4 / fused reasons) on every follow pass after the
+    first, so the counters record one scan, not one per poll;
+    ``final_snapshot`` forces
+    a snapshot after the stream drains (at a superbatch boundary, by
+    construction) — the follow service's checkpoint-interval and
+    clean-shutdown commits."""
     ingest_cfg = (
         ingest_workers
         if isinstance(ingest_workers, IngestConfig)
@@ -201,14 +228,16 @@ def run_scan(
     profile = ScanProfile(tracer=tracer)
     spinner = spinner or Spinner(enabled=False)
     t0 = time.monotonic()
-    seq = 0
-    obs_events.emit(
-        "scan_start",
-        topic=topic,
-        partitions=len(pindex),
-        batch_size=batch_size,
-    )
-    heartbeat = obs_events.Heartbeat(heartbeat_every_s)
+    seq = initial_seq
+    if emit_lifecycle:
+        obs_events.emit(
+            "scan_start",
+            topic=topic,
+            partitions=len(pindex),
+            batch_size=batch_size,
+        )
+    if heartbeat is None:
+        heartbeat = obs_events.Heartbeat(heartbeat_every_s)
     # Partitions THIS process feeds — the sharded branch narrows this to
     # its local rows' partitions, so that under multi-controller each
     # process's lag/ETA gauges carry a disjoint label set (the merge
@@ -412,9 +441,10 @@ def run_scan(
         and getattr(backend, "use_native", True)
         and fused_ingest_enabled()
     )
-    if _make_sink is not None and _declares_fused and not fused:
+    if _make_sink is not None and _declares_fused and not fused and book_once:
         # Book every closed gate — a bypass is never silent, including a
         # wrapper that forwards the capability flag but dropped sink=.
+        # (book_once: follow runs book on their FIRST pass only.)
         if not _accepts_sink:
             reason = "source-unfusable"
         elif not getattr(backend, "use_native", True):
@@ -457,7 +487,9 @@ def run_scan(
             table_bytes=table,
         )
         v4_reason = wire_cfg.wire_v4_reason
-        if v4_reason is not None:
+        if v4_reason is not None and book_once:
+            # Once per scan — and once per follow SERVICE run, not per
+            # poll pass (book_once is False on passes after the first).
             obs_metrics.WIRE_V4_FALLBACK.labels(reason=v4_reason).inc()
         wire_bytes0 = obs_metrics.WIRE_BYTES.value
 
@@ -939,20 +971,27 @@ def run_scan(
                 "note": "corrupt frame(s) on another process (see its log)",
             }
         }
-    if degraded or corrupt:
+    if degraded or corrupt or final_snapshot:
         # Degraded partitions carry an unscanned tail; corrupt ones carry
         # skipped spans the offset tracker never saw.  Snapshot so a rerun
         # resumes correctly (and, for corruption, re-seeds the skip list).
+        # ``final_snapshot`` forces the same commit for a clean drain —
+        # the follow service's checkpoint/shutdown boundary.
         maybe_snapshot(force=True)
 
     with profile.stage("finalize"):
         metrics = backend.finalize()
     metrics.partitions = pindex.ids
-    spinner.finish_with_message("done")
+    if emit_lifecycle:
+        spinner.finish_with_message("done")
     duration_secs = int(time.monotonic() - t0)
     # Final telemetry: drained partitions report zero lag, the stage
-    # profile folds into the registry, and the lifecycle closes.
-    heartbeat.force()  # the closing gauge refresh always lands
+    # profile folds into the registry, and the lifecycle closes.  Follow
+    # passes skip the force: the service refreshes lag gauges against the
+    # MOVING head every poll, and a forced heartbeat per pass would flood
+    # the event log at exactly the cadence the limiter exists to bound.
+    if emit_lifecycle:
+        heartbeat.force()  # the closing gauge refresh always lands
     maybe_heartbeat()
     # Locally-degraded partitions only: the -1 cross-process sentinel is
     # another process's partition, and THAT process books it — counting
@@ -963,16 +1002,17 @@ def run_scan(
     # profile books them live at every stage window exit, so the flight
     # recorder and the gather below see the same totals — no end-of-scan
     # record_profile fold.)
-    obs_events.emit(
-        "scan_end",
-        topic=topic,
-        records=seq,
-        duration_secs=duration_secs,
-        degraded=local_degraded,
-        corrupt_frames=sum(
-            d.get("frames", 0) for p, d in corrupt.items() if p >= 0
-        ),
-    )
+    if emit_lifecycle:
+        obs_events.emit(
+            "scan_end",
+            topic=topic,
+            records=seq,
+            duration_secs=duration_secs,
+            degraded=local_degraded,
+            corrupt_frames=sum(
+                d.get("frames", 0) for p, d in corrupt.items() if p >= 0
+            ),
+        )
     # Close out the wire accounting before the registry gathers, so the
     # bytes/record gauge lands in every snapshot the merge sees.
     if wire_stats is not None:
@@ -1011,4 +1051,5 @@ def run_scan(
         superbatch_k=super_k,
         dispatch_depth=int(getattr(backend, "dispatch_depth", 1) or 1),
         wire=wire_stats,
+        next_offsets=dict(tracker.next_offsets),
     )

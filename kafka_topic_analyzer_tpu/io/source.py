@@ -40,6 +40,17 @@ class RecordSource(abc.ABC):
         offsets (snapshot resume, checkpoint.py); missing partitions start
         at their earliest offset."""
 
+    def refresh_watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        """Re-poll the end watermarks and return the fresh
+        (start_offsets, end_offsets) — the follow-mode tail contract
+        (serve/follow.py): each poll widens the scan target to the moving
+        head.  Static sources (synthetic, segment files) have nothing to
+        refresh, so the default returns the fixed snapshot; the live wire
+        source re-queries the brokers THROUGH its retry/backoff budget and,
+        when the budget is exhausted, keeps the previous snapshot instead
+        of failing the service (io/kafka_wire.py)."""
+        return self.watermarks()
+
     def degraded_partitions(self) -> Dict[int, str]:
         """partition -> reason for partitions a scan dropped after
         exhausting their transport/protocol retry budget (graceful

@@ -327,3 +327,26 @@ def _summarize(
         top = sorted(stages.items(), key=lambda kv: -kv[1])[:1]
         parts.extend(f"{s} {pct(v)} of accounted time" for s, v in top)
     return "; ".join(parts)
+
+
+def diagnose_scan(result) -> Diagnosis:
+    """`diagnose` over a finished (or in-flight follow) `ScanResult`,
+    with the flight recorder folded in when one is active — the shared
+    entry point for the CLI's --stats/--json paths and the follow
+    service's /report.json publisher (serve/follow.py), so every surface
+    attributes from the same evidence."""
+    from kafka_topic_analyzer_tpu.obs import flight as _flight
+
+    rec = _flight.active()
+    if rec is not None:
+        # Close the timeline before reading it: the session-owned recorder
+        # is still sampling (teardown stops it later), and a scan shorter
+        # than the sampling interval would otherwise diagnose from an
+        # empty series.
+        rec.sample_once()
+    return diagnose(
+        result.telemetry,
+        controllers=max(1, len(result.ingest_workers_per_controller)),
+        dispatch_depth=result.dispatch_depth,
+        flight=rec.series() if rec is not None else None,
+    )

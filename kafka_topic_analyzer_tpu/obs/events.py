@@ -21,6 +21,19 @@ Event catalog (field names stable — they are an output format):
                             quarantined
 - ``scan_end``              topic, records, duration_secs, degraded,
                             corrupt_frames
+
+Follow-mode additions (serve/follow.py; a service run emits ONE
+scan_start/scan_end pair for its whole lifetime — per-pass lifecycle
+events are suppressed so a long-lived run cannot flood the log):
+
+- ``follow_poll``           poll, new_records, lag_total   (only on polls
+                            that found new records; idle polls are silent)
+- ``watermark_refresh_failed``  attempts, error   (budget exhausted; the
+                            previous watermark snapshot stays in force)
+- ``partition_healed``      partition   (a degraded partition caught back
+                            up to the head in a later follow pass)
+- ``follow_stop``           reason, polls, passes   (stop requested:
+                            signal name, 'idle', or a caller's reason)
 """
 
 from __future__ import annotations

@@ -11,6 +11,13 @@ series as JSON while ``--flight-record`` is active (404 otherwise):
 Prometheus scrapes sample the *instant*; the flight series carries the
 whole scan's per-stage history at the recorder's resolution, which is
 what the doctor's windowed verdicts and any post-hoc notebook need.
+
+``/report.json`` serves the follow service's point-in-time report (same
+schema as ``--json``) while ``--follow`` runs (404 otherwise): the drive
+loop publishes a pre-serialized document at every poll boundary
+(serve/state.py), and the handler reads only that latest snapshot — the
+rule 9 lock-discipline boundary that keeps a slow scrape from ever
+stalling ingest.
 """
 
 from __future__ import annotations
@@ -41,6 +48,28 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
         path = self.path.split("?", 1)[0]
+        if path == "/report.json":
+            # Follow-mode point-in-time report (serve/state.py).  The
+            # handler only ever reads the latest PRE-SERIALIZED document
+            # through the designated snapshot accessor — it must never
+            # call into the drive loop or take fold-state locks, so a
+            # slow scrape cannot stall ingest (tools/lint.sh rule 9).
+            from kafka_topic_analyzer_tpu.serve import state as _serve_state
+
+            svc = _serve_state.active()
+            if svc is None:
+                self.send_error(
+                    404, "no follow service (run with --follow)"
+                )
+                return
+            body = svc.report_bytes()
+            if body is None:
+                self.send_error(
+                    503, "report not yet assembled (first pass running)"
+                )
+                return
+            self._respond(body, "application/json")
+            return
         if path == "/flight":
             import json
 
@@ -57,7 +86,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             )
             return
         if path not in ("/metrics", "/"):
-            self.send_error(404, "try /metrics or /flight")
+            self.send_error(404, "try /metrics, /flight, or /report.json")
             return
         body = render_prometheus(self.server.registry.snapshot()).encode()
         self._respond(body, CONTENT_TYPE)

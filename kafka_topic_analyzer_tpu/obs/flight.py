@@ -101,6 +101,13 @@ def _default_tracks() -> "List[Tuple[str, str, Callable[[], float]]]":
         ("retire_s", "cum", lambda: _hist_sum(m.DISPATCH_SECONDS)),
         # Scan progress, so windows carry a records-rate alongside.
         ("records", "cum", lambda: m.SCAN_RECORDS.value),
+        # Follow-mode service signals (serve/follow.py): the moving-head
+        # lag and the poll/pass cadence, so a service run's flight series
+        # shows "how far behind the head" next to the stage occupancies
+        # for the life of the service.  Zero-valued lanes for batch scans.
+        ("follow_lag", "inst", lambda: m.FOLLOW_LAG.value),
+        ("follow_polls", "cum", lambda: m.FOLLOW_POLLS.value),
+        ("follow_passes", "cum", lambda: m.FOLLOW_PASSES.value),
     ]
     return tracks
 

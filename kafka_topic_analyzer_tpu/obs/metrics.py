@@ -267,6 +267,32 @@ BACKEND_FINALIZE_SECONDS = _REG.histogram(
     "Backend finalize (device sync + collective merge) latency",
     buckets=LATENCY_BUCKETS_S)
 
+# -- follow-mode service (serve/follow.py + io/kafka_wire.py) -----------------
+
+FOLLOW_POLLS = _REG.counter(
+    "kta_follow_polls_total",
+    "Watermark re-polls the follow service took at the head")
+FOLLOW_PASSES = _REG.counter(
+    "kta_follow_passes_total",
+    "Fold passes the follow service ran: the initial catch-up pass, one "
+    "per poll that found new records, and the final shutdown commit")
+FOLLOW_LAG = _REG.gauge(
+    "kta_follow_lag_records",
+    "Records between the follow cursor and the latest polled end "
+    "watermarks, summed over this process's partitions — recomputed "
+    "against the MOVING head every poll, unlike the per-partition "
+    "kta_partition_lag gauges a batch scan freezes at its start snapshot",
+    # Controllers feed disjoint partition sets; fleet lag is their sum.
+    merge="sum")
+WATERMARK_REFRESH_FAILURES = _REG.counter(
+    "kta_watermark_refresh_failures_total",
+    "Watermark re-polls that exhausted the transport retry budget and "
+    "kept the previous snapshot (the service retries next poll)")
+REPORT_SNAPSHOTS = _REG.counter(
+    "kta_report_snapshots_total",
+    "Point-in-time report documents published for /report.json (one per "
+    "follow poll boundary; the HTTP handler only ever reads the latest)")
+
 # -- flight recorder (obs/flight.py) ------------------------------------------
 
 FLIGHT_SAMPLES = _REG.counter(

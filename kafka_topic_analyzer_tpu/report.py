@@ -374,7 +374,82 @@ def render_telemetry_stats(
             f"{dispatch.batches:,} batches folded, "
             f"{dispatch.mean_latency_ms:.1f} ms mean dispatch latency"
         )
+    # Follow-service digest: polls/passes at the head plus the two
+    # never-silent failure counters.  Only rendered for --follow runs —
+    # batch scans never touch the follow instruments.
+    from kafka_topic_analyzer_tpu.results import FollowStats
+
+    follow = FollowStats.from_telemetry(snapshot)
+    if follow.polls or follow.passes:
+        lines.append(
+            f"  follow: {follow.polls:,} watermark polls, "
+            f"{follow.passes:,} fold passes, "
+            f"{follow.report_snapshots:,} report snapshots published, "
+            f"{follow.refresh_failures:,} refresh give-ups"
+        )
     return "\n".join(lines) + "\n"
+
+
+def attach_scan_digests(doc: dict, result, diagnosis=None) -> None:
+    """The digest blocks every ``--json`` document carries (single-topic,
+    multi-topic fan-in, and /report.json alike): ``segments`` when the
+    scan read a segment store, ``wire`` for packed backends, ``flight``
+    when a diagnosis was computed.  ONE implementation so the surfaces
+    cannot drift field-by-field."""
+    from kafka_topic_analyzer_tpu.results import SegmentStats
+
+    seg = SegmentStats.from_telemetry(result.telemetry)
+    if seg.files:
+        doc["segments"] = seg.as_dict()
+    if getattr(result, "wire", None) is not None:
+        doc["wire"] = result.wire.as_dict()
+    if diagnosis is not None:
+        doc["flight"] = diagnosis.as_dict()
+
+
+def attach_issue_blocks(doc: dict, result) -> None:
+    """The str-keyed ``corrupt_partitions``/``degraded_partitions`` maps
+    (shared by every --json surface and cli._scan_issue_exit)."""
+    corrupt = getattr(result, "corrupt_partitions", None) or {}
+    if corrupt:
+        doc["corrupt_partitions"] = {str(p): d for p, d in corrupt.items()}
+    if result.degraded_partitions:
+        doc["degraded_partitions"] = {
+            str(p): r for p, r in result.degraded_partitions.items()
+        }
+
+
+def build_json_doc(
+    topic: str,
+    result,
+    diagnosis=None,
+    follow: "Optional[dict]" = None,
+    windows: "Optional[dict]" = None,
+) -> dict:
+    """The machine-readable report document — ONE builder for every
+    surface that emits it: the CLI's ``--json`` stdout, the follow
+    service's poll-boundary publishes, and therefore the ``/report.json``
+    endpoint (serve/state.py), which by construction can never drift from
+    the CLI schema.  ``result`` is an `engine.ScanResult`; ``diagnosis``
+    the scan doctor's verdict (obs/doctor.diagnose_scan); ``follow`` and
+    ``windows`` the service-layer blocks (absent for batch scans)."""
+    doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)
+    doc["topic"] = topic
+    doc["duration_secs"] = result.duration_secs
+    doc["ingest_workers"] = result.ingest_workers
+    doc["ingest_workers_per_controller"] = (
+        result.ingest_workers_per_controller
+    )
+    doc["superbatch_k"] = result.superbatch_k
+    doc["dispatch_depth"] = result.dispatch_depth
+    doc["telemetry"] = result.telemetry
+    attach_scan_digests(doc, result, diagnosis)
+    if follow is not None:
+        doc["follow"] = follow
+    if windows is not None:
+        doc["windows"] = windows
+    attach_issue_blocks(doc, result)
+    return doc
 
 
 def render_extremes_table(metrics: TopicMetrics) -> str:

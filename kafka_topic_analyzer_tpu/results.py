@@ -218,6 +218,49 @@ class SegmentStats:
 
 
 @dataclasses.dataclass
+class FollowStats:
+    """Follow-service accounting extracted from a telemetry snapshot
+    (`ScanResult.telemetry`): watermark polls, fold passes, refresh
+    give-ups, and published report snapshots.  Consumed by the ``--stats``
+    digest (report.py) and the report document's ``follow`` block
+    (serve/follow.py layers the live cursor on top); empty
+    (``polls == 0``) for batch scans, which never touch the follow
+    instruments."""
+
+    #: Watermark re-polls the service took at the head.
+    polls: int
+    #: Fold passes (initial catch-up + one per productive poll + final).
+    passes: int
+    #: Re-polls that exhausted the retry budget and kept the old snapshot.
+    refresh_failures: int
+    #: Report documents published for /report.json.
+    report_snapshots: int
+
+    @classmethod
+    def from_telemetry(cls, snapshot: "Optional[dict]") -> "FollowStats":
+        def total(name: str) -> int:
+            metric = (snapshot or {}).get(name)
+            if not metric:
+                return 0
+            return int(sum(s.get("value", 0.0) for s in metric["samples"]))
+
+        return cls(
+            polls=total("kta_follow_polls_total"),
+            passes=total("kta_follow_passes_total"),
+            refresh_failures=total("kta_watermark_refresh_failures_total"),
+            report_snapshots=total("kta_report_snapshots_total"),
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "polls": self.polls,
+            "passes": self.passes,
+            "watermark_refresh_failures": self.refresh_failures,
+            "report_snapshots": self.report_snapshots,
+        }
+
+
+@dataclasses.dataclass
 class DispatchStats:
     """Superbatch-dispatch accounting extracted from a telemetry snapshot
     (`ScanResult.telemetry`): device dispatches, batches folded through

@@ -1,0 +1,25 @@
+"""Follow-mode service layer: the long-running analyzer (DESIGN.md §18).
+
+The batch CLI scans earliest→latest and exits; this package keeps the
+scan alive at the head and turns the process into a service:
+
+- ``serve.follow``  — the tail loop: re-poll watermarks, fold new records
+  incrementally through the existing engine (superbatch, parallel
+  ingest, and the sharded mesh all compose unchanged), checkpoint on an
+  interval, stop cleanly on SIGINT/SIGTERM;
+- ``serve.windows`` — the time-windowed folds: a ring of associatively
+  mergeable window states (per-window record rate, per-partition
+  cardinality, size distribution) answering "what changed in the last
+  5 minutes", which no cumulative fold can;
+- ``serve.state``   — the lock-consistent report snapshot the HTTP layer
+  serves at ``/report.json``: the drive loop PUBLISHES pre-serialized
+  documents, handlers only ever READ the latest — a slow scrape can
+  never stall ingest (tools/lint.sh rule 9 enforces the split).
+"""
+
+from kafka_topic_analyzer_tpu.serve.follow import FollowService  # noqa: F401
+from kafka_topic_analyzer_tpu.serve.state import (  # noqa: F401
+    ServiceState,
+    active,
+    set_active,
+)

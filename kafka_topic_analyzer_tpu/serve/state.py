@@ -1,0 +1,84 @@
+"""Lock-consistent report snapshots for the service HTTP surface.
+
+The snapshot-consistency rule (DESIGN.md §18): the follow drive loop
+assembles and serializes a full report document at each poll boundary and
+*publishes* it here; the ``/report.json`` handler (obs/exporters.py) only
+ever *reads* the latest published bytes.  The lock below guards a single
+reference swap on publish and a single reference read on serve — both
+O(1) — so a scrape returns in microseconds and can never block folding,
+and a publish can never block on a slow client.  Handlers must not reach
+any deeper: ``report_bytes``/``snapshot`` are the ONLY sanctioned
+accessors (tools/lint.sh rule 9 rejects handler code that calls into the
+drive loop or takes any other fold-state lock).
+
+Module-level ``active()``/``set_active()`` mirror obs/flight.py: the CLI
+registers the running service's state for the session so the exporter —
+which predates this package and must not import it eagerly — can look it
+up per request.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+
+
+class ServiceState:
+    """Latest published report document, pre-serialized.
+
+    Serialization happens on the PUBLISHING side (the drive loop, once
+    per poll boundary) — never per scrape — so N dashboard scrapes cost N
+    reference reads, not N ``json.dumps`` of a large document.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._doc: "Optional[dict]" = None
+        self._bytes: "Optional[bytes]" = None
+        self._published_at: "Optional[float]" = None
+
+    def publish(self, doc: dict) -> None:
+        """Swap in a new point-in-time report document (drive-loop side).
+        The document is stamped (``report_ts``) and serialized here, then
+        installed under the lock in one assignment."""
+        doc = dict(doc)
+        doc["report_ts"] = round(self._clock(), 3)
+        body = json.dumps(doc).encode()
+        with self._lock:
+            self._doc = doc
+            self._bytes = body
+            self._published_at = doc["report_ts"]
+        obs_metrics.REPORT_SNAPSHOTS.inc()
+
+    def report_bytes(self) -> "Optional[bytes]":
+        """The latest serialized report (HTTP-handler side), or None
+        before the first publish.  One lock acquire, one reference read."""
+        with self._lock:
+            return self._bytes
+
+    def snapshot(self) -> "Optional[dict]":
+        """The latest report document (test/introspection side)."""
+        with self._lock:
+            return self._doc
+
+    @property
+    def published_at(self) -> "Optional[float]":
+        with self._lock:
+            return self._published_at
+
+
+_active: "Optional[ServiceState]" = None
+
+
+def set_active(state: "Optional[ServiceState]") -> None:
+    global _active
+    _active = state
+
+
+def active() -> "Optional[ServiceState]":
+    return _active

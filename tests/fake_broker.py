@@ -363,6 +363,43 @@ class FakeBroker:
         self._threads.append(t)
         return self
 
+    def produce(self, partition: int, records: "List[Record]") -> None:
+        """Append records to a partition WHILE the broker serves — the
+        follow-mode test seam (tests/test_follow.py).  Offsets must
+        strictly extend the partition's retained log.  The records are
+        pre-encoded into one new fetch chunk, the chunk is made fetchable
+        first, and only then is the end watermark advanced (appends are
+        atomic under the GIL) — so a client can never observe a watermark
+        it cannot fetch up to."""
+        if not records:
+            return
+        if partition not in self.records:
+            raise AssertionError(
+                "produce() targets an existing partition (metadata is "
+                "fixed at construction)"
+            )
+        records = sorted(records, key=lambda r: r[0])
+        rs = self.records[partition]
+        if rs and records[0][0] <= rs[-1][0]:
+            raise AssertionError("produced offsets must extend the log")
+        if self.message_magic == 2:
+            encoded = kc.encode_record_batch(records, self.compression)
+        else:
+            encoded = kc.encode_message_set(
+                records, magic=self.message_magic,
+                compression=self.compression,
+            )
+        if self.corruption is not None:
+            encoded = self.corruption.apply(
+                partition, len(self._chunks[partition]), encoded
+            )
+        rs.extend(records)
+        self._chunks[partition].append(
+            (records[0][0], records[-1][0], encoded)
+        )
+        self._chunk_last_offsets[partition].append(records[-1][0])
+        self.end_offsets[partition] = records[-1][0] + 1
+
     def stop(self) -> None:
         self._stop.set()
         try:

@@ -1,0 +1,775 @@
+"""Follow mode (ISSUE 11): the long-running analyzer service.
+
+The contract under test, per DESIGN.md §18:
+
+- BYTE-IDENTITY: a followed topic stopped at offset X reports exactly
+  what a batch scan to X reports — across ingest workers × superbatch K
+  × mesh, with records arriving mid-follow (FakeBroker.produce);
+- DURABILITY: SIGTERM lands a final checkpoint and a clean exit, and a
+  restarted service resumes from any snapshot (batch- or follow-written)
+  with no loss and no double-count;
+- SERVICE SURFACE: /report.json serves the latest poll-boundary snapshot
+  (same schema as --json) while folding continues, without touching the
+  drive loop;
+- WINDOW ALGEBRA: ring states merge associatively/commutatively, and the
+  observer never perturbs the batches it watches;
+- HEAD BEHAVIOR: watermark refreshes ride the retry budget (a metadata
+  hiccup never kills the service), lag gauges track the MOVING head, and
+  an idle service does not flood the event log.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.config import (
+    AnalyzerConfig,
+    DispatchConfig,
+    FollowConfig,
+)
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+from kafka_topic_analyzer_tpu.obs import events as obs_events
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.records import RecordBatch
+from kafka_topic_analyzer_tpu.serve import state as serve_state
+from kafka_topic_analyzer_tpu.serve.follow import FollowService
+from kafka_topic_analyzer_tpu.serve.windows import (
+    WindowObserver,
+    WindowRing,
+    WindowState,
+)
+
+from fake_broker import FakeBroker, FakeCluster, FaultInjector
+
+pytestmark = pytest.mark.follow
+
+TOPIC = "follow.topic"
+
+FAST_RETRY = {
+    "retry.backoff.ms": "5",
+    "reconnect.backoff.max.ms": "40",
+}
+
+#: Tight service pacing so follow tests stay inside the tier-1 budget.
+FAST_FOLLOW = dict(
+    poll_interval_s=0.02,
+    idle_backoff_max_s=0.05,
+    window_secs=5.0,
+    window_count=4,
+)
+
+N_PARTS = 3
+PHASE1_N = 120
+PHASE2_N = 60
+
+
+def _mk_records(partition: int, lo: int, n: int):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 23}".encode() if i % 5 else None,
+            bytes(20 + (i % 13)) if i % 7 else None,
+        )
+        for i in range(lo, lo + n)
+    ]
+
+
+PHASE1 = {p: _mk_records(p, 0, PHASE1_N) for p in range(N_PARTS)}
+PHASE2 = {p: _mk_records(p, PHASE1_N, PHASE2_N) for p in range(N_PARTS)}
+FULL = {p: PHASE1[p] + PHASE2[p] for p in range(N_PARTS)}
+TOTAL = N_PARTS * (PHASE1_N + PHASE2_N)
+
+
+def _cfg(**kw) -> AnalyzerConfig:
+    base = dict(
+        num_partitions=N_PARTS,
+        batch_size=64,
+        count_alive_keys=True,
+        alive_bitmap_bits=16,
+        enable_hll=True,
+        hll_p=8,
+        enable_quantiles=True,
+        quantiles_per_partition=True,
+    )
+    base.update(kw)
+    return AnalyzerConfig(**base)
+
+
+def _metrics_doc(result) -> dict:
+    return result.metrics.to_dict(result.start_offsets, result.end_offsets)
+
+
+def _batch_scan(records, backend_factory, workers=1, batch_size=64):
+    with FakeBroker(TOPIC, records, max_records_per_fetch=48) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        result = run_scan(
+            TOPIC, src, backend_factory(), batch_size,
+            ingest_workers=workers,
+        )
+        src.close()
+    return result
+
+
+def _wait_for(predicate, timeout_s=20.0, interval_s=0.01, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _published_count(svc) -> int:
+    doc = svc.state.snapshot()
+    return doc["overall"]["count"] if doc else -1
+
+
+def _run_followed(
+    backend,
+    workers=1,
+    batch_size=64,
+    follow_kw=None,
+    snapshot_dir=None,
+    resume=False,
+    mid_follow=None,
+    stop_at=TOTAL,
+):
+    """Drive one follow session: serve PHASE1, wait for it to be folded
+    and published, produce PHASE2 (after the optional ``mid_follow`` hook
+    armed chaos), wait for ``stop_at`` records, stop, return the result."""
+    follow = FollowConfig(**dict(FAST_FOLLOW, **(follow_kw or {})))
+    with FakeBroker(TOPIC, PHASE1, max_records_per_fetch=48) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        svc = FollowService(
+            TOPIC, src, backend, batch_size, follow,
+            snapshot_dir=snapshot_dir, resume=resume,
+            ingest_workers=workers,
+        )
+        errors = []
+
+        def driver():
+            try:
+                _wait_for(
+                    lambda: _published_count(svc) >= N_PARTS * PHASE1_N,
+                    what="phase-1 report",
+                )
+                if mid_follow is not None:
+                    mid_follow(broker)
+                for p in range(N_PARTS):
+                    broker.produce(p, PHASE2[p])
+                _wait_for(
+                    lambda: _published_count(svc) >= stop_at,
+                    what="phase-2 report",
+                )
+            except BaseException as e:  # surfaced after join
+                errors.append(e)
+            finally:
+                svc.request_stop("test")
+
+        t = threading.Thread(target=driver)
+        t.start()
+        result = svc.run()
+        t.join()
+        src.close()
+        if errors:
+            raise errors[0]
+    return result, svc
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: followed-to-X == batch-to-X, across workers × K × mesh
+
+
+@pytest.fixture(scope="module")
+def batch_referee():
+    """Batch scan of the full topic — the byte-exact referee."""
+    return _metrics_doc(
+        _batch_scan(FULL, lambda: TpuBackend(_cfg(), init_now_s=10**10))
+    )
+
+
+@pytest.mark.parametrize("workers,superbatch", [
+    (1, 1), (4, 1), (1, 4), (4, 4),
+])
+def test_follow_byte_identity_matrix(batch_referee, workers, superbatch):
+    backend = TpuBackend(
+        _cfg(), init_now_s=10**10,
+        dispatch=DispatchConfig(superbatch=superbatch),
+    )
+    result, svc = _run_followed(backend, workers=workers)
+    assert _metrics_doc(result) == batch_referee
+    assert svc.passes >= 2  # initial catch-up + at least one tail pass
+    assert result.next_offsets == {
+        p: PHASE1_N + PHASE2_N for p in range(N_PARTS)
+    }
+
+
+@pytest.mark.parametrize("superbatch", [1, 4])
+def test_follow_sharded_mesh_identity(batch_referee, superbatch):
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    backend = ShardedTpuBackend(
+        _cfg(mesh_shape=(2, 1)),
+        dispatch=DispatchConfig(superbatch=superbatch),
+        init_now_s=10**10,
+    )
+    result, _ = _run_followed(backend, workers=2)
+    assert _metrics_doc(result) == batch_referee
+
+
+def test_follow_cpu_oracle_identity():
+    ref = _metrics_doc(
+        _batch_scan(FULL, lambda: CpuExactBackend(_cfg(), init_now_s=10**10))
+    )
+    result, _ = _run_followed(CpuExactBackend(_cfg(), init_now_s=10**10))
+    assert _metrics_doc(result) == ref
+
+
+def test_follow_chaos_leader_migration_and_faults(batch_referee):
+    """Transport chaos mid-follow: the tail passes recover exactly."""
+    follow = FollowConfig(**FAST_FOLLOW)
+    with FakeCluster(TOPIC, PHASE1, n_nodes=2, max_records_per_fetch=48) as cluster:
+        src = KafkaWireSource(
+            cluster.bootstrap, TOPIC, overrides=dict(FAST_RETRY)
+        )
+        backend = TpuBackend(_cfg(), init_now_s=10**10)
+        svc = FollowService(TOPIC, src, backend, 64, follow)
+        errors = []
+
+        def driver():
+            try:
+                _wait_for(
+                    lambda: _published_count(svc) >= N_PARTS * PHASE1_N,
+                    what="phase-1 report",
+                )
+                # Arm chaos, then produce the tail into it: partition 0
+                # migrates leader, node 1 drops a response mid-stream.
+                cluster.migrate_leader(0, 1)
+                cluster.nodes[1].faults = FaultInjector().drop_connection(
+                    64, times=1
+                )
+                for node in cluster.nodes:
+                    for p in range(N_PARTS):
+                        node.produce(p, PHASE2[p])
+                _wait_for(
+                    lambda: _published_count(svc) >= TOTAL,
+                    what="phase-2 report",
+                )
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                svc.request_stop("test")
+
+        t = threading.Thread(target=driver)
+        t.start()
+        result = svc.run()
+        t.join()
+        src.close()
+        if errors:
+            raise errors[0]
+    assert _metrics_doc(result) == batch_referee
+    assert result.degraded_partitions == {}
+
+
+# ---------------------------------------------------------------------------
+# durability: SIGTERM → checkpoint → restart → resume
+
+
+def test_sigterm_checkpoint_resume_roundtrip(tmp_path, batch_referee):
+    snap = str(tmp_path / "snaps")
+    follow = FollowConfig(**dict(FAST_FOLLOW, checkpoint_every_s=0.0))
+    # Session 1: fold phase 1, then SIGTERM from a helper thread — the
+    # handler requests a stop, the loop commits a final checkpoint and
+    # returns cleanly.
+    with FakeBroker(TOPIC, PHASE1, max_records_per_fetch=48) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        svc = FollowService(
+            TOPIC, src, TpuBackend(_cfg(), init_now_s=10**10), 64, follow,
+            snapshot_dir=snap,
+        )
+        restore = svc.install_signal_handlers()
+        try:
+            killer = threading.Thread(
+                target=lambda: (
+                    _wait_for(
+                        lambda: _published_count(svc) >= N_PARTS * PHASE1_N,
+                        what="phase-1 report",
+                    ),
+                    os.kill(os.getpid(), signal.SIGTERM),
+                )
+            )
+            killer.start()
+            result1 = svc.run()
+            killer.join()
+        finally:
+            restore()
+        src.close()
+    assert result1.metrics.overall_count == N_PARTS * PHASE1_N
+    assert svc._stop_reason == "SIGTERM"
+    assert os.path.exists(os.path.join(snap, "scan_snapshot.npz"))
+    # The metadata-only reader sees the final-checkpoint commit point.
+    from kafka_topic_analyzer_tpu.checkpoint import snapshot_info
+
+    info = snapshot_info(snap)
+    assert info["records_seen"] == N_PARTS * PHASE1_N
+    assert info["next_offsets"] == {
+        str(p): PHASE1_N for p in range(N_PARTS)
+    }
+
+    # Session 2: a fresh process-equivalent resumes from the checkpoint,
+    # tails phase 2, and the union must equal the batch referee — no
+    # record lost, none double-counted.
+    with FakeBroker(TOPIC, FULL, max_records_per_fetch=48) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        svc2 = FollowService(
+            TOPIC, src, TpuBackend(_cfg(), init_now_s=10**10), 64, follow,
+            snapshot_dir=snap, resume=True,
+        )
+        stopper = threading.Thread(
+            target=lambda: (
+                _wait_for(
+                    lambda: _published_count(svc2) >= TOTAL,
+                    what="resumed full report",
+                ),
+                svc2.request_stop("test"),
+            )
+        )
+        stopper.start()
+        result2 = svc2.run()
+        stopper.join()
+        src.close()
+    assert _metrics_doc(result2) == batch_referee
+
+
+def test_follow_resumes_batch_scan_snapshot(tmp_path, batch_referee):
+    """A snapshot written by a plain batch scan seeds a follow service —
+    the fingerprint doesn't know (or care) which mode wrote it."""
+    snap = str(tmp_path / "snaps")
+    with FakeBroker(TOPIC, PHASE1, max_records_per_fetch=48) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        run_scan(
+            TOPIC, src, TpuBackend(_cfg(), init_now_s=10**10), 64,
+            snapshot_dir=snap, snapshot_every_s=0.0,
+        )
+        src.close()
+    with FakeBroker(TOPIC, FULL, max_records_per_fetch=48) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        svc = FollowService(
+            TOPIC, src, TpuBackend(_cfg(), init_now_s=10**10), 64,
+            FollowConfig(**FAST_FOLLOW), snapshot_dir=snap, resume=True,
+        )
+        stopper = threading.Thread(
+            target=lambda: (
+                _wait_for(
+                    lambda: _published_count(svc) >= TOTAL,
+                    what="resumed full report",
+                ),
+                svc.request_stop("test"),
+            )
+        )
+        stopper.start()
+        result = svc.run()
+        stopper.join()
+        src.close()
+    assert _metrics_doc(result) == batch_referee
+
+
+# ---------------------------------------------------------------------------
+# service surface: /report.json under concurrent folding
+
+
+def test_report_json_served_while_folding(batch_referee):
+    from kafka_topic_analyzer_tpu.obs.exporters import PrometheusExporter
+
+    exporter = PrometheusExporter(0)
+    url = f"http://127.0.0.1:{exporter.port}/report.json"
+    try:
+        # No service active → 404 with a hint.
+        serve_state.set_active(None)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=5)
+        assert exc.value.code == 404
+
+        scraped = []
+
+        def mid(broker):
+            # Service is live (svc.run registered its state) and mid-fold:
+            # the endpoint must answer from the published snapshot without
+            # blocking on — or being blocked by — the drive loop.
+            t0 = time.monotonic()
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                doc = json.loads(resp.read())
+            scraped.append((time.monotonic() - t0, doc))
+
+        result, svc = _run_followed(
+            TpuBackend(_cfg(), init_now_s=10**10), mid_follow=mid
+        )
+        assert _metrics_doc(result) == batch_referee
+        elapsed, doc = scraped[0]
+        # The handler reads one pre-serialized snapshot: far under the
+        # 100 ms assembly bar even on a loaded CI box.
+        assert elapsed < 1.0
+        assert doc["topic"] == TOPIC
+        assert doc["overall"]["count"] >= N_PARTS * PHASE1_N
+        assert "follow" in doc and "windows" in doc and "flight" in doc
+        assert set(doc["follow"]["next_offsets"]) == {
+            str(p) for p in range(N_PARTS)
+        }
+        # Final published report equals the CLI's --json schema essentials.
+        final = svc.state.snapshot()
+        assert final["overall"]["count"] == TOTAL
+        # Windows describe the LIVE tail: the phase-1 catch-up backlog is
+        # deliberately excluded (it did not "change in the last N
+        # minutes"); only the records produced mid-follow are windowed.
+        assert final["windows"]["merged"]["records"] == N_PARTS * PHASE2_N
+        # Published totals are SERVICE totals, not last-pass totals: the
+        # cumulative duration rides every snapshot.
+        assert final["duration_secs"] == result.duration_secs
+    finally:
+        serve_state.set_active(None)
+        exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# window-ring algebra
+
+
+def _rand_batch(rng, n=64, parts=N_PARTS):
+    sizes = rng.integers(0, 500, n)
+    key_null = rng.random(n) < 0.2
+    return RecordBatch(
+        partition=rng.integers(0, parts, n).astype(np.int32),
+        key_len=np.where(key_null, 0, rng.integers(1, 20, n)).astype(np.int32),
+        value_len=sizes.astype(np.int32),
+        key_null=key_null,
+        value_null=rng.random(n) < 0.1,
+        ts_s=np.full(n, 1_600_000_000, dtype=np.int64),
+        key_hash32=rng.integers(0, 2**32, n, dtype=np.uint32),
+        key_hash64=rng.integers(0, 2**63, n, dtype=np.uint64),
+        valid=rng.random(n) < 0.95,
+    )
+
+
+def _state_tuple(st: WindowState):
+    return (
+        st.records.tolist(), st.bytes.tolist(), st.tombstones.tolist(),
+        st.hll.tolist(), st.size_hist.tolist(),
+    )
+
+
+def test_window_state_merge_algebra():
+    rng = np.random.default_rng(7)
+    rows = lambda b: b.partition.astype(np.int64)  # noqa: E731
+    states = []
+    for _ in range(3):
+        st = WindowState(N_PARTS, hll_p=6)
+        for _ in range(4):
+            b = _rand_batch(rng)
+            st.observe(rows(b), b)
+        states.append(st)
+    a, b, c = states
+    # Associative + commutative.
+    assert _state_tuple(a.merge(b).merge(c)) == _state_tuple(
+        a.merge(b.merge(c))
+    )
+    assert _state_tuple(a.merge(b)) == _state_tuple(b.merge(a))
+    # A fresh state is the merge identity.
+    ident = WindowState(N_PARTS, hll_p=6)
+    assert _state_tuple(a.merge(ident)) == _state_tuple(a)
+    # Splitting a stream across states then merging == one-state fold.
+    rng1, rng2 = np.random.default_rng(11), np.random.default_rng(11)
+    whole = WindowState(N_PARTS, hll_p=6)
+    parts_a, parts_b = WindowState(N_PARTS, hll_p=6), WindowState(N_PARTS, hll_p=6)
+    for i in range(6):
+        batch = _rand_batch(rng1)
+        whole.observe(rows(batch), batch)
+        again = _rand_batch(rng2)
+        (parts_a if i % 2 else parts_b).observe(rows(again), again)
+    assert _state_tuple(whole) == _state_tuple(parts_a.merge(parts_b))
+
+
+def test_window_ring_rotation_and_merge():
+    now = [0.0]
+    ring = WindowRing(
+        [0, 1, 2], window_secs=10.0, window_count=3, hll_p=6,
+        clock=lambda: now[0],
+    )
+    rng = np.random.default_rng(3)
+    b1 = _rand_batch(rng)
+    ring.observe_batch(b1)
+    now[0] = 11.0  # next window
+    b2 = _rand_batch(rng)
+    ring.observe_batch(b2)
+    rep = ring.report()
+    assert [w["window"] for w in rep["windows"]] == [0, 1]
+    total = int(b1.valid.sum() + b2.valid.sum())
+    assert rep["merged"]["records"] == total
+    assert sum(w["records"] for w in rep["windows"]) == total
+    # Ring bound: after 5 more windows only the newest 3 survive.
+    for wi in range(2, 7):
+        now[0] = wi * 10.0 + 1
+        ring.observe_batch(_rand_batch(rng))
+    rep = ring.report()
+    assert len(rep["windows"]) == 3
+    assert [w["window"] for w in rep["windows"]] == [4, 5, 6]
+    # Cardinality estimates land within the sketch's error regime.
+    merged = ring.merged()
+    est = sum(merged.cardinality())
+    assert est > 0
+
+
+def test_window_ring_prunes_by_index_distance_across_quiet_gaps():
+    """Quiet periods create no states, so the ring must prune by window
+    INDEX, not insertion count — a burst from hours ago cannot linger in
+    'the last N windows', and the merged rate denominator is the ring's
+    covered span (quiet windows included), not just the populated ones."""
+    now = [0.0]
+    ring = WindowRing(
+        [0, 1, 2], window_secs=10.0, window_count=3, hll_p=6,
+        clock=lambda: now[0],
+    )
+    rng = np.random.default_rng(9)
+    burst = _rand_batch(rng)
+    ring.observe_batch(burst)
+    # Long silence, then one batch far in the future: the old burst has
+    # aged out of the 3-window horizon entirely.
+    now[0] = 101.0
+    fresh = _rand_batch(rng)
+    ring.observe_batch(fresh)
+    rep = ring.report()
+    assert [w["window"] for w in rep["windows"]] == [10]
+    assert rep["merged"]["records"] == int(fresh.valid.sum())
+    # Coverage clamps to the ring horizon — NOT the sum of populated
+    # windows (which would claim a ~10x rate across the quiet gap).
+    assert ring.coverage_s() == pytest.approx(30.0)
+    assert rep["merged"]["rate_per_s"] == pytest.approx(
+        int(fresh.valid.sum()) / 30.0, rel=1e-6
+    )
+
+
+def test_follow_rejects_multi_controller_backend():
+    """Multi-controller pass entry would need per-poll lockstep
+    agreement; until ROADMAP item 2 builds it, refuse cleanly."""
+    class _Cfg:
+        data_shards = 2
+
+    class _MultiBackend:
+        config = _Cfg()
+        local_rows = [0]  # this process hosts 1 of 2 data rows
+
+        def global_any(self, flag):  # pragma: no cover - presence only
+            return flag
+
+    class _Src:
+        def partitions(self):
+            return [0, 1]
+
+    with pytest.raises(ValueError, match="multi-controller"):
+        FollowService("t", _Src(), _MultiBackend(), 64, FollowConfig())
+
+
+def test_window_observer_passes_batches_through_untouched():
+    class _Src:
+        def partitions(self):
+            return [0, 1, 2]
+
+        def batches(self, batch_size, partitions=None, start_at=None):
+            rng = np.random.default_rng(5)
+            for _ in range(3):
+                yield _rand_batch(rng)
+
+    ring = WindowRing([0, 1, 2], window_secs=60, window_count=2, hll_p=6)
+    obs = WindowObserver(_Src(), ring)
+    seen = list(obs.batches(64))
+    rng = np.random.default_rng(5)
+    expect = [_rand_batch(rng) for _ in range(3)]
+    for got, want in zip(seen, expect):
+        for name, _ in RecordBatch.FIELDS:
+            np.testing.assert_array_equal(
+                getattr(got, name), getattr(want, name)
+            )
+    assert ring.merged().records.sum() == sum(b.valid.sum() for b in expect)
+
+
+# ---------------------------------------------------------------------------
+# head behavior: watermark-refresh hardening, lag gauges, event flood
+
+
+def test_watermark_refresh_survives_broker_outage():
+    overrides = dict(FAST_RETRY, **{"transport.retry.budget": "2"})
+    broker = FakeBroker(TOPIC, PHASE1).start()
+    src = KafkaWireSource(
+        f"127.0.0.1:{broker.port}", TOPIC, overrides=overrides
+    )
+    start0, end0 = src.watermarks()
+    fails0 = obs_metrics.WATERMARK_REFRESH_FAILURES.value
+    broker.kill()  # dead broker: every re-poll attempt fails
+    start, end = src.refresh_watermarks()
+    # Budget exhausted → the PREVIOUS snapshot stays in force, the
+    # give-up is booked, and no exception reaches the service loop.
+    assert (start, end) == (start0, end0)
+    assert obs_metrics.WATERMARK_REFRESH_FAILURES.value == fails0 + 1
+    src.close()
+    broker.stop()
+
+
+def test_watermark_refresh_sees_moving_head():
+    with FakeBroker(TOPIC, PHASE1) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        _, end0 = src.watermarks()
+        assert end0 == {p: PHASE1_N for p in range(N_PARTS)}
+        broker.produce(0, PHASE2[0])
+        # The cached batch-scan snapshot must NOT move...
+        assert src.watermarks()[1] == end0
+        # ...until the follow loop explicitly refreshes it.
+        _, end1 = src.refresh_watermarks()
+        assert end1[0] == PHASE1_N + PHASE2_N
+        assert src.watermarks()[1] == end1
+        src.close()
+
+
+def test_follow_lifecycle_events_do_not_flood():
+    events = []
+    sink = lambda etype, fields: events.append((etype, fields))  # noqa: E731
+    obs_events.add_sink(sink)
+    try:
+        result, svc = _run_followed(
+            CpuExactBackend(_cfg(), init_now_s=10**10),
+            follow_kw=dict(poll_interval_s=0.005, idle_backoff_max_s=0.01),
+        )
+    finally:
+        obs_events.remove_sink(sink)
+    kinds = [e for e, _ in events]
+    # ONE lifecycle pair for the whole service run, not one per pass.
+    assert kinds.count("scan_start") == 1
+    assert kinds.count("scan_end") == 1
+    assert kinds.count("follow_stop") == 1
+    starts = [f for e, f in events if e == "scan_start"]
+    assert starts[0]["follow"] is True
+    # follow_poll only fires on productive polls — never once per idle
+    # poll, however many the head-idle period racked up.
+    polls = [f for e, f in events if e == "follow_poll"]
+    assert 1 <= len(polls) <= svc.passes
+    assert all(f["new_records"] > 0 for f in polls)
+    # The shared heartbeat limiter spans passes: a sub-interval service
+    # run emits at most the first-ready heartbeat plus the closing one.
+    assert kinds.count("heartbeat") <= 2
+    # Lag gauges settle at zero against the FINAL head, not the start
+    # snapshot.
+    assert obs_metrics.FOLLOW_LAG.value == 0
+
+
+def test_follow_empty_topic_waits_for_first_record():
+    empty = {p: [] for p in range(N_PARTS)}
+    follow = FollowConfig(**FAST_FOLLOW)
+    with FakeBroker(TOPIC, empty, max_records_per_fetch=48) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        assert src.is_empty()
+        svc = FollowService(
+            TOPIC, src, CpuExactBackend(_cfg(), init_now_s=10**10), 64,
+            follow,
+        )
+
+        def driver():
+            _wait_for(lambda: svc.state.snapshot() is not None,
+                      what="empty initial report")
+            broker.produce(0, PHASE2[0])
+            _wait_for(lambda: _published_count(svc) >= PHASE2_N,
+                      what="first records")
+            svc.request_stop("test")
+
+        t = threading.Thread(target=driver)
+        t.start()
+        result = svc.run()
+        t.join()
+        src.close()
+    assert result.metrics.overall_count == PHASE2_N
+    assert result.next_offsets[0] == PHASE1_N + PHASE2_N
+
+
+def test_follow_idle_exit_drains_and_stops():
+    """--follow-idle-exit: catch up, wait out the idle window, exit on
+    its own — no driver thread involved."""
+    follow = FollowConfig(
+        **dict(FAST_FOLLOW, idle_exit_s=0.15)
+    )
+    with FakeBroker(TOPIC, PHASE1, max_records_per_fetch=48) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        svc = FollowService(
+            TOPIC, src, CpuExactBackend(_cfg(), init_now_s=10**10), 64,
+            follow,
+        )
+        result = svc.run()
+        src.close()
+    assert svc._stop_reason == "idle"
+    assert result.metrics.overall_count == N_PARTS * PHASE1_N
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+
+
+def test_cli_follow_json(capsys):
+    with FakeBroker(TOPIC, FULL, max_records_per_fetch=48) as broker:
+        rc = __import__(
+            "kafka_topic_analyzer_tpu.cli", fromlist=["main"]
+        ).main([
+            "-t", TOPIC, "-b", f"127.0.0.1:{broker.port}",
+            "--librdkafka", "retry.backoff.ms=5,reconnect.backoff.max.ms=40",
+            "--follow", "--follow-idle-exit", "0.15",
+            "--poll-interval", "0.02", "--window-secs", "5",
+            "--json", "--quiet",
+        ])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["overall"]["count"] == TOTAL
+    assert doc["follow"]["passes"] >= 1
+    assert doc["follow"]["next_offsets"] == {
+        str(p): PHASE1_N + PHASE2_N for p in range(N_PARTS)
+    }
+    # Everything was already retained at service start, so it ALL folded
+    # in the catch-up pass — and catch-up records are excluded from the
+    # live-tail windows by design.
+    assert doc["windows"]["merged"]["records"] == 0
+    assert doc["telemetry"]["kta_follow_polls_total"]["samples"][0]["value"] >= 1
+
+
+def test_cli_follow_rejects_multi_topic(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "a,b", "-b", "127.0.0.1:1", "--follow", "--source", "kafka",
+    ])
+    assert rc == 1
+    assert "--follow does not support multi-topic" in capsys.readouterr().err

@@ -661,3 +661,99 @@ if failures:
 print(f"lint: OK ({len(names)} instruments: merge policies declared, "
       "README catalog rows present)")
 EOF
+
+# Ninth rule: the service HTTP surface can never stall ingest.  Handler
+# code (do_* methods / BaseHTTPRequestHandler subclasses) under serve/
+# and obs/exporters.py may not call into the drive loop or fold state
+# (run/update/finalize/get_state/..., the source read loop, the window
+# fold), and may not take locks of its own (.acquire / `with <lock>`):
+# everything a handler serves must come through a designated snapshot
+# accessor — serve.state.ServiceState.report_bytes/snapshot, the flight
+# recorder's series(), or render_prometheus over a registry snapshot —
+# whose single-reference-swap locking is owned by the publishing side.
+# A scrape is then O(1) reads, and a slow client can never hold a lock
+# the fold path wants (DESIGN.md §18 snapshot-consistency rule).
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PKG = pathlib.Path("kafka_topic_analyzer_tpu")
+SCOPE = sorted((PKG / "serve").glob("*.py")) + [PKG / "obs" / "exporters.py"]
+#: Drive-loop / fold-state entry points a handler must never reach.
+DRIVE_CALLS = {
+    "run", "run_scan", "run_follow",
+    "update", "update_shards", "update_superbatch",
+    "update_shards_superbatch", "finalize",
+    "get_state", "set_state", "get_state_local", "set_state_local",
+    "observe_batch", "observe", "merge", "merged",
+    "batches", "refresh_watermarks", "watermarks",
+    "publish", "request_stop",
+}
+#: The sanctioned read-only snapshot accessors.
+ACCESSORS = {"report_bytes", "snapshot", "series", "active",
+             "render_prometheus"}
+
+failures = []
+for path in SCOPE:
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+
+    handler_fns = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            bases = {
+                getattr(b, "id", getattr(b, "attr", "")) for b in node.bases
+            }
+            is_handler_cls = node.name.endswith("Handler") or any(
+                "Handler" in b for b in bases
+            )
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if is_handler_cls or item.name.startswith("do_"):
+                        handler_fns.append((node.name, item))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.startswith("do_"):
+                handler_fns.append(("", node))
+
+    for cls_name, fn in handler_fns:
+        qual = f"{cls_name}.{fn.name}" if cls_name else fn.name
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = None
+                if isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    name = node.func.id
+                if name in ACCESSORS:
+                    continue
+                if name in DRIVE_CALLS:
+                    failures.append(
+                        f"{path}:{node.lineno}: HTTP handler {qual!r} calls "
+                        f"drive-loop/fold-state entry point {name!r} — serve "
+                        "from the designated snapshot accessor instead"
+                    )
+                if name == "acquire":
+                    failures.append(
+                        f"{path}:{node.lineno}: HTTP handler {qual!r} takes "
+                        "a lock (.acquire) — locking belongs to the snapshot "
+                        "accessor, not the scrape path"
+                    )
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    src = ast.unparse(item.context_expr).lower()
+                    if "lock" in src:
+                        failures.append(
+                            f"{path}:{node.lineno}: HTTP handler {qual!r} "
+                            "holds a lock (`with ...lock...`) — serve "
+                            "pre-published snapshots instead"
+                        )
+
+if failures:
+    print("lint: service HTTP handlers must read only designated snapshot")
+    print("lint: accessors (no drive-loop calls, no fold-state locks —")
+    print("lint: a slow scrape can never stall ingest; DESIGN.md §18):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (service HTTP handlers read only published snapshots)")
+EOF
