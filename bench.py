@@ -193,6 +193,13 @@ def main() -> int:
                          "(host pre-reduced fold tables, default) vs v4 "
                          "per-record columns — byte-identical results, "
                          "different device fold cost (BENCH round 11)")
+    ap.add_argument("--alive-compaction", choices=["auto", "off"],
+                    default="auto",
+                    help="host-side LWW alive-pair compaction referee "
+                         "(BENCH round 13): 'auto' ships one bounded "
+                         "per-dispatch pair table applied after the scan, "
+                         "'off' keeps the per-row pair sections and the "
+                         "in-scan pair scatter — byte-identical results")
     ap.add_argument("--superbatch", default="1", metavar="K|auto",
                     help="stack K packed batches per jitted scan dispatch "
                          "(state donated once per superbatch; 'auto' "
@@ -272,6 +279,7 @@ def main() -> int:
         enable_quantiles="quantiles" in feats,
         use_pallas_counters=args.pallas,
         wire_format={"v4": 4, "v5": 5}[args.wire_format],
+        alive_compaction=args.alive_compaction,
     )
     spec = SyntheticSpec(
         num_partitions=args.partitions,

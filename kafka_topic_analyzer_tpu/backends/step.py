@@ -25,6 +25,58 @@ from kafka_topic_analyzer_tpu.ops.ddsketch import ddsketch_update
 from kafka_topic_analyzer_tpu.ops.hll import hll_apply
 
 
+def apply_pair_table(
+    state: AnalyzerState,
+    pairs,
+    config: AnalyzerConfig,
+    space_index=0,
+) -> AnalyzerState:
+    """Apply one dispatch's compacted alive table (DESIGN.md §19).
+
+    ``pairs`` is the `packing.unpack_pair_table_device` dict: the host
+    already LWW-merged every batch of the dispatch in stream order, so
+    ONE apply — after the dispatch's scan — replays exactly what the
+    per-batch scatters inside the scan body would have produced (LWW
+    compaction is LWW-associative), paying the bitmap update once per
+    dispatch instead of once per batch.  The table's form decides the
+    kernel (one rule, packing.alive_table_mode, read here via the section
+    names): set/clear word MASKS merge elementwise like any other v5
+    table — no scatter at all — while the bounded pair list keeps the
+    scatter apply for slot spaces too large to mask.  Under a
+    space-sharded mesh each shard masks/slices to its slot range
+    (``space_index``); the table is replicated over the space axis by its
+    input spec, so no per-step collective remains on the compacted path."""
+    if state.alive is None:
+        return state
+    if "alive_set" in pairs:
+        from kafka_topic_analyzer_tpu.ops.bitmap import bitmap_apply_masks
+
+        words = bitmap_apply_masks(
+            state.alive.words,
+            pairs["alive_set"],
+            pairs["alive_clear"],
+            bits=config.alive_bitmap_bits,
+            space_index=space_index,
+            space_shards=config.space_shards,
+        )
+    else:
+        words = bitmap_apply_pairs(
+            state.alive.words,
+            pairs["alive_slot"],
+            pairs["alive_flag"],
+            pairs["n_pairs"],
+            bits=config.alive_bitmap_bits,
+            space_index=space_index,
+            space_shards=config.space_shards,
+        )
+    return AnalyzerState(
+        metrics=state.metrics,
+        alive=AliveBitmapState(words=words),
+        hll=state.hll,
+        quantiles=state.quantiles,
+    )
+
+
 def superbatch_fold(
     state: AnalyzerState,
     bufs,
@@ -32,6 +84,7 @@ def superbatch_fold(
     config: AnalyzerConfig,
     space_index=0,
     space_axis: "str | None" = None,
+    pairs=None,
 ):
     """Fold a stacked superbatch — K packed buffers on a leading axis —
     into the state with a single ``lax.scan`` over that axis.
@@ -53,6 +106,12 @@ def superbatch_fold(
     use as a completion token for the bounded in-flight dispatch queue
     (it cannot alias a donated state leaf, so blocking on it is safe
     after later dispatches have consumed the state).
+
+    ``pairs`` (the compacted alive path) is the dispatch's merged pair
+    table, applied ONCE after the scan — see `apply_pair_table`; order is
+    preserved because the host merge already resolved per-slot last
+    writers across the K batches, and every other fold is
+    order-insensitive.
     """
     from kafka_topic_analyzer_tpu.jax_support import lax
 
@@ -63,7 +122,10 @@ def superbatch_fold(
             arrays["n_valid"],
         )
 
-    return lax.scan(body, state, bufs)
+    state, n_valid = lax.scan(body, state, bufs)
+    if pairs is not None:
+        state = apply_pair_table(state, pairs, config, space_index)
+    return state, n_valid
 
 
 def _apply_alive(
@@ -167,7 +229,9 @@ def _analyzer_step_v5(
     )
 
     alive_state = state.alive
-    if alive_state is not None:
+    if alive_state is not None and "alive_slot" in arrays:
+        # Compacted configs ship no per-row pair sections: the dispatch's
+        # merged pair table applies ONCE after the scan (apply_pair_table).
         alive_state = _apply_alive(
             alive_state, arrays, config, space_index, space_axis
         )
@@ -279,7 +343,9 @@ def analyzer_step(
     )
 
     alive_state = state.alive
-    if alive_state is not None:
+    if alive_state is not None and "alive_slot" in arrays:
+        # Compacted configs ship no per-row pair sections: the dispatch's
+        # merged pair table applies ONCE after the scan (apply_pair_table).
         alive_state = _apply_alive(
             alive_state, arrays, config, space_index, space_axis
         )

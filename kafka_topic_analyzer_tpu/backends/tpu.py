@@ -34,16 +34,24 @@ from kafka_topic_analyzer_tpu.backends.base import (
     instrument_steps,
 )
 from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
-from kafka_topic_analyzer_tpu.backends.step import analyzer_step, superbatch_fold
+from kafka_topic_analyzer_tpu.backends.step import (
+    analyzer_step,
+    apply_pair_table,
+    superbatch_fold,
+)
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig, DispatchConfig
 from kafka_topic_analyzer_tpu.models.state import AnalyzerState
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.packing import (
     SuperbatchStager,
+    batch_alive_pairs,
     pack_batch,
+    pack_pair_table,
     packed_nbytes,
+    pair_table_capacity,
     unpack_device,
     unpack_numpy,
+    unpack_pair_table_device,
 )
 from kafka_topic_analyzer_tpu.records import RecordBatch
 from kafka_topic_analyzer_tpu.results import TopicMetrics
@@ -51,7 +59,21 @@ from kafka_topic_analyzer_tpu.utils.timefmt import utc_now_seconds
 
 
 def make_packed_step(config: AnalyzerConfig):
-    """The jittable forward step: (state, packed uint8 buffer) → state."""
+    """The jittable forward step: (state, packed uint8 buffer) → state.
+
+    Under alive-pair compaction (``config.compact_alive``) the step takes
+    a second buffer — the batch's packed pair table — applied after the
+    fold exactly like the superbatch path applies its merged table."""
+    if config.compact_alive:
+        cap = pair_table_capacity(config, config.batch_size, 1)
+
+        def step_c(state: AnalyzerState, buf, pairbuf) -> AnalyzerState:
+            st = analyzer_step(state, unpack_device(buf, config), config)
+            return apply_pair_table(
+                st, unpack_pair_table_device(pairbuf, config, cap), config
+            )
+
+        return step_c
 
     def step(state: AnalyzerState, buf) -> AnalyzerState:
         return analyzer_step(state, unpack_device(buf, config), config)
@@ -59,14 +81,32 @@ def make_packed_step(config: AnalyzerConfig):
     return step
 
 
-def make_packed_superstep(config: AnalyzerConfig):
+def make_packed_superstep(config: AnalyzerConfig, k: int = 1):
     """The jittable superbatch step: (state, uint8[K, N]) → (state, token).
 
     One dispatch scan-folds the K stacked packed buffers in order
     (backends/step.py::superbatch_fold), donating the state once per
     superbatch instead of once per batch.  The token (int32[K] of
     per-batch valid counts) is a small non-donated output used by the
-    bounded dispatch queue as a completion marker."""
+    bounded dispatch queue as a completion marker.
+
+    Under alive-pair compaction the superstep takes the dispatch's merged
+    pair table (capacity ``pair_table_capacity(config, B, k)``) and
+    applies it ONCE after the scan — this is the compaction win: the
+    O(W) bitmap mask apply leaves the scan body entirely."""
+    if config.compact_alive:
+        cap = pair_table_capacity(config, config.batch_size, k)
+
+        def superstep_c(state: AnalyzerState, bufs, pairbuf):
+            return superbatch_fold(
+                state,
+                bufs,
+                lambda b: unpack_device(b, config),
+                config,
+                pairs=unpack_pair_table_device(pairbuf, config, cap),
+            )
+
+        return superstep_c
 
     def superstep(state: AnalyzerState, bufs):
         return superbatch_fold(
@@ -90,12 +130,19 @@ class StagedBatch:
     and the whole stack crosses in one large transfer at dispatch time.
     Deliberately just a typed buffer: all bookkeeping (counts, bytes,
     offsets) stays with the decoded batch the engine already holds.
+
+    ``pairs`` rides the compacted alive path (DESIGN.md §19): at K=1 it
+    is the batch's PACKED pair-table buffer (device-put alongside the
+    row on the producing thread); at K>1 the raw ``(slot u32[n], flag
+    u8[n])`` host arrays the dispatch-time merge consumes.  None when
+    compaction is off.
     """
 
-    __slots__ = ("buf",)
+    __slots__ = ("buf", "pairs")
 
-    def __init__(self, buf):
+    def __init__(self, buf, pairs=None):
         self.buf = buf
+        self.pairs = pairs
 
 
 def self_check_unpack(device=None) -> None:
@@ -166,9 +213,23 @@ class TpuBackend(MetricBackend):
         self.dispatch_config = dispatch if dispatch is not None else DispatchConfig()
         self.superbatch_k = self.dispatch_config.resolve(config.batch_size)
         self.dispatch_depth = self.dispatch_config.depth
+        # Compacted alive path (DESIGN.md §19): per-dispatch pair-table
+        # capacities for the two dispatch shapes this backend compiles.
+        self._compact = config.compact_alive
+        self._pair_cap1 = (
+            pair_table_capacity(config, config.batch_size, 1)
+            if self._compact
+            else 0
+        )
         if self.superbatch_k > 1:
+            self._pair_cap_k = (
+                pair_table_capacity(config, config.batch_size, self.superbatch_k)
+                if self._compact
+                else 0
+            )
             self._superstep = jax.jit(
-                make_packed_superstep(config), donate_argnums=(0,)
+                make_packed_superstep(config, self.superbatch_k),
+                donate_argnums=(0,),
             )
             self._stager = SuperbatchStager(
                 (packed_nbytes(config, config.batch_size),),
@@ -178,16 +239,42 @@ class TpuBackend(MetricBackend):
             self._queue = DispatchQueue(self.dispatch_depth)
             self._empty_buf: "np.ndarray | None" = None
 
+    def _pack_pairs(self, pair_lists, cap) -> np.ndarray:
+        """Merge + pack a dispatch's pair table, booking the raw→emitted
+        compaction split (never silent — the --stats ratio reads these)."""
+        buf, raw, emitted = pack_pair_table(
+            pair_lists, self.config, cap, use_native=self.use_native
+        )
+        obs_metrics.ALIVE_PAIRS_RAW.inc(raw)
+        obs_metrics.ALIVE_PAIRS_EMITTED.inc(emitted)
+        return buf
+
     def prepare(self, batch: RecordBatch) -> StagedBatch:
         """Pack (and, at superbatch K=1, start the host→device transfer
         for) a batch that will be fed to ``update``/``update_superbatch``
         later.  Safe to call from a worker thread (jax dispatch is
         thread-safe; the packers are pure numpy/C++).  At K>1 the buffer
         stays on the host: it is copied into its superbatch row at fan-in
-        time and crosses in the stack's single large transfer."""
+        time and crosses in the stack's single large transfer.  Compacted
+        alive configs stage the batch's pairs alongside: packed + put at
+        K=1 (the whole table is this batch's), raw host arrays at K>1
+        (the dispatch-time merge spans the superbatch)."""
         buf = pack_batch(batch, self.config, use_native=self.use_native)
         if self.superbatch_k > 1:
+            if self._compact:
+                return StagedBatch(
+                    buf, batch_alive_pairs(batch, self.config, self.use_native)
+                )
             return StagedBatch(buf)
+        if self._compact:
+            pairbuf = self._pack_pairs(
+                [batch_alive_pairs(batch, self.config, self.use_native)],
+                self._pair_cap1,
+            )
+            return StagedBatch(
+                jax.device_put(buf, self.device),
+                jax.device_put(pairbuf, self.device),
+            )
         return StagedBatch(jax.device_put(buf, self.device))
 
     def make_fused_sink(self, dense_of):
@@ -198,9 +285,15 @@ class TpuBackend(MetricBackend):
         stream — sinks are single-threaded state."""
         from kafka_topic_analyzer_tpu.packing import FusedPackSink
 
-        def stage(buf):
+        def stage(buf, pairs=None):
             if self.superbatch_k > 1:
-                return StagedBatch(buf)
+                return StagedBatch(buf, pairs)
+            if self._compact:
+                pairbuf = self._pack_pairs([pairs], self._pair_cap1)
+                return StagedBatch(
+                    jax.device_put(buf, self.device),
+                    jax.device_put(pairbuf, self.device),
+                )
             return StagedBatch(jax.device_put(buf, self.device))
 
         return FusedPackSink(
@@ -210,10 +303,26 @@ class TpuBackend(MetricBackend):
     def update(self, batch: "RecordBatch | StagedBatch") -> None:
         if isinstance(batch, StagedBatch):
             obs_metrics.WIRE_BYTES.inc(int(batch.buf.nbytes))
-            self.state = self._step(self.state, batch.buf)
+            if self._compact:
+                obs_metrics.WIRE_BYTES.inc(int(batch.pairs.nbytes))
+                self.state = self._step(self.state, batch.buf, batch.pairs)
+            else:
+                self.state = self._step(self.state, batch.buf)
             return
         buf = pack_batch(batch, self.config, use_native=self.use_native)
         obs_metrics.WIRE_BYTES.inc(int(buf.nbytes))
+        if self._compact:
+            pairbuf = self._pack_pairs(
+                [batch_alive_pairs(batch, self.config, self.use_native)],
+                self._pair_cap1,
+            )
+            obs_metrics.WIRE_BYTES.inc(int(pairbuf.nbytes))
+            self.state = self._step(
+                self.state,
+                jax.device_put(buf, self.device),
+                jax.device_put(pairbuf, self.device),
+            )
+            return
         self.state = self._step(self.state, jax.device_put(buf, self.device))
 
     def _empty_packed(self) -> np.ndarray:
@@ -238,18 +347,36 @@ class TpuBackend(MetricBackend):
             raise ValueError(f"superbatch of {len(staged)} batches (K={k})")
         self._queue.throttle()  # before staging: bounds host rows too
         rows = self._stager.next_slot()
+        pair_lists = []
         for i, item in enumerate(staged):
             if isinstance(item, StagedBatch):
                 np.copyto(rows[i], np.asarray(item.buf))
+                if self._compact and item.pairs is not None:
+                    pair_lists.append(item.pairs)
             else:
                 pack_batch(
                     item, self.config, use_native=self.use_native, out=rows[i]
                 )
+                if self._compact:
+                    pair_lists.append(
+                        batch_alive_pairs(item, self.config, self.use_native)
+                    )
         for i in range(len(staged), k):
             np.copyto(rows[i], self._empty_packed())
         obs_metrics.WIRE_BYTES.inc(int(rows.nbytes))
         bufs = jax.device_put(rows, self.device)
-        self.state, token = self._superstep(self.state, bufs)
+        if self._compact:
+            # The compaction tentpole: LWW-merge the superbatch's pairs in
+            # fold order into ONE bounded table — the device applies it
+            # once after the scan instead of scattering inside every scan
+            # step (identity-padded tail rows contribute no pairs).
+            pairbuf = self._pack_pairs(pair_lists, self._pair_cap_k)
+            obs_metrics.WIRE_BYTES.inc(int(pairbuf.nbytes))
+            self.state, token = self._superstep(
+                self.state, bufs, jax.device_put(pairbuf, self.device)
+            )
+        else:
+            self.state, token = self._superstep(self.state, bufs)
         self._queue.launched(token, len(staged))
 
     def drain_dispatch(self) -> None:

@@ -39,9 +39,16 @@ SNAPSHOT_NAME = "scan_snapshot.npz"
 #: pure execution strategy, safe to flip across a resume (the pallas and
 #: lax counter paths are bit-identical, tests/test_pallas_counters.py;
 #: wire v4 and v5 fold to byte-identical state, tests/test_wire_v5.py —
-#: a v4 snapshot resumes under v5 and vice versa).  Excluding wire_format
-#: also keeps pre-v5 snapshots' fingerprints valid unchanged.
-_EXECUTION_ONLY_FIELDS = ("use_pallas_counters", "wire_format")
+#: a v4 snapshot resumes under v5 and vice versa; compacted and
+#: uncompacted alive-pair folds are byte-identical,
+#: tests/test_alive_compaction.py).  Excluding wire_format (and
+#: alive_compaction) also keeps pre-v5 snapshots' fingerprints valid
+#: unchanged.
+_EXECUTION_ONLY_FIELDS = (
+    "use_pallas_counters",
+    "wire_format",
+    "alive_compaction",
+)
 
 
 def _fingerprint_at(
@@ -259,6 +266,64 @@ def save_snapshot(
     return path
 
 
+def _fingerprint_mismatch_message(
+    path: str, meta: dict, config: AnalyzerConfig, topic: str
+) -> str:
+    """A rejection message that NAMES the cause when it can.
+
+    Alive-key scans are mesh-pinned (`mesh_free_snapshots` — LWW bit
+    clears only resolve against the row that set the bit), and "I resumed
+    an alive scan on a different mesh" is by far the most common way to
+    hit this error — so instead of a bare "fingerprint mismatch", probe
+    whether the snapshot's stamp matches THIS config under some other
+    mesh shape and, when it does, say which mesh wrote it and what a
+    resume is allowed to change."""
+    base = (
+        f"snapshot at {path} was taken with a different topic/config "
+        "(fingerprint mismatch)"
+    )
+    if not config.count_alive_keys:
+        return base + " — delete it or match the original flags"
+    # Probe plausible writer meshes: same config, different (data, space)
+    # shape.  Bounded sweep — meshes are small integer grids.
+    stamp = meta.get("fingerprint")
+    for d in range(1, 65):
+        for s in (1, 2, 4, 8):
+            shape = (d, s)
+            if shape == tuple(config.mesh_shape):
+                continue
+            try:
+                probe = dataclasses.replace(config, mesh_shape=shape)
+            except ValueError:
+                continue
+            # s==1 writers stamp version 2 today, but r2/r3-era builds
+            # stamped every config v3 (see acceptable_fingerprints) —
+            # probe both so legacy snapshots get the same diagnosis.
+            versions = (2, 3) if s == 1 else (3,)
+            if any(
+                _fingerprint_at(probe, topic, v) == stamp for v in versions
+            ):
+                return (
+                    f"snapshot at {path} is MESH-PINNED and was written by "
+                    f"a mesh {shape[0]}x{shape[1]} scan: this scan counts "
+                    "alive keys (-c/--count-alive-keys), and alive-key "
+                    "snapshots only resume under the ORIGINAL mesh shape "
+                    "(last-writer-wins bit clears must land on the data "
+                    "row that set the bit — DESIGN.md §14).  Resume with "
+                    f"--mesh {shape[0]},{shape[1]} (ingest workers, "
+                    "superbatch, dispatch depth, wire format and "
+                    "alive-compaction may all change freely), or delete "
+                    "the snapshot to rescan under "
+                    f"--mesh {config.mesh_shape[0]},{config.mesh_shape[1]}"
+                )
+    return (
+        base
+        + " — this scan counts alive keys (-c/--count-alive-keys), whose "
+        "snapshots additionally pin the mesh shape; delete the snapshot "
+        "or match the original flags"
+    )
+
+
 def load_snapshot(
     directory: str,
     topic: str,
@@ -283,8 +348,7 @@ def load_snapshot(
         meta = json.loads(str(z["__meta__"]))
         if meta["fingerprint"] not in acceptable_fingerprints(config, topic):
             raise ValueError(
-                f"snapshot at {path} was taken with a different topic/config "
-                "(fingerprint mismatch) — delete it or match the original flags"
+                _fingerprint_mismatch_message(path, meta, config, topic)
             )
         if scope is not None:
             pid, nproc, rows = scope

@@ -195,6 +195,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "resolves to v5 unless KTA_WIRE_V4 is set. Results "
                         "are byte-identical either way; snapshots resume "
                         "across formats")
+    p.add_argument("--alive-compaction", choices=["auto", "off"],
+                   default="auto", metavar="auto|off",
+                   help="Host-side LWW compaction of the alive-key pairs "
+                        "into one bounded per-dispatch table (wire v5 "
+                        "only; DESIGN §19). 'auto' (default) compacts "
+                        "whenever -c runs under v5; 'off' keeps the "
+                        "per-row pair sections. Results are byte-identical "
+                        "either way; KTA_DISABLE_COMPACTION is the env "
+                        "kill switch, and a bypass is booked on "
+                        "kta_alive_compaction_off_total")
     p.add_argument("--native", choices=["auto", "on", "off"], default="auto",
                    help="Use the native C++ ingest shim when available")
     p.add_argument("--profile-dir", metavar="DIR",
@@ -624,6 +634,7 @@ def run_multi_topic(args, topics: "list[str]") -> int:
             mesh_shape=mesh_shape,
             use_pallas_counters=args.pallas,
             wire_format=resolve_wire_format(args),
+            alive_compaction=getattr(args, "alive_compaction", "auto"),
         )
         ingest_workers = resolve_ingest_workers(
             args, mesh_shape, len(multi.partitions())
@@ -824,6 +835,7 @@ def _run(args) -> int:
             mesh_shape=mesh_shape,
             use_pallas_counters=args.pallas,
             wire_format=resolve_wire_format(args),
+            alive_compaction=getattr(args, "alive_compaction", "auto"),
         )
         ingest_workers = resolve_ingest_workers(
             args, mesh_shape, len(source.partitions())

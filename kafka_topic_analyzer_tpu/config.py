@@ -382,6 +382,22 @@ class AnalyzerConfig:
     #: ops/pallas_counters.pallas_counters_merge instead.)
     use_pallas_counters: bool = False
 
+    #: Host-side alive-pair compaction (``--alive-compaction``; DESIGN §19):
+    #: ``auto`` (the default) compacts the last-writer-wins (slot, alive)
+    #: pairs out of the per-batch wire rows into ONE bounded per-dispatch
+    #: pair table — per-batch at K=1, per-SUPERBATCH at --superbatch K>1 —
+    #: that the device merges once per dispatch instead of running the
+    #: O(B) pair scatter (and its O(W) mask apply) inside every scan step.
+    #: LWW compaction is itself LWW-associative, so results are
+    #: byte-identical to the uncompacted fold.  Resolves ON only under the
+    #: v5 combiner format with the alive bitmap enabled; ``off`` (or the
+    #: ``KTA_DISABLE_COMPACTION`` env kill switch) keeps the v5 per-row
+    #: pair sections — the bypass is booked on
+    #: ``kta_alive_compaction_off_total{reason}``, never silent.  Pure
+    #: execution strategy: byte-identical results, outside the checkpoint
+    #: fingerprint (checkpoint.py), snapshots resume across the setting.
+    alive_compaction: str = "auto"
+
     #: Packed host→device wire format (packing.py): ``0`` = auto (resolved
     #: at construction — v5 unless the ``KTA_WIRE_V4`` kill switch is set),
     #: ``4`` = per-record columns + host-pre-reduced extreme/alive/HLL
@@ -451,6 +467,32 @@ class AnalyzerConfig:
             raise ValueError(
                 f"wire_format {self.wire_format!r} invalid (0=auto, 4, or 5)"
             )
+        if self.alive_compaction not in ("auto", "off"):
+            raise ValueError(
+                f"alive_compaction {self.alive_compaction!r} invalid "
+                "(auto or off)"
+            )
+        # Resolve alive-pair compaction ONCE, here, with the reason it is
+        # off recorded at resolution time (same discipline as the wire-v4
+        # reason above: the engine's fallback booking must describe the
+        # decision actually taken, not whatever the env says later).
+        compact = False
+        off_reason = None
+        if self.count_alive_keys:
+            import os
+
+            if self.alive_compaction == "off":
+                off_reason = "explicit"
+            elif os.environ.get("KTA_DISABLE_COMPACTION"):
+                off_reason = "env-kill-switch"
+            elif self.wire_format != 5:
+                # The compacted pair table is a v5 combiner section; the
+                # v4 layout keeps its per-row pairs.
+                off_reason = "wire-v4"
+            else:
+                compact = True
+        object.__setattr__(self, "_compact_alive", compact)
+        object.__setattr__(self, "_alive_compaction_off_reason", off_reason)
         if (
             self.use_pallas_counters
             and self.wire_format == 4
@@ -479,6 +521,24 @@ class AnalyzerConfig:
         a ``dataclasses.replace`` of an env-forced config re-labels as
         ``explicit``, which is what the copy's pinned field now is)."""
         return self._wire_v4_reason
+
+    @property
+    def compact_alive(self) -> bool:
+        """True when this config ships alive pairs as a compacted
+        per-dispatch pair table instead of per-row sections (resolved in
+        ``__post_init__`` — see ``alive_compaction``)."""
+        return self._compact_alive
+
+    @property
+    def alive_compaction_off_reason(self) -> "str | None":
+        """Why an alive-key scan runs WITHOUT pair compaction (None when
+        compaction is on, or when the config has no alive bitmap to
+        compact): ``explicit`` (--alive-compaction off),
+        ``env-kill-switch`` (KTA_DISABLE_COMPACTION), or ``wire-v4``.
+        Recorded at resolution time like ``wire_v4_reason`` so the
+        ``kta_alive_compaction_off_total`` booking can never drift from
+        the decision taken."""
+        return self._alive_compaction_off_reason
 
     @property
     def quantile_gamma(self) -> float:

@@ -485,13 +485,32 @@ def run_scan(
             batch_size=wire_b,
             per_record_bytes=per_rec,
             table_bytes=table,
+            alive_compaction=(
+                "on"
+                if wire_cfg.compact_alive
+                else (
+                    f"off ({wire_cfg.alive_compaction_off_reason})"
+                    if wire_cfg.count_alive_keys
+                    else "n/a"
+                )
+            ),
         )
         v4_reason = wire_cfg.wire_v4_reason
         if v4_reason is not None and book_once:
             # Once per scan — and once per follow SERVICE run, not per
             # poll pass (book_once is False on passes after the first).
             obs_metrics.WIRE_V4_FALLBACK.labels(reason=v4_reason).inc()
+        compaction_off = wire_cfg.alive_compaction_off_reason
+        if compaction_off is not None and book_once:
+            # An alive-key scan running WITHOUT pair compaction is booked
+            # with its resolved reason — the bypass is never silent (same
+            # discipline as the wire-v4 fallback above).
+            obs_metrics.ALIVE_COMPACTION_OFF.labels(
+                reason=compaction_off
+            ).inc()
         wire_bytes0 = obs_metrics.WIRE_BYTES.value
+        pairs_raw0 = obs_metrics.ALIVE_PAIRS_RAW.value
+        pairs_emitted0 = obs_metrics.ALIVE_PAIRS_EMITTED.value
 
     used_workers = 1
     # Superbatch dispatch (config.DispatchConfig, resolved by the backend):
@@ -1020,6 +1039,12 @@ def run_scan(
             obs_metrics.WIRE_BYTES.value - wire_bytes0
         )
         wire_stats.records = seq - seq_base
+        wire_stats.pairs_raw = int(
+            obs_metrics.ALIVE_PAIRS_RAW.value - pairs_raw0
+        )
+        wire_stats.pairs_emitted = int(
+            obs_metrics.ALIVE_PAIRS_EMITTED.value - pairs_emitted0
+        )
         obs_metrics.WIRE_BYTES_PER_RECORD.set(
             round(wire_stats.bytes_per_record, 2)
         )

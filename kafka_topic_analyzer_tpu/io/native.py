@@ -27,7 +27,7 @@ _NATIVE_DIR = os.path.join(
 )
 #: ABI version baked into the filename (see native/Makefile): a rebuild can
 #: never be shadowed by a stale still-mapped library at the same path.
-_ABI = 13
+_ABI = 14
 _SO_NAME = f"libkta_ingest.v{_ABI}.so"
 
 #: Env knob that disables the native shim entirely (pure-Python chain
@@ -129,6 +129,7 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
             lib.kta_decode_record_set.restype = ctypes.c_int64
             lib.kta_crc32c.restype = ctypes.c_uint32
             lib.kta_pack_scratch_len.restype = ctypes.c_int64
+            lib.kta_pairs_to_masks.restype = ctypes.c_int64
             lib.kta_pack_row_init.restype = ctypes.c_int64
             lib.kta_decode_pack_record_set.restype = ctypes.c_int64
             lib.kta_pack_append_columns.restype = ctypes.c_int64
@@ -274,6 +275,33 @@ def dedupe_slots_native(
     if count < 0:
         raise RuntimeError(f"kta_dedupe_slots failed with rc={count}")
     return slot_out[:count], alive_out[:count]
+
+
+def pairs_to_masks_native(
+    slots: np.ndarray,
+    flags: np.ndarray,
+    bits: int,
+    set_out: np.ndarray,
+    clear_out: np.ndarray,
+) -> int:
+    """LWW-apply a raw (slot, flag) pair stream — stream order, duplicates
+    allowed — straight into zeroed set/clear word masks (the compacted
+    alive table's MASK form, packing.alive_table_mode == 2).  Returns the
+    distinct touched-slot count (emitted-pairs telemetry)."""
+    lib = load_library()
+    slots = np.ascontiguousarray(slots, dtype=np.uint32)
+    flags = np.ascontiguousarray(flags, dtype=np.uint8)
+    touched = lib.kta_pairs_to_masks(
+        _as_ptr(slots, ctypes.c_uint32),
+        _as_ptr(flags, ctypes.c_uint8),
+        ctypes.c_int64(len(slots)),
+        ctypes.c_int32(bits),
+        _as_ptr(set_out, ctypes.c_uint32),
+        _as_ptr(clear_out, ctypes.c_uint32),
+    )
+    if touched < 0:
+        raise RuntimeError(f"kta_pairs_to_masks failed rc={touched}")
+    return int(touched)
 
 
 #: The decoder's SoA layout — ONE spec for every allocation site (per-frame
@@ -503,7 +531,14 @@ def pack_batch_native(
         ctypes.c_int64(batch.num_valid),
         ctypes.c_int64(b),
         ctypes.c_int32(config.num_partitions),
-        ctypes.c_int32(1 if config.count_alive_keys else 0),
+        # Under pair compaction the row carries no pair sections; the
+        # caller dedupes the columns separately (packing.batch_alive_pairs)
+        # so this whole-batch packer runs with alive OFF.
+        ctypes.c_int32(
+            0
+            if getattr(config, "compact_alive", False)
+            else (1 if config.count_alive_keys else 0)
+        ),
         ctypes.c_int32(config.alive_bitmap_bits),
         ctypes.c_int32(hll_wire_mode(config, b)),
         ctypes.c_int32(config.hll_p),
@@ -546,7 +581,7 @@ def _fused_pack_params(config, batch_size: int) -> "tuple":
     return (
         batch_size,
         config.num_partitions,
-        1 if config.count_alive_keys else 0,
+        _with_alive_mode(config),
         config.alive_bitmap_bits,
         hll_wire_mode(config, batch_size),
         config.hll_p,
@@ -567,6 +602,15 @@ def _fused_ctail(params) -> "list":
         ctypes.c_int32(hr), ctypes.c_int32(vc), ctypes.c_int32(v5),
         ctypes.c_int32(qr), ctypes.c_int32(qn), _edges_ptr(edges),
     ]
+
+
+def _with_alive_mode(config) -> int:
+    """The fused pass's alive mode: 0 = off, 1 = per-row pair sections,
+    2 = compacted (pairs divert to the scratch emission region and the
+    dispatch ships one merged pair table — AnalyzerConfig.compact_alive)."""
+    if not config.count_alive_keys:
+        return 0
+    return 2 if getattr(config, "compact_alive", False) else 1
 
 
 def _raise_pack_range(field: int, value: int) -> None:
@@ -590,16 +634,41 @@ def _raise_pack_range(field: int, value: int) -> None:
 
 
 def pack_scratch_len(config, batch_size: int) -> int:
-    """int64 elements of append scratch one fused row needs."""
+    """int64 elements of append scratch one fused row needs (includes the
+    compacted-pair emission region under AnalyzerConfig.compact_alive)."""
     lib = load_library()
     n = lib.kta_pack_scratch_len(
         ctypes.c_int64(batch_size),
-        ctypes.c_int32(1 if config.count_alive_keys else 0),
+        ctypes.c_int32(_with_alive_mode(config)),
         ctypes.c_int32(config.alive_bitmap_bits),
     )
     if n < 0:
         raise RuntimeError("kta_pack_scratch_len rejected batch_size")
     return int(n)
+
+
+def pack_take_pairs(
+    scratch: np.ndarray, config, batch_size: int
+) -> "tuple[np.ndarray, np.ndarray]":
+    """Copy the current row's compacted (slot, alive) pairs out of the
+    fused scratch's emission region (with_alive mode 2).  Callers harvest
+    BEFORE the next ``pack_row_init`` re-initializes the scratch; the
+    returned arrays are copies, safe past the row's lifetime.  The region
+    sits exactly ``kta_pack_scratch_len(b, 1, bits)`` int64 elements in —
+    the with_alive == 1 length, by the shim's layout contract."""
+    lib = load_library()
+    n = int(scratch[1])
+    off = int(
+        lib.kta_pack_scratch_len(
+            ctypes.c_int64(batch_size),
+            ctypes.c_int32(1),
+            ctypes.c_int32(config.alive_bitmap_bits),
+        )
+    )
+    region = scratch[off:].view(np.uint8)
+    slots = region[: 4 * batch_size].view(np.uint32)[:n].copy()
+    flags = region[4 * batch_size : 5 * batch_size][:n].copy()
+    return slots, flags
 
 
 def pack_row_init(out: np.ndarray, scratch: np.ndarray, config,

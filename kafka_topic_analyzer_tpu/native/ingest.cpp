@@ -104,7 +104,7 @@ struct KtaSynthSpec {
   int64_t ts_step_ms;
 };
 
-int32_t kta_version() { return 13; }
+int32_t kta_version() { return 14; }
 
 // CRC32-C (Castagnoli) over a byte buffer — Kafka's record-batch checksum.
 // Table-driven; the Python fallback (kafka_codec._crc32c) is a per-byte
@@ -543,6 +543,11 @@ extern "C" int64_t kta_pack_batch(
     uint8_t* out, int64_t out_cap) {
   if (n_valid < 0 || n_valid > batch_size) return -1;
   if (num_partitions <= 0) return -1;
+  // with_alive == 2 (pairs-to-scratch compaction) is a fused-row mode:
+  // this whole-batch packer has no scratch to emit into — the Python
+  // caller packs with alive OFF and dedupes the columns separately
+  // (packing.batch_alive_pairs).
+  if (with_alive != 0 && with_alive != 1) return -1;
   if (wire_v5)
     return pack_batch_v5(
         partition, key_len, value_len, key_null, value_null, ts_s, h32, h64,
@@ -791,7 +796,9 @@ inline bool pack_row_layout(uint8_t* out, int64_t out_cap, int64_t b,
                             int32_t q_rows, int32_t q_nbuckets,
                             const int64_t* q_edges, PackRowLayout* r) {
   if (!out || b < 0 || P <= 0 || P > 0x7fff) return false;
+  if (with_alive < 0 || with_alive > 2) return false;
   if (with_alive && (alive_bits < 1 || alive_bits > 32)) return false;
+  if (with_alive == 2 && !wire_v5) return false;  // compaction is v5-only
   if (with_hll == 3 && !wire_v5) return false;  // flat pairs are v5-only
   if (q_rows > 0 && (!wire_v5 || !q_edges || q_nbuckets < 1)) return false;
   if (q_rows > 1 && q_rows < P) return false;  // rows index by partition
@@ -800,7 +807,11 @@ inline bool pack_row_layout(uint8_t* out, int64_t out_cap, int64_t b,
     need += int64_t(P) * 7 * 8;
   else
     need += b * (2 + 2 + 4 + 1);
-  if (with_alive) need += b * 5;
+  // with_alive == 2 (compaction): the dedupe table still runs, but the
+  // pairs divert to a caller-scratch region (attach_scratch_pairs) and
+  // the row carries NO pair sections — the dispatch-level merged pair
+  // table ships them instead (packing.pack_pair_table).
+  if (with_alive == 1) need += b * 5;
   if (with_hll == 1) need += b * 3;
   if (with_hll == 3) need += b * 5;
   if (with_hll == 2) {
@@ -842,7 +853,7 @@ inline bool pack_row_layout(uint8_t* out, int64_t out_cap, int64_t b,
   r->szmm = out + pos;
   pos += 2 * P * 8;
   r->slot32 = r->alive8 = nullptr;
-  if (with_alive) {
+  if (with_alive == 1) {
     r->slot32 = out + pos;
     pos += b * 4;
     r->alive8 = out + pos;
@@ -939,6 +950,25 @@ inline int64_t pack_stash_len64(int64_t b, int32_t with_alive,
                                 int32_t with_hll, int32_t q_rows) {
   if (!with_alive && with_hll != 2 && q_rows <= 0) return 0;
   return (21 * b + 7) / 8;
+}
+
+// Compacted-pair emission region (with_alive == 2): slots u32[b] + flags
+// u8[b] carved out of the caller scratch PAST the full (unconditional)
+// stash, so Python locates it as kta_pack_scratch_len(b, 1, bits) int64
+// elements in — independent of which stash sections the config uses.
+inline int64_t pairs_off64(int64_t b, int32_t alive_bits) {
+  return 3 + pack_scratch_cap(b, 1, alive_bits) +
+         pack_stash_len64(b, 1, 2, 1);
+}
+
+inline int64_t pairs_len64(int64_t b) { return (5 * b + 7) / 8; }
+
+inline void attach_scratch_pairs(PackRowLayout* r, int64_t* scratch) {
+  if (r->with_alive != 2) return;
+  uint8_t* pb =
+      reinterpret_cast<uint8_t*>(scratch + pairs_off64(r->b, r->alive_bits));
+  r->slot32 = pb;
+  r->alive8 = pb + 4 * r->b;
 }
 
 // Grow the active dedupe table (doubling, bounded by the allocated max)
@@ -1310,9 +1340,13 @@ int64_t kta_pack_scratch_len(int64_t batch_size, int32_t with_alive,
   if (batch_size < 0) return -1;
   // The stash region is sized unconditionally (it also serves HLL table
   // mode with alive off, and wire v5's size stash) — a few MB at worst,
-  // allocated once per sink.
-  return 3 + pack_scratch_cap(batch_size, with_alive, alive_bits) +
-         pack_stash_len64(batch_size, 1, 2, 1);
+  // allocated once per sink.  with_alive == 2 (pair compaction) appends
+  // the pair emission region; its offset is exactly the with_alive == 1
+  // return value, which is how the Python side locates it.
+  int64_t n = 3 + pack_scratch_cap(batch_size, with_alive, alive_bits) +
+              pack_stash_len64(batch_size, 1, 2, 1);
+  if (with_alive == 2) n += pairs_len64(batch_size);
+  return n;
 }
 
 // Initialize one wire row (v4 or v5) for incremental appends: zero the
@@ -1340,6 +1374,11 @@ int64_t kta_pack_row_init(uint8_t* out, int64_t out_cap, int64_t* scratch,
   if (scratch_len < 3 + cap + pack_stash_len64(batch_size, with_alive,
                                                with_hll, q_rows))
     return -1;
+  if (with_alive == 2 &&
+      scratch_len < pairs_off64(batch_size, alive_bits) +
+                        pairs_len64(batch_size))
+    return -1;
+  attach_scratch_pairs(&r, scratch);
   std::memset(out, 0, r.need);
   for (int64_t p = 0; p < r.P; ++p) {
     store_at<int64_t>(r.tsmm, p, INT64_MAX);
@@ -1354,6 +1393,41 @@ int64_t kta_pack_row_init(uint8_t* out, int64_t out_cap, int64_t* scratch,
   scratch[2] = cap < 4096 ? cap : 4096;
   std::memset(scratch + 3, 0, size_t(scratch[2]) * 8);
   return r.need;
+}
+
+// Compacted alive-pair MASK build (packing.alive_table_mode == 2): apply
+// the raw (slot, flag) pair stream — concatenated per-dispatch batches,
+// STREAM ORDER, duplicates allowed — last-writer-wins straight into
+// set/clear word masks: a set pair turns its bit on in set and off in
+// clear, a tombstone pair the reverse, so the masks ARE the compacted
+// LWW monoid value and the device merge is one elementwise
+// (words & ~clear) | set pass (no scatter).  Both masks are zeroed here.
+// Returns the number of DISTINCT touched slots (the emitted-pairs
+// telemetry), or -1 on bad arguments.
+int64_t kta_pairs_to_masks(const uint32_t* slots, const uint8_t* flags,
+                           int64_t n, int32_t bits, uint32_t* set_out,
+                           uint32_t* clear_out) {
+  if (!set_out || !clear_out || n < 0 || bits < 1 || bits > 32) return -1;
+  if (n > 0 && (!slots || !flags)) return -1;
+  const int64_t W = int64_t(1) << (bits > 5 ? bits - 5 : 0);
+  std::memset(set_out, 0, size_t(W) * 4);
+  std::memset(clear_out, 0, size_t(W) * 4);
+  int64_t touched = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint32_t s = slots[i];
+    const int64_t w = s >> 5;
+    const uint32_t bit = 1u << (s & 31);
+    if (w >= W) return -1;  // slot past the declared bitmap width
+    if (!((set_out[w] | clear_out[w]) & bit)) ++touched;
+    if (flags[i]) {
+      set_out[w] |= bit;
+      clear_out[w] &= ~bit;
+    } else {
+      clear_out[w] |= bit;
+      set_out[w] &= ~bit;
+    }
+  }
+  return touched;
 }
 
 // Fused decode→pack over a record set's native-decodable prefix, starting
@@ -1391,6 +1465,7 @@ int64_t kta_decode_pack_record_set(
                        alive_bits, with_hll, hll_p, hll_rows, value_len_cap,
                        wire_v5, q_rows, q_nbuckets, q_edges, &r))
     return -1;
+  attach_scratch_pairs(&r, scratch);
   const bool need_stash = with_alive || with_hll == 2;
   FrameStash stash = stash_of(
       scratch, r.b, pack_scratch_cap(r.b, with_alive, alive_bits));
@@ -1631,8 +1706,11 @@ int64_t kta_decode_pack_record_set(
   st[3] = last_ts;
   if (!st[5]) st[4] = 0;
   // Live header: the row is a valid packed batch after every call.
+  // Under pair compaction the row has no pair sections — its header says
+  // n_pairs 0 (the pairs ride the dispatch-level merged table instead).
   const int32_t hv = static_cast<int32_t>(scratch[0]);
-  const int32_t hp = static_cast<int32_t>(scratch[1]);
+  const int32_t hp =
+      with_alive == 2 ? 0 : static_cast<int32_t>(scratch[1]);
   std::memcpy(out, &hv, 4);
   std::memcpy(out + 4, &hp, 4);
   return appended;
@@ -1667,6 +1745,7 @@ int64_t kta_pack_append_columns(
                        alive_bits, with_hll, hll_p, hll_rows, value_len_cap,
                        wire_v5, q_rows, q_nbuckets, q_edges, &r))
     return -1;
+  attach_scratch_pairs(&r, scratch);
   int64_t take = n - start;
   const int64_t space = r.b - scratch[0];
   if (space < 0) return -1;
@@ -1793,7 +1872,8 @@ int64_t kta_pack_append_columns(
   }
   scratch[0] = c0 + take;
   const int32_t hv = static_cast<int32_t>(scratch[0]);
-  const int32_t hp = static_cast<int32_t>(scratch[1]);
+  const int32_t hp =
+      with_alive == 2 ? 0 : static_cast<int32_t>(scratch[1]);
   std::memcpy(out, &hv, 4);
   std::memcpy(out + 4, &hp, 4);
   return take;

@@ -158,6 +158,22 @@ WIRE_V4_FALLBACK = _REG.counter(
     "combiner rows (reason: env-kill-switch = KTA_WIRE_V4, explicit = "
     "caller pinned v4) — a bypassed combiner is never silent",
     labelnames=("reason",))
+ALIVE_PAIRS_RAW = _REG.counter(
+    "kta_alive_pairs_raw_total",
+    "Per-batch LWW alive-pairs entering the dispatch-level compaction "
+    "merge (the compacted path's input side; DESIGN §19)")
+ALIVE_PAIRS_EMITTED = _REG.counter(
+    "kta_alive_pairs_emitted_total",
+    "Merged alive-pairs actually shipped in compacted per-dispatch pair "
+    "tables — emitted/raw is the measured compaction ratio the --stats "
+    "wire digest reports")
+ALIVE_COMPACTION_OFF = _REG.counter(
+    "kta_alive_compaction_off_total",
+    "Alive-key scans that ran WITHOUT pair compaction (reason: "
+    "env-kill-switch = KTA_DISABLE_COMPACTION, explicit = "
+    "--alive-compaction off, wire-v4 = the v4 layout keeps per-row "
+    "pairs) — a bypassed compaction is never silent",
+    labelnames=("reason",))
 
 # -- io/kafka_wire ------------------------------------------------------------
 

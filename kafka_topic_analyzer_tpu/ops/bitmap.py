@@ -112,6 +112,34 @@ def bitmap_apply_pairs(
     return (words & ~clear_words) | set_words
 
 
+def bitmap_apply_masks(
+    words,        # uint32[W] — this shard's packed bits
+    set_words,    # uint32[W_total] — full-bitmap LWW set mask
+    clear_words,  # uint32[W_total] — full-bitmap LWW clear mask
+    bits: int,
+    space_index=0,
+    space_shards: int = 1,
+):
+    """Apply host-built LWW set/clear word masks: the compacted alive
+    table's MASK form (packing.alive_table_mode == 2).
+
+    The host already resolved last-writer-wins per slot straight into
+    bitmask form (a later set clears the slot's clear bit and vice
+    versa), so the whole apply is ONE elementwise pass —
+    ``(words & ~clear) | set`` — with no scatter and no per-batch scratch
+    allocation.  Under a space-sharded mesh each shard dynamic-slices its
+    word range out of the replicated full-bitmap masks (slot-range
+    ownership, same rule as the pair forms)."""
+    from kafka_topic_analyzer_tpu.jax_support import lax
+
+    W = bitmap_num_words(bits, space_shards)
+    if space_shards > 1:
+        base = (jnp.int32(W) * space_index).astype(jnp.int32)
+        set_words = lax.dynamic_slice(set_words, (base,), (W,))
+        clear_words = lax.dynamic_slice(clear_words, (base,), (W,))
+    return (words & ~clear_words) | set_words
+
+
 def bitmap_popcount(words):
     """Number of alive slots — ``BitSet::len()`` (src/metric.rs:282-284)."""
     from kafka_topic_analyzer_tpu.jax_support import lax

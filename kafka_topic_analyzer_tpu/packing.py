@@ -93,8 +93,17 @@ MAX_PARTITIONS = 0x7FFF
 MAX_VALUE_LEN = (1 << 24) - 1
 
 
-def _sections(config: AnalyzerConfig, batch_size: int):
+def _sections(config: AnalyzerConfig, batch_size: int,
+              pair_table: bool = False):
     """(name, dtype, count) section list, in buffer order.
+
+    ``pair_table=True`` returns the layout of ONE compacted alive-pair
+    TABLE buffer instead (wire v5 + ``config.compact_alive`` — DESIGN
+    §19): the per-dispatch LWW-merged pair table the device applies once
+    per dispatch, with ``batch_size`` then meaning the table CAPACITY
+    (``pair_table_capacity``).  Same single-source discipline: the pair
+    packers (`pack_pair_table`) and unpackers (`unpack_pair_table_*`)
+    derive from this list, so they cannot skew (lint rule 7).
 
     The layout contract lives in ONE place — the module docstring above
     (wire format v4); this builder, the packers, the unpackers, and the
@@ -123,6 +132,17 @@ def _sections(config: AnalyzerConfig, batch_size: int):
     """
     b = batch_size
     p = config.num_partitions
+    if pair_table:
+        if alive_table_mode(config, b) == 2:
+            w = _alive_mask_words(config)
+            return [
+                ("alive_set", np.uint32, w),
+                ("alive_clear", np.uint32, w),
+            ]
+        return [
+            ("alive_slot", np.uint32, b),
+            ("alive_flag", np.uint8, b),
+        ]
     if config.wire_format == 5:
         sec = [
             # Pre-reduced counter deltas in results.COUNTER_CHANNELS
@@ -147,7 +167,10 @@ def _sections(config: AnalyzerConfig, batch_size: int):
             # removes a B-record scatter-min + scatter-max from the step.
             ("sz_minmax", np.int64, 2 * p),
         ]
-    if config.count_alive_keys:
+    if config.count_alive_keys and not getattr(config, "compact_alive", False):
+        # Compacted configs (wire v5 --alive-compaction auto) ship the
+        # pairs as ONE per-dispatch merged table (pair_table=True above)
+        # instead of 5 B/record of per-row sections.
         sec.append(("alive_slot", np.uint32, b))
         sec.append(("alive_flag", np.uint8, b))
     mode = hll_wire_mode(config, b)
@@ -313,6 +336,275 @@ def _dedupe_slots(h32, active, alive, bits, use_native=True):
         except ImportError:
             pass
     return dedupe_slots_numpy(h32, active, alive, bits)
+
+
+# ---------------------------------------------------------------------------
+# compacted alive-pair table (wire v5 + AnalyzerConfig.compact_alive;
+# DESIGN.md §19)
+#
+# With compaction on, the per-row pair sections disappear and every device
+# DISPATCH carries ONE pair-table buffer: the LWW merge — in stream order —
+# of the per-batch deduped pairs of all K batches the dispatch folds.  LWW
+# compaction is LWW-associative (compact(a,b) then merge with compact(c,d)
+# in order equals the uncompacted replay), so applying the merged table once
+# AFTER the superbatch scan is byte-identical to the per-batch scatter the
+# scan body used to run — and the O(W) bitmap mask apply is paid once per
+# dispatch instead of K times.
+
+
+def pair_table_capacity(config: AnalyzerConfig, batch_size: int,
+                        k: int = 1) -> int:
+    """Static capacity of one dispatch's compacted pair table — the
+    bounded-table growth rule: a dispatch folds at most ``k * batch_size``
+    records, and distinct slots can never exceed the bitmap's slot space,
+    so ``min(k·B, 2^bits)`` bounds the merge with NO overflow path (the
+    compacted wire shape never needs a mid-scan fallback)."""
+    return min(int(k) * int(batch_size), 1 << config.alive_bitmap_bits)
+
+
+#: Mask-form cap: the set/clear word masks may grow to at most this many
+#: bytes per dispatch (the other half of the bounded-table growth rule);
+#: past it the compacted PAIR list is the bounded form.  64 MiB covers
+#: ``alive_bitmap_bits <= 28``; the reference-exact 2^32 slot space stays
+#: on pairs.
+ALIVE_MASK_CAP_BYTES = 64 << 20
+
+#: Mask-vs-pairs trade factor: masks may cost up to this many times the
+#: pair list's wire bytes.  Measured rationale (BENCH round 13): the
+#: device applies elementwise mask words ~80-180x cheaper per byte than
+#: scatter elements (0.7 ms per 16 MB of masks vs ~21-60 ms per 2.6 MB
+#: of pair scatter at B=2^16 on the host-CPU jit), so trading up to 32x
+#: the bytes for the elementwise merge wins everywhere except
+#: tunnel-priced transports — where ``--alive-compaction off`` (or a
+#: bitmap past the caps) keeps the pair forms.
+ALIVE_MASK_TRADE_FACTOR = 32
+
+
+def _alive_mask_words(config: AnalyzerConfig) -> int:
+    return 1 << max(config.alive_bitmap_bits - 5, 0)
+
+
+def alive_table_mode(config: AnalyzerConfig, capacity: int) -> int:
+    """The compacted table's form — ONE rule (like ``hll_wire_mode``) so
+    the packers, the layout, and (via section names) the device apply can
+    never disagree:
+
+    - ``1`` — bounded pair list ``slot u32[T] | flag u8[T]``, applied by
+      a device scatter (the only form that stays bounded for huge slot
+      spaces);
+    - ``2`` — set/clear word masks ``u32[W] | u32[W]``: the host resolves
+      LWW straight into bitmask form and the device merges ELEMENTWISE
+      (``(words & ~clear) | set``) like any other wire-v5 table — no
+      scatter at all.
+    """
+    mask_nbytes = 2 * _alive_mask_words(config) * 4
+    if mask_nbytes <= min(
+        ALIVE_MASK_TRADE_FACTOR * 5 * capacity, ALIVE_MASK_CAP_BYTES
+    ):
+        return 2
+    return 1
+
+
+def pair_table_nbytes(config: AnalyzerConfig, capacity: int) -> int:
+    return HEADER_BYTES + sum(
+        np.dtype(dt).itemsize * n
+        for _, dt, n in _sections(config, capacity, pair_table=True)
+    )
+
+
+def batch_alive_pairs(
+    batch: RecordBatch, config: AnalyzerConfig, use_native: bool = True
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One batch's LWW-deduped (slot, alive) pairs for the compacted path
+    — exactly the pre-reduction the per-row sections used to carry, but
+    returned host-side so the dispatch can merge across batches."""
+    active = batch.valid & ~batch.key_null
+    alive = batch.valid & ~batch.value_null
+    return _dedupe_slots(
+        batch.key_hash32, active, alive, config.alive_bitmap_bits, use_native
+    )
+
+
+def _pairs_to_masks_numpy(
+    slots: np.ndarray, flags: np.ndarray, bits: int
+) -> "tuple[np.ndarray, np.ndarray]":
+    """Set/clear word masks from DEDUPED (unique-slot) pairs — the numpy
+    half of the mask-form build (`alive_table_mode` 2).  Sorted grouping
+    + ``bitwise_or.reduceat`` keeps it vectorized; uniqueness means the
+    set/clear interplay is already resolved, so plain ORs suffice."""
+    w_words = 1 << max(bits - 5, 0)
+    set_w = np.zeros(w_words, dtype=np.uint32)
+    clear_w = np.zeros(w_words, dtype=np.uint32)
+    if len(slots):
+        order = np.argsort(slots, kind="stable")
+        s = slots[order]
+        f = flags[order].astype(bool)
+        for subset, mask_out in ((f, set_w), (~f, clear_w)):
+            ss = s[subset]
+            if not len(ss):
+                continue
+            w = (ss >> np.uint32(5)).astype(np.int64)
+            b = np.uint32(1) << (ss & np.uint32(31))
+            starts = np.flatnonzero(np.r_[True, w[1:] != w[:-1]])
+            mask_out[w[starts]] = np.bitwise_or.reduceat(b, starts)
+    return set_w, clear_w
+
+
+def pack_pair_table(
+    pair_lists,
+    config: AnalyzerConfig,
+    capacity: int,
+    use_native: bool = True,
+    out: "np.ndarray | None" = None,
+) -> "tuple[np.ndarray, int, int]":
+    """LWW-merge per-batch pair lists — IN STREAM ORDER — into one packed
+    compacted-alive-table buffer: ``header u8[16]`` (n_pairs at the same
+    header slot the rows use) + the ``pair_table`` sections of
+    ``_sections``, in the form `alive_table_mode` picks — the bounded
+    pair list (mode 1) or set/clear word masks (mode 2).
+
+    Returns ``(buffer, raw_pairs, emitted_pairs)`` — the raw→emitted
+    split feeds ``kta_alive_pairs_{raw,emitted}_total`` (in mask form
+    "emitted" counts distinct touched slots).  The merge is the same
+    per-slot last-writer rule as the per-batch dedupe (later list wins,
+    later entry within a list wins); pair ORDER in a mode-1 buffer is
+    implementation-defined exactly like `_dedupe_slots` — the device
+    result is order-free because slots are unique."""
+    parts = [
+        (np.ascontiguousarray(s, dtype=np.uint32),
+         np.ascontiguousarray(f, dtype=np.uint8))
+        for s, f in pair_lists
+        if len(s)
+    ]
+    if parts:
+        slots = (
+            parts[0][0] if len(parts) == 1
+            else np.concatenate([p[0] for p in parts])
+        )
+        flags = (
+            parts[0][1] if len(parts) == 1
+            else np.concatenate([p[1] for p in parts])
+        )
+        raw = len(slots)
+    else:
+        raw = 0
+        slots = np.empty(0, dtype=np.uint32)
+        flags = np.empty(0, dtype=np.uint8)
+    mode = alive_table_mode(config, capacity)
+    nbytes = pair_table_nbytes(config, capacity)
+    if out is None:
+        out = np.empty(nbytes, dtype=np.uint8)
+    elif out.shape != (nbytes,) or out.dtype != np.uint8:
+        raise ValueError("pack_pair_table out= must be uint8[nbytes]")
+    header = np.zeros(4, dtype=np.int32)
+    pos = HEADER_BYTES
+    secs = {}
+    for name, dtype, count in _sections(config, capacity, pair_table=True):
+        nb = np.dtype(dtype).itemsize * count
+        secs[name] = out[pos : pos + nb].view(dtype)
+        pos += nb
+
+    if mode == 2:
+        # Mask form: resolve LWW straight into bitmask monoid values —
+        # one native pass over the RAW stream (no merge table at all), or
+        # dedupe-then-OR on the numpy path.
+        n = None
+        if use_native and raw:
+            try:
+                from kafka_topic_analyzer_tpu.io.native import (
+                    native_available,
+                    pairs_to_masks_native,
+                )
+
+                if native_available():
+                    n = pairs_to_masks_native(
+                        slots, flags, config.alive_bitmap_bits,
+                        secs["alive_set"], secs["alive_clear"],
+                    )
+            except ImportError:
+                pass
+        if n is None:
+            merged_slots, merged_flags = (
+                _dedupe_slots(
+                    slots, np.ones(raw, dtype=bool), flags,
+                    config.alive_bitmap_bits, use_native,
+                )
+                if raw
+                else (slots, flags)
+            )
+            set_w, clear_w = _pairs_to_masks_numpy(
+                merged_slots, merged_flags, config.alive_bitmap_bits
+            )
+            secs["alive_set"][:] = set_w
+            secs["alive_clear"][:] = clear_w
+            n = len(merged_slots)
+        header[1] = n
+        out[:HEADER_BYTES] = header.view(np.uint8)
+        return out, raw, n
+
+    if raw:
+        merged_slots, merged_flags = _dedupe_slots(
+            slots, np.ones(raw, dtype=bool), flags,
+            config.alive_bitmap_bits, use_native,
+        )
+    else:
+        merged_slots, merged_flags = slots, flags
+    n = len(merged_slots)
+    if n > capacity:
+        # Impossible by the capacity rule (pair_table_capacity); a breach
+        # means a caller merged more batches than the capacity was sized
+        # for — corrupting the table silently would be worse than dying.
+        raise AssertionError(
+            f"pair-table overflow: {n} merged pairs > capacity {capacity}"
+        )
+    header[1] = n
+    out[:HEADER_BYTES] = header.view(np.uint8)
+    for name in ("alive_slot", "alive_flag"):
+        sec = secs[name]
+        src = merged_slots if name == "alive_slot" else merged_flags
+        sec[:n] = src
+        sec[n:] = 0
+    return out, raw, n
+
+
+def unpack_pair_table_numpy(
+    buf: np.ndarray, config: AnalyzerConfig, capacity: int
+) -> Dict[str, np.ndarray]:
+    """Host-side reference unpack of a pair-table buffer (tests)."""
+    out: Dict[str, np.ndarray] = {
+        "n_pairs": buf[:HEADER_BYTES].view(np.int32)[1]
+    }
+    pos = HEADER_BYTES
+    for name, dtype, count in _sections(config, capacity, pair_table=True):
+        nb = np.dtype(dtype).itemsize * count
+        out[name] = buf[pos : pos + nb].view(dtype)
+        pos += nb
+    return out
+
+
+def unpack_pair_table_device(buf, config: AnalyzerConfig, capacity: int):
+    """uint8[pair_table_nbytes] → typed device arrays (runs under jit) —
+    the pair-table twin of `unpack_device`, same bitcast rules."""
+    from kafka_topic_analyzer_tpu.jax_support import jnp, lax
+
+    header = lax.bitcast_convert_type(
+        buf[:HEADER_BYTES].reshape(4, 4), jnp.int32
+    )
+    out = {"n_pairs": header[1]}
+    pos = HEADER_BYTES
+    for name, dtype, count in _sections(config, capacity, pair_table=True):
+        nb = np.dtype(dtype).itemsize * count
+        sec = buf[pos : pos + nb]
+        itemsize = np.dtype(dtype).itemsize
+        out[name] = (
+            sec
+            if itemsize == 1
+            else lax.bitcast_convert_type(
+                sec.reshape(-1, itemsize), jnp.dtype(dtype)
+            )
+        )
+        pos += nb
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -520,7 +812,7 @@ def pack_batch(
                 ),
             }
         )
-    if config.count_alive_keys:
+    if config.count_alive_keys and not config.compact_alive:
         active = batch.valid & ~batch.key_null
         alive = batch.valid & ~batch.value_null
         slots, flags = _dedupe_slots(
@@ -627,13 +919,18 @@ class PackedRow:
 
     __slots__ = (
         "buf", "staged", "n_valid", "next_offsets", "counts",
-        "last_partition", "last_offset", "last_ts_s",
+        "last_partition", "last_offset", "last_ts_s", "pairs",
     )
 
     def __init__(self, buf, staged, n_valid, next_offsets, counts,
-                 last_partition, last_offset, last_ts_s):
+                 last_partition, last_offset, last_ts_s, pairs=None):
         self.buf = buf
         self.staged = staged
+        #: Compacted-path alive pairs of THIS row — (slot u32[n], flag
+        #: u8[n]) host arrays in row stream order, None when the config
+        #: ships per-row pair sections instead (the staged form carries
+        #: the same pairs for the backends' dispatch merge).
+        self.pairs = pairs
         self.n_valid = n_valid
         #: true partition id -> one past the last appended offset (sources
         #: that carry offsets); exact-resume bookkeeping.
@@ -709,6 +1006,12 @@ class FusedPackSink:
         self._counts: "dict[int, int]" = {}
         self._last = (-1, -1, 0)
         self._done: "list[PackedRow]" = []
+        #: Compacted alive path (pack_config.compact_alive): the native
+        #: pass diverts each chunk's LWW pairs to the scratch emission
+        #: region; they are harvested — copied out — before every scratch
+        #: re-init and accumulate here until the row completes.
+        self._compact = getattr(pack_config, "compact_alive", False)
+        self._row_pairs: "list[tuple[np.ndarray, np.ndarray]]" = []
 
     # -- row lifecycle -------------------------------------------------------
 
@@ -732,11 +1035,37 @@ class FusedPackSink:
                 self.chunk_records,
             )
 
+    def _harvest_pairs(self) -> None:
+        """Copy the current chunk's compacted pairs out of the scratch
+        emission region — MUST run before any ``pack_row_init`` resets the
+        scratch (chunk rotation, row completion, flush padding)."""
+        if self._compact and int(self._scratch[1]):
+            self._row_pairs.append(
+                self._native.pack_take_pairs(
+                    self._scratch, self.pack_config, self.chunk_records
+                )
+            )
+
+    def _take_row_pairs(self) -> "tuple[np.ndarray, np.ndarray] | None":
+        if not self._compact:
+            return None
+        pairs = self._row_pairs
+        self._row_pairs = []
+        if not pairs:
+            return (np.empty(0, dtype=np.uint32), np.empty(0, dtype=np.uint8))
+        if len(pairs) == 1:
+            return pairs[0]
+        return (
+            np.concatenate([p[0] for p in pairs]),
+            np.concatenate([p[1] for p in pairs]),
+        )
+
     def _advance_full_chunks(self) -> None:
         """Eagerly rotate past filled chunks: completing the row when the
         last chunk fills (full rows emit as soon as they exist — the same
         moment the chained flush would yield the corresponding batch)."""
         while self._row is not None and int(self._scratch[0]) == self.chunk_records:
+            self._harvest_pairs()
             self._chunk += 1
             if self._chunk >= self.space_shards:
                 self._complete_row()
@@ -753,14 +1082,25 @@ class FusedPackSink:
 
         obs_metrics.FUSED_BATCHES.inc()
         obs_metrics.FUSED_RECORDS.inc(self._count)
+        pairs = self._take_row_pairs()
+        if self._stage is None:
+            staged = None
+        elif self._compact:
+            # Compacted path: the stage callback carries the row's pairs
+            # into the staged form so the backend's dispatch merge sees
+            # them without re-reading the (sectionless) row.
+            staged = self._stage(row, pairs)
+        else:
+            staged = self._stage(row)
         self._done.append(
             PackedRow(
                 row,
-                self._stage(row) if self._stage is not None else None,
+                staged,
                 self._count,
                 self._next_offsets,
                 self._counts,
                 *self._last,
+                pairs=pairs,
             )
         )
 
@@ -913,7 +1253,9 @@ class FusedPackSink:
             return
         if self._count == 0:
             self._row = None  # nothing appended: emit nothing (chain parity)
+            self._row_pairs = []
             return
+        self._harvest_pairs()  # before the pad inits reset the scratch
         for s in range(self._chunk + 1, self.space_shards):
             self._native.pack_row_init(
                 self._row[s], self._scratch, self.pack_config,

@@ -13,8 +13,13 @@ Per-step communication is a single small ICI collective: the alive
 bitmap's host-deduped (slot, aliveness) pairs are all_gathered over
 'space' and applied in source-chunk order, because a slot's updates may
 straddle chunk boundaries and last-writer-wins is order-sensitive
-(backends/step.py).  Everything else is chunk-local per step; the
-remaining axes reduce once, in finalize:
+(backends/step.py).  Under alive-pair COMPACTION (the wire-v5 default —
+``AnalyzerConfig.compact_alive``, DESIGN §19) even that disappears: the
+host LWW-merges each data row's pairs per DISPATCH into one bounded
+table whose ``P(data, None)`` spec replicates it over 'space' at
+transfer time, and each space shard applies its slot range once after
+the (scanned) step — no per-step collective remains.  Everything else
+is chunk-local per step; the remaining axes reduce once, in finalize:
 
 - counters / byte sums / counts : ``psum``   over ('data', 'space')
 - timestamp & size extremes     : ``pmin`` / ``pmax`` over ('data', 'space')
@@ -47,9 +52,20 @@ from kafka_topic_analyzer_tpu.backends.base import (
     instrument_steps,
 )
 from kafka_topic_analyzer_tpu.backends.finalize import metrics_from_state
-from kafka_topic_analyzer_tpu.backends.step import analyzer_step, superbatch_fold
+from kafka_topic_analyzer_tpu.backends.step import (
+    analyzer_step,
+    apply_pair_table,
+    superbatch_fold,
+)
 from kafka_topic_analyzer_tpu.config import AnalyzerConfig, DispatchConfig
-from kafka_topic_analyzer_tpu.packing import pack_chunks, unpack_device
+from kafka_topic_analyzer_tpu.packing import (
+    batch_alive_pairs,
+    pack_chunks,
+    pack_pair_table,
+    pair_table_capacity,
+    unpack_device,
+    unpack_pair_table_device,
+)
 from kafka_topic_analyzer_tpu.jax_support import jnp, lax, shard_map
 from kafka_topic_analyzer_tpu.models.compaction import AliveBitmapState
 from kafka_topic_analyzer_tpu.models.message_metrics import MessageMetricsState
@@ -151,12 +167,18 @@ class PackedShard:
     """One data row's batch already packed into its space chunks
     ``[S, chunk_nbytes]`` by ``ShardedTpuBackend.prepare_shard`` — the
     sharded counterpart of ``backends.tpu.StagedBatch``.  Just a typed
-    array: all bookkeeping stays with the decoded batch the engine holds."""
+    array: all bookkeeping stays with the decoded batch the engine holds.
 
-    __slots__ = ("chunks",)
+    ``pairs`` rides the compacted alive path: the row batch's LWW
+    ``(slot u32[n], flag u8[n])`` host arrays in stream order (per-chunk
+    deduped on the fused path — the dispatch merge resolves cross-chunk
+    duplicates), None when compaction is off."""
 
-    def __init__(self, chunks: np.ndarray):
+    __slots__ = ("chunks", "pairs")
+
+    def __init__(self, chunks: np.ndarray, pairs=None):
         self.chunks = chunks
+        self.pairs = pairs
 
 
 @instrument_steps
@@ -231,8 +253,20 @@ class ShardedTpuBackend(MetricBackend):
         self.snapshot_capable = not self._multiprocess or self._rows_contiguous
 
         chunk_config = self._chunk_config
+        # Compacted alive path (DESIGN.md §19): each data row ships ONE
+        # LWW-merged pair table per dispatch, replicated over the space
+        # axis by its P(data, None) spec — each space shard applies its
+        # slot range AFTER the scan, so the per-step pair all_gather over
+        # 'space' disappears from the compacted step entirely.
+        self._compact = config.compact_alive
+        self._pair_cap1 = (
+            pair_table_capacity(config, config.batch_size, 1)
+            if self._compact
+            else 0
+        )
+        self._pair_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
 
-        def _step_body(state, bufs):
+        def _step_body(state, bufs, ptabs=None):
             local = jax.tree.map(lambda x: x[0], state)
             arrays = unpack_device(bufs[0, 0], chunk_config)
             space_idx = lax.axis_index(SPACE_AXIS)
@@ -243,6 +277,15 @@ class ShardedTpuBackend(MetricBackend):
                 space_index=space_idx,
                 space_axis=SPACE_AXIS,
             )
+            if ptabs is not None:
+                new = apply_pair_table(
+                    new,
+                    unpack_pair_table_device(
+                        ptabs[0], config, self._pair_cap1
+                    ),
+                    config,
+                    space_index=space_idx,
+                )
             return jax.tree.map(lambda x: x[None], new)
 
         # The Pallas counter kernel declares its varying axes (vma) so the
@@ -257,7 +300,11 @@ class ShardedTpuBackend(MetricBackend):
         step = shard_map(
             _step_body,
             mesh=self.mesh,
-            in_specs=(self._specs, P(DATA_AXIS, SPACE_AXIS)),
+            in_specs=(
+                (self._specs, P(DATA_AXIS, SPACE_AXIS), P(DATA_AXIS, None))
+                if self._compact
+                else (self._specs, P(DATA_AXIS, SPACE_AXIS))
+            ),
             out_specs=self._specs,
             check_vma=not relax_vma,
         )
@@ -276,7 +323,15 @@ class ShardedTpuBackend(MetricBackend):
         self.superbatch_k = self.dispatch_config.resolve(config.batch_size)
         self.dispatch_depth = self.dispatch_config.depth
         if self.superbatch_k > 1:
-            def _superstep_body(state, bufs):
+            self._pair_cap_k = (
+                pair_table_capacity(
+                    config, config.batch_size, self.superbatch_k
+                )
+                if self._compact
+                else 0
+            )
+
+            def _superstep_body(state, bufs, ptabs=None):
                 # bufs block: [K, 1, 1, chunk_nbytes] per (data, space)
                 # device (in_spec puts the round axis on no mesh axis).
                 local = jax.tree.map(lambda x: x[0], state)
@@ -288,6 +343,16 @@ class ShardedTpuBackend(MetricBackend):
                     chunk_config,
                     space_index=space_idx,
                     space_axis=SPACE_AXIS,
+                    # Compacted path: the row's K rounds merged into one
+                    # table, applied once after the scanned rounds — the
+                    # per-scan-step pair all_gather is gone.
+                    pairs=(
+                        unpack_pair_table_device(
+                            ptabs[0], config, self._pair_cap_k
+                        )
+                        if ptabs is not None
+                        else None
+                    ),
                 )
                 # Completion token: per-device [1, 1] block → global
                 # [D, S] (no extra collective; any leaf syncs the step).
@@ -297,7 +362,15 @@ class ShardedTpuBackend(MetricBackend):
             superstep = shard_map(
                 _superstep_body,
                 mesh=self.mesh,
-                in_specs=(self._specs, P(None, DATA_AXIS, SPACE_AXIS)),
+                in_specs=(
+                    (
+                        self._specs,
+                        P(None, DATA_AXIS, SPACE_AXIS),
+                        P(DATA_AXIS, None),
+                    )
+                    if self._compact
+                    else (self._specs, P(None, DATA_AXIS, SPACE_AXIS))
+                ),
                 out_specs=(self._specs, P(DATA_AXIS, SPACE_AXIS)),
                 check_vma=not relax_vma,
             )
@@ -401,11 +474,47 @@ class ShardedTpuBackend(MetricBackend):
             out=out,
         )
 
+    def _row_pairs(self, batch: "Optional[RecordBatch]"):
+        """One data row's LWW pairs for the compacted path (None rows —
+        another process's, or identity pads — contribute none)."""
+        if batch is None or len(batch) == 0:
+            return (np.empty(0, np.uint32), np.empty(0, np.uint8))
+        return batch_alive_pairs(batch, self.config, self.use_native)
+
+    def _pack_row_pair_tables(self, pair_lists_per_row, cap) -> np.ndarray:
+        """``[local_rows, pair_table_nbytes]`` — one merged table per fed
+        data row, raw→emitted compaction split booked (never silent)."""
+        bufs = []
+        for pair_lists in pair_lists_per_row:
+            buf, raw, emitted = pack_pair_table(
+                pair_lists, self.config, cap, use_native=self.use_native
+            )
+            obs_metrics.ALIVE_PAIRS_RAW.inc(raw)
+            obs_metrics.ALIVE_PAIRS_EMITTED.inc(emitted)
+            bufs.append(buf)
+        return np.stack(bufs)
+
+    def _put_pair_tables(self, tables: np.ndarray):
+        obs_metrics.WIRE_BYTES.inc(int(tables.nbytes))
+        if self._multiprocess:
+            return jax.make_array_from_process_local_data(
+                self._pair_sharding,
+                tables,
+                global_shape=(self.config.data_shards,) + tables.shape[1:],
+            )
+        return jax.device_put(tables, self._pair_sharding)
+
     def prepare_shard(self, batch: RecordBatch) -> "PackedShard":
         """Pack one data row's batch ahead of its collective step — safe on
         a prefetch worker thread (pure numpy/C++), so the S-way chunk
         packing of every row overlaps the device's current step instead of
-        serializing in front of update_shards (engine staging)."""
+        serializing in front of update_shards (engine staging).  Compacted
+        alive configs dedupe the row's pairs here too (the dispatch merges
+        them per row)."""
+        if self._compact:
+            return PackedShard(
+                self._pack_chunks(batch), self._row_pairs(batch)
+            )
         return PackedShard(self._pack_chunks(batch))
 
     def make_fused_sink(self, dense_of):
@@ -413,7 +522,9 @@ class ShardedTpuBackend(MetricBackend):
         ``[S, chunk_nbytes]`` chunk stacks — records fill chunk 0..S-1 at
         chunk_size each, the exact ``pack_chunks`` rule, so a fused row
         is byte-for-byte what ``prepare_shard`` would have staged.  One
-        sink per fed data row's ingest stream (engine.run_scan)."""
+        sink per fed data row's ingest stream (engine.run_scan).  Under
+        compaction the sink hands the row's harvested pairs to the staged
+        form (PackedShard.pairs)."""
         from kafka_topic_analyzer_tpu.packing import FusedPackSink
 
         return FusedPackSink(
@@ -439,9 +550,10 @@ class ShardedTpuBackend(MetricBackend):
         if len(batches) != d:
             raise ValueError(f"expected {d} shard batches, got {len(batches)}")
 
+        local = [batches[r] for r in self.local_rows]
         per_shard = np.stack([
             b.chunks if isinstance(b, PackedShard) else self._pack_chunks(b)
-            for b in (batches[r] for r in self.local_rows)
+            for b in local
         ])  # [local_rows, S, chunk_nbytes]
         obs_metrics.WIRE_BYTES.inc(int(per_shard.nbytes))  # this process's rows
         if self._multiprocess:
@@ -452,6 +564,24 @@ class ShardedTpuBackend(MetricBackend):
             )
         else:
             bufs = jax.device_put(per_shard, self._buf_sharding)
+        if self._compact:
+            tables = self._pack_row_pair_tables(
+                [
+                    [
+                        b.pairs
+                        if isinstance(b, PackedShard) and b.pairs is not None
+                        else self._row_pairs(
+                            None if isinstance(b, PackedShard) else b
+                        )
+                    ]
+                    for b in local
+                ],
+                self._pair_cap1,
+            )
+            self.state = self._step(
+                self.state, bufs, self._put_pair_tables(tables)
+            )
+            return
         self.state = self._step(self.state, bufs)
 
     def update_shards_superbatch(
@@ -504,7 +634,30 @@ class ShardedTpuBackend(MetricBackend):
             )
         else:
             bufs = jax.device_put(stacked, self._superbuf_sharding)
-        self.state, token = self._superstep(self.state, bufs)
+        if self._compact:
+            # Per-row LWW merge across the superbatch's K rounds, in round
+            # order — the scanned steps then fold pair-free and each row's
+            # table applies once after the scan (identity-pad rounds
+            # contribute no pairs).
+            per_row_lists = []
+            for r in self.local_rows:
+                lists = []
+                for batches in rounds:
+                    b = batches[r]
+                    if isinstance(b, PackedShard):
+                        if b.pairs is not None:
+                            lists.append(b.pairs)
+                    else:
+                        lists.append(self._row_pairs(b))
+                per_row_lists.append(lists)
+            tables = self._pack_row_pair_tables(
+                per_row_lists, self._pair_cap_k
+            )
+            self.state, token = self._superstep(
+                self.state, bufs, self._put_pair_tables(tables)
+            )
+        else:
+            self.state, token = self._superstep(self.state, bufs)
         self._queue.launched(token, len(rounds))
 
     def global_any(self, flag: bool) -> bool:

@@ -309,6 +309,17 @@ def render_telemetry_stats(
             f"{wire.table_bytes:,} B fold-table per {wire.batch_size:,}"
             f"-record buffer"
         )
+        # Alive-pair compaction line (DESIGN §19): the measured
+        # raw→emitted dedupe of the per-dispatch pair tables, or — never
+        # silently — why an alive-key scan ran uncompacted.
+        if wire.alive_compaction == "on":
+            lines.append(
+                f"  alive-compaction: on — {wire.pairs_raw:,} raw pairs "
+                f"→ {wire.pairs_emitted:,} emitted "
+                f"(ratio {wire.compaction_ratio:.3f})"
+            )
+        elif wire.alive_compaction != "n/a":
+            lines.append(f"  alive-compaction: {wire.alive_compaction}")
     # Fused ingest digest: rows/records through the one-pass native
     # decode→pack, and — never silently — everything that bypassed it,
     # by reason (compressed/legacy frames, salvage, missing shim).

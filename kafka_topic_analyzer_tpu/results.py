@@ -152,6 +152,17 @@ class WireStats:
     bytes_total: int = 0
     #: Valid records the scan folded (denominator for bytes/record).
     records: int = 0
+    #: Alive-pair compaction state (DESIGN §19): ``on``, ``off`` (with the
+    #: resolved reason — explicit / env-kill-switch / wire-v4), or ``n/a``
+    #: for scans without the alive bitmap.
+    alive_compaction: str = "n/a"
+    #: Per-batch LWW pairs entering the dispatch-level compaction merge
+    #: (``kta_alive_pairs_raw_total`` delta for this scan).
+    pairs_raw: int = 0
+    #: Merged pairs shipped in per-dispatch tables
+    #: (``kta_alive_pairs_emitted_total`` delta) — emitted/raw is the
+    #: measured compaction ratio (1.0 = all-unique worst case).
+    pairs_emitted: int = 0
 
     @property
     def packed_nbytes(self) -> int:
@@ -163,8 +174,16 @@ class WireStats:
             return 0.0
         return self.bytes_total / self.records
 
+    @property
+    def compaction_ratio(self) -> float:
+        """emitted/raw pairs — the measured dispatch-level dedupe win
+        (0.0 when the compacted path saw no pairs)."""
+        if not self.pairs_raw:
+            return 0.0
+        return self.pairs_emitted / self.pairs_raw
+
     def as_dict(self) -> dict:
-        return {
+        doc = {
             "format": self.format,
             "batch_size": self.batch_size,
             "per_record_bytes": self.per_record_bytes,
@@ -172,7 +191,13 @@ class WireStats:
             "packed_nbytes": self.packed_nbytes,
             "bytes_total": self.bytes_total,
             "bytes_per_record": round(self.bytes_per_record, 2),
+            "alive_compaction": self.alive_compaction,
         }
+        if self.alive_compaction == "on":
+            doc["alive_pairs_raw"] = self.pairs_raw
+            doc["alive_pairs_emitted"] = self.pairs_emitted
+            doc["alive_compaction_ratio"] = round(self.compaction_ratio, 4)
+        return doc
 
 
 @dataclasses.dataclass

@@ -405,6 +405,11 @@ def run(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--wire-format", choices=["v4", "v5"], default="v5",
                     help="Packed wire format referee (BENCH round 11): v5 "
                          "combiner rows vs v4 per-record columns")
+    ap.add_argument("--alive-compaction", choices=["auto", "off"],
+                    default="auto",
+                    help="alive-pair compaction referee (BENCH round 13): "
+                         "'auto' = one bounded per-dispatch pair table, "
+                         "'off' = per-row pair sections + in-scan scatter")
     ap.add_argument("--superbatch", default="1", metavar="K|auto",
                     help="stack K packed batches per jitted scan dispatch "
                          "(tpu backend; 'auto' targets 2^20 records per "
@@ -481,6 +486,7 @@ def run(argv: "list[str] | None" = None) -> int:
         enable_quantiles="quantiles" in feats,
         mesh_shape=mesh_shape,
         wire_format={"v4": 4, "v5": 5}[args.wire_format],
+        alive_compaction=args.alive_compaction,
     )
     degraded = False
     if args.backend == "tpu":

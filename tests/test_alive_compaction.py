@@ -1,0 +1,778 @@
+"""Host-side LWW alive-pair compaction (ISSUE 12, DESIGN §19).
+
+The byte-identity bar: a compacted scan (``--alive-compaction auto``, the
+wire-v5 default — pairs leave the per-row sections and ship as ONE
+LWW-merged per-dispatch table applied after the scan) must equal the
+uncompacted scan byte-for-byte across (wire, segfile) × workers × K ×
+mesh, under corruption/quarantine rewind, across resume, and across
+follow passes.  The algebra bar: host compaction ∘ device merge must
+equal the uncompacted per-record fold over generated update streams —
+duplicate slots within and across frames, tombstone↔set flips, arbitrary
+batch and superbatch splits — for BOTH the native and numpy packers
+(the hypothesis property test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.config import (
+    AnalyzerConfig,
+    CorruptionConfig,
+    DispatchConfig,
+    FollowConfig,
+)
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+from kafka_topic_analyzer_tpu.obs.registry import default_registry
+from kafka_topic_analyzer_tpu.packing import (
+    batch_alive_pairs,
+    pack_batch,
+    pack_pair_table,
+    packed_nbytes,
+    pair_table_capacity,
+    pair_table_nbytes,
+    unpack_numpy,
+    unpack_pair_table_numpy,
+)
+from kafka_topic_analyzer_tpu.records import RecordBatch
+
+from fake_broker import CorruptionInjector, FakeBroker
+
+pytestmark = pytest.mark.alivecompact
+
+TOPIC = "compact.topic"
+
+FAST_RETRY = {
+    "retry.backoff.ms": "5",
+    "reconnect.backoff.max.ms": "40",
+}
+
+
+def _mk_records(partition: int, n: int):
+    # Dense key reuse + frequent tombstones: the LWW order-sensitivity
+    # this feature must preserve, and real cross-batch duplication for
+    # the compaction ratio to bite on.
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 17}".encode() if i % 5 else None,
+            bytes(20 + (i % 13)) if i % 3 else None,
+        )
+        for i in range(n)
+    ]
+
+
+N_PARTS = 4
+N_REC = 300
+RECORDS = {p: _mk_records(p, N_REC) for p in range(N_PARTS)}
+
+
+def _cfg(compaction: str, **kw) -> AnalyzerConfig:
+    base = dict(
+        num_partitions=N_PARTS,
+        batch_size=128,
+        count_alive_keys=True,
+        alive_bitmap_bits=16,
+        enable_hll=True,
+        hll_p=8,
+        enable_quantiles=True,
+        wire_format=5,
+    )
+    base.update(kw)
+    return AnalyzerConfig(alive_compaction=compaction, **base)
+
+
+def _full_doc(result) -> dict:
+    return {
+        "metrics": result.metrics.to_dict(
+            result.start_offsets, result.end_offsets
+        ),
+        "start": result.start_offsets,
+        "end": result.end_offsets,
+        "degraded": result.degraded_partitions,
+        "corrupt": result.corrupt_partitions,
+    }
+
+
+def _wire_scan(compaction, workers=1, superbatch=1, backend_cls=TpuBackend,
+               mesh=None, **cfg_kw):
+    cfg = _cfg(compaction, **cfg_kw)
+    if mesh is not None:
+        cfg = dataclasses.replace(cfg, mesh_shape=mesh)
+    with FakeBroker(TOPIC, RECORDS, max_records_per_fetch=60) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        backend = backend_cls(
+            cfg, init_now_s=10**10,
+            dispatch=DispatchConfig(superbatch=superbatch),
+        )
+        result = run_scan(
+            TOPIC, src, backend, cfg.batch_size, ingest_workers=workers
+        )
+        src.close()
+    return result
+
+
+@pytest.fixture(scope="module")
+def uncompacted_baseline():
+    """The --alive-compaction off scan — the byte-exact referee."""
+    return _full_doc(_wire_scan("off"))
+
+
+# ---------------------------------------------------------------------------
+# scan-level identity: (wire) × workers × K × mesh
+
+
+@pytest.mark.parametrize("workers,superbatch", [
+    (1, 1), (4, 1), (1, 4), (4, 4),
+])
+def test_compacted_wire_scan_identical(
+    uncompacted_baseline, workers, superbatch
+):
+    result = _wire_scan("auto", workers=workers, superbatch=superbatch)
+    assert _full_doc(result) == uncompacted_baseline
+    assert result.wire is not None
+    assert result.wire.alive_compaction == "on"
+    assert result.wire.pairs_emitted > 0
+    assert result.wire.pairs_raw >= result.wire.pairs_emitted
+    if superbatch > 1 and workers == 1:
+        # Cross-batch dedupe only exists at K>1, and only when one
+        # dispatch sees the same partition more than once (the 4-worker
+        # fan-in gives each superbatch one batch per partition — disjoint
+        # key spaces, honestly ratio 1.0).  Sequential ingest repeats the
+        # 17-key cycle within a superbatch, so the ratio must bite here.
+        assert result.wire.compaction_ratio < 1.0
+
+
+@pytest.mark.parametrize("mesh,superbatch", [
+    ((2, 1), 1), ((2, 1), 4), ((2, 2), 1), ((2, 2), 4),
+])
+def test_compacted_sharded_scan_identical(
+    uncompacted_baseline, mesh, superbatch
+):
+    from kafka_topic_analyzer_tpu.parallel.sharded import ShardedTpuBackend
+
+    for compaction in ("off", "auto"):
+        result = _wire_scan(
+            compaction, mesh=mesh, superbatch=superbatch,
+            backend_cls=ShardedTpuBackend,
+        )
+        assert _full_doc(result) == uncompacted_baseline, (mesh, compaction)
+
+
+def test_compacted_rows_drop_pair_sections():
+    """The wire saving is structural: compacted v5 rows carry NO alive
+    sections (5 B/record gone), and the per-dispatch pair table is the
+    only place pairs travel — with identical LWW content."""
+    from kafka_topic_analyzer_tpu.packing import _sections
+
+    cfg_on = _cfg("auto")
+    cfg_off = _cfg("off")
+    names_on = {n for n, _, _ in _sections(cfg_on, 128)}
+    assert "alive_slot" not in names_on and "alive_flag" not in names_on
+    assert (packed_nbytes(cfg_off, 128) - packed_nbytes(cfg_on, 128)
+            == 128 * 5)
+
+    spec = SyntheticSpec(
+        num_partitions=2, messages_per_partition=200,
+        keys_per_partition=11, tombstone_permille=300, seed=9,
+    )
+    batch = next(SyntheticSource(spec).batches(128))
+    for use_native in (False, True):
+        if use_native:
+            native = pytest.importorskip(
+                "kafka_topic_analyzer_tpu.io.native"
+            )
+            if not native.native_available():
+                pytest.skip("native shim unavailable")
+        row = pack_batch(batch, cfg_on, use_native=use_native)
+        assert int(unpack_numpy(row.copy(), cfg_on)["n_pairs"]) == 0
+        off_row = unpack_numpy(
+            pack_batch(batch, cfg_off, use_native=use_native).copy(), cfg_off
+        )
+        n_off = int(off_row["n_pairs"])
+        cap = pair_table_capacity(cfg_on, 128, 1)
+        tbl, raw, emitted = pack_pair_table(
+            [batch_alive_pairs(batch, cfg_on, use_native)],
+            cfg_on, cap, use_native=use_native,
+        )
+        assert tbl.nbytes == pair_table_nbytes(cfg_on, cap)
+        ut = unpack_pair_table_numpy(tbl, cfg_on, cap)
+        assert int(ut["n_pairs"]) == n_off == emitted
+        # bits=16 picks the MASK form: reconstruct the per-slot LWW map
+        # from the set/clear words and compare against the off-path pairs.
+        assert "alive_set" in ut
+        got = {}
+        for w, (sw, cw) in enumerate(zip(
+            np.asarray(ut["alive_set"]).tolist(),
+            np.asarray(ut["alive_clear"]).tolist(),
+        )):
+            for bit in range(32):
+                if sw & (1 << bit):
+                    got[w * 32 + bit] = 1
+                elif cw & (1 << bit):
+                    got[w * 32 + bit] = 0
+        assert got == dict(
+            zip(off_row["alive_slot"][:n_off].tolist(),
+                off_row["alive_flag"][:n_off].tolist()))
+
+
+# ---------------------------------------------------------------------------
+# segfile cold path
+
+
+def test_compacted_segfile_scan_identical(tmp_path):
+    from kafka_topic_analyzer_tpu.io.segfile import (
+        SegmentDumpWriter,
+        SegmentFileSource,
+    )
+
+    spec = SyntheticSpec(
+        num_partitions=3, messages_per_partition=700, keys_per_partition=40,
+        seed=5, key_null_permille=60, tombstone_permille=200,
+    )
+    d = str(tmp_path / "segs")
+    writer = SegmentDumpWriter(d, "seg.topic", records_per_chunk=256)
+    src = SyntheticSource(spec)
+    writer.set_base_offsets(src.watermarks()[0])
+    for b in src.batches(180):
+        writer.append(b)
+    writer.close()
+
+    def scan(compaction, workers=1, superbatch=1):
+        cfg = AnalyzerConfig(
+            num_partitions=3, batch_size=128, count_alive_keys=True,
+            alive_bitmap_bits=14, enable_hll=True, hll_p=8,
+            wire_format=5, alive_compaction=compaction,
+        )
+        s = SegmentFileSource(d, "seg.topic")
+        r = run_scan(
+            "seg.topic", s,
+            TpuBackend(cfg, init_now_s=10**10,
+                       dispatch=DispatchConfig(superbatch=superbatch)),
+            128, ingest_workers=workers,
+        )
+        return _full_doc(r)
+
+    base = scan("off")
+    assert scan("auto") == base
+    assert scan("auto", workers=2) == base
+    assert scan("auto", superbatch=4) == base
+
+
+# ---------------------------------------------------------------------------
+# corruption / quarantine rewind parity
+
+
+def test_compacted_corruption_quarantine_parity(tmp_path):
+    """Deterministic poison under --on-corruption=quarantine: the
+    compacted scan classifies, accounts, and quarantines EXACTLY like the
+    uncompacted one — frame rewind must leave the pair emission region as
+    atomic as the row sections (pairs only emit after a frame validates)."""
+    def poisoned():
+        inj = (
+            CorruptionInjector()
+            .flip_byte(1, chunk=1, offset=-1)
+            .flip_byte(2, chunk=3, offset=-3)
+        )
+        return FakeBroker(
+            TOPIC, RECORDS, max_records_per_fetch=50, corruption=inj,
+            honor_partition_max_bytes=True,
+        )
+
+    def run(compaction, qdir, superbatch=1):
+        cfg = _cfg(compaction)
+        with poisoned() as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC,
+                overrides=dict(FAST_RETRY, **{"check.crcs": "true"}),
+                corruption=CorruptionConfig(
+                    policy="quarantine", quarantine_dir=qdir
+                ),
+            )
+            r = run_scan(
+                TOPIC, src,
+                TpuBackend(cfg, init_now_s=10**10,
+                           dispatch=DispatchConfig(superbatch=superbatch)),
+                128,
+            )
+            spans = src.corruption_spans()
+            src.close()
+        return _full_doc(r), spans
+
+    doc_off, spans_off = run("off", str(tmp_path / "qoff"))
+    doc_on, spans_on = run("auto", str(tmp_path / "qon"))
+    doc_on_k, spans_on_k = run("auto", str(tmp_path / "qonk"), superbatch=4)
+    assert doc_on == doc_off
+    assert doc_on_k == doc_off
+    assert sorted(doc_on["corrupt"]) == [1, 2]
+    assert spans_on == spans_off == spans_on_k
+    assert sorted(os.listdir(tmp_path / "qon")) == sorted(
+        os.listdir(tmp_path / "qoff")
+    )
+
+
+# ---------------------------------------------------------------------------
+# cross-config resume (compaction is execution strategy)
+
+
+class _Interrupt(Exception):
+    pass
+
+
+class _InterruptingSource(SyntheticSource):
+    def __init__(self, spec, limit):
+        super().__init__(spec)
+        self.limit = limit
+
+    def batches(self, batch_size, partitions=None, start_at=None):
+        it = super().batches(batch_size, partitions, start_at)
+        for i, b in enumerate(it):
+            if start_at is None and i >= self.limit:
+                raise _Interrupt()
+            yield b
+
+
+RESUME_SPEC = SyntheticSpec(
+    num_partitions=3, messages_per_partition=2_000, keys_per_partition=80,
+    tombstone_permille=250, seed=31,
+)
+
+
+@pytest.mark.parametrize("first,second", [("auto", "off"), ("off", "auto")])
+def test_cross_compaction_resume(tmp_path, first, second):
+    """A snapshot taken mid-scan with compaction one way resumes the
+    other way, reproducing the uninterrupted scan exactly — the setting
+    is execution strategy, outside the checkpoint fingerprint."""
+    cfg_first = AnalyzerConfig(
+        num_partitions=3, batch_size=512, count_alive_keys=True,
+        alive_bitmap_bits=18, enable_hll=True, hll_p=10,
+        wire_format=5, alive_compaction=first,
+    )
+    cfg_second = dataclasses.replace(cfg_first, alive_compaction=second)
+    full = run_scan(
+        "t", SyntheticSource(RESUME_SPEC),
+        TpuBackend(cfg_second, init_now_s=10**10), 512,
+    ).metrics.to_dict(None, None)
+
+    with pytest.raises(_Interrupt):
+        run_scan(
+            "t", _InterruptingSource(RESUME_SPEC, limit=5),
+            TpuBackend(cfg_first, init_now_s=10**10,
+                       dispatch=DispatchConfig(superbatch=2)), 512,
+            snapshot_dir=str(tmp_path), snapshot_every_s=0.0,
+        )
+    resumed = run_scan(
+        "t", SyntheticSource(RESUME_SPEC),
+        TpuBackend(cfg_second, init_now_s=0), 512,
+        snapshot_dir=str(tmp_path), resume=True,
+    )
+    assert resumed.metrics.to_dict(None, None) == full
+
+
+# ---------------------------------------------------------------------------
+# follow mode: pass-chained folds with compaction on == batch scan
+
+
+def _wait_for(predicate, timeout_s=30.0, interval_s=0.01, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_follow_compacted_matches_batch():
+    from kafka_topic_analyzer_tpu.serve.follow import FollowService
+
+    phase1 = {p: RECORDS[p][:200] for p in range(N_PARTS)}
+    phase2 = {p: RECORDS[p][200:] for p in range(N_PARTS)}
+    total = N_PARTS * N_REC
+
+    def followed(compaction):
+        cfg = _cfg(compaction, batch_size=64)
+        follow = FollowConfig(
+            poll_interval_s=0.02, idle_backoff_max_s=0.05, window_count=0
+        )
+        with FakeBroker(TOPIC, phase1, max_records_per_fetch=48) as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+            )
+            svc = FollowService(
+                TOPIC, src,
+                TpuBackend(cfg, init_now_s=10**10,
+                           dispatch=DispatchConfig(superbatch=4)),
+                64, follow,
+            )
+            errors = []
+
+            def folded():
+                doc = svc.state.snapshot()
+                return doc["overall"]["count"] if doc else -1
+
+            def driver():
+                try:
+                    _wait_for(
+                        lambda: folded() >= N_PARTS * 200,
+                        what="phase-1 fold",
+                    )
+                    for p in range(N_PARTS):
+                        broker.produce(p, phase2[p])
+                    _wait_for(
+                        lambda: folded() >= total, what="phase-2 fold"
+                    )
+                except BaseException as e:
+                    errors.append(e)
+                finally:
+                    svc.request_stop("test")
+
+            t = threading.Thread(target=driver)
+            t.start()
+            result = svc.run()
+            t.join()
+            src.close()
+            if errors:
+                raise errors[0]
+        return result.metrics.to_dict(None, None)
+
+    batch = _wire_scan("off").metrics.to_dict(None, None)
+    assert followed("auto") == batch
+    assert followed("off") == batch
+
+
+# ---------------------------------------------------------------------------
+# compaction algebra: host compaction ∘ device merge ≡ per-record fold
+# (hypothesis property test, both packers)
+
+
+def _reference_alive_count(stream, bits):
+    """Pure-python per-record LWW replay: the metric's DEFINITION."""
+    alive = {}
+    mask = (1 << bits) - 1
+    for batch in stream:
+        nv = batch.num_valid
+        for i in range(nv):
+            if batch.key_null[i]:
+                continue
+            alive[int(batch.key_hash32[i]) & mask] = not batch.value_null[i]
+    return sum(1 for v in alive.values() if v)
+
+
+def _bitmap_words(table_groups, cfg):
+    """Apply per-dispatch compacted tables in order through the DEVICE
+    merge — pair-scatter or elementwise-mask form, exactly as
+    backends.step.apply_pair_table dispatches on the section names."""
+    from kafka_topic_analyzer_tpu.jax_support import jnp
+    from kafka_topic_analyzer_tpu.ops.bitmap import (
+        bitmap_apply_masks,
+        bitmap_apply_pairs,
+        bitmap_num_words,
+        bitmap_popcount,
+    )
+
+    words = jnp.zeros(
+        (bitmap_num_words(cfg.alive_bitmap_bits),), dtype=jnp.uint32
+    )
+    for ut in table_groups:
+        if "alive_set" in ut:
+            words = bitmap_apply_masks(
+                words,
+                jnp.asarray(np.asarray(ut["alive_set"])),
+                jnp.asarray(np.asarray(ut["alive_clear"])),
+                bits=cfg.alive_bitmap_bits,
+            )
+        else:
+            words = bitmap_apply_pairs(
+                words,
+                jnp.asarray(np.asarray(ut["alive_slot"])),
+                jnp.asarray(np.asarray(ut["alive_flag"])),
+                jnp.int32(int(ut["n_pairs"])),
+                bits=cfg.alive_bitmap_bits,
+            )
+    return int(bitmap_popcount(words))
+
+
+def _stream_batch(parts, records):
+    n = len(records)
+    key_null = np.array([r[0] is None for r in records], dtype=bool)
+    value_null = np.array([r[1] for r in records], dtype=bool)
+    h32 = np.array([0 if r[0] is None else r[0] for r in records],
+                   dtype=np.uint32)
+    return RecordBatch(
+        partition=np.zeros(n, dtype=np.int32),
+        key_len=np.where(key_null, 0, 3).astype(np.int32),
+        value_len=np.where(value_null, 0, 5).astype(np.int32),
+        key_null=key_null,
+        value_null=value_null,
+        ts_s=np.arange(n, dtype=np.int64),
+        key_hash32=h32,
+        key_hash64=h32.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15),
+        valid=np.ones(n, dtype=bool),
+    )
+
+
+def test_compaction_algebra_matches_per_record_fold():
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    native = pytest.importorskip("kafka_topic_analyzer_tpu.io.native")
+    use_native = native.native_available()
+
+    @settings(max_examples=50, deadline=None)
+    @given(st.data())
+    def run(data):
+        bits = data.draw(st.integers(min_value=3, max_value=8))
+        # A stream of (key-hash-or-None, tombstone?) updates over a TINY
+        # slot space: duplicates within and across batches, tombstone↔set
+        # flips, guaranteed.
+        n = data.draw(st.integers(min_value=0, max_value=120))
+        updates = [
+            (
+                None
+                if data.draw(st.booleans()) and data.draw(st.booleans())
+                else data.draw(st.integers(0, 2**32 - 1)),
+                data.draw(st.booleans()),
+            )
+            for _ in range(n)
+        ]
+        # Arbitrary batch split, then arbitrary superbatch (dispatch)
+        # grouping of those batches.
+        cuts = sorted(data.draw(
+            st.lists(st.integers(0, n), max_size=6)
+        )) + [n]
+        batches, lo = [], 0
+        for hi in cuts:
+            if hi > lo:
+                batches.append(_stream_batch(1, updates[lo:hi]))
+                lo = hi
+        k = data.draw(st.integers(min_value=1, max_value=4))
+        # bits 26 forces the bounded PAIR form at this tiny capacity;
+        # small bits take the mask form — both kernels must agree.
+        if data.draw(st.booleans()) and data.draw(st.booleans()):
+            bits = 26
+        cfg = AnalyzerConfig(
+            num_partitions=1, batch_size=128, count_alive_keys=True,
+            alive_bitmap_bits=bits, wire_format=5,
+        )
+        ref = _reference_alive_count(batches, bits)
+        for nat in ([False, True] if use_native else [False]):
+            groups = []
+            for g in range(0, len(batches), k):
+                cap = pair_table_capacity(cfg, 128, k)
+                tbl, _, _ = pack_pair_table(
+                    [
+                        batch_alive_pairs(b, cfg, use_native=nat)
+                        for b in batches[g : g + k]
+                    ],
+                    cfg, cap, use_native=nat,
+                )
+                groups.append(unpack_pair_table_numpy(tbl, cfg, cap))
+            assert _bitmap_words(groups, cfg) == ref, (nat, bits, k)
+
+    run()
+
+
+def test_compaction_algebra_seeded_sweep():
+    """Seeded twin of the hypothesis property above — the same
+    compaction ∘ merge ≡ per-record-fold check runs even where the
+    hypothesis package is absent (tier-1 containers)."""
+    try:
+        from kafka_topic_analyzer_tpu.io.native import native_available
+
+        use_native = native_available()
+    except ImportError:
+        use_native = False
+    rng = np.random.default_rng(0xC0FFEE)
+    for trial in range(40):
+        bits = int(rng.integers(3, 9))
+        n = int(rng.integers(0, 121))
+        updates = [
+            (
+                None if rng.random() < 0.2 else int(rng.integers(0, 2**32)),
+                bool(rng.random() < 0.4),
+            )
+            for _ in range(n)
+        ]
+        cuts = sorted(rng.integers(0, n + 1, size=int(rng.integers(0, 6))).tolist()) + [n]
+        batches, lo = [], 0
+        for hi in cuts:
+            if hi > lo:
+                batches.append(_stream_batch(1, updates[lo:hi]))
+                lo = hi
+        k = int(rng.integers(1, 5))
+        if trial % 8 == 7:
+            bits = 26  # the bounded PAIR form (masks past the trade cap)
+        cfg = AnalyzerConfig(
+            num_partitions=1, batch_size=128, count_alive_keys=True,
+            alive_bitmap_bits=bits, wire_format=5,
+        )
+        from kafka_topic_analyzer_tpu.packing import alive_table_mode
+        assert alive_table_mode(cfg, pair_table_capacity(cfg, 128, k)) == (
+            1 if bits == 26 else 2
+        )
+        ref = _reference_alive_count(batches, bits)
+        for nat in ([False, True] if use_native else [False]):
+            groups = []
+            for g in range(0, len(batches), k):
+                cap = pair_table_capacity(cfg, 128, k)
+                tbl, _, _ = pack_pair_table(
+                    [
+                        batch_alive_pairs(b, cfg, use_native=nat)
+                        for b in batches[g : g + k]
+                    ],
+                    cfg, cap, use_native=nat,
+                )
+                groups.append(unpack_pair_table_numpy(tbl, cfg, cap))
+            assert _bitmap_words(groups, cfg) == ref, (trial, nat, bits, k)
+
+
+# ---------------------------------------------------------------------------
+# gating, kill switches, accounting
+
+
+def _metric_total(name: str) -> float:
+    m = default_registry().snapshot().get(name)
+    return sum(s["value"] for s in m["samples"]) if m else 0.0
+
+
+def test_compaction_resolution_and_kill_switches(monkeypatch):
+    on = AnalyzerConfig(num_partitions=2, batch_size=64,
+                        count_alive_keys=True)
+    assert on.compact_alive and on.alive_compaction_off_reason is None
+
+    off = AnalyzerConfig(num_partitions=2, batch_size=64,
+                         count_alive_keys=True, alive_compaction="off")
+    assert not off.compact_alive
+    assert off.alive_compaction_off_reason == "explicit"
+
+    v4 = AnalyzerConfig(num_partitions=2, batch_size=64,
+                        count_alive_keys=True, wire_format=4)
+    assert not v4.compact_alive
+    assert v4.alive_compaction_off_reason == "wire-v4"
+
+    monkeypatch.setenv("KTA_DISABLE_COMPACTION", "1")
+    env = AnalyzerConfig(num_partitions=2, batch_size=64,
+                         count_alive_keys=True)
+    assert not env.compact_alive
+    assert env.alive_compaction_off_reason == "env-kill-switch"
+    monkeypatch.delenv("KTA_DISABLE_COMPACTION")
+
+    no_alive = AnalyzerConfig(num_partitions=2, batch_size=64)
+    assert not no_alive.compact_alive
+    assert no_alive.alive_compaction_off_reason is None
+
+    with pytest.raises(ValueError, match="alive_compaction"):
+        AnalyzerConfig(num_partitions=2, batch_size=64,
+                       alive_compaction="maybe")
+
+
+def test_pair_counters_and_fallback_booked():
+    before_raw = _metric_total("kta_alive_pairs_raw_total")
+    before_em = _metric_total("kta_alive_pairs_emitted_total")
+    before_off = _metric_total("kta_alive_compaction_off_total")
+
+    result = _wire_scan("auto", superbatch=4)
+    raw = _metric_total("kta_alive_pairs_raw_total") - before_raw
+    em = _metric_total("kta_alive_pairs_emitted_total") - before_em
+    assert raw > 0 and 0 < em <= raw
+    assert result.wire.pairs_raw == int(raw)
+    assert result.wire.pairs_emitted == int(em)
+    assert _metric_total("kta_alive_compaction_off_total") == before_off
+
+    off_result = _wire_scan("off")
+    assert (
+        _metric_total("kta_alive_compaction_off_total") == before_off + 1
+    )
+    assert off_result.wire.alive_compaction == "off (explicit)"
+    assert off_result.wire.pairs_raw == 0
+
+
+def test_stats_compaction_line_renders():
+    from kafka_topic_analyzer_tpu.report import render_telemetry_stats
+
+    result = _wire_scan("auto", superbatch=4)
+    text = render_telemetry_stats(result.telemetry, wire=result.wire)
+    assert "alive-compaction: on" in text
+    assert "ratio" in text
+
+    off = _wire_scan("off")
+    text_off = render_telemetry_stats(off.telemetry, wire=off.wire)
+    assert "alive-compaction: off (explicit)" in text_off
+    assert off.wire.as_dict()["alive_compaction"] == "off (explicit)"
+    doc = result.wire.as_dict()
+    assert doc["alive_pairs_raw"] == result.wire.pairs_raw
+    assert 0 < doc["alive_compaction_ratio"] <= 1
+
+
+def test_worst_case_all_unique_ratio_is_one():
+    """All-unique keys: compaction cannot dedupe anything — the ratio is
+    honestly 1.0 and results still match the uncompacted fold."""
+    spec = SyntheticSpec(
+        num_partitions=2, messages_per_partition=1500,
+        keys_per_partition=1_000_000, tombstone_permille=100, seed=13,
+    )
+
+    def scan(compaction):
+        cfg = AnalyzerConfig(
+            num_partitions=2, batch_size=256, count_alive_keys=True,
+            alive_bitmap_bits=24, wire_format=5,
+            alive_compaction=compaction,
+        )
+        return run_scan(
+            "t", SyntheticSource(spec),
+            TpuBackend(cfg, init_now_s=10**10,
+                       dispatch=DispatchConfig(superbatch=4)),
+            256,
+        )
+
+    on = scan("auto")
+    off = scan("off")
+    assert on.metrics.to_dict(None, None) == off.metrics.to_dict(None, None)
+    # Not exactly 1.0 only if the 1M-key draw collides; allow a hair.
+    assert on.wire.compaction_ratio > 0.99
+
+
+# ---------------------------------------------------------------------------
+# mesh-pinned alive resume rejection names the feature + allowed configs
+
+
+def test_mesh_pinned_resume_error_names_feature(tmp_path):
+    from kafka_topic_analyzer_tpu.checkpoint import (
+        load_snapshot,
+        save_snapshot,
+    )
+    from kafka_topic_analyzer_tpu.models.state import AnalyzerState
+
+    cfg21 = AnalyzerConfig(
+        num_partitions=4, batch_size=128, count_alive_keys=True,
+        alive_bitmap_bits=12, mesh_shape=(2, 1),
+    )
+    save_snapshot(
+        str(tmp_path), "t", cfg21, AnalyzerState.init(cfg21),
+        {0: 5}, 5, 0,
+    )
+    cfg11 = dataclasses.replace(cfg21, mesh_shape=(1, 1))
+    with pytest.raises(ValueError) as ei:
+        load_snapshot(str(tmp_path), "t", cfg11)
+    msg = str(ei.value)
+    assert "MESH-PINNED" in msg
+    assert "count-alive-keys" in msg
+    assert "--mesh 2,1" in msg          # the config that may resume it
+    assert "--mesh 1,1" in msg          # what a rescan would run
+    # A genuinely different config (other topic) stays a generic mismatch
+    # but still names the mesh-pinning rule for alive scans.
+    with pytest.raises(ValueError, match="alive keys"):
+        load_snapshot(str(tmp_path), "other-topic".replace("-", "_"),
+                      dataclasses.replace(cfg11, num_partitions=5))

@@ -276,8 +276,12 @@ def test_alive_bitmap_snapshots_stay_mesh_pinned(tmp_path):
         alive_bitmap_bits=18, mesh_shape=(4, 1),
     )
     be4 = ShardedTpuBackend(cfg4, init_now_s=5)
-    with pytest.raises(ValueError, match="fingerprint"):
+    # The rejection names the offending feature and the mesh that may
+    # resume the snapshot (PR 12's diagnosable-error satellite).
+    with pytest.raises(ValueError, match="MESH-PINNED") as ei:
         load_snapshot(str(tmp_path), "t", cfg4, template=be4.get_state())
+    assert "--mesh 2,1" in str(ei.value)
+    assert "count-alive-keys" in str(ei.value)
 
 
 def test_scoped_mesh_free_snapshot_canonicalizes_and_distributes(tmp_path):

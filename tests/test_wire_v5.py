@@ -452,9 +452,13 @@ def test_v5_native_rows_equal_numpy_rows():
         a = pack_batch(batch, cfg, use_native=False)
         b = pack_batch(batch, cfg, use_native=True)
         assert np.array_equal(a, b), kw
-    # alive combo: pair order differs (sorted vs first-touch)
+    # alive combo: pair order differs (sorted vs first-touch).  Pinned to
+    # --alive-compaction off: this asserts the UNCOMPACTED v5 pair-section
+    # layout (the compacted rows — no pair sections at all — are covered
+    # by tests/test_alive_compaction.py).
     cfg = AnalyzerConfig(num_partitions=4, batch_size=500, wire_format=5,
-                         count_alive_keys=True, alive_bitmap_bits=14)
+                         count_alive_keys=True, alive_bitmap_bits=14,
+                         alive_compaction="off")
     ua = unpack_numpy(pack_batch(batch, cfg, use_native=False).copy(), cfg)
     ub = unpack_numpy(pack_batch(batch, cfg, use_native=True).copy(), cfg)
     np_pairs = int(ua["n_pairs"])

@@ -510,6 +510,20 @@ if "KTA_DISABLE_FUSED" not in (PKG / "packing.py").read_text():
         "packing.py: KTA_DISABLE_FUSED env knob missing from "
         "fused_ingest_enabled"
     )
+# (c) alive-pair compaction is an optimization with the same contract:
+# the env kill switch must exist at the one resolution site (config.py),
+# and the engine must book every bypassed compaction with its reason
+# (kta_alive_compaction_off_total — a silent bypass is a lint failure).
+if "KTA_DISABLE_COMPACTION" not in (PKG / "config.py").read_text():
+    failures.append(
+        "config.py: KTA_DISABLE_COMPACTION env knob missing from the "
+        "alive_compaction resolution (__post_init__)"
+    )
+if "ALIVE_COMPACTION_OFF.labels(" not in (PKG / "engine.py").read_text():
+    failures.append(
+        "engine.py: kta_alive_compaction_off_total booking missing — an "
+        "alive-key scan running uncompacted must record its reason"
+    )
 
 if failures:
     print("lint: fused decode→pack call sites must be gated so the")
@@ -543,10 +557,48 @@ SCOPE = (
     + sorted(pathlib.Path("tests").glob("*.py"))
 )
 
+#: The compacted pair-table layout (PR 12) has exactly one source too:
+#: packing._sections(pair_table=True) behind these helpers.  Scoped files
+#: may CALL them (imported from packing) but never re-derive the layout.
+PAIR_HELPERS = {
+    "pack_pair_table", "unpack_pair_table_device",
+    "unpack_pair_table_numpy", "pair_table_capacity", "pair_table_nbytes",
+}
+
 failures = []
 for path in SCOPE:
     tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    packing_imports = set()
     for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and node.module
+            and node.module.endswith("packing")
+        ):
+            packing_imports |= {a.name for a in node.names}
+    for node in ast.walk(tree):
+        # (c) pair-table helpers must come from packing (no local
+        # reimplementation/shadowing of the pair-table buffer layout;
+        # wrappers that CALL the imported helpers are fine).
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name in PAIR_HELPERS
+        ):
+            failures.append(
+                f"{path}:{node.lineno}: local {node.name!r} definition "
+                "shadows the packing helper — the pair-table layout "
+                "lives in packing._sections only"
+            )
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in PAIR_HELPERS
+            and node.func.id not in packing_imports
+        ):
+            failures.append(
+                f"{path}:{node.lineno}: {node.func.id} called without "
+                "importing it from packing — pair tables are only "
+                "addressed via packing._sections' helpers"
+            )
         # (a) HEADER_BYTES belongs to packing.py.
         if isinstance(node, ast.Name) and node.id == "HEADER_BYTES":
             failures.append(
