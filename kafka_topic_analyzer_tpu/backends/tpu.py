@@ -239,6 +239,20 @@ class TpuBackend(MetricBackend):
             self._queue = DispatchQueue(self.dispatch_depth)
             self._empty_buf: "np.ndarray | None" = None
 
+    def set_dispatch_depth(self, depth: int) -> None:
+        """Re-bound the in-flight dispatch window between passes — the
+        fleet scheduler's dispatch-share grants (DESIGN.md §20) become
+        real backpressure here, not just ledger rows.  Shrinks apply
+        immediately (DispatchQueue.throttle reads the bound per call,
+        and a live shrink just waits in-flight work below the new bound);
+        grows clamp at the CONSTRUCTED depth, because the stager ring was
+        sized then and a wider window would outrun its slots."""
+        depth = max(1, min(int(depth), self.dispatch_config.depth))
+        self.dispatch_depth = depth
+        q = getattr(self, "_queue", None)
+        if q is not None:
+            q.depth = depth
+
     def _pack_pairs(self, pair_lists, cap) -> np.ndarray:
         """Merge + pack a dispatch's pair table, booking the raw→emitted
         compaction split (never silent — the --stats ratio reads these)."""

@@ -415,6 +415,47 @@ def snapshot_info(directory: str, scope=None) -> "Optional[dict]":
         return json.loads(str(z["__meta__"]))
 
 
+def topic_snapshot_dir(directory: str, topic: str) -> str:
+    """Fleet-mode checkpoint namespacing: each topic's snapshots live in
+    their own subdirectory of the fleet ``--snapshot-dir`` (Kafka topic
+    names are ``[a-zA-Z0-9._-]``, so the name IS a safe path segment).  A
+    solo scan of one topic pointed at the same subdirectory resumes the
+    fleet's checkpoint and vice versa — the snapshot format never learns
+    it was written by a fleet."""
+    return os.path.join(directory, topic)
+
+
+def list_topic_snapshots(directory: str) -> "dict[str, dict]":
+    """topic -> snapshot metadata for every per-topic snapshot under a
+    fleet snapshot directory (`snapshot_info` over each subdirectory) —
+    the fleet resume banner: "which topics will pick up where" from the
+    files alone, before any broker handshake or state load."""
+    out: "dict[str, dict]" = {}
+    if not os.path.isdir(directory):
+        return out
+    for name in sorted(os.listdir(directory)):
+        sub = os.path.join(directory, name)
+        if not os.path.isdir(sub):
+            continue
+        try:
+            info = snapshot_info(sub)
+        except Exception:
+            # One topic's corrupt/truncated snapshot (a fleet killed
+            # mid-write) must not break the inventory — the fleet's
+            # isolation contract starts at the banner.  That topic's own
+            # resume will surface the real error in its status row.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "snapshot inventory: unreadable snapshot under %r "
+                "(skipped)", sub, exc_info=True,
+            )
+            continue
+        if info is not None:
+            out[name] = info
+    return out
+
+
 def load_corrupt_spans(directory: str, scope=None) -> list:
     """The ``corrupt_spans`` metadata of a snapshot, or [] when the
     snapshot (or the list) is absent.  Split from `load_snapshot` so the

@@ -254,6 +254,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "and serves the ring-buffered series at /flight on "
                         "--metrics-port. The bottleneck verdict itself is "
                         "always computed — the recorder adds the timeline")
+    p.add_argument("--fleet", action="store_true",
+                   help="Cluster-wide topic discovery + scan: ask the "
+                        "cluster for ALL topics (one all-topics Metadata "
+                        "request), filter them (-t becomes a comma-"
+                        "separated include-glob list, default '*'; "
+                        "--fleet-exclude subtracts; internal "
+                        "__consumer_offsets-style topics are excluded "
+                        "unless --fleet-internal), then scan every match "
+                        "— up to --fleet-concurrency topics at once, "
+                        "sharing the global --ingest-workers and "
+                        "--dispatch-depth budgets across the concurrent "
+                        "scans.  Per-topic results are byte-identical to "
+                        "solo scans; one topic's failure never kills the "
+                        "fleet (it becomes a status row).  Composes with "
+                        "--follow (the whole cluster tailed as one "
+                        "service), --json (cluster rollup + per-topic "
+                        "documents), --snapshot-dir (one subdirectory "
+                        "per topic) and /report.json?topic= on "
+                        "--metrics-port")
+    p.add_argument("--fleet-exclude", metavar="GLOBS",
+                   help="Comma-separated topic-name globs to exclude "
+                        "from --fleet discovery (applied after the -t "
+                        "include globs)")
+    p.add_argument("--fleet-internal", action="store_true",
+                   help="Include broker-internal topics "
+                        "(__consumer_offsets-style; metadata-flagged or "
+                        "__-prefixed) in --fleet discovery")
+    p.add_argument("--fleet-concurrency", default="auto", metavar="N|auto",
+                   help="Per-topic scans admitted concurrently under "
+                        "--fleet ('auto' sizes from the worker budget "
+                        "and topic count). The admission scheduler "
+                        "defers the rest until budget returns. "
+                        "Default: auto")
     p.add_argument("--follow", action="store_true",
                    help="Run as a long-lived analyzer service: after the "
                         "initial earliest→latest pass, keep re-polling "
@@ -426,8 +459,10 @@ def wrap_with_dump(args, topic: str, source):
         return source
     if args.resume:
         raise UserInputError(
-            "--dump-segments cannot be combined with --resume "
-            "(the dump would miss already-scanned records)"
+            "--dump-segments cannot be combined with --resume (the dump "
+            "would miss already-scanned records); drop --resume — or "
+            "delete the snapshot — so the dump scan covers the topic "
+            "from its earliest offset"
         )
     from kafka_topic_analyzer_tpu.io.segfile import SegmentDumpWriter, TeeSource
 
@@ -567,7 +602,12 @@ def parse_from_timestamp_flag(args) -> "int | None":
             "timestamp index lookup)"
         )
     if args.resume:
-        raise ValueError("--from-timestamp cannot be combined with --resume")
+        raise ValueError(
+            "--from-timestamp cannot be combined with --resume (the "
+            "snapshot's offsets already fix where the scan continues); "
+            "drop --resume to seek to the timestamp, or drop "
+            "--from-timestamp to resume the snapshot"
+        )
     return parse_timestamp_ms(args.from_timestamp)
 
 
@@ -757,6 +797,285 @@ def run_multi_topic(args, topics: "list[str]") -> int:
     return _scan_issue_exit(result, render=True)
 
 
+def _fleet_exit(fleet_result) -> int:
+    """Fleet exit precedence mirrors the solo scan's (degraded outranks
+    corrupt — PR 3's contract) with one rung above both: a topic whose
+    scan hard-failed (isolation caught it; its numbers are partial)."""
+    if fleet_result.any_failed:
+        return 1
+    if fleet_result.any_degraded:
+        return EXIT_DEGRADED
+    if fleet_result.any_corrupt:
+        return EXIT_CORRUPT
+    return 0
+
+
+def run_fleet(args, topics: "list[str] | None" = None) -> int:
+    """Cluster-wide scan (--fleet), or an explicit multi-topic follow
+    (``-t a,b --follow`` — each topic keeps its solo pass chain; the
+    fleet scheduler shares the budgets).  ``topics`` pins the list and
+    skips discovery."""
+    from kafka_topic_analyzer_tpu.config import IngestConfig
+    from kafka_topic_analyzer_tpu.fleet.discovery import (
+        discover_topics,
+        parse_globs,
+    )
+    from kafka_topic_analyzer_tpu.fleet.scheduler import (
+        FleetScheduler,
+        TopicSeed,
+    )
+    from kafka_topic_analyzer_tpu.fleet.service import FleetService
+
+    with user_input_phase():
+        if args.source != "kafka":
+            raise ValueError(
+                "--fleet requires --source kafka (discovery reads cluster "
+                "metadata); synthetic/segfile sources scan solo"
+            )
+        if not args.bootstrap_server:
+            raise SystemExit("--fleet requires -b/--bootstrap-server")
+        mesh_shape = parse_mesh(args.mesh)
+        if mesh_shape != (1, 1):
+            raise ValueError(
+                "--fleet does not support --mesh yet (fleet scans run "
+                "per-topic single-device backends); drop --mesh, or scan "
+                "one topic solo to use a device mesh"
+            )
+        if args.distributed:
+            raise ValueError(
+                "--fleet does not support --distributed (per-poll "
+                "admission would need fleet-wide lockstep agreement); "
+                "run the fleet single-controller"
+            )
+        if args.dump_segments:
+            raise ValueError(
+                "--fleet does not support --dump-segments (the dump tee "
+                "is single-topic); run a solo scan with --dump-segments "
+                "per topic instead"
+            )
+        if args.from_timestamp:
+            raise ValueError(
+                "--fleet does not support --from-timestamp yet (the "
+                "cutoff would need a per-topic seek); scan the topic "
+                "solo with --from-timestamp instead"
+            )
+        dispatch = resolve_dispatch(args)
+        ingest_cfg = IngestConfig.parse(args.ingest_workers)
+        text = str(args.fleet_concurrency).strip().lower()
+        explicit_concurrency = None
+        if text != "auto":
+            try:
+                explicit_concurrency = int(text)
+            except ValueError:
+                raise ValueError(
+                    f"bad --fleet-concurrency {args.fleet_concurrency!r}: "
+                    "expected a positive integer or 'auto'"
+                ) from None
+            if explicit_concurrency < 1:
+                raise ValueError("--fleet-concurrency must be >= 1")
+
+    banner_out = sys.stderr if args.json else sys.stdout
+    rediscover = None
+    if topics is None:
+        include = parse_globs(args.topic) or ["*"]
+        exclude = parse_globs(args.fleet_exclude)
+
+        def discover() -> "list[TopicSeed]":
+            return [
+                TopicSeed(name=d.name, partitions=d.partitions)
+                for d in discover_topics(
+                    args.bootstrap_server, include, exclude,
+                    args.fleet_internal,
+                )
+            ]
+
+        seeds = discover()
+        if args.follow:
+            rediscover = discover
+        print(
+            f"Fleet discovery: {len(seeds)} topic(s) matched "
+            f"{','.join(include)}"
+            + (f" minus {','.join(exclude)}" if exclude else ""),
+            file=banner_out,
+        )
+    else:
+        # Explicit list (multi-topic --follow): real partition counts
+        # come from one all-topics metadata round trip — the worker
+        # budget below is resolved against them, and a placeholder of 1
+        # would silently cap the whole fleet at len(topics) workers.  An
+        # unreachable cluster keeps the placeholders; every scan then
+        # fails in isolation and the service exits, like solo.
+        parts_by_name: "dict[str, int]" = {}
+        try:
+            wanted = set(topics)
+            for d in discover_topics(
+                args.bootstrap_server, include_internal=True
+            ):
+                if d.name in wanted:
+                    parts_by_name[d.name] = d.partitions
+        except Exception as e:
+            print(
+                f"warning: could not size the fleet from cluster "
+                f"metadata ({e}); worker budget assumes 1 partition "
+                "per topic",
+                file=sys.stderr,
+            )
+        seeds = [
+            TopicSeed(name=t, partitions=parts_by_name.get(t, 1))
+            for t in topics
+        ]
+    if not seeds:
+        print(
+            "No topics matched the fleet filters, no analysis possible. "
+            "Exiting.",
+            file=sys.stderr,
+        )
+        sys.exit(-2)
+
+    total_parts = sum(max(1, s.partitions) for s in seeds)
+    worker_budget = ingest_cfg.resolve(max(1, total_parts))
+    max_concurrent = (
+        explicit_concurrency
+        if explicit_concurrency is not None
+        else max(1, min(4, len(seeds), worker_budget))
+    )
+    # Under --fleet, --dispatch-depth is the GLOBAL in-flight budget the
+    # concurrent device scans share (each admitted scan holds >= 1
+    # token).  The cpu oracle has no dispatch queue, so its token budget
+    # just matches the concurrency.
+    dispatch_budget = (
+        max(1, args.dispatch_depth)
+        if args.backend == "tpu"
+        else max_concurrent
+    )
+
+    def source_factory(topic: str):
+        return make_source(args, topic=topic)
+
+    def backend_factory(topic: str, num_partitions: int, grant):
+        with user_input_phase():
+            config = AnalyzerConfig(
+                num_partitions=num_partitions,
+                batch_size=args.batch_size,
+                count_alive_keys=args.count_alive_keys,
+                alive_bitmap_bits=args.alive_bitmap_bits,
+                enable_hll=args.distinct_keys,
+                distinct_keys_per_partition=args.distinct_keys_per_partition,
+                enable_quantiles=args.quantiles,
+                quantiles_per_partition=args.quantiles_per_partition,
+                mesh_shape=(1, 1),
+                use_pallas_counters=args.pallas,
+                wire_format=resolve_wire_format(args),
+                alive_compaction=getattr(args, "alive_compaction", "auto"),
+            )
+        topic_dispatch = None
+        if dispatch is not None:
+            from kafka_topic_analyzer_tpu.config import DispatchConfig
+
+            topic_dispatch = DispatchConfig(
+                superbatch=dispatch.superbatch,
+                depth=grant.dispatch_depth,
+            )
+        return _make_cli_backend(args, config, (1, 1), dispatch=topic_dispatch)
+
+    follow_cfg = None
+    if args.follow:
+        with user_input_phase():
+            from kafka_topic_analyzer_tpu.config import FollowConfig
+
+            follow_cfg = FollowConfig(
+                poll_interval_s=args.poll_interval,
+                checkpoint_every_s=(
+                    args.checkpoint_interval
+                    if args.checkpoint_interval is not None
+                    else args.snapshot_every
+                ),
+                idle_exit_s=args.follow_idle_exit,
+            )
+
+    scheduler = FleetScheduler(worker_budget, dispatch_budget, max_concurrent)
+    from kafka_topic_analyzer_tpu.utils.progress import Spinner
+
+    svc = FleetService(
+        seeds,
+        source_factory,
+        backend_factory,
+        args.batch_size,
+        scheduler,
+        follow=follow_cfg,
+        snapshot_dir=args.snapshot_dir,
+        resume=args.resume,
+        # /report.json assembly is pure waste when no HTTP server exists
+        # to serve it (same rule as the solo follow service).
+        publish_reports=args.metrics_port is not None,
+        spinner=Spinner(enabled=not args.quiet),
+        rediscover=rediscover,
+    )
+    print(
+        f"Fleet scan of {len(seeds)} topic(s): "
+        f"{worker_budget} worker(s), dispatch budget {dispatch_budget}, "
+        f"concurrency {max_concurrent}"
+        + (" (follow)" if args.follow else ""),
+        file=banner_out,
+    )
+    if args.follow:
+        restore = svc.install_signal_handlers()
+        try:
+            fleet_result = svc.run_follow()
+        finally:
+            restore()
+    else:
+        fleet_result = svc.run_batch()
+
+    if args.stats:
+        from kafka_topic_analyzer_tpu.obs.registry import default_registry
+        from kafka_topic_analyzer_tpu.report import (
+            render_fleet_status,
+            render_telemetry_stats,
+        )
+
+        sys.stderr.write(render_fleet_status(fleet_result.rollup))
+        sys.stderr.write(
+            render_telemetry_stats(default_registry().snapshot())
+        )
+    if args.json:
+        import json
+
+        from kafka_topic_analyzer_tpu.report import build_json_doc
+
+        doc = dict(fleet_result.rollup)
+        doc["topics"] = {
+            t: build_json_doc(
+                t,
+                result,
+                diagnosis=_diagnose(result),
+                fleet=fleet_result.statuses[t].as_dict(),
+            )
+            for t, result in sorted(fleet_result.results.items())
+        }
+        rc = _fleet_exit(fleet_result)
+        print(json.dumps(doc))
+        return rc
+    from kafka_topic_analyzer_tpu.report import (
+        render_fleet_status,
+        render_report,
+    )
+
+    for t, result in sorted(fleet_result.results.items()):
+        sys.stdout.write(
+            render_report(
+                t,
+                result.metrics,
+                result.start_offsets,
+                result.end_offsets,
+                result.duration_secs,
+                show_alive_keys=args.count_alive_keys,
+            )
+        )
+    sys.stdout.write(render_fleet_status(fleet_result.rollup))
+    return _fleet_exit(fleet_result)
+
+
 def main(argv: "list[str] | None" = None) -> int:
     from kafka_topic_analyzer_tpu.utils.log import init_logging
 
@@ -791,13 +1110,18 @@ def _run(args) -> int:
 
         with user_input_phase():
             initialize_distributed(args.distributed)
+    if args.fleet:
+        return run_fleet(args)
     # Kafka topic names cannot contain commas, so "-t a,b,c" unambiguously
     # selects multi-topic fan-in (new capability; BASELINE.json config 5).
     if "," in args.topic:
         if args.follow:
-            raise UserInputError(
-                "--follow does not support multi-topic fan-in yet "
-                "(ROADMAP item 2: the fleet scheduler is its second tenant)"
+            # Lifted (fleet mode): an explicit topic list under --follow
+            # runs through the fleet scheduler — each topic keeps its
+            # solo pass chain (NOT the fan-in's merged state), budgets
+            # are shared, and /report.json?topic= serves each document.
+            return run_fleet(
+                args, topics=[t for t in args.topic.split(",") if t]
             )
         return run_multi_topic(args, [t for t in args.topic.split(",") if t])
     with user_input_phase():

@@ -1,0 +1,13 @@
+"""Fleet mode: one service audits the whole cluster (DESIGN.md §20).
+
+The reference tool analyzes exactly one topic per invocation.  This
+package generalizes the *scenario* axis the way ``parallel/`` generalized
+the hardware axis: ``discovery`` turns cluster metadata into a filtered
+topic list, ``scheduler`` shares the global ingest-worker and
+dispatch-depth budgets across N concurrent per-topic scans (and
+rebalances them between polls on the scan doctor's verdicts), and
+``service`` drives the admitted scans — each one a plain
+``engine.run_scan`` pass chain, byte-identical to a solo scan of that
+topic — with per-topic failure isolation, per-topic checkpoint/report
+namespacing, and a cluster rollup report.
+"""

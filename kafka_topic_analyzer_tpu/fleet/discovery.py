@@ -1,0 +1,110 @@
+"""Cluster topic discovery: metadata → the fleet's topic list.
+
+One all-topics Metadata request (``io/kafka_wire.discover_cluster_topics``
+— the same v5–v12 negotiation the per-topic source runs, with a null
+topic array) answers "what could a fleet scan cover"; this module turns
+that raw listing into the list a fleet scan *should* cover:
+
+- **include globs** (``-t`` under ``--fleet``; comma-separated fnmatch
+  patterns, default ``*``) select topics by name;
+- **exclude globs** (``--fleet-exclude``) drop matches back out — applied
+  after includes, so ``-t 'orders.*' --fleet-exclude '*.dlq'`` reads the
+  way it is written;
+- **internal topics** (``__consumer_offsets``-style) are dropped unless
+  ``--fleet-internal`` asks for them: both the broker's ``is_internal``
+  metadata flag and the ``__`` name prefix count, because older brokers
+  (Metadata v0/v1 era) did not always flag system topics.
+
+Errored topic metadata (a topic mid-deletion answers with an error code)
+is skipped with a log line — a fleet audit reports the cluster that
+exists, it does not abort on the one topic that is going away.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import logging
+from typing import Iterable, List, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscoveredTopic:
+    """One discovery hit: everything the scheduler needs to seed a scan
+    without a per-topic handshake."""
+
+    name: str
+    #: Partition count from the metadata response — the admission
+    #: scheduler's seed weight (watermark lag refines it once the topic's
+    #: source exists).
+    partitions: int
+    #: Broker-flagged or ``__``-prefixed system topic.
+    internal: bool = False
+
+
+def parse_globs(spec: "Optional[str]") -> "List[str]":
+    """Comma-separated glob list → pattern list ('' / None → no patterns)."""
+    if not spec:
+        return []
+    return [g.strip() for g in spec.split(",") if g.strip()]
+
+
+def is_internal_name(name: str) -> bool:
+    """``__consumer_offsets``-style system-topic naming (the prefix
+    convention predates the metadata flag)."""
+    return name.startswith("__")
+
+
+def filter_topics(
+    topics: "Iterable[DiscoveredTopic]",
+    include: "Sequence[str]" = ("*",),
+    exclude: "Sequence[str]" = (),
+    include_internal: bool = False,
+) -> "List[DiscoveredTopic]":
+    """Apply include/exclude globs + internal exclusion; sorted by name
+    so every fleet run (and every re-discovery poll) sees a deterministic
+    ordering."""
+    include = list(include) or ["*"]
+    out = []
+    for t in topics:
+        if t.internal and not include_internal:
+            continue
+        if not any(fnmatch.fnmatchcase(t.name, g) for g in include):
+            continue
+        if any(fnmatch.fnmatchcase(t.name, g) for g in exclude):
+            continue
+        out.append(t)
+    return sorted(out, key=lambda t: t.name)
+
+
+def discover_topics(
+    bootstrap_servers: str,
+    include: "Sequence[str]" = ("*",),
+    exclude: "Sequence[str]" = (),
+    include_internal: bool = False,
+    timeout_s: float = 10.0,
+) -> "List[DiscoveredTopic]":
+    """All-topics metadata → filtered, sorted `DiscoveredTopic` list.
+
+    An empty result is a valid answer (an empty cluster, or filters that
+    match nothing) — the CLI decides whether that is an error."""
+    from kafka_topic_analyzer_tpu.io.kafka_wire import discover_cluster_topics
+
+    found: "List[DiscoveredTopic]" = []
+    for md in discover_cluster_topics(bootstrap_servers, timeout_s=timeout_s):
+        if md.error:
+            log.warning(
+                "discovery: skipping topic %r (metadata error %d)",
+                md.name, md.error,
+            )
+            continue
+        found.append(
+            DiscoveredTopic(
+                name=md.name,
+                partitions=len(md.partitions),
+                internal=bool(md.is_internal) or is_internal_name(md.name),
+            )
+        )
+    return filter_topics(found, include, exclude, include_internal)

@@ -1,0 +1,61 @@
+"""The cluster rollup document: the fleet's answer in one page.
+
+Per-topic documents stay the solo ``--json`` schema (one builder,
+``report.build_json_doc`` — /report.json?topic= can never drift from a
+solo scan's output).  This module builds the document ABOVE them: the
+cluster totals, the top-N topics by records/bytes/lag, and the per-topic
+status/verdict rows — what ``--fleet --json`` prints, what ``--stats``
+tabulates (report.render_fleet_status), and what the bare ``/report.json``
+endpoint serves while a fleet runs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+#: Rows in each "top topics by X" list — a rollup is a summary, the full
+#: per-topic detail lives one ``?topic=`` away.
+TOP_N = 5
+
+
+def _top(statuses: "Dict[str, object]", key: str) -> "List[dict]":
+    ranked = sorted(
+        ((t, getattr(s, key)) for t, s in statuses.items()),
+        key=lambda kv: (-kv[1], kv[0]),
+    )
+    return [
+        {"topic": t, key: v} for t, v in ranked[:TOP_N] if v > 0
+    ]
+
+
+def build_fleet_rollup(
+    statuses: "Dict[str, object]",
+    discovered: int,
+    duration_secs: int,
+) -> dict:
+    """``statuses`` maps topic -> fleet.service.TopicStatus."""
+    counts: "Dict[str, int]" = {}
+    for s in statuses.values():
+        counts[s.status] = counts.get(s.status, 0) + 1
+    return {
+        "fleet": {
+            "topics_discovered": discovered,
+            "topics": len(statuses),
+            "status_counts": dict(sorted(counts.items())),
+            "totals": {
+                "records": sum(s.records for s in statuses.values()),
+                "bytes": sum(s.bytes for s in statuses.values()),
+                "lag": sum(s.lag for s in statuses.values()),
+                "passes": sum(s.passes for s in statuses.values()),
+            },
+            "top_topics": {
+                "by_records": _top(statuses, "records"),
+                "by_bytes": _top(statuses, "bytes"),
+                "by_lag": _top(statuses, "lag"),
+            },
+            "statuses": {
+                t: statuses[t].as_dict() for t in sorted(statuses)
+            },
+        },
+        "duration_secs": duration_secs,
+    }

@@ -1,0 +1,275 @@
+"""The fleet admission scheduler: N topic scans, one budget (DESIGN.md §20).
+
+A fleet scan multiplies the per-topic pipeline across the cluster, but
+the host resources it multiplies over — ingest worker threads and
+superbatch dispatch depth — are global.  This module owns the admission
+algebra that shares them:
+
+- **Admission**: a topic with work (watermark lag, or its initial
+  catch-up) asks for a grant; the scheduler admits up to
+  ``max_concurrent`` topics at once, each holding at least one ingest
+  worker and one dispatch-depth token, and defers the rest until budget
+  returns.  Wave *planning* for batch fleets reuses the greedy-LPT rule
+  from ``parallel/ingest.shard_partitions(weights=)`` — topics descend by
+  weight onto the least-loaded wave, so one giant topic does not serialize
+  the whole cluster behind it — and worker *splitting* within an admitted
+  set reuses ``allocate_row_workers`` (every admitted topic gets >= 1
+  worker, the remainder goes where partitions-per-worker is worst).
+- **Rebalance** (between follow polls): the scan doctor's per-topic
+  verdicts (obs/doctor.diagnose_scan) drive budget moves — a
+  *dispatch-bound* scan's workers are stalled on the device, so it sheds
+  one to the pool; an *ingest-bound* scan is starved on fetch→decode, so
+  it takes a worker from the pool and sheds dispatch share it cannot use.
+  Grants change only between passes (a pass runs with the workers it was
+  granted), so rebalancing never perturbs in-flight fold order.
+
+Invariants (property-tested in tests/test_fleet.py): at every point in
+any admit/release/rebalance sequence, the sum of granted workers never
+exceeds the worker budget, the sum of granted dispatch tokens never
+exceeds the dispatch budget, every active grant keeps >= 1 of each, and
+a topic's workers never exceed its partition count (a worker beyond it
+would own an empty partition group).
+
+Every admission decision books exactly one ``kta_fleet_admissions_total``
+reason (tools/lint.sh rule 10) — the admission trace is reconstructible
+from the counter alone.  The scheduler itself is pure bookkeeping: it
+never touches sources, backends, or the drive loop (also rule 10), which
+is what keeps it unit-testable without a broker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+
+
+@dataclasses.dataclass
+class Grant:
+    """One admitted topic's slice of the global budgets."""
+
+    workers: int
+    dispatch_depth: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TopicSeed:
+    """Admission input for one topic: identity plus the two weights the
+    scheduler balances on (partition count bounds the useful worker
+    grant; lag orders who goes first)."""
+
+    name: str
+    partitions: int
+    #: Records behind the head (watermark lag); batch seeding uses the
+    #: full retained count.  0 = nothing to do.
+    lag: int = 0
+
+    @property
+    def weight(self) -> int:
+        """LPT weight: lag when known, else partition count — a topic we
+        know nothing about yet is assumed proportional to its width."""
+        return self.lag if self.lag > 0 else self.partitions
+
+
+class FleetScheduler:
+    def __init__(
+        self,
+        worker_budget: int,
+        dispatch_budget: int,
+        max_concurrent: int,
+    ):
+        if worker_budget < 1:
+            raise ValueError("fleet worker budget must be >= 1")
+        if dispatch_budget < 1:
+            raise ValueError("fleet dispatch budget must be >= 1")
+        if max_concurrent < 1:
+            raise ValueError("fleet concurrency must be >= 1")
+        self.worker_budget = worker_budget
+        self.dispatch_budget = dispatch_budget
+        self.max_concurrent = max_concurrent
+        #: topic -> live Grant (the budget ledger).
+        self._grants: "Dict[str, Grant]" = {}
+        #: topic -> partition count (the per-topic worker clamp).
+        self._partitions: "Dict[str, int]" = {}
+
+    # -- ledger views ---------------------------------------------------------
+
+    def grants(self) -> "Dict[str, Grant]":
+        return {t: dataclasses.replace(g) for t, g in self._grants.items()}
+
+    def grant_for(self, topic: str) -> "Grant | None":
+        g = self._grants.get(topic)
+        return dataclasses.replace(g) if g is not None else None
+
+    @property
+    def workers_granted(self) -> int:
+        return sum(g.workers for g in self._grants.values())
+
+    @property
+    def dispatch_granted(self) -> int:
+        return sum(g.dispatch_depth for g in self._grants.values())
+
+    @property
+    def active(self) -> int:
+        return len(self._grants)
+
+    # -- wave planning (batch fleets) -----------------------------------------
+
+    def plan_waves(self, seeds: "Sequence[TopicSeed]") -> "List[List[str]]":
+        """Group the topic set into admission waves of at most
+        ``max_concurrent`` topics, balanced by weight via the greedy-LPT
+        grouping rule (parallel/ingest.shard_partitions(weights=) — the
+        same deterministic descend-by-weight-onto-least-loaded placement
+        that shards partitions across ingest workers).  Waves run in
+        index order; within a wave, scans run concurrently."""
+        from kafka_topic_analyzer_tpu.parallel.ingest import shard_partitions
+
+        if not seeds:
+            return []
+        n_waves = max(1, -(-len(seeds) // self.max_concurrent))
+        idx_groups = shard_partitions(
+            list(range(len(seeds))),
+            n_waves,
+            weights={i: s.weight for i, s in enumerate(seeds)},
+        )
+        # LPT balances weight, not cardinality: spill overfull groups'
+        # lightest members into the emptiest groups so no wave exceeds
+        # the concurrency bound (budget would be over-subscribed).
+        groups = [list(g) for g in idx_groups]
+        while True:
+            over = next(
+                (g for g in groups if len(g) > self.max_concurrent), None
+            )
+            if over is None:
+                break
+            under = min(groups, key=len)
+            if len(under) >= self.max_concurrent:
+                groups.append([])
+                under = groups[-1]
+            lightest = min(over, key=lambda i: (seeds[i].weight, i))
+            over.remove(lightest)
+            under.append(lightest)
+        return [
+            [seeds[i].name for i in sorted(g)] for g in groups if g
+        ]
+
+    # -- admission ------------------------------------------------------------
+
+    def admit(
+        self,
+        ready: "Sequence[TopicSeed]",
+        reason: str = "admitted-poll",
+    ) -> "Dict[str, Grant]":
+        """Grant budget to as many of ``ready`` as fit (heaviest first).
+
+        Already-admitted topics are left untouched (their grants persist
+        across polls until ``release``).  Newly admitted topics split the
+        UNGRANTED worker budget via ``allocate_row_workers`` (>= 1 each,
+        clamped at partition count) and the ungranted dispatch budget
+        evenly (>= 1 each).  Topics that fit no budget slot are deferred
+        — booked, not forgotten: the next poll re-offers them.  Returns
+        the grants for exactly the topics admitted THIS call."""
+        new = [
+            s for s in sorted(ready, key=lambda s: (-s.weight, s.name))
+            if s.name not in self._grants
+        ]
+        admitted: "Dict[str, Grant]" = {}
+        if not new:
+            return admitted
+        free_slots = self.max_concurrent - self.active
+        free_workers = self.worker_budget - self.workers_granted
+        free_dispatch = self.dispatch_budget - self.dispatch_granted
+        n = min(len(new), free_slots, free_workers, free_dispatch)
+        if n > 0:
+            from kafka_topic_analyzer_tpu.parallel.ingest import (
+                allocate_row_workers,
+            )
+
+            take = new[:n]
+            split = allocate_row_workers(
+                free_workers,
+                {i: max(1, s.partitions) for i, s in enumerate(take)},
+            )
+            depth_each = max(1, free_dispatch // n)
+            spent_dispatch = 0
+            for i, s in enumerate(take):
+                depth = min(depth_each, free_dispatch - spent_dispatch - (n - i - 1))
+                depth = max(1, depth)
+                spent_dispatch += depth
+                g = Grant(workers=max(1, split.get(i, 1)), dispatch_depth=depth)
+                self._grants[s.name] = g
+                self._partitions[s.name] = max(1, s.partitions)
+                admitted[s.name] = dataclasses.replace(g)
+                obs_metrics.FLEET_ADMISSIONS.labels(reason=reason).inc()
+        for s in new[n:]:
+            obs_metrics.FLEET_ADMISSIONS.labels(reason="deferred-budget").inc()
+        obs_metrics.FLEET_TOPICS_ACTIVE.set(self.active)
+        return admitted
+
+    def skip_idle(self, count: int) -> None:
+        """Book topics that polled at the head with nothing to do — an
+        admission DECISION (the answer was "no work"), so it is traced
+        like every other one."""
+        for _ in range(max(0, int(count))):
+            obs_metrics.FLEET_ADMISSIONS.labels(reason="skipped-empty").inc()
+
+    def release(self, topic: str) -> None:
+        """Return a finished (or caught-up, or failed) topic's budget."""
+        if self._grants.pop(topic, None) is not None:
+            obs_metrics.FLEET_ADMISSIONS.labels(reason="released").inc()
+        obs_metrics.FLEET_TOPICS_ACTIVE.set(self.active)
+
+    # -- the rebalance rule (between polls) -----------------------------------
+
+    def rebalance(self, verdicts: "Dict[str, str]") -> int:
+        """Move budget between live grants on doctor verdicts; returns the
+        number of moves applied (booked on kta_fleet_rebalances_total).
+
+        The rule (DESIGN.md §20): dispatch-bound scans shed one worker
+        each into the pool (their workers are stalled on the device
+        anyway) and keep their dispatch share; ingest-bound scans shed
+        dispatch share down to 1 (their device is idle) and then draw
+        workers from the pool — heaviest-clamped-first, one at a time,
+        until the pool is dry or every ingest-bound scan is at its
+        partition clamp.  Balanced/no-signal scans hold still.  All
+        invariants (budget sums, >= 1 floors, partition clamps) are
+        preserved by construction."""
+        moves = 0
+        # Shed: dispatch-bound workers → pool; ingest-bound dispatch → pool.
+        for t in sorted(verdicts):
+            g = self._grants.get(t)
+            if g is None:
+                continue
+            v = verdicts[t]
+            if v == "dispatch-bound" and g.workers > 1:
+                g.workers -= 1
+                moves += 1
+            elif v == "ingest-bound" and g.dispatch_depth > 1:
+                g.dispatch_depth = 1
+                moves += 1
+        # Draw: pool workers → ingest-bound scans, most-starved first
+        # (fewest workers per partition), clamped at partition count.
+        pool = self.worker_budget - self.workers_granted
+        starved = [
+            t for t in sorted(verdicts)
+            if verdicts[t] == "ingest-bound" and t in self._grants
+        ]
+        while pool > 0 and starved:
+            best = None
+            for t in starved:
+                g = self._grants[t]
+                clamp = self._partitions.get(t, g.workers)
+                if g.workers >= clamp:
+                    continue
+                ratio = clamp / g.workers
+                if best is None or ratio > best[0]:
+                    best = (ratio, t)
+            if best is None:
+                break
+            self._grants[best[1]].workers += 1
+            pool -= 1
+            moves += 1
+        if moves:
+            obs_metrics.FLEET_REBALANCES.inc(moves)
+        return moves

@@ -518,6 +518,10 @@ class TopicMetadata:
     error: int
     name: str
     partitions: List[PartitionMetadata]
+    #: Broker-flagged internal topic (``__consumer_offsets`` and friends;
+    #: Metadata v1+).  Fleet discovery (fleet/discovery.py) excludes these
+    #: by default — auditing the cluster means the *user's* topics.
+    is_internal: int = 0
 
 
 @dataclasses.dataclass
@@ -543,7 +547,7 @@ def encode_metadata_response(resp: MetadataResponse, version: int = 1) -> bytes:
             w.i16(t.error).compact_string(t.name)
             if version >= 10:
                 w.raw(_NULL_UUID)  # topic_id
-            w.i8(0)  # is_internal
+            w.i8(t.is_internal)
             w.compact_array_len(len(t.partitions))
             for p in t.partitions:
                 w.i16(p.error).i32(p.partition).i32(p.leader)
@@ -568,7 +572,7 @@ def encode_metadata_response(resp: MetadataResponse, version: int = 1) -> bytes:
     w.i32(resp.controller_id)
     w.i32(len(resp.topics))
     for t in resp.topics:
-        w.i16(t.error).string(t.name).i8(0)  # is_internal
+        w.i16(t.error).string(t.name).i8(t.is_internal)
         w.i32(len(t.partitions))
         for p in t.partitions:
             w.i16(p.error).i32(p.partition).i32(p.leader)
@@ -598,7 +602,7 @@ def decode_metadata_response(r: ByteReader, version: int = 1) -> MetadataRespons
             name = r.compact_string() or ""
             if version >= 10:
                 r._take(16)  # topic_id
-            r.i8()  # is_internal
+            internal = r.i8()
             parts = []
             for _ in range(r.compact_array_len()):
                 perr = r.i16()
@@ -615,7 +619,7 @@ def decode_metadata_response(r: ByteReader, version: int = 1) -> MetadataRespons
                 parts.append(PartitionMetadata(perr, pid, leader))
             r.i32()  # topic_authorized_operations
             r.skip_tags()
-            topics.append(TopicMetadata(err, name, parts))
+            topics.append(TopicMetadata(err, name, parts, is_internal=internal))
         if 8 <= version <= 10:
             r.i32()  # cluster_authorized_operations
         r.skip_tags()
@@ -636,7 +640,7 @@ def decode_metadata_response(r: ByteReader, version: int = 1) -> MetadataRespons
     for _ in range(r.i32()):
         err = r.i16()
         name = r.string() or ""
-        r.i8()  # is_internal
+        internal = r.i8()
         parts = []
         for _ in range(r.i32()):
             perr = r.i16()
@@ -650,7 +654,7 @@ def decode_metadata_response(r: ByteReader, version: int = 1) -> MetadataRespons
                 for _ in range(r.i32()):
                     r.i32()  # offline_replicas
             parts.append(PartitionMetadata(perr, pid, leader))
-        topics.append(TopicMetadata(err, name, parts))
+        topics.append(TopicMetadata(err, name, parts, is_internal=internal))
     return MetadataResponse(brokers, controller, topics)
 
 

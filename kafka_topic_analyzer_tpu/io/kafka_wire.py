@@ -363,6 +363,90 @@ def parse_bootstrap(bootstrap_servers: str) -> List[Tuple[str, int]]:
     return out
 
 
+def discover_cluster_topics(
+    bootstrap_servers: str,
+    timeout_s: float = 10.0,
+    retries: int = 3,
+) -> "List[kc.TopicMetadata]":
+    """All-topics Metadata request: every topic the cluster knows, with
+    partition topology and the broker's ``is_internal`` flag — the fleet
+    discovery path (fleet/discovery.py).
+
+    Same Metadata v5–v12 negotiation `KafkaWireSource` runs for its one
+    topic (preferred-first candidates against the broker's advertised
+    ApiVersions range, with the KIP-511 v3→v0 handshake downgrade), but
+    with a *null* topic array, which Kafka defines as "all topics".  One
+    bootstrap round trip answers "what would a fleet scan cover" without
+    a single per-topic handshake — the response's partition lists seed the
+    admission scheduler's weights directly.
+
+    Stateless and connection-per-call: discovery happens once per fleet
+    startup (and on re-discovery polls), so caching connections here would
+    only complicate the per-topic sources that follow.  Topics whose
+    metadata carries an error are returned as-is (callers decide; fleet
+    discovery skips them with a log line).  Raises `KafkaProtocolError`
+    when no bootstrap server answers within ``retries`` attempts.
+    """
+    candidates = (12, 5, 1)  # mirror KafkaWireSource._CANDIDATES[METADATA]
+    servers = parse_bootstrap(bootstrap_servers)
+    if not servers:
+        raise kc.KafkaProtocolError("no bootstrap servers given")
+    last_error: "BaseException | None" = None
+    for attempt in range(retries):
+        host, port = servers[attempt % len(servers)]
+        conn = None
+        try:
+            conn = BrokerConnection(host, port, timeout_s=timeout_s)
+            # KIP-511 downgrade dance (see KafkaWireSource._version): offer
+            # flexible v3 first; an UNSUPPORTED_VERSION v0-format answer
+            # retries at v0; a broker with no ApiVersions at all gets the
+            # legacy default (the last candidate).
+            ranges: "Dict[int, tuple[int, int]]" = {}
+            for av in (3, 0):
+                try:
+                    r = conn.request(
+                        kc.API_VERSIONS, av,
+                        kc.encode_api_versions_request(av),
+                    )
+                    ranges = kc.decode_api_versions_response(r, av)
+                    break
+                except kc.UnsupportedVersionError:
+                    if av == 0:
+                        ranges = {}
+                    continue
+                except kc.KafkaProtocolError:
+                    ranges = {}
+                    break
+            v = candidates[-1]
+            if ranges and kc.API_METADATA in ranges:
+                lo, hi = ranges[kc.API_METADATA]
+                v = next((c for c in candidates if lo <= c <= hi), None)
+                if v is None:
+                    raise kc.KafkaProtocolError(
+                        f"broker supports Metadata versions [{lo}, {hi}] "
+                        f"but this client implements {sorted(candidates)}"
+                    )
+            r = conn.request(
+                kc.API_METADATA, v, kc.encode_metadata_request(None, v)
+            )
+            md = kc.decode_metadata_response(r, v)
+            obs_metrics.FLEET_TOPICS_DISCOVERED.inc(len(md.topics))
+            return md.topics
+        except (OSError, kc.KafkaProtocolError) as e:
+            last_error = e
+            log.warning(
+                "all-topics metadata from %s:%d failed (%s); retrying",
+                host, port, e,
+            )
+        finally:
+            if conn is not None:
+                conn.close()
+    raise kc.KafkaProtocolError(
+        f"cluster topic discovery failed after {retries} attempts: "
+        f"{last_error}"
+    )
+
+
 class _TransportFailure:
     """Phase-1 fetch result when a leader's transport died mid-round: the
     serial phase books the failure against the leader's partitions instead

@@ -47,22 +47,35 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/report.json":
-            # Follow-mode point-in-time report (serve/state.py).  The
+            # Follow/fleet point-in-time report (serve/state.py).  The
             # handler only ever reads the latest PRE-SERIALIZED document
             # through the designated snapshot accessor — it must never
             # call into the drive loop or take fold-state locks, so a
             # slow scrape cannot stall ingest (tools/lint.sh rule 9).
+            # ``?topic=<name>`` selects a fleet topic's document; without
+            # it, the main slot (single-topic report, or the fleet's
+            # cluster rollup) is served.
+            from urllib.parse import parse_qs
+
             from kafka_topic_analyzer_tpu.serve import state as _serve_state
 
             svc = _serve_state.active()
             if svc is None:
                 self.send_error(
-                    404, "no follow service (run with --follow)"
+                    404, "no follow/fleet service (run with --follow/--fleet)"
                 )
                 return
-            body = svc.report_bytes()
+            topic = (parse_qs(query).get("topic") or [None])[0]
+            body = svc.report_bytes(topic)
+            if body is None and topic is not None:
+                self.send_error(
+                    404,
+                    f"no report for topic {topic!r} (unknown topic, or "
+                    "its first fleet pass has not finished)",
+                )
+                return
             if body is None:
                 self.send_error(
                     503, "report not yet assembled (first pass running)"

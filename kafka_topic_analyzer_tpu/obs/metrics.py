@@ -309,6 +309,44 @@ REPORT_SNAPSHOTS = _REG.counter(
     "Point-in-time report documents published for /report.json (one per "
     "follow poll boundary; the HTTP handler only ever reads the latest)")
 
+# -- fleet mode (fleet/discovery.py + fleet/scheduler.py + fleet/service.py) --
+
+FLEET_TOPICS_DISCOVERED = _REG.counter(
+    "kta_fleet_topics_discovered_total",
+    "Topics returned by all-topics cluster metadata requests (every "
+    "discovery pass counts the full listing, pre-filter — re-discovery "
+    "polls make this grow by roughly the cluster's topic count per poll)")
+FLEET_ADMISSIONS = _REG.counter(
+    "kta_fleet_admissions_total",
+    "Admission decisions the fleet scheduler took, by reason: "
+    "admitted-seed (initial greedy-LPT wave placement), admitted-poll "
+    "(a lagging topic granted a pass), deferred-budget (ready but the "
+    "concurrency/worker budget was spent), skipped-empty (no lag), "
+    "released (scan finished, budget returned) — every decision books "
+    "exactly one reason, so the admission trace is reconstructible from "
+    "the counter alone (tools/lint.sh rule 10)",
+    labelnames=("reason",))
+FLEET_TOPICS_ACTIVE = _REG.gauge(
+    "kta_fleet_topics_active",
+    "Per-topic scans currently admitted and holding budget in this "
+    "process's fleet service",
+    # One fleet service per process; a multi-process fleet would run
+    # disjoint topic sets, so the cluster-wide figure is the sum.
+    merge="sum")
+FLEET_TOPIC_LAG = _REG.gauge(
+    "kta_fleet_topic_lag_records",
+    "Records between a fleet topic's cursor and its latest polled end "
+    "watermarks (the per-topic twin of kta_follow_lag_records; admission "
+    "weight input)",
+    labelnames=("topic",),
+    # Topics are disjoint across fleet processes: fleet-wide lag sums.
+    merge="sum")
+FLEET_REBALANCES = _REG.counter(
+    "kta_fleet_rebalances_total",
+    "Budget rebalances the fleet scheduler applied between polls "
+    "(doctor-verdict driven: ingest-bound scans shed dispatch share and "
+    "gain workers freed from dispatch-bound scans)")
+
 # -- flight recorder (obs/flight.py) ------------------------------------------
 
 FLIGHT_SAMPLES = _REG.counter(

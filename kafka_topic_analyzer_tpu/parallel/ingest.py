@@ -98,7 +98,12 @@ def shard_partitions(
 
     Empty groups are dropped (callers clamp ``workers`` to the partition
     count first, but a caller that does not must still get only live
-    workers)."""
+    workers).
+
+    The fleet scheduler reuses this exact rule one level up
+    (fleet/scheduler.py::plan_waves): topics descend by lag/partition
+    weight onto the least-loaded admission wave — the grouping algebra is
+    the same whether the items are partitions or whole topics."""
     if workers < 1:
         raise ValueError("workers must be >= 1")
     if weights:
@@ -128,7 +133,9 @@ def allocate_row_workers(
     worker at a time to the row with the most partitions per worker (ties
     by row index), clamped at the row's partition count — a worker beyond
     it would own an empty group.  Pure function of the inputs, so every
-    controller (and every rerun) allocates identically."""
+    controller (and every rerun) allocates identically.  (The fleet
+    scheduler reuses this rule to split the global worker budget across
+    an admitted wave of topic scans — fleet/scheduler.py.)"""
     if budget < 1:
         raise ValueError("worker budget must be >= 1")
     alloc = {r: (1 if n > 0 else 0) for r, n in row_counts.items()}

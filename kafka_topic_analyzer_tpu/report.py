@@ -436,14 +436,17 @@ def build_json_doc(
     diagnosis=None,
     follow: "Optional[dict]" = None,
     windows: "Optional[dict]" = None,
+    fleet: "Optional[dict]" = None,
 ) -> dict:
     """The machine-readable report document — ONE builder for every
     surface that emits it: the CLI's ``--json`` stdout, the follow
-    service's poll-boundary publishes, and therefore the ``/report.json``
-    endpoint (serve/state.py), which by construction can never drift from
-    the CLI schema.  ``result`` is an `engine.ScanResult`; ``diagnosis``
-    the scan doctor's verdict (obs/doctor.diagnose_scan); ``follow`` and
-    ``windows`` the service-layer blocks (absent for batch scans)."""
+    service's poll-boundary publishes, the fleet service's per-topic
+    publishes, and therefore the ``/report.json`` endpoint
+    (serve/state.py) with and without ``?topic=``, which by construction
+    can never drift from the CLI schema.  ``result`` is an
+    `engine.ScanResult`; ``diagnosis`` the scan doctor's verdict
+    (obs/doctor.diagnose_scan); ``follow``/``windows``/``fleet`` the
+    service-layer blocks (absent for batch scans)."""
     doc = result.metrics.to_dict(result.start_offsets, result.end_offsets)
     doc["topic"] = topic
     doc["duration_secs"] = result.duration_secs
@@ -459,8 +462,65 @@ def build_json_doc(
         doc["follow"] = follow
     if windows is not None:
         doc["windows"] = windows
+    if fleet is not None:
+        doc["fleet"] = fleet
     attach_issue_blocks(doc, result)
     return doc
+
+
+def render_fleet_status(rollup: dict) -> str:
+    """The fleet status table + totals block from a rollup document
+    (fleet/report.build_fleet_rollup) — what ``--fleet`` prints after the
+    per-topic reports and what ``--stats`` sends to stderr.  One renderer
+    over the same document /report.json serves, so the table an operator
+    reads and the JSON a dashboard reads cannot disagree."""
+    fleet = rollup.get("fleet") or {}
+    statuses: Dict[str, dict] = fleet.get("statuses") or {}
+    eq = "=" * 120
+    lines: List[str] = [eq]
+    totals = fleet.get("totals") or {}
+    lines.append(
+        f"FLEET: {fleet.get('topics', 0)} topic(s) "
+        f"(of {fleet.get('topics_discovered', 0)} discovered) — "
+        f"{totals.get('records', 0)} records, "
+        f"{totals.get('bytes', 0)} bytes, "
+        f"lag {totals.get('lag', 0)}, "
+        f"{totals.get('passes', 0)} pass(es)"
+    )
+    rows: List[List[str]] = [
+        ["Topic", "Status", "P", "Records", "Bytes", "Lag", "W", "Passes",
+         "Verdict"],
+    ]
+    for t in sorted(statuses):
+        s = statuses[t]
+        rows.append([
+            t,
+            s.get("status", "?"),
+            f"{s.get('partitions', 0)}",
+            f"{s.get('records', 0)}",
+            f"{s.get('bytes', 0)}",
+            f"{s.get('lag', 0)}",
+            f"{s.get('workers', 0)}",
+            f"{s.get('passes', 0)}",
+            s.get("verdict", "") or "-",
+        ])
+    body = "\n".join(lines) + "\n" + render_table(rows)
+    issues = [
+        (t, statuses[t].get("error"))
+        for t in sorted(statuses)
+        if statuses[t].get("status") == "failed"
+    ]
+    if issues:
+        bang = "!" * 120
+        body += bang + "\n"
+        body += (
+            f"WARNING: {len(issues)} topic(s) FAILED — their rows above "
+            "are partial; every other topic's results are unaffected\n"
+        )
+        for t, err in issues:
+            body += f"  topic {t}: {err}\n"
+        body += bang + "\n"
+    return body + eq + "\n"
 
 
 def render_extremes_table(metrics: TopicMetrics) -> str:

@@ -141,7 +141,6 @@ class FollowService:
         self.state = serve_state.ServiceState()
         self._stop = threading.Event()
         self._stop_reason: "Optional[str]" = None
-        self._signals_seen = 0
         # Idle pacing: poll_interval floor, exponential backoff to the
         # ceiling over consecutive empty polls (io/retry.Backoff — the
         # delay schedule only; idle waits are not transport retries, so
@@ -179,35 +178,13 @@ class FollowService:
     def install_signal_handlers(self):
         """SIGINT/SIGTERM → graceful stop at the next boundary; a SECOND
         SIGINT restores the default handler so an operator can still
-        hard-interrupt a pass (the engine's failure path then flushes the
-        tail and writes the failure snapshot).  Returns a restore
-        callable; both install and restore are no-ops off the main thread
-        (``signal.signal`` raises ValueError there)."""
-        import signal as _signal
+        hard-interrupt a pass.  Shared wiring with the fleet service
+        (serve/signals.py); returns a restore callable."""
+        from kafka_topic_analyzer_tpu.serve.signals import (
+            install_stop_handlers,
+        )
 
-        prev = {}
-
-        def handler(signum, frame):
-            self._signals_seen += 1
-            name = _signal.Signals(signum).name
-            self.request_stop(name)
-            if signum == _signal.SIGINT and self._signals_seen >= 2:
-                _signal.signal(_signal.SIGINT, _signal.default_int_handler)
-
-        for sig in (_signal.SIGINT, _signal.SIGTERM):
-            try:
-                prev[sig] = _signal.signal(sig, handler)
-            except ValueError:  # not the main thread
-                pass
-
-        def restore() -> None:
-            for sig, old in prev.items():
-                try:
-                    _signal.signal(sig, old)
-                except ValueError:
-                    pass
-
-        return restore
+        return install_stop_handlers(self.request_stop)
 
     # -- the loop -------------------------------------------------------------
 

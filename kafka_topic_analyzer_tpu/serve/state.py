@@ -41,30 +41,54 @@ class ServiceState:
         self._doc: "Optional[dict]" = None
         self._bytes: "Optional[bytes]" = None
         self._published_at: "Optional[float]" = None
+        #: Fleet mode: topic -> (doc, bytes) per-topic documents, published
+        #: by the fleet service after each topic's pass and served at
+        #: ``/report.json?topic=<name>``.  The main document slot above is
+        #: then the cluster ROLLUP.  Same locking discipline: per-topic
+        #: publishes swap one dict entry; reads are one lookup.
+        self._topic_docs: "dict[str, tuple[dict, bytes]]" = {}
 
-    def publish(self, doc: dict) -> None:
+    def publish(self, doc: dict, topic: "Optional[str]" = None) -> None:
         """Swap in a new point-in-time report document (drive-loop side).
         The document is stamped (``report_ts``) and serialized here, then
-        installed under the lock in one assignment."""
+        installed under the lock in one assignment.  With ``topic`` set,
+        the document lands in that topic's fleet slot instead of the main
+        (single-topic report / fleet rollup) slot."""
         doc = dict(doc)
         doc["report_ts"] = round(self._clock(), 3)
         body = json.dumps(doc).encode()
         with self._lock:
-            self._doc = doc
-            self._bytes = body
-            self._published_at = doc["report_ts"]
+            if topic is not None:
+                self._topic_docs[topic] = (doc, body)
+            else:
+                self._doc = doc
+                self._bytes = body
+                self._published_at = doc["report_ts"]
         obs_metrics.REPORT_SNAPSHOTS.inc()
 
-    def report_bytes(self) -> "Optional[bytes]":
+    def report_bytes(self, topic: "Optional[str]" = None) -> "Optional[bytes]":
         """The latest serialized report (HTTP-handler side), or None
-        before the first publish.  One lock acquire, one reference read."""
+        before the first publish.  One lock acquire, one reference read.
+        With ``topic`` set: that topic's latest fleet document (None for
+        an unknown/not-yet-published topic)."""
         with self._lock:
+            if topic is not None:
+                entry = self._topic_docs.get(topic)
+                return entry[1] if entry is not None else None
             return self._bytes
 
-    def snapshot(self) -> "Optional[dict]":
+    def snapshot(self, topic: "Optional[str]" = None) -> "Optional[dict]":
         """The latest report document (test/introspection side)."""
         with self._lock:
+            if topic is not None:
+                entry = self._topic_docs.get(topic)
+                return entry[0] if entry is not None else None
             return self._doc
+
+    def topics(self) -> "list[str]":
+        """Topic names with a published fleet document (sorted)."""
+        with self._lock:
+            return sorted(self._topic_docs)
 
     @property
     def published_at(self) -> "Optional[float]":

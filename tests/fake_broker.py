@@ -212,6 +212,8 @@ class FakeBroker:
         response_delay=None,
         faults: "Optional[FaultInjector]" = None,
         corruption: "Optional[CorruptionInjector]" = None,
+        extra_topics: "Optional[Dict[str, Dict[int, List[Record]]]]" = None,
+        internal_topics: "Optional[Dict[str, Dict[int, List[Record]]]]" = None,
     ):
         #: Transport-fault plan (connection drops/refusals, stalls,
         #: transient fetch errors); None = behave.  Mutable attribute, so
@@ -276,71 +278,37 @@ class FakeBroker:
         #: Pretend to be an ancient broker with no ApiVersions support.
         self.no_api_versions = no_api_versions
         self.topic = topic
-        self.records = {
-            p: sorted(rs, key=lambda r: r[0]) for p, rs in partition_records.items()
-        }
+        #: topic name -> per-topic log store ({"records", "start_offsets",
+        #: "end_offsets", "chunks", "chunk_last"}).  The broker serves
+        #: every topic here: Metadata(all-topics) lists them (internal
+        #: flags included), ListOffsets/Fetch route by the request's topic
+        #: name.  The corruption/control/coverage injectors stay keyed on
+        #: the DEFAULT topic's partitions (the single-topic seam every
+        #: existing test drives); extra topics serve clean v2 frames.
+        self._stores: "Dict[str, dict]" = {}
+        #: Topic names flagged is_internal in metadata (plus anything
+        #: passed via ``internal_topics``) — the __consumer_offsets shape
+        #: fleet discovery must exclude by default.
+        self.internal_names: "set[str]" = set()
         self.compression = compression
         self.max_records_per_fetch = max_records_per_fetch
-        self.start_offsets = start_offsets or {
-            p: (rs[0][0] if rs else 0) for p, rs in self.records.items()
-        }
-        # High watermark: one past the last retained offset (overridable to
-        # simulate a watermark snapshot older than the retained log).
-        self.end_offsets = end_offsets or {
-            p: (rs[-1][0] + 1 if rs else self.start_offsets[p])
-            for p, rs in self.records.items()
-        }
-        # Pre-encode each partition's records into fetch-sized record sets at
-        # startup: encoding per fetch in pure Python made the broker ~100x
-        # slower than the client it exists to test.
-        self._chunks: Dict[int, "list[tuple[int, int, bytes]]"] = {}
-        self._chunk_last_offsets: Dict[int, "list[int]"] = {}
-        control = self.control_offsets
-        for p, rs in self.records.items():
-            chunks = []
-            for ci, lo in enumerate(range(0, len(rs), max_records_per_fetch)):
-                part = rs[lo : lo + max_records_per_fetch]
-                last = self.coverage_overrides.get(p, {}).get(ci, part[-1][0])
-                ctrl = control.get(p, set())
-                if message_magic == 2 and any(r[0] in ctrl for r in part):
-                    assert ci not in self.coverage_overrides.get(p, {}), (
-                        "control_offsets and coverage_overrides cannot "
-                        "target the same chunk (coverage would be dropped)"
-                    )
-                    # Transactional log shape: marker offsets become
-                    # single-record control batches between data batches.
-                    pieces, run = [], []
-
-                    def flush_run():
-                        if run:
-                            pieces.append(
-                                kc.encode_record_batch(list(run), compression)
-                            )
-                            run.clear()
-
-                    for rec in part:
-                        if rec[0] in ctrl:
-                            flush_run()
-                            pieces.append(
-                                kc.encode_control_batch(rec[0], rec[1])
-                            )
-                        else:
-                            run.append(rec)
-                    flush_run()
-                    encoded = b"".join(pieces)
-                elif message_magic == 2:
-                    encoded = kc.encode_record_batch(
-                        part, compression, last_offset=last
-                    )
-                else:
-                    encoded = kc.encode_message_set(
-                        part, magic=message_magic, compression=compression
-                    )
-                if self.corruption is not None:
-                    encoded = self.corruption.apply(p, ci, encoded)
-                chunks.append((part[0][0], last, encoded))
-            self._chunks[p] = chunks
-            self._chunk_last_offsets[p] = [c[1] for c in chunks]
+        self._stores[topic] = self._build_store(
+            topic, partition_records,
+            start_offsets=start_offsets, end_offsets=end_offsets,
+        )
+        for name, recs in (extra_topics or {}).items():
+            self._stores[name] = self._build_store(name, recs)
+        for name, recs in (internal_topics or {}).items():
+            self._stores[name] = self._build_store(name, recs)
+            self.internal_names.add(name)
+        # Single-topic attribute surface (aliases of the default topic's
+        # store) — the seam every pre-fleet test drives.
+        store = self._stores[topic]
+        self.records = store["records"]
+        self.start_offsets = store["start_offsets"]
+        self.end_offsets = store["end_offsets"]
+        self._chunks = store["chunks"]
+        self._chunk_last_offsets = store["chunk_last"]
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind(("127.0.0.1", 0))
@@ -355,6 +323,115 @@ class FakeBroker:
         self._conn_lock = threading.Lock()
         self._open_conns: "set[socket.socket]" = set()
 
+    # -- per-topic log stores --------------------------------------------------
+
+    def _build_store(
+        self,
+        name: str,
+        partition_records: Dict[int, List[Record]],
+        start_offsets: Optional[Dict[int, int]] = None,
+        end_offsets: Optional[Dict[int, int]] = None,
+    ) -> dict:
+        """Pre-encode one topic's records into fetch-sized record sets at
+        startup: encoding per fetch in pure Python made the broker ~100x
+        slower than the client it exists to test."""
+        records = {
+            p: sorted(rs, key=lambda r: r[0])
+            for p, rs in partition_records.items()
+        }
+        start_offsets = start_offsets or {
+            p: (rs[0][0] if rs else 0) for p, rs in records.items()
+        }
+        # High watermark: one past the last retained offset (overridable to
+        # simulate a watermark snapshot older than the retained log).
+        end_offsets = end_offsets or {
+            p: (rs[-1][0] + 1 if rs else start_offsets[p])
+            for p, rs in records.items()
+        }
+        # Injectors (corruption/control/coverage) target the default topic
+        # only — they are keyed by bare partition, a pre-fleet contract.
+        is_default = name == self.topic
+        chunks_by_p: Dict[int, "list[tuple[int, int, bytes]]"] = {}
+        chunk_last: Dict[int, "list[int]"] = {}
+        control = self.control_offsets if is_default else {}
+        coverage = self.coverage_overrides if is_default else {}
+        for p, rs in records.items():
+            chunks = []
+            for ci, lo in enumerate(range(0, len(rs), self.max_records_per_fetch)):
+                part = rs[lo : lo + self.max_records_per_fetch]
+                last = coverage.get(p, {}).get(ci, part[-1][0])
+                ctrl = control.get(p, set())
+                if self.message_magic == 2 and any(r[0] in ctrl for r in part):
+                    assert ci not in coverage.get(p, {}), (
+                        "control_offsets and coverage_overrides cannot "
+                        "target the same chunk (coverage would be dropped)"
+                    )
+                    # Transactional log shape: marker offsets become
+                    # single-record control batches between data batches.
+                    pieces, run = [], []
+
+                    def flush_run():
+                        if run:
+                            pieces.append(
+                                kc.encode_record_batch(
+                                    list(run), self.compression
+                                )
+                            )
+                            run.clear()
+
+                    for rec in part:
+                        if rec[0] in ctrl:
+                            flush_run()
+                            pieces.append(
+                                kc.encode_control_batch(rec[0], rec[1])
+                            )
+                        else:
+                            run.append(rec)
+                    flush_run()
+                    encoded = b"".join(pieces)
+                elif self.message_magic == 2:
+                    encoded = kc.encode_record_batch(
+                        part, self.compression, last_offset=last
+                    )
+                else:
+                    encoded = kc.encode_message_set(
+                        part, magic=self.message_magic,
+                        compression=self.compression,
+                    )
+                if self.corruption is not None and is_default:
+                    encoded = self.corruption.apply(p, ci, encoded)
+                chunks.append((part[0][0], last, encoded))
+            chunks_by_p[p] = chunks
+            chunk_last[p] = [c[1] for c in chunks]
+        return {
+            "records": records,
+            "start_offsets": start_offsets,
+            "end_offsets": end_offsets,
+            "chunks": chunks_by_p,
+            "chunk_last": chunk_last,
+        }
+
+    def create_topic(
+        self,
+        name: str,
+        partition_records: Dict[int, List[Record]],
+        internal: bool = False,
+    ) -> None:
+        """Add a topic WHILE the broker serves — the mid-test creation
+        seam fleet discovery tests drive (a re-discovery poll must see the
+        new topic).  The store is fully built before the dict insert, and
+        the insert is atomic under the GIL, so a concurrent Metadata
+        request sees either no topic or a complete one."""
+        if name in self._stores:
+            raise AssertionError(f"topic {name!r} already exists")
+        store = self._build_store(name, partition_records)
+        self._stores[name] = store
+        if internal:
+            self.internal_names.add(name)
+
+    def topic_names(self) -> "list[str]":
+        return sorted(self._stores)
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "FakeBroker":
@@ -363,23 +440,33 @@ class FakeBroker:
         self._threads.append(t)
         return self
 
-    def produce(self, partition: int, records: "List[Record]") -> None:
+    def produce(
+        self,
+        partition: int,
+        records: "List[Record]",
+        topic: "Optional[str]" = None,
+    ) -> None:
         """Append records to a partition WHILE the broker serves — the
         follow-mode test seam (tests/test_follow.py).  Offsets must
         strictly extend the partition's retained log.  The records are
         pre-encoded into one new fetch chunk, the chunk is made fetchable
         first, and only then is the end watermark advanced (appends are
         atomic under the GIL) — so a client can never observe a watermark
-        it cannot fetch up to."""
+        it cannot fetch up to.  ``topic`` defaults to the broker's default
+        topic; fleet tests pass the name explicitly."""
         if not records:
             return
-        if partition not in self.records:
+        name = topic if topic is not None else self.topic
+        store = self._stores.get(name)
+        if store is None:
+            raise AssertionError(f"produce() targets unknown topic {name!r}")
+        if partition not in store["records"]:
             raise AssertionError(
                 "produce() targets an existing partition (metadata is "
                 "fixed at construction)"
             )
         records = sorted(records, key=lambda r: r[0])
-        rs = self.records[partition]
+        rs = store["records"][partition]
         if rs and records[0][0] <= rs[-1][0]:
             raise AssertionError("produced offsets must extend the log")
         if self.message_magic == 2:
@@ -389,16 +476,16 @@ class FakeBroker:
                 records, magic=self.message_magic,
                 compression=self.compression,
             )
-        if self.corruption is not None:
+        if self.corruption is not None and name == self.topic:
             encoded = self.corruption.apply(
-                partition, len(self._chunks[partition]), encoded
+                partition, len(store["chunks"][partition]), encoded
             )
         rs.extend(records)
-        self._chunks[partition].append(
+        store["chunks"][partition].append(
             (records[0][0], records[-1][0], encoded)
         )
-        self._chunk_last_offsets[partition].append(records[-1][0])
-        self.end_offsets[partition] = records[-1][0] + 1
+        store["chunk_last"][partition].append(records[-1][0])
+        store["end_offsets"][partition] = records[-1][0] + 1
 
     def stop(self) -> None:
         self._stop.set()
@@ -608,23 +695,28 @@ class FakeBroker:
                 api_version,
             )
         if api_key == kc.API_METADATA:
-            requested = kc.decode_metadata_request(r, api_version) or []
+            requested = kc.decode_metadata_request(r, api_version)
             brokers = (
                 self.cluster.broker_addrs()
                 if self.cluster is not None
                 else {self.node_id: ("127.0.0.1", self.port)}
             )
             topics: List[kc.TopicMetadata] = []
-            for name in requested if requested else [self.topic]:
-                if name == self.topic:
+            # None/empty = ALL topics (the fleet discovery request path);
+            # a name list answers per topic, unknown names with the error.
+            names = requested if requested else self.topic_names()
+            for name in names:
+                store = self._stores.get(name)
+                if store is not None:
                     topics.append(
                         kc.TopicMetadata(
                             0,
                             name,
                             [
                                 kc.PartitionMetadata(0, p, self._leader(p))
-                                for p in sorted(self.records)
+                                for p in sorted(store["records"])
                             ],
+                            is_internal=int(name in self.internal_names),
                         )
                     )
                 else:
@@ -642,29 +734,32 @@ class FakeBroker:
                 kc.MetadataResponse(brokers, 0, topics), version=api_version
             )
         if api_key == kc.API_LIST_OFFSETS:
-            _topic, parts = kc.decode_list_offsets_request(r, api_version)
+            req_topic, parts = kc.decode_list_offsets_request(r, api_version)
+            store = self._stores.get(req_topic, None)
+            records = store["records"] if store is not None else {}
             results = []
             for pid, ts in parts:
-                if pid not in self.records:
+                if pid not in records:
                     results.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, -1))
                 elif ts == kc.EARLIEST_TIMESTAMP:
-                    results.append((pid, 0, -1, self.start_offsets[pid]))
+                    results.append((pid, 0, -1, store["start_offsets"][pid]))
                 elif ts == kc.LATEST_TIMESTAMP:
-                    results.append((pid, 0, -1, self.end_offsets[pid]))
+                    results.append((pid, 0, -1, store["end_offsets"][pid]))
                 else:
                     # Timestamp lookup: earliest offset whose record ts >= query
                     # (-1 when no such record), like a real broker.
                     hit = next(
-                        (off for off, rts, _k, _v in self.records[pid] if rts >= ts),
+                        (off for off, rts, _k, _v in records[pid] if rts >= ts),
                         -1,
                     )
                     results.append((pid, 0, ts, hit))
             return kc.encode_list_offsets_response(
-                self.topic, results, api_version
+                req_topic, results, api_version
             )
         if api_key == kc.API_FETCH:
             self.fetch_count += 1
-            _topic, parts, _mw, _mb, _xb = kc.decode_fetch_request(r, api_version)
+            req_topic, parts, _mw, _mb, _xb = kc.decode_fetch_request(r, api_version)
+            store = self._stores.get(req_topic, None)
             out = []
             budget = _xb if self.honor_max_bytes else None
             served_any = False
@@ -677,7 +772,7 @@ class FakeBroker:
                         # warn, back off, and re-poll.
                         out.append((pid, code, -1, b""))
                         continue
-                rs = self.records.get(pid)
+                rs = store["records"].get(pid) if store is not None else None
                 if rs is None:
                     out.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, b""))
                     continue
@@ -686,12 +781,12 @@ class FakeBroker:
                     # not lead.
                     out.append((pid, kc.ERR_NOT_LEADER_FOR_PARTITION, -1, b""))
                     continue
-                hw = self.end_offsets[pid]
+                hw = store["end_offsets"][pid]
                 # First pre-encoded chunk whose last offset reaches the fetch
                 # position (it may start earlier; clients filter by offset,
                 # exactly as with real compacted batches).
-                chunks = self._chunks[pid]
-                i = bisect.bisect_left(self._chunk_last_offsets[pid], fetch_offset)
+                chunks = store["chunks"][pid]
+                i = bisect.bisect_left(store["chunk_last"][pid], fetch_offset)
                 if self.honor_partition_max_bytes:
                     buf = bytearray()
                     for j in range(i, len(chunks)):
@@ -714,7 +809,7 @@ class FakeBroker:
                 if record_set:
                     served_any = True
                 out.append((pid, 0, hw, record_set))
-            return kc.encode_fetch_response(self.topic, out, api_version)
+            return kc.encode_fetch_response(req_topic, out, api_version)
         raise AssertionError(f"fake broker: unsupported api {api_key}")
 
     def _leader(self, partition: int) -> int:
@@ -760,6 +855,28 @@ class FakeCluster:
         """SIGKILL one node: listener and live connections drop; leadership
         of its partitions must be migrated for the scan to recover."""
         self.nodes[node_id].kill()
+
+    def create_topic(
+        self,
+        name: str,
+        partition_records: "Dict[int, List[Record]]",
+        internal: bool = False,
+    ) -> None:
+        """Mid-test topic creation on every node (all nodes replicate all
+        topics, like the single-topic records every node already serves)."""
+        for b in self.nodes:
+            b.create_topic(name, partition_records, internal=internal)
+
+    def produce(
+        self,
+        partition: int,
+        records: "List[Record]",
+        topic: "Optional[str]" = None,
+    ) -> None:
+        """Append to every node's replica of the partition (tests produce
+        through the cluster so a leader migration cannot strand records)."""
+        for b in self.nodes:
+            b.produce(partition, records, topic=topic)
 
     def broker_addrs(self) -> Dict[int, "tuple[str, int]"]:
         return {b.node_id: ("127.0.0.1", b.port) for b in self.nodes}

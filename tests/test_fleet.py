@@ -1,0 +1,857 @@
+"""Fleet mode (ISSUE 13): cluster-wide discovery, admission, isolation.
+
+The contract under test, per DESIGN.md §20:
+
+- DISCOVERY: one all-topics Metadata request lists every topic with its
+  internal flag; glob include/exclude + internal exclusion filter it;
+  mid-test topic creation is visible to a re-discovery;
+- ADMISSION ALGEBRA: at every point in any admit/release/rebalance
+  sequence, granted workers/dispatch never exceed the budgets, every
+  active grant keeps >= 1 of each, and workers never exceed a topic's
+  partition count;
+- BYTE-IDENTITY: a fleet scan's per-topic metrics equal solo scans of
+  the same topics (swept over workers x superbatch), and agree with the
+  MultiTopicSource fan-in's slice_rows projection — the two independent
+  oracles;
+- ISOLATION: one topic's deterministic corruption (fail policy) marks
+  THAT topic failed in the status table; every other topic's results are
+  byte-identical to its solo scan;
+- DURABILITY: fleet follow SIGTERM lands per-topic checkpoints in
+  per-topic subdirectories, and a restarted fleet resumes each topic
+  with no loss and no double-count;
+- SURFACES: /report.json serves the cluster rollup, ?topic= each solo
+  --json-schema document; the CLI's --fleet --json and the lifted
+  multi-topic --follow path work end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.config import (
+    AnalyzerConfig,
+    DispatchConfig,
+    FollowConfig,
+)
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.fleet.discovery import (
+    DiscoveredTopic,
+    discover_topics,
+    filter_topics,
+    parse_globs,
+)
+from kafka_topic_analyzer_tpu.fleet.scheduler import (
+    FleetScheduler,
+    TopicSeed,
+)
+from kafka_topic_analyzer_tpu.fleet.service import FleetService
+from kafka_topic_analyzer_tpu.io.kafka_wire import (
+    KafkaWireSource,
+    discover_cluster_topics,
+)
+from kafka_topic_analyzer_tpu.serve import state as serve_state
+
+from fake_broker import CorruptionInjector, FakeBroker
+
+pytestmark = pytest.mark.fleet
+
+TOPICS = ["fleet.a", "fleet.b", "fleet.c"]
+N_PARTS = 4
+PHASE1_N = 60
+PHASE2_N = 30
+FULL_N = PHASE1_N + PHASE2_N
+
+FAST_RETRY = {
+    "retry.backoff.ms": "5",
+    "reconnect.backoff.max.ms": "40",
+}
+
+FAST_FOLLOW = dict(
+    poll_interval_s=0.02,
+    idle_backoff_max_s=0.05,
+)
+
+
+def _mk_records(salt: int, partition: int, lo: int, n: int):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{salt}-{partition}-{i % 17}".encode() if i % 5 else None,
+            bytes(15 + ((i + salt) % 11)) if i % 7 else None,
+        )
+        for i in range(lo, lo + n)
+    ]
+
+
+def _topic_records(salt: int, n: int, lo: int = 0):
+    return {p: _mk_records(salt, p, lo, n) for p in range(N_PARTS)}
+
+
+FULL = {t: _topic_records(i, FULL_N) for i, t in enumerate(TOPICS)}
+PHASE1 = {t: _topic_records(i, PHASE1_N) for i, t in enumerate(TOPICS)}
+PHASE2 = {
+    t: _topic_records(i, PHASE2_N, lo=PHASE1_N) for i, t in enumerate(TOPICS)
+}
+INTERNAL = {"__consumer_offsets": {0: _mk_records(99, 0, 0, 5)}}
+
+
+def _mk_broker(records_by_topic, **kw):
+    names = list(records_by_topic)
+    return FakeBroker(
+        names[0],
+        records_by_topic[names[0]],
+        extra_topics={t: records_by_topic[t] for t in names[1:]},
+        internal_topics=dict(INTERNAL),
+        max_records_per_fetch=48,
+        **kw,
+    )
+
+
+def _cfg(parts=N_PARTS, **kw) -> AnalyzerConfig:
+    base = dict(
+        num_partitions=parts,
+        batch_size=64,
+        count_alive_keys=True,
+        alive_bitmap_bits=16,
+        enable_hll=True,
+        hll_p=8,
+        enable_quantiles=True,
+        quantiles_per_partition=True,
+    )
+    base.update(kw)
+    return AnalyzerConfig(**base)
+
+
+def _source(broker, topic, **overrides):
+    return KafkaWireSource(
+        f"127.0.0.1:{broker.port}", topic,
+        overrides=dict(FAST_RETRY, **overrides),
+    )
+
+
+def _metrics_doc(result) -> dict:
+    return result.metrics.to_dict(result.start_offsets, result.end_offsets)
+
+
+def _fleet_service(
+    broker,
+    topics=TOPICS,
+    worker_budget=3,
+    dispatch_budget=3,
+    max_concurrent=3,
+    superbatch=1,
+    follow=None,
+    source_overrides=None,
+    **kw,
+):
+    scheduler = FleetScheduler(worker_budget, dispatch_budget, max_concurrent)
+
+    def source_factory(topic):
+        return _source(broker, topic, **(source_overrides or {}))
+
+    def backend_factory(topic, parts, grant):
+        return TpuBackend(
+            _cfg(parts),
+            dispatch=DispatchConfig(
+                superbatch=superbatch, depth=grant.dispatch_depth
+            ),
+            init_now_s=10**10,
+        )
+
+    seeds = [TopicSeed(name=t, partitions=N_PARTS) for t in topics]
+    return FleetService(
+        seeds, source_factory, backend_factory, 64, scheduler,
+        follow=follow, **kw,
+    )
+
+
+def _wait_for(predicate, timeout_s=30.0, interval_s=0.01, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# discovery
+
+
+def test_discover_cluster_topics_lists_all_with_internal_flags():
+    with _mk_broker(FULL) as broker:
+        mds = discover_cluster_topics(f"127.0.0.1:{broker.port}")
+    by_name = {t.name: t for t in mds}
+    assert set(by_name) == set(TOPICS) | {"__consumer_offsets"}
+    assert by_name["__consumer_offsets"].is_internal == 1
+    for t in TOPICS:
+        assert by_name[t].is_internal == 0
+        assert len(by_name[t].partitions) == N_PARTS
+
+
+def test_discovery_filters_globs_and_internal():
+    with _mk_broker(FULL) as broker:
+        bootstrap = f"127.0.0.1:{broker.port}"
+        # Default: every user topic, internal excluded.
+        ds = discover_topics(bootstrap)
+        assert [d.name for d in ds] == sorted(TOPICS)
+        assert all(d.partitions == N_PARTS for d in ds)
+        # Include glob narrows.
+        ds = discover_topics(bootstrap, include=["*.a"])
+        assert [d.name for d in ds] == ["fleet.a"]
+        # Exclude applies after include.
+        ds = discover_topics(bootstrap, include=["fleet.*"], exclude=["*.b"])
+        assert [d.name for d in ds] == ["fleet.a", "fleet.c"]
+        # Internal opt-in.
+        ds = discover_topics(bootstrap, include_internal=True)
+        assert "__consumer_offsets" in [d.name for d in ds]
+
+
+def test_discovery_sees_mid_test_topic_creation():
+    with _mk_broker(FULL) as broker:
+        bootstrap = f"127.0.0.1:{broker.port}"
+        assert [d.name for d in discover_topics(bootstrap)] == sorted(TOPICS)
+        broker.create_topic("fleet.new", {0: _mk_records(7, 0, 0, 10)})
+        assert [d.name for d in discover_topics(bootstrap)] == sorted(
+            TOPICS + ["fleet.new"]
+        )
+        # Internal mid-test creation stays excluded by default.
+        broker.create_topic(
+            "__txn_state", {0: _mk_records(8, 0, 0, 3)}, internal=True
+        )
+        assert "__txn_state" not in [
+            d.name for d in discover_topics(bootstrap)
+        ]
+
+
+def test_discovery_empty_cluster():
+    # A cluster whose only topic is internal: the fleet has nothing to do.
+    with FakeBroker(
+        "__consumer_offsets", {0: []}, max_records_per_fetch=48
+    ) as broker:
+        assert discover_topics(f"127.0.0.1:{broker.port}") == []
+
+
+def test_filter_topics_unit():
+    topics = [
+        DiscoveredTopic("orders", 4),
+        DiscoveredTopic("orders.dlq", 1),
+        DiscoveredTopic("users", 2),
+        DiscoveredTopic("__consumer_offsets", 50, internal=True),
+        DiscoveredTopic("__unflagged_system", 1),  # name-prefix rule
+    ]
+    # `__unflagged_system` carries internal=False from this fake metadata,
+    # but discover_topics flags the name prefix; filter_topics only sees
+    # the flag — mark it the way discovery would.
+    topics[-1] = DiscoveredTopic("__unflagged_system", 1, internal=True)
+    assert [t.name for t in filter_topics(topics)] == [
+        "orders", "orders.dlq", "users",
+    ]
+    assert [t.name for t in filter_topics(topics, include=["orders*"])] == [
+        "orders", "orders.dlq",
+    ]
+    assert [
+        t.name
+        for t in filter_topics(
+            topics, include=["orders*"], exclude=["*.dlq"]
+        )
+    ] == ["orders"]
+    assert "__consumer_offsets" in [
+        t.name for t in filter_topics(topics, include_internal=True)
+    ]
+    assert filter_topics([]) == []
+    assert parse_globs(" a , b ,") == ["a", "b"]
+    assert parse_globs(None) == []
+
+
+# ---------------------------------------------------------------------------
+# the admission algebra
+
+
+def _assert_invariants(sched: FleetScheduler, partitions):
+    assert sched.workers_granted <= sched.worker_budget
+    assert sched.dispatch_granted <= sched.dispatch_budget
+    assert sched.active <= sched.max_concurrent
+    for t, g in sched.grants().items():
+        assert g.workers >= 1
+        assert g.dispatch_depth >= 1
+        assert g.workers <= max(1, partitions[t])
+
+
+def test_scheduler_budget_conservation_property():
+    """Sum of granted workers/dispatch <= the budgets at EVERY point of
+    arbitrary admit/release/rebalance sequences (seeded, deterministic)."""
+    rng = random.Random(1234)
+    for trial in range(20):
+        wb = rng.randint(1, 16)
+        db = rng.randint(1, 8)
+        mc = rng.randint(1, 6)
+        sched = FleetScheduler(wb, db, mc)
+        partitions = {
+            f"t{i}": rng.randint(1, 12) for i in range(rng.randint(1, 10))
+        }
+        for _ in range(40):
+            op = rng.random()
+            if op < 0.45:
+                ready = [
+                    TopicSeed(t, partitions[t], lag=rng.randint(0, 1000))
+                    for t in rng.sample(
+                        sorted(partitions), rng.randint(1, len(partitions))
+                    )
+                ]
+                sched.admit(ready)
+            elif op < 0.7:
+                grants = sched.grants()
+                if grants:
+                    sched.release(rng.choice(sorted(grants)))
+            else:
+                verdicts = {
+                    t: rng.choice(
+                        ["ingest-bound", "dispatch-bound", "balanced"]
+                    )
+                    for t in sched.grants()
+                }
+                sched.rebalance(verdicts)
+            _assert_invariants(sched, partitions)
+
+
+def test_scheduler_plan_waves_covers_all_within_bound():
+    sched = FleetScheduler(8, 4, max_concurrent=2)
+    seeds = [TopicSeed(f"t{i}", 2, lag=(i + 1) * 100) for i in range(7)]
+    waves = sched.plan_waves(seeds)
+    flat = [t for w in waves for t in w]
+    assert sorted(flat) == sorted(s.name for s in seeds)  # each exactly once
+    assert all(len(w) <= 2 for w in waves)
+    assert sched.plan_waves([]) == []
+
+
+def test_scheduler_rebalance_rule():
+    sched = FleetScheduler(worker_budget=6, dispatch_budget=4, max_concurrent=2)
+    sched.admit([TopicSeed("a", 8, lag=100), TopicSeed("b", 8, lag=90)])
+    ga, gb = sched.grant_for("a"), sched.grant_for("b")
+    assert ga.workers + gb.workers <= 6
+    assert ga.dispatch_depth >= 1 and gb.dispatch_depth >= 1
+    moves = sched.rebalance({"a": "dispatch-bound", "b": "ingest-bound"})
+    assert moves > 0
+    ga2, gb2 = sched.grant_for("a"), sched.grant_for("b")
+    assert ga2.workers < ga.workers          # dispatch-bound shed a worker
+    assert gb2.dispatch_depth == 1           # ingest-bound shed dispatch
+    assert gb2.workers > gb.workers          # ...and drew from the pool
+    _assert_invariants(sched, {"a": 8, "b": 8})
+    # Balanced verdicts hold still.
+    before = {t: (g.workers, g.dispatch_depth)
+              for t, g in sched.grants().items()}
+    assert sched.rebalance({"a": "balanced", "b": "balanced"}) == 0
+    assert before == {
+        t: (g.workers, g.dispatch_depth) for t, g in sched.grants().items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# fleet-vs-solo byte-identity
+
+
+@pytest.fixture(scope="module")
+def solo_referee():
+    """Solo scans of each topic — the byte-exact referee docs."""
+    docs = {}
+    with _mk_broker(FULL) as broker:
+        for topic in TOPICS:
+            src = _source(broker, topic)
+            result = run_scan(
+                topic, src, TpuBackend(_cfg(), init_now_s=10**10), 64
+            )
+            src.close()
+            docs[topic] = _metrics_doc(result)
+    return docs
+
+
+@pytest.mark.parametrize("workers,superbatch", [
+    (1, 1), (4, 1), (1, 4), (4, 4),
+])
+def test_fleet_batch_byte_identity_matrix(solo_referee, workers, superbatch):
+    with _mk_broker(FULL) as broker:
+        svc = _fleet_service(
+            broker,
+            worker_budget=workers * len(TOPICS),
+            dispatch_budget=2 * len(TOPICS),
+            superbatch=superbatch,
+        )
+        fr = svc.run_batch()
+    assert set(fr.results) == set(TOPICS)
+    for topic in TOPICS:
+        assert fr.statuses[topic].status == "ok"
+        assert _metrics_doc(fr.results[topic]) == solo_referee[topic]
+    if workers == 4:
+        # The budget actually split: every topic's scan ran its granted
+        # worker count (clamped at the partition count).
+        assert all(
+            fr.results[t].ingest_workers == min(4, N_PARTS) for t in TOPICS
+        )
+    # The rollup totals equal the sum of the referees.
+    totals = fr.rollup["fleet"]["totals"]
+    assert totals["records"] == sum(
+        d["overall"]["count"] for d in solo_referee.values()
+    )
+    assert totals["bytes"] == sum(
+        d["overall"]["size_bytes"] for d in solo_referee.values()
+    )
+
+
+def test_fleet_matches_fan_in_projection_oracle():
+    """The second oracle (ISSUE 13): the MultiTopicSource fan-in scan's
+    per-topic slice_rows projection must agree with the fleet's per-topic
+    results — two entirely different multi-topic paths, one answer."""
+    from kafka_topic_analyzer_tpu.io.multi import MultiTopicSource
+    from kafka_topic_analyzer_tpu.results import slice_rows
+
+    plain = dict(
+        count_alive_keys=False, enable_hll=False, enable_quantiles=False,
+        quantiles_per_partition=False,
+    )
+    with _mk_broker(FULL) as broker:
+        # Fleet scan (plain config: slices can't carry merged sketches).
+        scheduler = FleetScheduler(3, 3, 3)
+        svc = FleetService(
+            [TopicSeed(name=t, partitions=N_PARTS) for t in TOPICS],
+            lambda t: _source(broker, t),
+            lambda t, parts, grant: TpuBackend(
+                _cfg(parts, **plain), init_now_s=10**10
+            ),
+            64,
+            scheduler,
+        )
+        fr = svc.run_batch()
+        # Fan-in oracle over the same topics.
+        multi = MultiTopicSource(
+            [(t, _source(broker, t)) for t in TOPICS]
+        )
+        union = run_scan(
+            "fanin", multi,
+            TpuBackend(
+                _cfg(len(multi.partitions()), **plain), init_now_s=10**10
+            ),
+            64,
+        ).metrics
+        multi.close()
+    for topic in TOPICS:
+        rows = multi.rows_for(topic)
+        ids = [multi.true_partition(r) for r in rows]
+        sliced = slice_rows(union, rows, ids)
+        solo = fr.results[topic].metrics
+        assert np.array_equal(sliced.per_partition, solo.per_partition)
+        assert sliced.overall_count == solo.overall_count
+        assert sliced.overall_size == solo.overall_size
+        assert sliced.earliest_ts_s == solo.earliest_ts_s
+        assert sliced.latest_ts_s == solo.latest_ts_s
+        assert sliced.smallest_message == solo.smallest_message
+        assert sliced.largest_message == solo.largest_message
+
+
+# ---------------------------------------------------------------------------
+# isolation: one poisoned topic cannot take the fleet down
+
+
+def test_one_topic_poisoned_isolation(solo_referee):
+    # fleet.a (the broker's default topic) serves a deterministically
+    # corrupt frame; the default --on-corruption=fail aborts THAT scan.
+    corruption = CorruptionInjector().corrupt_length(partition=0, chunk=0)
+    with _mk_broker(FULL, corruption=corruption) as broker:
+        svc = _fleet_service(broker)
+        fr = svc.run_batch()
+    assert fr.statuses["fleet.a"].status == "failed"
+    assert fr.statuses["fleet.a"].error
+    assert fr.any_failed
+    # The OTHER topics' results are byte-identical to their solo scans.
+    for topic in ("fleet.b", "fleet.c"):
+        assert fr.statuses[topic].status == "ok"
+        assert _metrics_doc(fr.results[topic]) == solo_referee[topic]
+    # The status table reports the poisoned topic.
+    rollup = fr.rollup["fleet"]
+    assert rollup["status_counts"]["failed"] == 1
+    assert rollup["status_counts"]["ok"] == 2
+    assert "error" in rollup["statuses"]["fleet.a"]
+    from kafka_topic_analyzer_tpu.report import render_fleet_status
+
+    table = render_fleet_status(fr.rollup)
+    assert "failed" in table and "fleet.a" in table
+    assert "unaffected" in table
+
+
+# ---------------------------------------------------------------------------
+# fleet follow: SIGTERM → per-topic checkpoints → resume
+
+
+def test_fleet_follow_sigterm_checkpoint_resume(tmp_path, solo_referee):
+    snap = str(tmp_path / "fleet-snaps")
+    follow = FollowConfig(**dict(FAST_FOLLOW, checkpoint_every_s=0.0))
+    phase1_total = N_PARTS * PHASE1_N
+
+    def published(svc, topic):
+        doc = svc.state.snapshot(topic)
+        return doc["overall"]["count"] if doc else -1
+
+    # Session 1: fold phase 1 of every topic, then SIGTERM.
+    with _mk_broker(PHASE1) as broker:
+        svc = _fleet_service(broker, follow=follow, snapshot_dir=snap)
+        restore = svc.install_signal_handlers()
+        try:
+            killer = threading.Thread(
+                target=lambda: (
+                    _wait_for(
+                        lambda: all(
+                            published(svc, t) >= phase1_total for t in TOPICS
+                        ),
+                        what="phase-1 fleet reports",
+                    ),
+                    os.kill(os.getpid(), signal.SIGTERM),
+                )
+            )
+            killer.start()
+            fr1 = svc.run_follow()
+            killer.join()
+        finally:
+            restore()
+    assert svc._stop_reason == "SIGTERM"
+    for t in TOPICS:
+        assert fr1.results[t].metrics.overall_count == phase1_total
+        # Per-topic checkpoint namespacing: one subdirectory per topic.
+        assert os.path.exists(
+            os.path.join(snap, t, "scan_snapshot.npz")
+        )
+    from kafka_topic_analyzer_tpu.checkpoint import list_topic_snapshots
+
+    inventory = list_topic_snapshots(snap)
+    assert set(inventory) == set(TOPICS)
+    assert all(
+        info["records_seen"] == phase1_total for info in inventory.values()
+    )
+
+    # Session 2: resume each topic from its checkpoint, tail phase 2.
+    with _mk_broker(FULL) as broker:
+        svc2 = _fleet_service(
+            broker, follow=follow, snapshot_dir=snap, resume=True,
+        )
+        stopper = threading.Thread(
+            target=lambda: (
+                _wait_for(
+                    lambda: all(
+                        published(svc2, t) >= N_PARTS * FULL_N
+                        for t in TOPICS
+                    ),
+                    what="resumed fleet reports",
+                ),
+                svc2.request_stop("test"),
+            )
+        )
+        stopper.start()
+        fr2 = svc2.run_follow()
+        stopper.join()
+    for t in TOPICS:
+        assert _metrics_doc(fr2.results[t]) == solo_referee[t]
+
+
+def test_fleet_follow_rediscovers_created_topic():
+    follow = FollowConfig(**dict(FAST_FOLLOW))
+    new_records = {0: _mk_records(42, 0, 0, 20)}
+    with _mk_broker(PHASE1) as broker:
+        bootstrap = f"127.0.0.1:{broker.port}"
+
+        def rediscover():
+            return [
+                TopicSeed(name=d.name, partitions=d.partitions)
+                for d in discover_topics(bootstrap)
+            ]
+
+        svc = _fleet_service(
+            broker, follow=follow, rediscover=rediscover, rediscover_every=2,
+        )
+
+        def driver():
+            _wait_for(
+                lambda: svc.state.snapshot("fleet.a") is not None,
+                what="initial fleet report",
+            )
+            broker.create_topic("fleet.created", new_records)
+            _wait_for(
+                lambda: (
+                    svc.state.snapshot("fleet.created") is not None
+                    and svc.state.snapshot("fleet.created")["overall"]["count"]
+                    >= 20
+                ),
+                what="created-topic report",
+            )
+            svc.request_stop("test")
+
+        t = threading.Thread(target=driver)
+        t.start()
+        fr = svc.run_follow()
+        t.join()
+    assert "fleet.created" in fr.results
+    assert fr.results["fleet.created"].metrics.overall_count == 20
+    assert fr.statuses["fleet.created"].status == "ok"
+
+
+def test_fleet_batch_scans_all_topics_under_tight_dispatch_budget(
+    solo_referee,
+):
+    """A dispatch-token budget smaller than the wave defers topics; a
+    batch fleet must RE-OFFER the deferred remainder, not drop it — the
+    default --dispatch-depth 2 against 3 topics hits exactly this."""
+    with _mk_broker(FULL) as broker:
+        svc = _fleet_service(
+            broker, worker_budget=6, dispatch_budget=1, max_concurrent=3,
+        )
+        fr = svc.run_batch()
+    assert set(fr.results) == set(TOPICS)
+    for topic in TOPICS:
+        assert fr.statuses[topic].status == "ok"
+        assert _metrics_doc(fr.results[topic]) == solo_referee[topic]
+
+
+def test_fleet_follow_stops_when_every_topic_failed():
+    """Failure isolation needs survivors: an unreachable cluster fails
+    every topic, and the follow loop must exit (reason 'all-failed')
+    instead of polling a dead cluster forever."""
+    scheduler = FleetScheduler(2, 2, 2)
+
+    def dead_source(topic):
+        raise OSError("connection refused")
+
+    svc = FleetService(
+        [TopicSeed(name=t, partitions=1) for t in ("a", "b")],
+        dead_source,
+        lambda t, parts, grant: None,
+        64,
+        scheduler,
+        follow=FollowConfig(**FAST_FOLLOW),
+    )
+    t0 = time.monotonic()
+    fr = svc.run_follow()
+    assert time.monotonic() - t0 < 10.0
+    assert svc._stop_reason == "all-failed"
+    assert fr.any_failed
+    assert all(s.status == "failed" for s in fr.statuses.values())
+    assert fr.results == {}
+
+
+def test_poll_failure_releases_held_grant():
+    """A topic that fails during the watermark poll while HOLDING a
+    grant must return its budget — otherwise every such failure shrinks
+    the fleet's pool permanently."""
+    scheduler = FleetScheduler(2, 2, 2)
+
+    class _BoomSource:
+        def partitions(self):
+            return [0]
+
+        def refresh_watermarks(self):
+            raise OSError("broker gone")
+
+    svc = FleetService(
+        [TopicSeed("t", 1)],
+        lambda t: _BoomSource(),
+        lambda *a: None,
+        64,
+        scheduler,
+        follow=FollowConfig(**FAST_FOLLOW),
+    )
+    scheduler.admit([TopicSeed("t", 1, lag=5)])
+    assert scheduler.active == 1
+    assert svc._poll_topic(svc.scans["t"]) == 0
+    assert svc.scans["t"].status.status == "failed"
+    assert scheduler.active == 0          # budget returned
+    assert scheduler.workers_granted == 0
+
+
+def test_backend_dispatch_depth_regrant_clamps_at_construction():
+    """Rebalanced dispatch shares become a REAL backend bound between
+    passes (shrink applies; grow clamps at the constructed depth, which
+    sized the stager ring)."""
+    backend = TpuBackend(
+        _cfg(),
+        dispatch=DispatchConfig(superbatch=2, depth=3),
+        init_now_s=10**10,
+    )
+    assert backend.dispatch_depth == 3
+    backend.set_dispatch_depth(1)
+    assert backend.dispatch_depth == 1
+    assert backend._queue.depth == 1
+    backend.set_dispatch_depth(8)      # grow clamps at construction
+    assert backend.dispatch_depth == 3
+    assert backend._queue.depth == 3
+
+
+def test_fleet_empty_topic_is_a_status_row():
+    records = dict(FULL)
+    records["fleet.empty"] = {p: [] for p in range(N_PARTS)}
+    with _mk_broker(records) as broker:
+        svc = _fleet_service(broker, topics=TOPICS + ["fleet.empty"])
+        fr = svc.run_batch()
+    assert fr.statuses["fleet.empty"].status == "empty"
+    assert "fleet.empty" not in fr.results
+    assert all(fr.statuses[t].status == "ok" for t in TOPICS)
+    assert not fr.any_failed
+
+
+# ---------------------------------------------------------------------------
+# report surfaces: rollup + ?topic= routing
+
+
+def test_report_json_topic_routing(solo_referee):
+    from kafka_topic_analyzer_tpu.obs.exporters import PrometheusExporter
+
+    exporter = PrometheusExporter(0)
+    base = f"http://127.0.0.1:{exporter.port}/report.json"
+    try:
+        serve_state.set_active(None)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base, timeout=5)
+        assert exc.value.code == 404
+
+        with _mk_broker(FULL) as broker:
+            svc = _fleet_service(broker)
+            serve_state.set_active(svc.state)
+            # Before any publish: rollup 503, unknown topic 404.
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base, timeout=5)
+            assert exc.value.code == 503
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + "?topic=nope", timeout=5)
+            assert exc.value.code == 404
+            fr = svc.run_batch()
+        # Bare /report.json = the cluster rollup.
+        with urllib.request.urlopen(base, timeout=5) as resp:
+            rollup = json.loads(resp.read())
+        assert rollup["fleet"]["topics"] == len(TOPICS)
+        assert set(rollup["fleet"]["statuses"]) == set(TOPICS)
+        # ?topic= = that topic's solo-schema document.
+        for topic in TOPICS:
+            with urllib.request.urlopen(
+                base + f"?topic={topic}", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["topic"] == topic
+            assert doc["overall"] == solo_referee[topic]["overall"]
+            assert doc["partitions"] == solo_referee[topic]["partitions"]
+            assert doc["fleet"]["status"] == "ok"
+        # Unknown topic still 404s after publishes.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "?topic=nope", timeout=5)
+        assert exc.value.code == 404
+        assert fr.rollup["fleet"]["totals"]["records"] == sum(
+            d["overall"]["count"] for d in solo_referee.values()
+        )
+    finally:
+        serve_state.set_active(None)
+        exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+
+
+def test_cli_fleet_json(capsys, solo_referee):
+    from kafka_topic_analyzer_tpu import cli
+
+    with _mk_broker(FULL) as broker:
+        rc = cli.main([
+            "-t", "*", "--fleet", "-b", f"127.0.0.1:{broker.port}",
+            "--librdkafka", "retry.backoff.ms=5,reconnect.backoff.max.ms=40",
+            "-c", "--distinct-keys",
+            "--json", "--quiet",
+        ])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(doc["fleet"]["statuses"]) == set(TOPICS)  # internal excluded
+    assert set(doc["topics"]) == set(TOPICS)
+    for topic in TOPICS:
+        assert (
+            doc["topics"][topic]["overall"]["count"]
+            == solo_referee[topic]["overall"]["count"]
+        )
+        assert doc["topics"][topic]["fleet"]["status"] == "ok"
+
+
+def test_cli_fleet_exclude_globs(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    with _mk_broker(FULL) as broker:
+        rc = cli.main([
+            "-t", "fleet.*", "--fleet", "--fleet-exclude", "*.b,*.c",
+            "-b", f"127.0.0.1:{broker.port}",
+            "--librdkafka", "retry.backoff.ms=5",
+            "--json", "--quiet",
+        ])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert list(doc["fleet"]["statuses"]) == ["fleet.a"]
+
+
+def test_cli_multi_topic_follow_lifted(capsys):
+    """The PR-11 rejection is gone: an explicit topic list under --follow
+    runs through the fleet scheduler, each topic solo-identical."""
+    from kafka_topic_analyzer_tpu import cli
+
+    with _mk_broker(FULL) as broker:
+        rc = cli.main([
+            "-t", "fleet.a,fleet.b", "-b", f"127.0.0.1:{broker.port}",
+            "--librdkafka", "retry.backoff.ms=5,reconnect.backoff.max.ms=40",
+            "--follow", "--follow-idle-exit", "0.2",
+            "--poll-interval", "0.02",
+            "--json", "--quiet",
+        ])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(doc["fleet"]["statuses"]) == {"fleet.a", "fleet.b"}
+    for topic in ("fleet.a", "fleet.b"):
+        assert (
+            doc["topics"][topic]["overall"]["count"] == N_PARTS * FULL_N
+        )
+
+
+def test_cli_fleet_rejections_name_the_lifting_flag(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main([
+        "-t", "*", "--fleet", "-b", "127.0.0.1:1", "--mesh", "2",
+    ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "--fleet does not support --mesh" in err
+    assert "solo" in err  # names the path that lifts the restriction
+
+    rc = cli.main([
+        "-t", "*", "--fleet", "-b", "127.0.0.1:1", "--source", "synthetic",
+    ])
+    assert rc == 1
+    assert "--fleet requires --source kafka" in capsys.readouterr().err
+
+
+def test_fleet_admissions_are_booked():
+    """Rule-10 contract, dynamically: a fleet run leaves a reconstructible
+    admission trace on kta_fleet_admissions_total."""
+    from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+
+    def count(reason):
+        return obs_metrics.FLEET_ADMISSIONS.labels(reason=reason).value
+
+    seed0 = count("admitted-seed")
+    released0 = count("released")
+    with _mk_broker(FULL) as broker:
+        svc = _fleet_service(broker)
+        svc.run_batch()
+    assert count("admitted-seed") - seed0 == len(TOPICS)
+    assert count("released") - released0 == len(TOPICS)

@@ -765,11 +765,19 @@ def test_cli_follow_json(capsys):
     assert doc["telemetry"]["kta_follow_polls_total"]["samples"][0]["value"] >= 1
 
 
-def test_cli_follow_rejects_multi_topic(capsys):
+def test_cli_follow_multi_topic_routes_to_fleet(capsys):
+    """The PR-11 multi-topic rejection is LIFTED: '-t a,b --follow' now
+    runs through the fleet scheduler (tests/test_fleet.py proves the
+    happy path).  Against an unreachable cluster every topic fails in
+    isolation and the fleet exits 1 — it does not poll a dead cluster
+    forever, and it does not print the old rejection."""
     from kafka_topic_analyzer_tpu import cli
 
     rc = cli.main([
         "-t", "a,b", "-b", "127.0.0.1:1", "--follow", "--source", "kafka",
+        "--librdkafka", "retry.backoff.ms=1,reconnect.backoff.max.ms=5",
+        "--quiet",
     ])
     assert rc == 1
-    assert "--follow does not support multi-topic" in capsys.readouterr().err
+    err = capsys.readouterr().err
+    assert "--follow does not support multi-topic" not in err

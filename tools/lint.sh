@@ -809,3 +809,91 @@ if failures:
     sys.exit(1)
 print("lint: OK (service HTTP handlers read only published snapshots)")
 EOF
+
+# Tenth rule: the fleet admission layer is PURE BOOKKEEPING.  (a) The
+# scheduler (fleet/scheduler.py) — and any HTTP-handler code under
+# fleet/ — may not call collective or drive-loop entry points directly
+# (rule 9's surface plus the host-level collectives from rule 5): the
+# layer that decides WHO runs must never be the layer that runs them, or
+# an admission decision could block on a fetch, hold a fold lock, or
+# launch a one-sided collective.  Only fleet/service.py drives scans.
+# (b) Every admission decision books a kta_fleet_* reason: each decision
+# method on the scheduler (admit/release/skip/rebalance families) must
+# reference a FLEET_* instrument — the admission trace must be
+# reconstructible from the counters alone (DESIGN.md §20).
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PKG = pathlib.Path("kafka_topic_analyzer_tpu")
+FLEET = sorted((PKG / "fleet").glob("*.py"))
+SCHEDULER = PKG / "fleet" / "scheduler.py"
+#: Rule 9's drive-loop surface + rule 5's host-level collectives.
+FORBIDDEN = {
+    "run", "run_scan", "run_follow", "run_batch",
+    "update", "update_shards", "update_superbatch",
+    "update_shards_superbatch", "finalize",
+    "get_state", "set_state", "get_state_local", "set_state_local",
+    "observe_batch", "observe", "batches",
+    "refresh_watermarks", "watermarks",
+    "global_any", "gather_telemetry", "_step", "_superstep",
+}
+#: Scheduler methods that ARE admission decisions: each must book.
+DECISION_PREFIXES = ("admit", "release", "skip_", "rebalance")
+
+failures = []
+for path in FLEET:
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    is_scheduler = path == SCHEDULER
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            bases = {
+                getattr(b, "id", getattr(b, "attr", "")) for b in node.bases
+            }
+            is_handler = node.name.endswith("Handler") or any(
+                "Handler" in b for b in bases
+            )
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                check_calls = is_scheduler or is_handler
+                if check_calls:
+                    for n in ast.walk(item):
+                        if isinstance(n, ast.Call):
+                            name = None
+                            if isinstance(n.func, ast.Attribute):
+                                name = n.func.attr
+                            elif isinstance(n.func, ast.Name):
+                                name = n.func.id
+                            if name in FORBIDDEN:
+                                failures.append(
+                                    f"{path}:{n.lineno}: fleet scheduler/"
+                                    f"handler {node.name}.{item.name} calls "
+                                    f"drive-loop/collective entry point "
+                                    f"{name!r} — only fleet/service.py "
+                                    "drives scans"
+                                )
+                if is_scheduler and item.name.startswith(DECISION_PREFIXES):
+                    books = any(
+                        isinstance(n, ast.Attribute)
+                        and n.attr.startswith("FLEET_")
+                        for n in ast.walk(item)
+                    )
+                    if not books:
+                        failures.append(
+                            f"{path}:{item.lineno}: admission decision "
+                            f"{node.name}.{item.name} books no kta_fleet_* "
+                            "reason (obs/metrics FLEET_* instrument)"
+                        )
+
+if failures:
+    print("lint: the fleet admission layer must stay pure bookkeeping")
+    print("lint: (no drive-loop/collective calls from the scheduler or")
+    print("lint: fleet handlers; every admission decision books a")
+    print("lint: kta_fleet_* reason — DESIGN.md §20):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (fleet scheduler is pure; admission decisions book reasons)")
+EOF
