@@ -125,13 +125,37 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Synthetic workload spec, comma separated k=v: "
                         "partitions,messages,keys,key_null,tombstones,vmin,"
                         "vmax,seed")
-    p.add_argument("--segment-dir", metavar="DIR",
+    p.add_argument("--segment-dir", metavar="DIR|URL",
                    help="Segment store of .ktaseg dumps (--source segfile): "
-                        "a local directory today; scheme:// specs are "
-                        "reserved for object stores (io/segstore.py). "
-                        "Composes with --ingest-workers (partitions shard "
-                        "across parallel decode+pack workers, balanced by "
-                        "the catalog's record counts) and --superbatch")
+                        "a local directory (or file://DIR), or a remote "
+                        "object store — http(s)://host[:port]/bucket"
+                        "[/prefix] for any S3-compatible endpoint "
+                        "(path-style), s3://bucket[/prefix] through "
+                        "KTA_S3_ENDPOINT. Composes with --ingest-workers "
+                        "(partitions shard across parallel decode+pack "
+                        "workers, balanced by the catalog's record "
+                        "counts), --superbatch, --segment-readahead and "
+                        "--segment-cache")
+    p.add_argument("--segment-readahead", default="auto", metavar="N|auto",
+                   help="Remote chunks prefetched ahead of each ingest "
+                        "stream (per --ingest-workers worker), so per-GET "
+                        "wire latency overlaps the running decode→pack "
+                        "pass instead of serializing with it. 'auto' = 4 "
+                        "for remote stores, 0 (synchronous) for local "
+                        "directories. Results are byte-identical at any "
+                        "depth. Default: auto")
+    p.add_argument("--segment-cache", metavar="DIR",
+                   help="Local chunk cache for remote segment stores: "
+                        "fetched chunks land here (atomic rename-in, "
+                        "sha256 sidecar) and repeated audits of the same "
+                        "archive run at local-disk speed. Entries are "
+                        "verified on every hit — a flipped byte is "
+                        "detected, booked and re-fetched, never served")
+    p.add_argument("--segment-cache-bytes", type=int, default=1 << 30,
+                   metavar="BYTES",
+                   help="Size bound of --segment-cache: inserts evict "
+                        "least-recently-used entries past it. "
+                        "Default: 1 GiB")
     p.add_argument("--batch-size", type=int, default=1 << 18,
                    help="Records per device step")
     p.add_argument("--alive-bitmap-bits", type=int, default=32,
@@ -400,6 +424,11 @@ def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object
             "--on-corruption/--quarantine-dir require --source kafka "
             "(only the wire scan can classify and re-fetch frames)"
         )
+    if args.source != "segfile" and getattr(args, "segment_cache", None):
+        raise ValueError(
+            "--segment-cache requires --source segfile (it caches chunks "
+            "fetched from a remote segment store)"
+        )
     if args.source == "synthetic":
         from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
 
@@ -416,10 +445,34 @@ def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object
         return SyntheticSource(spec)
     if args.source == "segfile":
         if not args.segment_dir:
-            raise SystemExit("--source segfile requires --segment-dir")
+            raise SystemExit(
+                "--source segfile requires --segment-dir (a local "
+                "directory of .ktaseg dumps, or a remote store spec like "
+                "http(s)://host:port/bucket or s3://bucket/prefix)"
+            )
+        import dataclasses
+
+        from kafka_topic_analyzer_tpu.config import (
+            SegmentFetchConfig,
+            TransportRetryConfig,
+        )
         from kafka_topic_analyzer_tpu.io.segfile import SegmentFileSource
 
-        return SegmentFileSource(args.segment_dir, topic=topic)
+        fetch = SegmentFetchConfig.parse(
+            readahead=getattr(args, "segment_readahead", "auto"),
+            cache_dir=getattr(args, "segment_cache", None),
+            cache_max_bytes=getattr(args, "segment_cache_bytes", 1 << 30),
+        )
+        # The remote tier runs the SAME retry substrate as the wire scan,
+        # so the same --librdkafka knobs tune it (retry.backoff.ms,
+        # reconnect.backoff.max.ms, transport.retry.budget).
+        retry_overrides = parse_kv_pairs(args.librdkafka)
+        if retry_overrides:
+            fetch = dataclasses.replace(
+                fetch,
+                retry=TransportRetryConfig.from_overrides(retry_overrides),
+            )
+        return SegmentFileSource(args.segment_dir, topic=topic, fetch=fetch)
     # kafka
     if not args.bootstrap_server:
         raise SystemExit("--source kafka requires -b/--bootstrap-server")
@@ -830,7 +883,9 @@ def run_fleet(args, topics: "list[str] | None" = None) -> int:
         if args.source != "kafka":
             raise ValueError(
                 "--fleet requires --source kafka (discovery reads cluster "
-                "metadata); synthetic/segfile sources scan solo"
+                "metadata); a segment store is one topic's immutable "
+                "archive with no topic list or moving head — scan it solo "
+                "with --source segfile (synthetic sources scan solo too)"
             )
         if not args.bootstrap_server:
             raise SystemExit("--fleet requires -b/--bootstrap-server")
@@ -1127,6 +1182,14 @@ def _run(args) -> int:
     with user_input_phase():
         # Cheap flag validation first — before any broker handshake or dump
         # directory creation.
+        if args.follow and args.source == "segfile":
+            raise ValueError(
+                "--follow cannot tail --source segfile (a segment store "
+                "is immutable — there is no moving head to poll); run "
+                "the batch scan of the store, or --follow the live "
+                "topic with --source kafka (add --dump-segments to keep "
+                "the archive fresh)"
+            )
         from_ts_ms = parse_from_timestamp_flag(args)
         source = wrap_with_dump(args, args.topic, make_source(args))
         start_at, exhausted = resolve_start_offsets(

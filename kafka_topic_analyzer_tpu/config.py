@@ -280,6 +280,91 @@ class FollowConfig:
             raise ValueError("window hll precision must be in [4, 16]")
 
 
+@dataclasses.dataclass(frozen=True)
+class SegmentFetchConfig:
+    """Remote-segment-tier knobs (``--segment-readahead``/``--segment-cache``;
+    io/objstore.py + io/segstore.py, DESIGN.md §21).
+
+    Like `IngestConfig`, deliberately NOT part of `AnalyzerConfig`: how a
+    chunk's bytes ARRIVE (read ahead over the network, served from a local
+    cache, or memory-mapped) changes neither state shapes nor fold
+    semantics — a remote scan is byte-identical to the local-directory
+    scan of the same chunks — so none of it may churn the checkpoint
+    fingerprint.  A snapshot taken against one store resumes against any
+    other store holding the same segments (cross-store resume).
+    """
+
+    #: Chunks prefetched ahead of the consuming stream, PER ingest stream
+    #: (each ``--ingest-workers`` worker runs its own pool, so in-flight
+    #: chunk memory is bounded by workers × (readahead + 1) chunks).
+    #: ``"auto"`` resolves per store: 0 for local directories (the memmap
+    #: faults pages in for free) and 4 for remote stores (enough streams
+    #: in flight to hide tens of ms of per-GET latency behind the fused
+    #: decode→pack pass).  0 disables the pool: every chunk fetch is
+    #: synchronous at first touch.
+    readahead: "int | str" = "auto"
+    #: Local chunk-cache directory (``--segment-cache``); None disables.
+    #: Remote stores only — caching a local directory would just copy it.
+    cache_dir: "str | None" = None
+    #: Cache size bound in bytes (``--segment-cache-bytes``): inserts
+    #: evict least-recently-used entries past it.
+    cache_max_bytes: int = 1 << 30
+    #: Per-request socket timeout (connect and read) in seconds.  A stall
+    #: past it is a transient transport failure: backoff, retry, budget.
+    timeout_s: float = 30.0
+    #: Transport retry pacing + per-partition budget — the SAME recovery
+    #: substrate the live wire scan runs (io/retry.py): a partition whose
+    #: chunks stay unreachable past the budget is degraded, not fatal.
+    retry: TransportRetryConfig = dataclasses.field(
+        default_factory=TransportRetryConfig
+    )
+
+    def __post_init__(self) -> None:
+        if isinstance(self.readahead, str):
+            if self.readahead != "auto":
+                raise ValueError(
+                    f"segment readahead {self.readahead!r} invalid "
+                    "(an integer >= 0, or 'auto')"
+                )
+        elif self.readahead < 0:
+            raise ValueError("segment readahead must be >= 0")
+        if self.cache_max_bytes < 1:
+            raise ValueError("--segment-cache-bytes must be >= 1")
+        if self.timeout_s <= 0:
+            raise ValueError("segment fetch timeout must be > 0 seconds")
+
+    @classmethod
+    def parse(
+        cls,
+        readahead: str = "auto",
+        cache_dir: "str | None" = None,
+        cache_max_bytes: int = 1 << 30,
+    ) -> "SegmentFetchConfig":
+        """CLI spelling: ``--segment-readahead N|auto`` + cache flags."""
+        text = str(readahead).strip().lower()
+        if text == "auto":
+            ra: "int | str" = "auto"
+        else:
+            try:
+                ra = int(text)
+            except ValueError:
+                raise ValueError(
+                    f"bad --segment-readahead {readahead!r}: expected an "
+                    "integer >= 0 or 'auto'"
+                ) from None
+        return cls(
+            readahead=ra, cache_dir=cache_dir, cache_max_bytes=cache_max_bytes
+        )
+
+    def resolve_readahead(self, remote: bool) -> int:
+        """Concrete per-stream read-ahead depth: ``auto`` = 4 for remote
+        stores (hide per-GET wire latency behind the running decode→pack
+        pass), 0 for local directories (nothing to hide — page faults)."""
+        if self.readahead == "auto":
+            return 4 if remote else 0
+        return int(self.readahead)
+
+
 #: Valid --on-corruption policies, in escalation order.
 CORRUPTION_POLICIES = ("fail", "skip", "quarantine")
 

@@ -1,0 +1,469 @@
+"""Object-store transport for the remote segment tier (DESIGN.md §21).
+
+The cold scan path's storage seam (io/segstore.py) needs exactly three
+operations against a remote store: LIST a topic's chunk objects, fetch a
+byte RANGE of one (the catalog's header probe), and fetch a whole chunk
+body.  This module is the S3-shaped HTTP client behind
+``ObjectSegmentStore`` plus the local segment cache:
+
+- `RetryingHttp` — THE retry-budget wrapper.  Every socket the remote tier
+  touches lives inside this class (tools/lint.sh rule 11): one method pair
+  does the raw request, one public ``get`` drives it through the PR-1
+  recovery substrate — capped-exponential `io/retry.Backoff` between
+  attempts (sleeps booked, never bare ``time.sleep``) and a
+  `PartitionRetryBudget` so a partition whose chunks stay unreachable is
+  DEGRADED (scan continues without it, reported) instead of retried
+  forever.  Transient failures are resets/timeouts/truncated bodies/5xx;
+  a 200-body whose MD5 disagrees with the response ETag is *in-flight*
+  damage by definition and retries the same way.  4xx are deterministic
+  and never retried.
+- `SegmentCache` — the content-verified local chunk cache
+  (``--segment-cache DIR``): entries are keyed by the address digest
+  (store + object name + size), written tmp-file → atomic rename, carry a
+  sha256 sidecar recorded at fetch time, and are VERIFIED on every hit —
+  a flipped byte in a cached entry is detected, booked
+  (``kta_segstore_fallback_total{reason="cache-poisoned"}``), evicted,
+  and re-fetched; it is never silently served.  The cache is a
+  size-bounded LRU (hits refresh mtime; inserts evict oldest-first past
+  ``max_bytes``).
+
+Wire shape (path-style S3): ``GET {base}/?list-type=2&prefix=P`` returns
+a ListBucketResult XML of Key/Size/ETag rows; ``GET {base}/{key}`` with an
+optional ``Range: bytes=a-b`` header returns 200/206.  Any S3-compatible
+endpoint serves this; ``tools/objstore_serve.py`` is the local
+implementation the tests and benchmarks run against.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import io
+import json
+import os
+import re
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+from xml.etree import ElementTree
+
+from kafka_topic_analyzer_tpu.config import SegmentFetchConfig
+from kafka_topic_analyzer_tpu.io.retry import Backoff, PartitionRetryBudget
+from kafka_topic_analyzer_tpu.obs import events as obs_events
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+
+
+class ObjectStoreError(IOError):
+    """A remote-store operation that failed deterministically (bad spec,
+    missing object, exhausted retry budget).  ``IOError`` so the CLI's
+    environment-failure path reports one clean line, not a traceback."""
+
+
+class SegmentFetchUnavailable(ObjectStoreError):
+    """A chunk fetch that exhausted its transport retry budget.  Carries
+    the partition so the segment source can mark exactly it degraded
+    (the PR-1 graceful-degradation contract) and keep scanning the rest."""
+
+    def __init__(self, message: str, partition: "Optional[int]" = None):
+        super().__init__(message)
+        self.partition = partition
+
+
+class _Transient(Exception):
+    """Internal marker for a retryable failure (5xx, truncated body,
+    ETag/MD5 disagreement): never escapes ``RetryingHttp.get``."""
+
+
+def parse_object_store_spec(spec: str) -> "Tuple[bool, str, int, str]":
+    """``(tls, host, port, base_path)`` for a remote store spec.
+
+    ``http(s)://host[:port]/base`` addresses any S3-compatible endpoint
+    path-style; ``s3://bucket[/prefix]`` is sugar for path-style access
+    through the endpoint in ``KTA_S3_ENDPOINT`` (default
+    ``https://s3.amazonaws.com`` — unauthenticated GETs, i.e. public or
+    proxy-fronted buckets; signed access belongs to a fronting proxy)."""
+    m = re.match(r"^(https?)://([^/:]+)(?::(\d+))?(/.*)?$", spec)
+    if m:
+        tls = m.group(1) == "https"
+        host = m.group(2)
+        port = int(m.group(3)) if m.group(3) else (443 if tls else 80)
+        base = (m.group(4) or "").rstrip("/")
+        return tls, host, port, base
+    m = re.match(r"^s3://([^/]+)(/.*)?$", spec)
+    if m:
+        endpoint = os.environ.get("KTA_S3_ENDPOINT", "https://s3.amazonaws.com")
+        tls, host, port, base = parse_object_store_spec(endpoint)
+        return tls, host, port, f"{base}/{m.group(1)}{(m.group(2) or '').rstrip('/')}"
+    raise ValueError(
+        f"bad object store spec {spec!r}: expected http(s)://host[:port]/bucket"
+        "[/prefix] or s3://bucket[/prefix]"
+    )
+
+
+class RetryingHttp:
+    """The one place remote-tier bytes cross a socket (lint rule 11).
+
+    Connections are per-thread (the read-ahead pool fetches concurrently)
+    and evicted on any failure so a retry reconnects fresh.  ``get`` is
+    the public surface: every attempt is paced by the shared `Backoff`
+    schedule, every retry booked on ``kta_segstore_retries_total``, and
+    per-partition failure streaks run through the `PartitionRetryBudget`
+    so the degraded transition matches the live wire scan's semantics.
+    """
+
+    def __init__(self, spec: str, fetch: SegmentFetchConfig):
+        self.spec = spec
+        self.tls, self.host, self.port, self.base = parse_object_store_spec(spec)
+        # Path-style S3 splits the base into BUCKET (the LIST endpoint —
+        # /bucket/?list-type=2) and KEY PREFIX (folded into the prefix=
+        # parameter and every object key): a /bucket/some/prefix spec
+        # must never issue GET /bucket/some/prefix/?list-type=2, which
+        # is an object GET, not a bucket LIST.
+        parts = [p for p in self.base.split("/") if p]
+        self.bucket_path = f"/{parts[0]}" if parts else ""
+        self.key_prefix = "/".join(parts[1:])
+        if self.key_prefix:
+            self.key_prefix += "/"
+        self.timeout_s = fetch.timeout_s
+        self.backoff = Backoff(fetch.retry)
+        self.budget = PartitionRetryBudget(fetch.retry.retry_budget)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def url_of(self, path: str) -> str:
+        """Absolute URL of a request path, for error messages/logs."""
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.host}:{self.port}{path}"
+
+    # -- raw request (the only socket touch) ---------------------------------
+
+    def _connection(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            cls = (
+                http.client.HTTPSConnection if self.tls
+                else http.client.HTTPConnection
+            )
+            conn = cls(self.host, self.port, timeout=self.timeout_s)
+            self._local.conn = conn
+        return conn
+
+    def _evict_connection(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._local.conn = None
+
+    def _one_request(
+        self, path: str, rng: "Optional[Tuple[int, int]]"
+    ) -> "Tuple[int, bytes, Dict[str, str]]":
+        """One GET on this thread's connection: (status, body, headers).
+        Raises OSError/http.client exceptions on transport failure."""
+        headers = {}
+        if rng is not None:
+            lo, hi = rng
+            headers["Range"] = (
+                f"bytes=-{hi}" if lo is None else f"bytes={lo}-{hi}"
+            )
+        conn = self._connection()
+        conn.request("GET", path, headers=headers)
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, body, {k.lower(): v for k, v in resp.getheaders()}
+
+    # -- the retry-budget wrapper --------------------------------------------
+
+    def get(
+        self,
+        path: str,
+        rng: "Optional[Tuple[Optional[int], int]]" = None,
+        kind: str = "body",
+        partition: "Optional[int]" = None,
+        expect: "Optional[int]" = None,
+    ) -> bytes:
+        """GET with retry/budget/integrity.  ``rng`` is an inclusive byte
+        range ((None, n) = suffix range, S3 semantics); ``expect`` the
+        exact body length required (a short read is a transient truncated
+        stream, like the wire client's).  ``partition`` routes failure
+        streaks through the shared budget: exhaustion raises
+        `SegmentFetchUnavailable` (the caller degrades the partition);
+        catalog-time operations with no partition fail after the same
+        number of attempts."""
+        if partition is not None and partition in self.budget.degraded:
+            raise SegmentFetchUnavailable(
+                f"{self.url_of(path)}: partition {partition} "
+                f"already degraded ({self.budget.degraded[partition]})",
+                partition=partition,
+            )
+        attempt = 0
+        while True:
+            try:
+                try:
+                    status, body, headers = self._one_request(path, rng)
+                except (OSError, http.client.HTTPException) as e:
+                    self._evict_connection()
+                    raise _Transient(
+                        f"{type(e).__name__}: {e}"
+                    ) from e
+                if status in (500, 502, 503, 504):
+                    raise _Transient(f"HTTP {status}")
+                if status not in (200, 206):
+                    raise ObjectStoreError(
+                        f"object store GET {self.url_of(path)} failed: "
+                        f"HTTP {status}"
+                    )
+                if expect is not None and len(body) != expect:
+                    self._evict_connection()
+                    raise _Transient(
+                        f"truncated body ({len(body)} of {expect} bytes)"
+                    )
+                if status == 200 and rng is None:
+                    # Whole-object GET: S3 ETags for simple objects are the
+                    # body MD5 — a mismatch is by definition damage in
+                    # flight (or a lying server) and retries as transient.
+                    etag = headers.get("etag", "").strip('"')
+                    if re.fullmatch(r"[0-9a-f]{32}", etag) and (
+                        hashlib.md5(body).hexdigest() != etag
+                    ):
+                        raise _Transient("body MD5 does not match ETag")
+                obs_metrics.SEGSTORE_GETS.labels(kind=kind).inc()
+                obs_metrics.SEGSTORE_BYTES.inc(len(body))
+                if partition is not None:
+                    with self._lock:
+                        self.budget.record_success(partition)
+                return body
+            except _Transient as e:
+                attempt += 1
+                obs_metrics.SEGSTORE_RETRIES.inc()
+                if partition is not None:
+                    with self._lock:
+                        self.budget.record_failure(partition, str(e))
+                        exhausted = partition in self.budget.degraded
+                    if exhausted:
+                        raise SegmentFetchUnavailable(
+                            f"{self.url_of(path)}: "
+                            f"{self.budget.degraded[partition]}",
+                            partition=partition,
+                        ) from e
+                elif attempt >= self.budget.budget:
+                    raise ObjectStoreError(
+                        f"object store GET {self.url_of(path)} failed "
+                        f"after {attempt} attempts (last: {e})"
+                    ) from e
+                self.backoff.sleep_for(attempt)
+
+    def list_objects(self, prefix: str) -> "List[Tuple[str, int]]":
+        """LIST (name, size) under ``prefix`` — ListObjectsV2-shaped:
+        ``{bucket}/?list-type=2&prefix={key_prefix}{prefix}`` returning
+        ListBucketResult XML.  Keys come back as full bucket keys; the
+        basename is the store-relative name, so flat and prefixed
+        layouts enumerate identically."""
+        from urllib.parse import quote
+
+        body = self.get(
+            f"{self.bucket_path}/?list-type=2"
+            f"&prefix={quote(self.key_prefix + prefix)}",
+            kind="list",
+        )
+        try:
+            root = ElementTree.parse(io.BytesIO(body)).getroot()
+        except ElementTree.ParseError as e:
+            raise ObjectStoreError(
+                f"object store LIST {self.spec} returned unparseable XML: {e}"
+            ) from e
+        # S3 proper namespaces the document; local servers may not.
+        ns = ""
+        if root.tag.startswith("{"):
+            ns = root.tag[: root.tag.index("}") + 1]
+        out = []
+        for c in root.iter(f"{ns}Contents"):
+            key = c.findtext(f"{ns}Key") or ""
+            size = int(c.findtext(f"{ns}Size") or 0)
+            out.append((key.rsplit("/", 1)[-1], size))
+        return out
+
+    def object_path(self, name: str) -> str:
+        from urllib.parse import quote
+
+        return f"{self.bucket_path}/{quote(self.key_prefix + name)}"
+
+
+def _book_fallback(reason: str) -> None:
+    """Every fallback-to-direct-fetch path books its reason — a cache
+    bypass is never silent (lint rule 11; same discipline as the fused
+    and compaction fallbacks)."""
+    obs_metrics.SEGSTORE_FALLBACK.labels(reason=reason).inc()
+
+
+class SegmentCache:
+    """Content-verified local chunk cache with LRU size bounding.
+
+    Entry layout: ``DIR/{digest}.seg`` (the raw chunk bytes) +
+    ``DIR/{digest}.json`` sidecar ``{name, size, sha256}``, where digest =
+    sha256 of the store spec + object name + size — two stores (or a
+    re-dumped object of a different size) can never collide.  Writes land
+    tmp-file → ``os.replace`` so a crashed writer leaves no partial entry;
+    the sidecar lands LAST, so an entry is visible only once both halves
+    are durable.  Hits re-hash the bytes against the sidecar's sha256:
+    the cache serves exactly what was fetched and verified, or nothing.
+    """
+
+    def __init__(self, directory: str, max_bytes: int, store_key: str):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.max_bytes = max_bytes
+        self.store_key = store_key
+        self._lock = threading.Lock()
+        #: Running resident-bytes estimate so inserts are O(1): the full
+        #: directory sweep (and the estimate's re-sync) only runs when
+        #: this crosses the bound — a year-scale fill must not stat the
+        #: whole cache on every insert.
+        self._total = sum(
+            st.st_size
+            for st in (
+                self._stat(os.path.join(directory, f))
+                for f in os.listdir(directory)
+                if f.endswith(".seg")
+            )
+            if st is not None
+        )
+
+    @staticmethod
+    def _stat(path: str):
+        try:
+            return os.stat(path)
+        except OSError:
+            return None
+
+    def _digest(self, name: str, size: int) -> str:
+        return hashlib.sha256(
+            f"{self.store_key}\n{name}\n{size}".encode()
+        ).hexdigest()
+
+    def _paths(self, digest: str) -> "Tuple[str, str]":
+        return (
+            os.path.join(self.directory, f"{digest}.seg"),
+            os.path.join(self.directory, f"{digest}.json"),
+        )
+
+    def get(self, name: str, size: int) -> "Optional[bytes]":
+        """Verified bytes for (name, size), or None (miss / poisoned —
+        a poisoned entry is evicted and booked, the caller re-fetches).
+
+        LOCK-FREE on the read+hash path: entries are immutable once
+        renamed in (os.replace is atomic, the sidecar lands last), and a
+        concurrent eviction's unlink leaves an already-open file readable
+        (worst case: this read becomes a miss).  Holding the cache lock
+        here would serialize every stream's verification hashing — the
+        warm re-audit's whole cost — behind one core."""
+        seg, meta = self._paths(self._digest(name, size))
+        try:
+            with open(meta, "rb") as f:
+                sidecar = json.load(f)
+            with open(seg, "rb") as f:
+                data = f.read()
+        except (OSError, ValueError):
+            obs_metrics.SEGSTORE_CACHE_MISSES.inc()
+            return None
+        if hashlib.sha256(data).hexdigest() != sidecar.get("sha256"):
+            # A flipped byte at rest in the CACHE: never serve it —
+            # drop the entry, book the reason, fall back to a direct
+            # fetch (the store itself is re-verified on that path).
+            _book_fallback("cache-poisoned")
+            obs_events.emit(
+                "segment_cache_poisoned", name=name, entry=seg
+            )
+            with self._lock:
+                self._remove(seg, meta)
+            obs_metrics.SEGSTORE_CACHE_MISSES.inc()
+            return None
+        obs_metrics.SEGSTORE_CACHE_HITS.inc()
+        now = None  # touch: mtime = now marks the entry recently used
+        try:
+            os.utime(seg, now)
+        except OSError:
+            pass
+        return data
+
+    def evict(self, name: str, size: int) -> None:
+        """Drop one entry (a STALE hit: its bytes match their sidecar —
+        not rot — but no longer match what the store's catalog now
+        declares, e.g. the archive was re-dumped at the same size).  The
+        caller books the fallback reason and re-fetches."""
+        with self._lock:
+            self._remove(*self._paths(self._digest(name, size)))
+        obs_metrics.SEGSTORE_CACHE_EVICTIONS.inc()
+
+    def put(self, name: str, size: int, data: bytes) -> None:
+        """Insert one verified chunk.  The write itself runs UNLOCKED —
+        tmp names are per-thread and the double rename is atomic, so
+        concurrent writers of different chunks never serialize their
+        hashing/IO; only the LRU sweep takes the lock."""
+        digest = self._digest(name, size)
+        seg, meta = self._paths(digest)
+        try:
+            tmp = f"{seg}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, seg)
+            mtmp = f"{meta}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(mtmp, "w", encoding="utf-8") as f:
+                json.dump(
+                    {
+                        "name": name,
+                        "size": size,
+                        "sha256": hashlib.sha256(data).hexdigest(),
+                    },
+                    f,
+                )
+            os.replace(mtmp, meta)
+        except OSError:
+            # An unwritable cache must not fail the scan — the chunk
+            # was already fetched and verified; book the bypass.
+            _book_fallback("cache-io-error")
+            return
+        with self._lock:
+            self._total += len(data)
+            if self._total > self.max_bytes:
+                self._evict_to_bound(keep=digest)
+
+    def _remove(self, seg: str, meta: str) -> None:
+        """Unlink one entry, keeping the resident-bytes estimate in step
+        (callers hold the lock)."""
+        st = self._stat(seg)
+        if st is not None:
+            self._total -= st.st_size
+        for path in (seg, meta):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _evict_to_bound(self, keep: "Optional[str]" = None) -> None:
+        """Drop least-recently-used entries until total bytes fit the
+        bound (one full sweep, which also re-syncs the running estimate
+        against reality — re-puts of an existing digest and external
+        deletions drift it).  The just-inserted entry (``keep``)
+        survives even when it alone exceeds the bound — a cache that
+        immediately discards what it just fetched would thrash forever."""
+        entries = []
+        total = 0
+        for fname in os.listdir(self.directory):
+            if not fname.endswith(".seg"):
+                continue
+            st = self._stat(os.path.join(self.directory, fname))
+            if st is None:
+                continue
+            entries.append((st.st_mtime, st.st_size, fname[: -len(".seg")]))
+            total += st.st_size
+        entries.sort()
+        self._total = total
+        for _, size, digest in entries:
+            if self._total <= self.max_bytes:
+                break
+            if digest == keep:
+                continue
+            self._remove(*self._paths(digest))
+            obs_metrics.SEGSTORE_CACHE_EVICTIONS.inc()

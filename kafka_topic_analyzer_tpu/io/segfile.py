@@ -31,11 +31,13 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Dict, Iterator, List, Optional, Tuple
+import threading
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from kafka_topic_analyzer_tpu.io.kafka_codec import CorruptFrameError
+from kafka_topic_analyzer_tpu.io.objstore import SegmentFetchUnavailable
 from kafka_topic_analyzer_tpu.io.source import RecordSource
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.records import RecordBatch
@@ -95,6 +97,74 @@ def segment_path(directory: str, topic: str, partition: int) -> str:
     return os.path.join(directory, f"{topic}-{partition}.ktaseg")
 
 
+def parse_segment_header(
+    header: bytes, path: str
+) -> "Tuple[int, int, int, int]":
+    """Validate + decode one .ktaseg header → (partition, flags,
+    start_offset, count).  ONE implementation for every byte source —
+    local files, remotely fetched chunk bodies, and the remote catalog's
+    ranged header probes — so classification can never diverge by tier."""
+    if len(header) != HEADER_SIZE:
+        raise TruncatedSegmentError(
+            f"{path}: truncated header ({len(header)} of "
+            f"{HEADER_SIZE} bytes)",
+            path=path,
+            span=(0, len(header)),
+        )
+    magic, partition, flags, start_offset, count = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise MalformedSegmentError(
+            f"{path}: bad magic {magic!r}", path=path, span=(0, 8)
+        )
+    if count < 0 or partition < 0:
+        raise MalformedSegmentError(
+            f"{path}: impossible header (partition {partition}, "
+            f"count {count})",
+            path=path,
+            partition=partition,
+            span=(0, HEADER_SIZE),
+            num_records=max(count, 0),
+        )
+    return partition, flags, start_offset, count
+
+
+def segment_column_layout(
+    count: int, flags: int
+) -> "Tuple[Dict[str, Tuple[int, np.dtype]], int]":
+    """(column name -> (byte offset, dtype), expected total size) for a
+    chunk with the given header — the layout every reader shares."""
+    col_offsets: Dict[str, Tuple[int, np.dtype]] = {}
+    off = HEADER_SIZE
+    cols = list(COLUMNS) + (
+        [("offsets", np.int64)] if flags & FLAG_OFFSETS else []
+    )
+    for name, dtype in cols:
+        col_offsets[name] = (off, np.dtype(dtype))
+        off += count * np.dtype(dtype).itemsize
+    return col_offsets, off
+
+
+def check_segment_size(
+    actual: int, expected: int, path: str, partition: int, count: int
+) -> None:
+    """Classify a chunk whose byte length disagrees with its header's
+    column layout: short = truncated (interrupted dump, partial copy or
+    fetch), long = malformed (trailing garbage)."""
+    if actual != expected:
+        kind = (
+            TruncatedSegmentError if actual < expected
+            else MalformedSegmentError
+        )
+        raise kind(
+            f"{path}: size {actual} != expected {expected} for "
+            f"{count} records",
+            path=path,
+            partition=partition,
+            span=(0, actual),
+            num_records=count,
+        )
+
+
 def write_segment(
     path: str,
     partition: int,
@@ -152,52 +222,17 @@ class SegmentFile:
         self.path = path
         with open(path, "rb") as f:
             header = f.read(HEADER_SIZE)
-        if len(header) != HEADER_SIZE:
-            raise TruncatedSegmentError(
-                f"{path}: truncated header ({len(header)} of "
-                f"{HEADER_SIZE} bytes)",
-                path=path,
-                span=(0, len(header)),
-            )
-        magic, partition, flags, start_offset, count = _HEADER.unpack(header)
-        if magic != MAGIC:
-            raise MalformedSegmentError(
-                f"{path}: bad magic {magic!r}", path=path, span=(0, 8)
-            )
-        if count < 0 or partition < 0:
-            raise MalformedSegmentError(
-                f"{path}: impossible header (partition {partition}, "
-                f"count {count})",
-                path=path,
-                partition=partition,
-                span=(0, HEADER_SIZE),
-                num_records=max(count, 0),
-            )
+        partition, flags, start_offset, count = parse_segment_header(
+            header, path
+        )
         self.partition = partition
         self.start_offset = start_offset
         self.count = count
         self.has_offsets = bool(flags & FLAG_OFFSETS)
-        self._col_offsets: Dict[str, Tuple[int, np.dtype]] = {}
-        off = HEADER_SIZE
-        cols = list(COLUMNS) + ([("offsets", np.int64)] if self.has_offsets else [])
-        for name, dtype in cols:
-            self._col_offsets[name] = (off, np.dtype(dtype))
-            off += count * np.dtype(dtype).itemsize
-        expected = off
-        actual = os.path.getsize(path)
-        if actual != expected:
-            kind = (
-                TruncatedSegmentError if actual < expected
-                else MalformedSegmentError
-            )
-            raise kind(
-                f"{path}: size {actual} != expected {expected} for "
-                f"{count} records",
-                path=path,
-                partition=partition,
-                span=(0, actual),
-                num_records=count,
-            )
+        self._col_offsets, expected = segment_column_layout(count, flags)
+        check_segment_size(
+            os.path.getsize(path), expected, path, partition, count
+        )
         self._mm = np.memmap(path, dtype=np.uint8, mode="r")
         #: Lazily-built constants for the zero-copy read path: every batch
         #: of this file shares one partition/valid array via prefix views.
@@ -260,6 +295,183 @@ class SegmentFile:
         if self.has_offsets:
             batch.offsets = self.column("offsets", lo, hi)
         return batch.copy() if copy else batch
+
+
+class RemoteSegmentFile(SegmentFile):
+    """One object-store chunk, open for reading (DESIGN.md §21).
+
+    The catalog opens it from a ranged HEADER probe alone — validation
+    (header decode, size-vs-layout check against the LIST size, overlap
+    ordering) never downloads a chunk body.  The body arrives lazily, the
+    first time a column is touched (``ensure_body``): cache → verified
+    fetch → ``np.frombuffer``, after which every inherited read path —
+    ``column`` views, ``read_batch`` zero-copy semantics, the fused
+    ``append_columns`` feed — works byte-for-byte like the memory-mapped
+    local file, because ``_mm`` is the same uint8 array shape over the
+    same bytes.  ``release()`` drops the body reference once the stream
+    has consumed the chunk (outstanding batch views keep the buffer alive
+    through numpy's base refcount), bounding a stream's resident memory
+    to readahead + 1 chunks.
+
+    Acquisition failures are CACHED on the file: a read-ahead pool thread
+    that hit a deterministic failure (classified corruption, exhausted
+    retry budget) must hand the consumer exactly that failure, not
+    trigger a second fetch cycle.
+    """
+
+    def __init__(
+        self,
+        fetch_body: "Callable[[Callable[[bytes], None]], bytes]",
+        name: str,
+        location: str,
+        size: int,
+        header: bytes,
+        end_offset: "Optional[int]" = None,
+    ):
+        # Deliberately no super().__init__: there is no local path to map.
+        self.path = f"{location.rstrip('/')}/{name}"
+        self.name = name
+        partition, flags, start_offset, count = parse_segment_header(
+            header, self.path
+        )
+        self.partition = partition
+        self.start_offset = start_offset
+        self.count = count
+        self.has_offsets = bool(flags & FLAG_OFFSETS)
+        self._header = header
+        self._col_offsets, expected = segment_column_layout(count, flags)
+        check_segment_size(size, expected, self.path, partition, count)
+        self._expected_size = expected
+        self._fetch_body = fetch_body
+        self._end = end_offset
+        self._lock = threading.Lock()
+        self._data: "Optional[np.ndarray]" = None
+        self._failure: "Optional[BaseException]" = None
+        self._const_partition = None
+        self._const_valid = None
+
+    @property
+    def end_offset(self) -> int:
+        """Offset-exact for gappy chunks WITHOUT a body fetch: the store
+        probed the trailing offsets entry (suffix range) at open time."""
+        if self._end is not None:
+            return self._end
+        return self.start_offset + self.count
+
+    @property
+    def _mm(self) -> np.ndarray:
+        return self.ensure_body()
+
+    def ensure_body(self) -> np.ndarray:
+        """The chunk's bytes, fetching (cache → store, verified) on first
+        touch.  Thread-safe: a read-ahead pool thread and the consuming
+        stream serialize on the per-chunk lock, so the consumer blocks on
+        an in-flight prefetch instead of fetching twice."""
+        with self._lock:
+            if self._failure is not None:
+                raise self._failure
+            if self._data is None:
+                try:
+                    raw = self._fetch_body(self._validate_body)
+                except (CorruptSegmentError, SegmentFetchUnavailable) as e:
+                    self._failure = e  # deterministic: replay, don't refetch
+                    raise
+                self._data = np.frombuffer(raw, dtype=np.uint8)
+            return self._data
+
+    def _validate_body(self, raw: bytes) -> None:
+        """Classify FETCHED bytes with the exact local-reader taxonomy:
+        short body = truncated, header bytes that no longer decode (or
+        disagree with what the catalog validated) = malformed.  The store
+        disambiguates in-flight vs at-rest damage around this (one
+        re-fetch — io/kafka_wire.py's rule)."""
+        if len(raw) < HEADER_SIZE:
+            raise TruncatedSegmentError(
+                f"{self.path}: fetched body holds {len(raw)} of "
+                f"{HEADER_SIZE} header bytes",
+                path=self.path,
+                partition=self.partition,
+                span=(0, len(raw)),
+            )
+        header = bytes(raw[:HEADER_SIZE])
+        parse_segment_header(header, self.path)
+        if header != self._header:
+            raise MalformedSegmentError(
+                f"{self.path}: fetched header disagrees with the "
+                "catalog-validated header — object changed or damaged "
+                "since the catalog opened it",
+                path=self.path,
+                partition=self.partition,
+                span=(0, HEADER_SIZE),
+                num_records=self.count,
+            )
+        check_segment_size(
+            len(raw), self._expected_size, self.path, self.partition,
+            self.count,
+        )
+
+    def release(self) -> None:
+        """Drop the body reference (batch views already handed out keep
+        the underlying buffer alive; new touches re-fetch via the cache)."""
+        with self._lock:
+            self._data = None
+
+
+class _ChunkReadahead:
+    """Bounded per-stream read-ahead pool (``--segment-readahead N``).
+
+    While the consuming stream runs chunk i through decode→pack, up to N
+    further chunks of the SAME stream are fetching on pool threads
+    (``RemoteSegmentFile.ensure_body`` — cache-aware, failure-caching),
+    so per-GET wire latency overlaps compute instead of serializing with
+    it.  Pool threads never surface errors: a failed prefetch parks the
+    failure on its chunk, and the consumer re-raises it at the chunk's
+    position in the stream — ordering, degradation, and corruption
+    semantics are exactly the synchronous path's.
+    """
+
+    def __init__(self, depth: int):
+        import concurrent.futures
+
+        self.depth = depth
+        self._ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=depth, thread_name_prefix="kta-seg-readahead"
+        )
+        self._submitted: "set[int]" = set()
+        self._consumed: "set[int]" = set()
+
+    @staticmethod
+    def _prefetch(seg: "RemoteSegmentFile") -> None:
+        try:
+            seg.ensure_body()
+        except Exception:
+            pass  # parked on the segment; the consumer re-raises in order
+
+    def schedule(self, plan, i: int, degraded: "Dict[int, str]") -> None:
+        """Keep chunks [i, i+N] of the plan in flight (skipping local
+        chunks and partitions already degraded this scan)."""
+        for j in range(i, min(i + self.depth + 1, len(plan))):
+            if j in self._submitted:
+                continue
+            self._submitted.add(j)
+            p, seg, _first = plan[j]
+            if p in degraded or not isinstance(seg, RemoteSegmentFile):
+                self._consumed.add(j)
+                continue
+            obs_metrics.SEGSTORE_READAHEAD.inc(1)
+            self._ex.submit(self._prefetch, seg)
+
+    def done(self, i: int) -> None:
+        """The consumer reached chunk i: it no longer counts as ahead."""
+        if i in self._submitted and i not in self._consumed:
+            self._consumed.add(i)
+            obs_metrics.SEGSTORE_READAHEAD.inc(-1)
+
+    def close(self) -> None:
+        for j in self._submitted - self._consumed:
+            self._consumed.add(j)
+            obs_metrics.SEGSTORE_READAHEAD.inc(-1)
+        self._ex.shutdown(wait=False, cancel_futures=True)
 
 
 class SegmentDumpWriter:
@@ -419,18 +631,30 @@ class SegmentFileSource(RecordSource):
     partition's records travel one worker's stream in offset order).
     """
 
-    def __init__(self, store, topic: str):
+    def __init__(self, store, topic: str, fetch=None):
+        from kafka_topic_analyzer_tpu.config import SegmentFetchConfig
         from kafka_topic_analyzer_tpu.io.segstore import (
             SegmentCatalog,
             open_segment_store,
         )
 
+        fetch = fetch if fetch is not None else SegmentFetchConfig()
         if isinstance(store, str):
-            store = open_segment_store(store)
+            store = open_segment_store(store, fetch=fetch)
         self.store = store
         self.topic = topic
         self.catalog = SegmentCatalog(store, topic)
         self.segments: Dict[int, List[SegmentFile]] = self.catalog.segments
+        #: Per-stream read-ahead depth (0 = synchronous-at-first-touch;
+        #: resolves to 0 for local stores, where there is nothing to hide).
+        self.readahead = fetch.resolve_readahead(
+            bool(getattr(store, "is_remote", False))
+        )
+        #: partition -> reason, for partitions dropped mid-scan after their
+        #: chunk fetches exhausted the transport retry budget (the PR-1
+        #: degraded surface, shared across parallel-ingest worker streams).
+        self._degraded: Dict[int, str] = {}
+        self._degraded_lock = threading.Lock()
         if not self.segments:
             raise SystemExit(
                 f"no {topic}-*.ktaseg files in {store.describe()!r}"
@@ -438,6 +662,17 @@ class SegmentFileSource(RecordSource):
 
     def partitions(self) -> List[int]:
         return sorted(self.segments)
+
+    def degraded_partitions(self) -> Dict[int, str]:
+        return dict(self._degraded)
+
+    def _note_degraded(self, partition: int, reason: str) -> None:
+        """Drop ``partition`` from the rest of the scan (its remaining
+        chunks are skipped) and record why — the engine reports it and
+        exits EXIT_DEGRADED, exactly like a wire partition past its
+        budget.  Lock-guarded: worker streams share this map."""
+        with self._degraded_lock:
+            self._degraded.setdefault(partition, reason)
 
     def watermarks(self) -> Tuple[Dict[int, int], Dict[int, int]]:
         return self.catalog.watermarks()
@@ -463,7 +698,12 @@ class SegmentFileSource(RecordSource):
     ) -> Iterator[RecordBatch]:
         parts = sorted(partitions) if partitions is not None else self.partitions()
         # Sequential per-partition chunks: fastest IO pattern, and the order
-        # contract only requires per-partition offset order.
+        # contract only requires per-partition offset order.  The plan is
+        # materialized up front so the read-ahead pool can see (and start
+        # fetching) the chunks BEHIND the one the stream is consuming.
+        # (Resume into a gappy remote chunk touches its offsets column —
+        # one synchronous body fetch, cache-served on a re-resume.)
+        plan: "List[Tuple[int, SegmentFile, int]]" = []
         for p in parts:
             resume = start_at.get(p) if start_at else None
             for seg in self.segments[p]:
@@ -476,9 +716,46 @@ class SegmentFileSource(RecordSource):
                         first = int(np.searchsorted(offs, resume))
                     else:
                         first = min(max(resume - seg.start_offset, 0), seg.count)
+                plan.append((p, seg, first))
+        pool = None
+        if self.readahead > 0 and any(
+            isinstance(seg, RemoteSegmentFile) for _, seg, _ in plan
+        ):
+            pool = _ChunkReadahead(self.readahead)
+        try:
+            for i, (p, seg, first) in enumerate(plan):
+                if p in self._degraded:
+                    if pool is not None:
+                        pool.done(i)
+                    if isinstance(seg, RemoteSegmentFile):
+                        # A chunk the pool prefetched before its partition
+                        # degraded must not stay pinned in memory for the
+                        # rest of the stream.
+                        seg.release()
+                    continue  # budget exhausted earlier in this stream
+                if pool is not None:
+                    pool.schedule(plan, i, self._degraded)
+                try:
+                    if isinstance(seg, RemoteSegmentFile):
+                        # Materialize the body HERE, before any records are
+                        # booked or appended: a chunk either enters the
+                        # scan whole or degrades its partition cleanly.
+                        seg.ensure_body()
+                except SegmentFetchUnavailable as e:
+                    # The transport budget for this partition ran out:
+                    # drop it from the scan and keep going — the engine
+                    # reports the degraded set (graceful degradation,
+                    # io/retry.py), exactly like a dead wire partition.
+                    self._note_degraded(p, str(e))
+                    if pool is not None:
+                        pool.done(i)
+                    seg.release()
+                    continue
+                if pool is not None:
+                    pool.done(i)
                 if sink is not None:
-                    # Fused cold path: the whole chunk's memmap views in
-                    # one native append (file page → packed row; the sink
+                    # Fused cold path: the whole chunk's column views in
+                    # one native append (chunk bytes → packed row; the sink
                     # cuts batch_size rows itself).  ts_mode=1 is the
                     # reader's ``ts_ms // 1000`` rule.  Batches book at
                     # the batch_size-cut count the chained loop below
@@ -508,12 +785,27 @@ class SegmentFileSource(RecordSource):
                         ),
                     )
                     yield from sink.take_completed()
-                    continue
-                for lo in range(first, seg.count, batch_size):
-                    hi = min(lo + batch_size, seg.count)
-                    obs_metrics.SEGMENT_RECORDS.inc(hi - lo)
-                    obs_metrics.SEGMENT_BATCHES.inc()
-                    yield seg.read_batch(lo, hi)
+                else:
+                    for lo in range(first, seg.count, batch_size):
+                        hi = min(lo + batch_size, seg.count)
+                        obs_metrics.SEGMENT_RECORDS.inc(hi - lo)
+                        obs_metrics.SEGMENT_BATCHES.inc()
+                        yield seg.read_batch(lo, hi)
+                if isinstance(seg, RemoteSegmentFile):
+                    # Consumed: drop the stream's body reference (views
+                    # already yielded keep the buffer alive; memory stays
+                    # bounded at readahead + 1 chunks per stream).
+                    seg.release()
+        finally:
+            if pool is not None:
+                pool.close()
+                # Sweep bodies the pool prefetched but the consumer never
+                # reached (early generator close, errors): best-effort —
+                # a fetch still racing in a pool thread may repopulate
+                # its one chunk after this, bounded by the pool depth.
+                for _, seg, _ in plan:
+                    if isinstance(seg, RemoteSegmentFile):
+                        seg.release()
         if sink is not None:
             sink.flush()
             yield from sink.take_completed()

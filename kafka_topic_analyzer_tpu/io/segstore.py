@@ -7,10 +7,14 @@ and the scan needs exactly two operations against it: enumerate a topic's
 chunks and open one for reading.  This module is that seam:
 
 - `SegmentStore` — the two-method fetch interface (`list_refs`, `open`).
-  `DirectorySegmentStore` is the local implementation; an object-store
-  client plugs in here without touching the reader, the catalog, or the
-  engine (`open_segment_store` is the factory that will learn its URL
-  schemes).
+  `DirectorySegmentStore` is the local tier (memory-mapped files);
+  `ObjectSegmentStore` is the remote tier (DESIGN.md §21): an S3-shaped
+  HTTP client (LIST + ranged GET via io/objstore.py's retry-budget
+  transport) whose catalog validation runs off ranged HEADER probes —
+  never a chunk body — and whose chunk bodies arrive lazily through the
+  read-ahead pool and the sha256-verified local cache.
+  `open_segment_store` is the factory: plain paths and ``file://`` are
+  local, ``http(s)://`` / ``s3://`` are remote.
 - `SegmentCatalog` — a validated view of one topic's chunks: header↔name
   consistency, per-partition chunk ordering by start offset, overlap
   rejection, and the per-partition record counts the parallel cold path
@@ -29,6 +33,7 @@ import abc
 import dataclasses
 import os
 import re
+import struct
 from typing import TYPE_CHECKING, Dict, List, Tuple
 
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
@@ -104,17 +109,191 @@ class DirectorySegmentStore(SegmentStore):
         return self.directory
 
 
-def open_segment_store(spec: str) -> SegmentStore:
-    """Store factory for ``--segment-dir``: a plain path is a local
-    directory; a ``scheme://`` spec is reserved for remote stores (object
-    storage) and rejected with the seam named, so the error reads as
-    "not yet" rather than "never"."""
+class ObjectSegmentStore(SegmentStore):
+    """The remote tier: chunks in an S3-shaped object store, addressed by
+    ``http(s)://host[:port]/bucket[/prefix]`` or ``s3://bucket[/prefix]``.
+
+    Enumeration is one ListObjectsV2-shaped request; opening a ref costs
+    a ranged HEADER probe (plus, for gappy chunks, an 8-byte suffix probe
+    for the offset-exact end watermark) — catalog validation never
+    downloads a chunk body.  Bodies arrive through `fetch_chunk`:
+    sha256-verified local cache first (``--segment-cache``), then a
+    budget-retried GET whose bytes are classified with the local reader's
+    exact corruption taxonomy; a classification failure is re-fetched
+    ONCE to rule out an in-flight flip (io/kafka_wire.py's rule) before
+    it counts as at-rest corruption.
+    """
+
+    #: The source resolves ``--segment-readahead auto`` against this.
+    is_remote = True
+
+    def __init__(self, spec: str, fetch=None):
+        from kafka_topic_analyzer_tpu.config import SegmentFetchConfig
+        from kafka_topic_analyzer_tpu.io.objstore import (
+            RetryingHttp,
+            SegmentCache,
+        )
+
+        fetch = fetch if fetch is not None else SegmentFetchConfig()
+        self.spec = spec.rstrip("/")
+        self.transport = RetryingHttp(self.spec, fetch)
+        self.cache = (
+            SegmentCache(fetch.cache_dir, fetch.cache_max_bytes, self.spec)
+            if fetch.cache_dir
+            else None
+        )
+
+    def list_refs(self, topic: str) -> List[SegmentRef]:
+        pattern = topic_chunk_pattern(topic)
+        refs = []
+        for name, size in sorted(self.transport.list_objects(f"{topic}-")):
+            m = pattern.match(name)
+            if not m:
+                continue
+            refs.append(
+                SegmentRef(name=name, partition=int(m.group(1)), size=size)
+            )
+        return refs
+
+    def open(self, ref: SegmentRef) -> "SegmentFile":
+        from kafka_topic_analyzer_tpu.io.segfile import (
+            FLAG_OFFSETS,
+            HEADER_SIZE,
+            RemoteSegmentFile,
+            parse_segment_header,
+        )
+
+        path = self.transport.object_path(ref.name)
+        # Catalog probes deliberately carry NO partition: a store that is
+        # unreachable at SETUP time fails the scan cleanly (after the
+        # same attempt budget) rather than silently dropping partitions
+        # from the catalog — the degraded surface only covers partitions
+        # the scan actually admitted (body fetches, during batches()).
+        header = self.transport.get(
+            path,
+            rng=(0, HEADER_SIZE - 1),
+            kind="header",
+            expect=min(HEADER_SIZE, ref.size),
+        )
+        _p, flags, _start, count = parse_segment_header(
+            header, f"{self.spec}/{ref.name}"
+        )
+        end_offset = None
+        if flags & FLAG_OFFSETS and count > 0:
+            # Gappy chunk: the offset-exact end watermark is the LAST
+            # offsets entry — an 8-byte suffix probe, not a body download.
+            tail = self.transport.get(
+                path,
+                rng=(ref.size - 8, ref.size - 1),
+                kind="header",
+                expect=8,
+            )
+            end_offset = struct.unpack("<q", tail)[0] + 1
+
+        def fetch_body(validate):
+            return self.fetch_chunk(ref, validate)
+
+        return RemoteSegmentFile(
+            fetch_body, ref.name, self.spec, ref.size, header, end_offset
+        )
+
+    def open_all(self, refs: List[SegmentRef]) -> "List[SegmentFile]":
+        """Open many refs with their header probes in flight concurrently
+        (order-preserving).  An archived year is tens of thousands of
+        chunks; serial round-trips would put a wire RTT in front of every
+        one before the scan even starts."""
+        if len(refs) <= 1:
+            return [self.open(r) for r in refs]
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, len(refs)),
+            thread_name_prefix="kta-seg-catalog",
+        ) as ex:
+            return list(ex.map(self.open, refs))
+
+    def fetch_chunk(self, ref: SegmentRef, validate) -> bytes:
+        """One whole verified chunk body (RemoteSegmentFile.ensure_body's
+        acquisition path): cache hit (sha256-checked) → else a
+        budget-retried GET, classified by ``validate`` with one
+        disambiguating re-fetch, then written back to the cache."""
+        from kafka_topic_analyzer_tpu.io.segfile import CorruptSegmentError
+
+        if self.cache is not None:
+            data = self.cache.get(ref.name, ref.size)
+            if data is not None:
+                try:
+                    validate(data)
+                    return data
+                except CorruptSegmentError:
+                    # The entry matches its OWN sha256 sidecar (so it is
+                    # not rot) but no longer matches what the catalog
+                    # validated — the archive was re-dumped at the same
+                    # name and size.  A stale entry is a miss, never an
+                    # abort: evict, book, fetch fresh.
+                    from kafka_topic_analyzer_tpu.io.objstore import (
+                        _book_fallback,
+                    )
+
+                    self.cache.evict(ref.name, ref.size)
+                    _book_fallback("cache-stale")
+        path = self.transport.object_path(ref.name)
+        data = self.transport.get(
+            path, kind="body", partition=ref.partition, expect=ref.size
+        )
+        try:
+            validate(data)
+        except CorruptSegmentError:
+            # Structural classification failed.  The MD5/ETag check (when
+            # the server sends one) already retried in-flight damage, but
+            # not every endpoint ETags — ONE ranged re-fetch disambiguates:
+            # identical bytes fail identically (at-rest corruption, the
+            # classified error propagates); different bytes mean the first
+            # copy was damaged in flight.  Mirrors io/kafka_wire.py's
+            # one-re-fetch rule for suspect frames.
+            obs_metrics.CORRUPT_REFETCHES.inc()
+            data = self.transport.get(
+                path, kind="refetch", partition=ref.partition,
+                expect=ref.size,
+            )
+            validate(data)
+        if self.cache is not None:
+            self.cache.put(ref.name, ref.size, data)
+        return data
+
+    def describe(self) -> str:
+        return self.spec
+
+
+#: Schemes `open_segment_store` routes (a plain path means file://).
+SUPPORTED_STORE_SCHEMES = ("file", "http", "https", "s3")
+
+
+def open_segment_store(spec: str, fetch=None) -> SegmentStore:
+    """Store factory for ``--segment-dir``: a plain path or ``file://``
+    spec is a local directory; ``http(s)://host[:port]/bucket[/prefix]``
+    and ``s3://bucket[/prefix]`` open the remote tier (`ObjectSegmentStore`
+    — DESIGN.md §21).  ``fetch`` (config.SegmentFetchConfig) carries the
+    read-ahead/cache/retry knobs; unknown schemes are rejected with the
+    supported list and the plug-in seam named."""
     m = re.match(r"^([a-z][a-z0-9+.-]*)://", spec)
-    if m and m.group(1) != "file":
+    scheme = m.group(1) if m else None
+    if scheme in ("http", "https", "s3"):
+        return ObjectSegmentStore(spec, fetch=fetch)
+    if m and scheme != "file":
+        supported = ", ".join(
+            f"{s}://" for s in SUPPORTED_STORE_SCHEMES
+        )
         raise ValueError(
-            f"segment store scheme {m.group(1)!r} is not implemented yet "
-            "(io/segstore.py SegmentStore is the plug-in seam); today only "
-            "local directories are supported"
+            f"segment store scheme {scheme!r} is not supported "
+            f"(supported: a plain directory path, {supported}); "
+            "io/segstore.py SegmentStore is the plug-in seam for more"
+        )
+    if fetch is not None and fetch.cache_dir:
+        raise ValueError(
+            "--segment-cache only applies to remote segment stores "
+            "(http://, https://, s3:// specs) — a local directory IS "
+            "the cache"
         )
     path = spec[len("file://"):] if m else spec
     if not os.path.isdir(path):
@@ -140,8 +319,15 @@ class SegmentCatalog:
         self.segments: "Dict[int, List[SegmentFile]]" = {}
         self.num_files = 0
         self.total_bytes = 0
-        for ref in store.list_refs(topic):
-            seg = store.open(ref)
+        refs = store.list_refs(topic)
+        # Remote stores open refs concurrently (ObjectSegmentStore.open_all
+        # — a header round-trip per chunk must not serialize over an
+        # archived year's chunk count); order is preserved either way.
+        opener = getattr(store, "open_all", None)
+        segs = opener(refs) if opener is not None else [
+            store.open(r) for r in refs
+        ]
+        for ref, seg in zip(refs, segs):
             if seg.partition != ref.partition:
                 raise MalformedSegmentError(
                     f"{ref.name}: header partition {seg.partition} does "

@@ -123,6 +123,53 @@ SEGMENT_BATCHES = _REG.counter(
     "kta_segment_batches_total",
     "Batches cut from memory-mapped segment chunks")
 
+# -- remote segment tier (io/objstore.py + io/segstore.py) --------------------
+
+SEGSTORE_GETS = _REG.counter(
+    "kta_segstore_gets_total",
+    "Object-store GET requests the remote segment tier completed, by kind "
+    "(list = chunk enumeration, header = catalog header/end-offset range "
+    "probes, body = whole-chunk fetches, refetch = the one disambiguating "
+    "re-fetch after a classification failure)",
+    labelnames=("kind",))
+SEGSTORE_BYTES = _REG.counter(
+    "kta_segstore_bytes_fetched_total",
+    "Bytes fetched from object stores by the remote segment tier "
+    "(response bodies of completed GETs)")
+SEGSTORE_RETRIES = _REG.counter(
+    "kta_segstore_retries_total",
+    "Transient object-store request failures retried through the backoff "
+    "schedule (resets/timeouts/5xx/truncated or MD5-mismatched bodies)")
+SEGSTORE_READAHEAD = _REG.gauge(
+    "kta_segstore_readahead_occupancy",
+    "Remote chunks currently prefetched (or fetching) ahead of the "
+    "consuming ingest streams, summed over this process's per-stream "
+    "read-ahead pools (0..workers x --segment-readahead)",
+    # Each process's streams prefetch disjoint chunks; fleet-wide
+    # occupancy is their sum, not the worst pool's.
+    merge="sum")
+SEGSTORE_CACHE_HITS = _REG.counter(
+    "kta_segstore_cache_hits_total",
+    "Chunk fetches served from the local segment cache after sha256 "
+    "verification (--segment-cache)")
+SEGSTORE_CACHE_MISSES = _REG.counter(
+    "kta_segstore_cache_misses_total",
+    "Chunk fetches the local segment cache could not serve (absent, "
+    "unreadable, or poisoned entries)")
+SEGSTORE_CACHE_EVICTIONS = _REG.counter(
+    "kta_segstore_cache_evictions_total",
+    "Cache entries evicted: least-recently-used past --segment-cache-bytes, "
+    "plus poisoned entries dropped on detection")
+SEGSTORE_FALLBACK = _REG.counter(
+    "kta_segstore_fallback_total",
+    "Chunk acquisitions that fell back to a direct store fetch, by reason "
+    "(cache-poisoned = a cached entry failed sha256 verification, "
+    "cache-stale = a verified entry no longer matches the catalog's "
+    "header — the archive was re-dumped at the same name and size, "
+    "cache-io-error = the cache directory was unreadable/unwritable) — "
+    "a cache bypass is never silent",
+    labelnames=("reason",))
+
 # -- fused ingest (packing.FusedPackSink + io/kafka_wire + io/segfile) --------
 
 FUSED_BATCHES = _REG.counter(

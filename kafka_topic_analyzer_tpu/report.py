@@ -296,6 +296,22 @@ def render_telemetry_stats(
             f"({seg.bytes_mapped / 1e6:,.1f} MB mapped), "
             f"{seg.records:,.0f} records in {seg.batches:,.0f} batches"
         )
+    # Remote-tier digest (io/objstore.py): what the object-store client
+    # actually fetched, retried, and served from the local cache.  Only
+    # rendered when the scan spoke to a remote store.
+    if seg.gets:
+        line = (
+            f"  segstore: {seg.gets:,} GETs "
+            f"({seg.bytes_fetched / 1e6:,.1f} MB fetched), "
+            f"{seg.retries:,} retries"
+        )
+        if seg.cache_hits or seg.cache_misses or seg.cache_evictions:
+            line += (
+                f", cache {seg.cache_hits:,} hit(s) / "
+                f"{seg.cache_misses:,} miss(es) / "
+                f"{seg.cache_evictions:,} eviction(s)"
+            )
+        lines.append(line)
     # Packed wire-format digest (results.WireStats, engine-built): which
     # format the scan's device buffers used, the actual bytes/record, and
     # the fold-table vs per-record split — the v4→v5 combiner saving as a

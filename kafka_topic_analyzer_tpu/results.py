@@ -211,12 +211,23 @@ class SegmentStats:
 
     #: .ktaseg chunks opened by the catalog.
     files: int
-    #: Bytes of chunk data memory-mapped.
+    #: Bytes of chunk data memory-mapped (local tier) or catalogued
+    #: (remote tier lists the same sizes).
     bytes_mapped: int
     #: Records read out of the mapped chunks.
     records: int
     #: Batches cut from them.
     batches: int
+    #: Remote-tier accounting (io/objstore.py; all zero for local scans):
+    #: object-store GETs completed (list + header probes + bodies +
+    #: disambiguation re-fetches), bytes fetched, transient retries, and
+    #: the local segment cache's hit/miss/eviction counts.
+    gets: int = 0
+    bytes_fetched: int = 0
+    retries: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    cache_evictions: int = 0
 
     @classmethod
     def from_telemetry(cls, snapshot: "Optional[dict]") -> "SegmentStats":
@@ -231,15 +242,31 @@ class SegmentStats:
             bytes_mapped=int(total("kta_segment_bytes_mapped_total")),
             records=int(total("kta_segment_records_total")),
             batches=int(total("kta_segment_batches_total")),
+            gets=int(total("kta_segstore_gets_total")),
+            bytes_fetched=int(total("kta_segstore_bytes_fetched_total")),
+            retries=int(total("kta_segstore_retries_total")),
+            cache_hits=int(total("kta_segstore_cache_hits_total")),
+            cache_misses=int(total("kta_segstore_cache_misses_total")),
+            cache_evictions=int(total("kta_segstore_cache_evictions_total")),
         )
 
     def as_dict(self) -> dict:
-        return {
+        doc = {
             "files": self.files,
             "bytes_mapped": self.bytes_mapped,
             "records": self.records,
             "batches": self.batches,
         }
+        if self.gets:
+            # Remote-tier block only when the scan actually spoke to an
+            # object store — local cold scans keep the historical shape.
+            doc["store_gets"] = self.gets
+            doc["store_bytes_fetched"] = self.bytes_fetched
+            doc["store_retries"] = self.retries
+            doc["cache_hits"] = self.cache_hits
+            doc["cache_misses"] = self.cache_misses
+            doc["cache_evictions"] = self.cache_evictions
+        return doc
 
 
 @dataclasses.dataclass

@@ -1,21 +1,28 @@
 """Cold-path capacity benchmark: broker-bypass segment scanning rec/s vs
-worker count (BENCH round 8).
+worker count (BENCH round 8), plus the remote-tier latency-hiding referee
+(BENCH round 14).
 
-Measures the `--source segfile` ingest pipeline — memory-mapped .ktaseg
-chunks → zero-copy column views → wire-v4 pack — through the same
-partition-sharded fan-in the engine runs (`parallel/ingest.py`), minus the
-device backend, so the number is the cold scan's host ingest ceiling.  The
-referee for the worker sweep is the round-3 socket-free pipeline
-measurement (12-13M rec/s/core on this class of box): the segment path
-deletes the kernel receive cost entirely, so N workers should aggregate
-toward N x the per-core pipeline rate until memory bandwidth binds.
+Measures the `--source segfile` ingest pipeline — .ktaseg chunks →
+zero-copy column views → wire pack — through the same partition-sharded
+fan-in the engine runs (`parallel/ingest.py`), minus the device backend,
+so the number is the cold scan's host ingest ceiling.  With ``--store
+serve`` the same chunks are served through the in-process S3-shaped
+object store (tools/objstore_serve.py) with ``--inject-latency-ms`` of
+per-GET service delay, and the sweep crosses worker counts with
+``--readahead`` depths — the referee for DESIGN.md §21's claim that
+read-ahead hides wire latency behind the decode→pack pass.  ``--cache``
+adds the warm-vs-cold re-audit split (pass 1 fills the segment cache,
+later passes hit it).
 
-One JSON line, bench_ingest-style: per-N wall rates (best-of with the
+One JSON line, bench_ingest-style: per-cell wall rates (best-of with the
 full run list), records/client-CPU-second, and the catalog digest.
 
 Usage:
     python -m kafka_topic_analyzer_tpu.tools.bench_segments \
         --records 8000000 --partitions 16 --workers 1,2,4,8
+    python -m kafka_topic_analyzer_tpu.tools.bench_segments \
+        --records 2000000 --partitions 16 --workers 4 \
+        --store serve --inject-latency-ms 50 --readahead 0,4
 """
 
 from __future__ import annotations
@@ -30,7 +37,7 @@ import time
 
 import numpy as np
 
-from kafka_topic_analyzer_tpu.config import AnalyzerConfig
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig, SegmentFetchConfig
 
 
 def _build_segments(args, directory: str) -> None:
@@ -46,6 +53,7 @@ def _build_segments(args, directory: str) -> None:
     rc = ms_main([
         "--out", directory, "--topic", args.topic, "--synthetic", spec,
         "--batch-size", str(max(args.batch_size, 1 << 18)),
+        "--chunk-records", str(args.chunk_records),
         "--native", args.native,
     ])
     if rc != 0:
@@ -101,15 +109,39 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--partitions", type=int, default=16)
     ap.add_argument("--keys", type=int, default=5000)
     ap.add_argument("--batch-size", type=int, default=1 << 16)
+    ap.add_argument("--chunk-records", type=int, default=1 << 16,
+                    help="rolled chunk size for synthesized segments "
+                         "(many chunks per partition = the shape "
+                         "read-ahead works against)")
     ap.add_argument("--workers", default="1,2,4,8",
                     help="comma-separated worker counts to sweep")
+    ap.add_argument("--store", default="dir", metavar="dir|serve|URL",
+                    help="'dir' scans the local directory (the round-8 "
+                         "referee); 'serve' serves the same chunks "
+                         "through the in-process S3-shaped store "
+                         "(tools/objstore_serve.py) and scans remotely; "
+                         "an http(s):// URL scans that store as-is")
+    ap.add_argument("--inject-latency-ms", type=float, default=0.0,
+                    help="per-GET service delay for --store serve — the "
+                         "wire-RTT stand-in the read-ahead referee "
+                         "measures against")
+    ap.add_argument("--readahead", default="auto",
+                    help="comma-separated --segment-readahead depths to "
+                         "sweep for remote stores (e.g. '0,4'); 'auto' "
+                         "uses the resolved default")
+    ap.add_argument("--cache", metavar="DIR",
+                    help="run remote cells through a --segment-cache at "
+                         "DIR: the first pass per cell is recorded as "
+                         "COLD (cache cleared), later passes as WARM")
+    ap.add_argument("--timeout-s", type=float, default=30.0,
+                    help="remote fetch timeout per request")
     ap.add_argument("--repeat", type=int, default=3,
-                    help="passes per worker count; best is the headline "
+                    help="passes per cell; best is the headline "
                          "(capacity is a max on a shared box), with the "
                          "full run list alongside")
     ap.add_argument("--no-pack", action="store_true",
-                    help="skip the wire-v4 pack stage (isolates the "
-                         "mmap-read cost; default stages pack on the "
+                    help="skip the wire pack stage (isolates the "
+                         "read cost; default stages pack on the "
                          "workers exactly like the tpu cold scan)")
     ap.add_argument("--features", default="counters",
                     help="comma list for the pack config: counters[,alive]"
@@ -119,6 +151,18 @@ def main(argv: "list[str] | None" = None) -> int:
     sweep = [int(w) for w in args.workers.split(",") if w]
     if any(w < 1 for w in sweep):
         ap.error("--workers entries must be >= 1")
+    if args.cache and args.store == "dir":
+        ap.error("--cache only applies to remote stores (--store serve/URL)")
+    if args.cache and args.repeat < 2:
+        ap.error(
+            "--cache needs --repeat >= 2: pass 1 is the COLD fill; "
+            "reporting it as the warm headline would compare cold to cold"
+        )
+    ra_sweep: "list[int | str]" = [
+        ("auto" if r.strip().lower() == "auto" else int(r))
+        for r in args.readahead.split(",")
+        if r.strip()
+    ]
 
     from kafka_topic_analyzer_tpu.io.segfile import SegmentFileSource
     from kafka_topic_analyzer_tpu.packing import pack_batch
@@ -131,8 +175,37 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"bench_segments: building segments in {seg_dir}",
               file=sys.stderr)
         _build_segments(args, seg_dir)
+    server = None
+    store_spec = None
+    if args.store == "serve":
+        from kafka_topic_analyzer_tpu.tools.objstore_serve import (
+            ObjectStoreHttpServer,
+        )
+
+        server = ObjectStoreHttpServer(
+            seg_dir, latency_ms=args.inject_latency_ms
+        ).start()
+        store_spec = server.url
+        print(f"bench_segments: serving {seg_dir} at {store_spec} "
+              f"(+{args.inject_latency_ms:g} ms/GET)", file=sys.stderr)
+    elif args.store != "dir":
+        store_spec = args.store
+    remote = store_spec is not None
+    if not remote:
+        ra_sweep = ["auto"]  # local: readahead resolves to 0; one cell
+
+    def make_source(ra) -> SegmentFileSource:
+        if not remote:
+            return SegmentFileSource(seg_dir, args.topic)
+        fetch = SegmentFetchConfig(
+            readahead=ra,
+            cache_dir=args.cache,
+            timeout_s=args.timeout_s,
+        )
+        return SegmentFileSource(store_spec, args.topic, fetch=fetch)
+
     try:
-        probe = SegmentFileSource(seg_dir, args.topic)
+        probe = make_source(0 if remote else "auto")
         feats = {f.strip() for f in args.features.split(",") if f.strip()}
         config = AnalyzerConfig(
             num_partitions=len(probe.partitions()),
@@ -144,7 +217,7 @@ def main(argv: "list[str] | None" = None) -> int:
         use_native = args.native in ("auto", "on")
         stage = None
         if not args.no_pack:
-            # Mirror the engine's worker staging: dense ids + wire-v4 pack
+            # Mirror the engine's worker staging: dense ids + wire pack
             # (native, GIL-released) on the worker thread.  Synthetic dumps
             # are dense already; a user-supplied catalog may not be.
             from kafka_topic_analyzer_tpu.engine import PartitionIndex
@@ -163,6 +236,9 @@ def main(argv: "list[str] | None" = None) -> int:
             "batch_size": args.batch_size,
             "pack": not args.no_pack,
             "features": sorted(feats),
+            "store": args.store,
+            "inject_latency_ms": args.inject_latency_ms,
+            "cache": bool(args.cache),
             "catalog": {
                 "files": probe.catalog.num_files,
                 "bytes": probe.catalog.total_bytes,
@@ -173,35 +249,53 @@ def main(argv: "list[str] | None" = None) -> int:
         rates: "dict[str, int]" = {}
         runs: "dict[str, list[int]]" = {}
         cpu_rates: "dict[str, int]" = {}
+        cold_rates: "dict[str, int]" = {}
         for n in sweep:
-            best = None
-            n_runs = []
-            for _ in range(max(args.repeat, 1)):
-                # A fresh source per pass: per-file constant caches and OS
-                # page cache persist (deliberately — cold *IO* is the disk's
-                # story; this measures the pipeline), but reader state does
-                # not leak across worker counts.
-                src = SegmentFileSource(seg_dir, args.topic)
-                r = _measure(src, args.batch_size, n, stage)
-                n_runs.append(round(r["records"] / r["wall"]))
-                if best is None or r["records"] / r["wall"] > (
-                    best["records"] / best["wall"]
-                ):
-                    best = r
-            rates[str(n)] = max(n_runs)
-            runs[str(n)] = n_runs
-            cpu_rates[str(n)] = (
-                round(best["records"] / best["cpu"]) if best["cpu"] else 0
-            )
-            print(
-                f"bench_segments: {n} worker(s) {best['records']} records, "
-                f"best of {len(n_runs)}: {max(n_runs):,}/s "
-                f"(wall={best['wall']:.2f}s cpu={best['cpu']:.2f}s)",
-                file=sys.stderr,
-            )
+            for ra in ra_sweep:
+                key = str(n) if not remote else f"w{n}.ra{ra}"
+                if args.cache:
+                    # Cold half of the warm-vs-cold referee: an empty
+                    # cache, so pass 1 pays every fetch.
+                    shutil.rmtree(args.cache, ignore_errors=True)
+                best = None
+                n_runs = []
+                for rep in range(max(args.repeat, 1)):
+                    # A fresh source per pass: per-file constant caches and
+                    # OS page cache persist (deliberately — cold *IO* is
+                    # the disk's story; this measures the pipeline), but
+                    # reader state does not leak across cells.
+                    src = make_source(ra)
+                    r = _measure(src, args.batch_size, n, stage)
+                    rate = round(r["records"] / r["wall"])
+                    n_runs.append(rate)
+                    if args.cache and rep == 0:
+                        cold_rates[key] = rate
+                    if best is None or r["records"] / r["wall"] > (
+                        best["records"] / best["wall"]
+                    ):
+                        best = r
+                warm_runs = n_runs[1:] if args.cache and len(n_runs) > 1 \
+                    else n_runs
+                rates[key] = max(warm_runs)
+                runs[key] = n_runs
+                cpu_rates[key] = (
+                    round(best["records"] / best["cpu"]) if best["cpu"] else 0
+                )
+                print(
+                    f"bench_segments: {key}: {best['records']} records, "
+                    f"best of {len(n_runs)}: {rates[key]:,}/s "
+                    f"(wall={best['wall']:.2f}s cpu={best['cpu']:.2f}s)"
+                    + (
+                        f" cold={cold_rates[key]:,}/s"
+                        if key in cold_rates else ""
+                    ),
+                    file=sys.stderr,
+                )
         doc["seg_msgs_per_sec"] = rates
         doc["seg_runs"] = runs
         doc["seg_cpu_msgs_per_sec"] = cpu_rates
+        if cold_rates:
+            doc["seg_cold_msgs_per_sec"] = cold_rates
         if "1" in rates:
             doc["speedup_vs_1"] = {
                 n: round(v / rates["1"], 2) for n, v in rates.items()
@@ -209,6 +303,8 @@ def main(argv: "list[str] | None" = None) -> int:
         print(json.dumps(doc))
         return 0
     finally:
+        if server is not None:
+            server.close()
         if tmp is not None:
             shutil.rmtree(tmp, ignore_errors=True)
 

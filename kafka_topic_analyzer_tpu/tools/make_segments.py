@@ -62,6 +62,10 @@ def main(argv: "list[str] | None" = None) -> int:
                          "the analyzer CLI), e.g. "
                          "\"partitions=4,messages=100000,keys=5000\"")
     ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--chunk-records", type=int, default=0,
+                    help="roll output into {topic}-{p}.cN.ktaseg chunks of "
+                         "this many records (0 = one chunk per partition) — "
+                         "the shape remote-tier read-ahead works against")
     ap.add_argument("--native", choices=["auto", "on", "off"], default="auto")
     args = ap.parse_args(argv)
 
@@ -86,6 +90,24 @@ def main(argv: "list[str] | None" = None) -> int:
         src = SyntheticSource(spec)
 
     os.makedirs(args.out, exist_ok=True)
+    if args.chunk_records > 0:
+        from kafka_topic_analyzer_tpu.io.segfile import SegmentDumpWriter
+
+        writer = SegmentDumpWriter(
+            args.out, args.topic, records_per_chunk=args.chunk_records
+        )
+        # Batch at the chunk size so rolling (batch-granular) lands chunks
+        # of exactly the requested record count.
+        for p in src.partitions():
+            for b in src.batches(
+                min(args.batch_size, args.chunk_records), partitions=[p]
+            ):
+                writer.append(b)
+        writer.close()
+        n = len(os.listdir(args.out))
+        print(f"wrote {n} rolled chunk file(s) to {args.out}",
+              file=sys.stderr)
+        return 0
     for p in src.partitions():
         batches = list(src.batches(args.batch_size, partitions=[p]))
         path = write_segment_from_batches(args.out, args.topic, p, batches)

@@ -1,0 +1,369 @@
+"""Serve a .ktaseg directory as an S3-shaped object store (DESIGN.md §21).
+
+The remote segment tier (io/objstore.py + ObjectSegmentStore) speaks a
+small, honest subset of S3 path-style HTTP: ListObjectsV2
+(``GET /bucket/?list-type=2&prefix=``), whole-object GET with an MD5 ETag,
+and ranged GET (``Range: bytes=a-b`` / ``bytes=-n``).  This module is a
+local implementation of exactly that subset, so the whole tier — catalog
+header probes, read-ahead, retry/budget recovery, cache verification — is
+provable (tests) and measurable (tools/bench_segments.py) without real S3:
+
+    python -m kafka_topic_analyzer_tpu.tools.objstore_serve \
+        --root ./segments --port 9000 --latency-ms 25
+    kafka-topic-analyzer -t orders --source segfile \
+        --segment-dir http://127.0.0.1:9000/segments
+
+``latency_ms`` injects a per-request service delay (the wire-RTT stand-in
+the read-ahead pool exists to hide); ``fault_hook`` lets a harness script
+failures per request — drop the connection, stall past the client timeout,
+return 5xx, or corrupt response bytes in flight (see
+tests/fake_objstore.py for the scripted wrapper).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import re
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple, Union
+from urllib.parse import parse_qs, unquote, urlparse
+from xml.sax.saxutils import escape
+
+#: fault_hook(key, rng, index) -> one of:
+#:   None                   serve normally
+#:   ("status", code)       respond with that HTTP status, empty body
+#:   "drop"                 close the socket without responding
+#:   ("stall", seconds)     sleep that long BEFORE responding (client
+#:                          timeouts see a hung server)
+#:   ("flip", byte_index)   serve the body with one bit flipped there
+#:   ("truncate", nbytes)   serve only the first nbytes of the body
+#:                          (Content-Length still claims the full size —
+#:                          a mid-GET connection drop)
+FaultHook = Callable[[str, Optional[Tuple[Optional[int], int]], int], object]
+
+
+class ObjectStoreHttpServer:
+    """A threading HTTP server exposing ``root`` (a directory path, or a
+    mutable ``{name: bytes}`` dict) as one S3-shaped bucket."""
+
+    def __init__(
+        self,
+        root: "Union[str, Dict[str, bytes]]",
+        bucket: str = "segments",
+        latency_ms: float = 0.0,
+        fault_hook: "Optional[FaultHook]" = None,
+        send_etag: bool = True,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.root = root
+        self.bucket = bucket
+        self.latency_ms = latency_ms
+        self.fault_hook = fault_hook
+        self.send_etag = send_etag
+        self.requests_served = 0
+        self._request_index = 0
+        self._lock = threading.Lock()
+        #: key -> (stat signature, md5) so ETags (whole-object by S3
+        #: semantics) are computed once per object version, not per
+        #: request — a 32-byte header probe must not cost a full-file
+        #: read + hash, or the server's own overhead drowns the injected
+        #: latency the benchmarks measure.
+        self._etags: "Dict[str, Tuple[object, str]]" = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: D102 — quiet server
+                pass
+
+            def do_GET(self):  # noqa: N802 — http.server contract
+                outer._handle(self)
+
+        class Server(ThreadingHTTPServer):
+            # Many concurrent clients (read-ahead pools x ingest workers)
+            # connect in one burst; the http.server default backlog of 5
+            # drops SYNs and the kernel's ~1s retransmit would masquerade
+            # as store latency.  A real endpoint accepts deeper.
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="kta-objstore-serve",
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ObjectStoreHttpServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "ObjectStoreHttpServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/{self.bucket}"
+
+    # -- object access -------------------------------------------------------
+
+    def _keys(self) -> "list[str]":
+        if isinstance(self.root, dict):
+            return sorted(self.root)
+        return sorted(
+            f for f in os.listdir(self.root)
+            if os.path.isfile(os.path.join(self.root, f))
+        )
+
+    def _size(self, key: str) -> "Optional[int]":
+        if isinstance(self.root, dict):
+            data = self.root.get(key)
+            return None if data is None else len(data)
+        path = os.path.join(self.root, key)
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return None
+
+    def _read_range(
+        self, key: str, rng: "Optional[Tuple[Optional[int], int]]"
+    ) -> "Tuple[Optional[bytes], int]":
+        """(bytes of the requested range — or the whole object — and the
+        full object size).  File roots read ONLY the range: a ranged
+        header probe costs a seek + a few bytes, not the chunk."""
+        if isinstance(self.root, dict):
+            data = self.root.get(key)
+            if data is None:
+                return None, 0
+            full = len(data)
+            if rng is None:
+                return data, full
+            lo, hi = rng
+            return (data[-hi:] if hi else b"") if lo is None else (
+                data[lo : hi + 1]
+            ), full
+        path = os.path.join(self.root, key)
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                if rng is None:
+                    return f.read(), size
+                lo, hi = rng
+                if lo is None:
+                    f.seek(max(0, size - hi))
+                    return (f.read() if hi else b""), size
+                f.seek(lo)
+                return f.read(max(0, hi - lo + 1)), size
+        except OSError:
+            return None, 0
+
+    def _etag(self, key: str) -> "Optional[str]":
+        """Whole-object MD5 (S3 ETag semantics), computed once per object
+        version: keyed on (size, mtime) for file roots and on the bytes
+        object's identity for dict roots, so a mutated object re-hashes
+        and an untouched one never does."""
+        if isinstance(self.root, dict):
+            data = self.root.get(key)
+            if data is None:
+                return None
+            sig: object = ("d", id(data), len(data))
+        else:
+            try:
+                st = os.stat(os.path.join(self.root, key))
+            except OSError:
+                return None
+            sig = (st.st_size, st.st_mtime_ns)
+        cached = self._etags.get(key)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        data, _ = self._read_range(key, None)
+        if data is None:
+            return None
+        etag = hashlib.md5(data).hexdigest()
+        self._etags[key] = (sig, etag)
+        return etag
+
+    # -- request handling ----------------------------------------------------
+
+    @staticmethod
+    def _parse_range(header: str) -> "Optional[Tuple[Optional[int], int]]":
+        m = re.fullmatch(r"bytes=(\d*)-(\d*)", header or "")
+        if not m or (not m.group(1) and not m.group(2)):
+            return None
+        if not m.group(1):  # suffix range: bytes=-n
+            return None, int(m.group(2))
+        return int(m.group(1)), int(m.group(2) or (1 << 62))
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        with self._lock:
+            index = self._request_index
+            self._request_index += 1
+        parsed = urlparse(req.path)
+        parts = [p for p in unquote(parsed.path).split("/") if p]
+        if not parts or parts[0] != self.bucket:
+            self._respond(req, 404, b"no such bucket")
+            return
+        if self.latency_ms > 0:
+            time.sleep(self.latency_ms / 1000.0)
+        query = parse_qs(parsed.query)
+        if len(parts) == 1 and "list-type" in query:
+            self._handle_list(req, query.get("prefix", [""])[0])
+            return
+        if len(parts) < 2:
+            self._respond(req, 400, b"missing key")
+            return
+        self._handle_object(req, "/".join(parts[1:]), index)
+
+    def _handle_list(self, req: BaseHTTPRequestHandler, prefix: str) -> None:
+        rows = []
+        for key in self._keys():
+            if not key.startswith(prefix):
+                continue
+            size = self._size(key)
+            if size is None:
+                continue
+            etag = (self._etag(key) or "") if self.send_etag else ""
+            rows.append(
+                "<Contents>"
+                f"<Key>{escape(key)}</Key><Size>{size}</Size>"
+                + (f"<ETag>&quot;{etag}&quot;</ETag>" if etag else "")
+                + "</Contents>"
+            )
+        body = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            "<ListBucketResult>"
+            f"<Name>{escape(self.bucket)}</Name>"
+            "<IsTruncated>false</IsTruncated>"
+            f"{''.join(rows)}"
+            "</ListBucketResult>"
+        ).encode()
+        self._respond(req, 200, body, content_type="application/xml")
+
+    def _handle_object(
+        self, req: BaseHTTPRequestHandler, key: str, index: int
+    ) -> None:
+        rng = self._parse_range(req.headers.get("Range", ""))
+        action = (
+            self.fault_hook(key, rng, index)
+            if self.fault_hook is not None
+            else None
+        )
+        if isinstance(action, tuple) and action[0] == "stall":
+            time.sleep(action[1])
+            action = None
+        if action == "drop":
+            # Kill the socket without an HTTP response: the client sees a
+            # reset/short read mid-GET.
+            try:
+                req.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            req.close_connection = True
+            return
+        if isinstance(action, tuple) and action[0] == "status":
+            self._respond(req, int(action[1]), b"injected fault")
+            return
+        data, _full_size = self._read_range(key, rng)
+        if data is None:
+            self._respond(req, 404, b"no such key")
+            return
+        status = 200 if rng is None else 206
+        claimed_len = len(data)
+        if isinstance(action, tuple) and action[0] == "flip":
+            flipped = bytearray(data)
+            flipped[action[1] % max(1, len(flipped))] ^= 0x01
+            data = bytes(flipped)
+        elif isinstance(action, tuple) and action[0] == "truncate":
+            data = data[: action[1]]
+        headers = {}
+        if self.send_etag:
+            # S3 semantics: the ETag always describes the WHOLE object
+            # (the TRUE object — an injected in-flight flip must not
+            # change it, exactly like real wire damage would not).
+            etag = self._etag(key)
+            if etag:
+                headers["ETag"] = f'"{etag}"'
+        self._respond(
+            req, status, data, claimed_len=claimed_len, headers=headers
+        )
+        with self._lock:
+            self.requests_served += 1
+
+    def _respond(
+        self,
+        req: BaseHTTPRequestHandler,
+        status: int,
+        body: bytes,
+        content_type: str = "application/octet-stream",
+        claimed_len: "Optional[int]" = None,
+        headers: "Optional[Dict[str, str]]" = None,
+    ) -> None:
+        try:
+            req.send_response(status)
+            req.send_header("Content-Type", content_type)
+            req.send_header(
+                "Content-Length",
+                str(len(body) if claimed_len is None else claimed_len),
+            )
+            for k, v in (headers or {}).items():
+                req.send_header(k, v)
+            req.end_headers()
+            req.wfile.write(body)
+            if claimed_len is not None and claimed_len != len(body):
+                # Truncation fault: the headers promised more than was
+                # written — drop the connection so the client's read fails.
+                req.connection.shutdown(socket.SHUT_RDWR)
+                req.close_connection = True
+        except OSError:
+            req.close_connection = True
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", required=True,
+                    help="directory of .ktaseg chunks to serve")
+    ap.add_argument("--bucket", default="segments",
+                    help="bucket name (the URL path prefix)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 binds an ephemeral port (announced on stdout)")
+    ap.add_argument("--latency-ms", type=float, default=0.0,
+                    help="injected per-request service delay")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        ap.error(f"--root {args.root!r} is not a directory")
+    server = ObjectStoreHttpServer(
+        args.root, bucket=args.bucket, latency_ms=args.latency_ms,
+        host=args.host, port=args.port,
+    ).start()
+    print(f"objstore_serve: {server.url} (root {args.root})", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""FakeObjectStore: the latency/fault-injectable S3-shaped test server.
+
+A thin scripting layer over the real local server implementation
+(kafka_topic_analyzer_tpu/tools/objstore_serve.py — the same code the
+bench drives), so tests can enqueue per-object fault scripts:
+
+    with FakeObjectStore(seg_dir) as store:
+        store.script("t-0.ktaseg", "drop", ("status", 503))
+        ...  # the next two BODY GETs of t-0 fail those ways, then serve
+
+Scripts apply to whole-body GETs only by default (the fetch path under
+test); header/list probes stay clean unless ``body_only=False``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+from typing import Optional, Tuple
+
+from kafka_topic_analyzer_tpu.tools.objstore_serve import (
+    ObjectStoreHttpServer,
+)
+
+
+class FakeObjectStore(ObjectStoreHttpServer):
+    def __init__(self, root, **kw):
+        self._script_lock = threading.Lock()
+        #: key -> list of (action, body_only) consumed FIFO per matching GET.
+        self._scripts: "dict[str, list]" = {}
+        #: Whole-body GETs observed per key (fault-scripted ones included).
+        self.body_gets: "Counter[str]" = Counter()
+        super().__init__(root, fault_hook=self._hook, **kw)
+
+    def script(self, key: str, *actions, body_only: bool = True) -> None:
+        """Enqueue fault actions for successive GETs of ``key`` (see
+        objstore_serve.FaultHook for the action vocabulary)."""
+        with self._script_lock:
+            self._scripts.setdefault(key, []).extend(
+                (a, body_only) for a in actions
+            )
+
+    def _hook(
+        self,
+        key: str,
+        rng: "Optional[Tuple[Optional[int], int]]",
+        index: int,
+    ):
+        with self._script_lock:
+            if rng is None:
+                self.body_gets[key] += 1
+            queue = self._scripts.get(key)
+            if not queue:
+                return None
+            action, body_only = queue[0]
+            if body_only and rng is not None:
+                return None
+            queue.pop(0)
+            return action

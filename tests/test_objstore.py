@@ -1,0 +1,690 @@
+"""The remote segment tier (DESIGN.md §21): object-store scans must be
+byte-identical to local-directory scans of the same chunks — across
+workers × superbatch × readahead, under injected transport faults, through
+the local segment cache, and across cross-store resume — with the PR-1
+degraded surface and the PR-3 corruption taxonomy carried over intact.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from fake_objstore import FakeObjectStore
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.config import (
+    AnalyzerConfig,
+    SegmentFetchConfig,
+    TransportRetryConfig,
+)
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.segfile import (
+    MalformedSegmentError,
+    SegmentFileSource,
+    write_segment_from_batches,
+)
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+from kafka_topic_analyzer_tpu.obs.registry import default_registry
+
+pytestmark = pytest.mark.objstore
+
+SPEC = SyntheticSpec(
+    num_partitions=3,
+    messages_per_partition=2_000,
+    keys_per_partition=90,
+    tombstone_permille=130,
+    seed=11,
+)
+#: Fast-failing retry schedule for fault tests (no real sleeping to speak
+#: of; the budget semantics are what is under test).
+FAST_RETRY = TransportRetryConfig(
+    backoff_ms=1, backoff_max_ms=4, retry_budget=4
+)
+
+
+def fetch_cfg(readahead=2, cache=None, retry=FAST_RETRY, timeout=5.0):
+    return SegmentFetchConfig(
+        readahead=readahead, cache_dir=cache, retry=retry, timeout_s=timeout
+    )
+
+
+@pytest.fixture()
+def seg_dir(tmp_path):
+    src = SyntheticSource(SPEC)
+    d = tmp_path / "segs"
+    d.mkdir()
+    for p in src.partitions():
+        write_segment_from_batches(
+            str(d), "t", p, list(src.batches(700, partitions=[p]))
+        )
+    return str(d)
+
+
+def cpu_cfg(**kw):
+    base = dict(
+        num_partitions=3, batch_size=700, count_alive_keys=True,
+        alive_bitmap_bits=18, enable_hll=True, hll_p=8,
+    )
+    base.update(kw)
+    return AnalyzerConfig(**base)
+
+
+def scan_doc(result):
+    d = result.metrics.to_dict(result.start_offsets, result.end_offsets)
+    d["degraded"] = dict(result.degraded_partitions)
+    return d
+
+
+def metric_total(name):
+    m = default_registry().snapshot().get(name)
+    if not m:
+        return 0.0
+    return sum(s["value"] for s in m["samples"])
+
+
+# ---------------------------------------------------------------------------
+# store factory / spec parsing
+
+
+def test_open_segment_store_routes_remote_schemes(seg_dir, monkeypatch):
+    from kafka_topic_analyzer_tpu.io.objstore import parse_object_store_spec
+    from kafka_topic_analyzer_tpu.io.segstore import (
+        ObjectSegmentStore,
+        open_segment_store,
+    )
+
+    assert isinstance(
+        open_segment_store("http://127.0.0.1:9/bucket"), ObjectSegmentStore
+    )
+    assert isinstance(
+        open_segment_store("https://s3.example.com/bucket/p"),
+        ObjectSegmentStore,
+    )
+    assert isinstance(open_segment_store("s3://bucket/pre"), ObjectSegmentStore)
+    # s3:// resolves through KTA_S3_ENDPOINT, path-style.
+    monkeypatch.setenv("KTA_S3_ENDPOINT", "http://minio.local:9000")
+    assert parse_object_store_spec("s3://arch/orders") == (
+        False, "minio.local", 9000, "/arch/orders"
+    )
+    assert parse_object_store_spec("http://h:81/b") == (False, "h", 81, "/b")
+    assert parse_object_store_spec("https://h/b")[:3] == (True, "h", 443)
+    with pytest.raises(ValueError, match="bad object store spec"):
+        parse_object_store_spec("ftp://nope")
+
+
+def test_unknown_scheme_lists_supported(tmp_path):
+    from kafka_topic_analyzer_tpu.io.segstore import open_segment_store
+
+    with pytest.raises(ValueError, match="not supported") as e:
+        open_segment_store("gs://bucket/prefix")
+    for spelled in ("file://", "http://", "https://", "s3://", "plug-in"):
+        assert spelled in str(e.value)
+
+
+def test_cache_rejected_for_local_store(seg_dir, tmp_path):
+    from kafka_topic_analyzer_tpu.io.segstore import open_segment_store
+
+    with pytest.raises(ValueError, match="--segment-cache only applies"):
+        open_segment_store(
+            seg_dir, fetch=fetch_cfg(cache=str(tmp_path / "c"))
+        )
+
+
+# ---------------------------------------------------------------------------
+# catalog over the wire
+
+
+def test_remote_catalog_uses_header_probes_only(seg_dir):
+    with FakeObjectStore(seg_dir) as store:
+        src = SegmentFileSource(store.url, "t", fetch=fetch_cfg())
+        # Validation complete (header↔name, ordering, sizes) with ZERO
+        # chunk bodies downloaded.
+        assert sum(store.body_gets.values()) == 0
+        local = SegmentFileSource(seg_dir, "t")
+        assert src.partitions() == local.partitions()
+        assert src.watermarks() == local.watermarks()
+        assert src.partition_record_counts() == local.partition_record_counts()
+        assert src.readahead == 2  # the explicit fetch_cfg depth
+
+
+def test_remote_catalog_auto_readahead_and_gappy_end_offsets(tmp_path):
+    from kafka_topic_analyzer_tpu.io.kafka_wire import records_to_batch
+    from kafka_topic_analyzer_tpu.io.segfile import SegmentDumpWriter
+    from kafka_topic_analyzer_tpu.records import RecordBatch
+
+    rows = [
+        (0, 1_600_000_000_000 + off, f"k{off % 7}".encode(), bytes(12))
+        for off in range(0, 300, 3)
+    ]
+    batch = records_to_batch(rows)
+    batch.offsets = np.arange(0, 300, 3, dtype=np.int64)
+    writer = SegmentDumpWriter(str(tmp_path), "gap", records_per_chunk=40)
+    for lo in range(0, 100, 25):
+        writer.append(batch.take(np.arange(lo, lo + 25)))
+    writer.close()
+
+    with FakeObjectStore(str(tmp_path)) as store:
+        src = SegmentFileSource(store.url, "gap")  # default fetch config
+        assert src.readahead == 4  # auto resolves to 4 for remote stores
+        # Offset-exact watermarks from the 8-byte suffix probes — still no
+        # body fetches.
+        assert src.watermarks() == (({0: 0}), ({0: 298}))
+        assert sum(store.body_gets.values()) == 0
+        # Offset-exact resume mid-chunk (this one does read bodies).
+        resumed = RecordBatch.concat(list(src.batches(50, start_at={0: 151})))
+        assert int(resumed.offsets[0]) == 153
+
+
+def test_prefixed_store_spec_lists_and_fetches(seg_dir):
+    """A /bucket/some/prefix spec must LIST against the BUCKET with the
+    key prefix folded into ?prefix=, and GET prefixed keys — a prefixed
+    archive layout scans byte-identically to the flat one."""
+    cfg = cpu_cfg()
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    objects = {
+        f"arch/2026/{name}": data
+        for name, data in _as_dict_root(seg_dir).items()
+    }
+    with FakeObjectStore(objects, bucket="tiered") as store:
+        spec = f"http://127.0.0.1:{store.port}/tiered/arch/2026"
+        src = SegmentFileSource(spec, "t", fetch=fetch_cfg(2))
+        assert src.partitions() == [0, 1, 2]
+        got = run_scan(
+            "t", src, CpuExactBackend(cfg, init_now_s=10**10), 700
+        )
+        assert scan_doc(got) == ref
+        assert store.body_gets["arch/2026/t-0.ktaseg"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance matrix: remote == local across workers × K × readahead
+
+
+def test_remote_scan_matches_local_matrix(seg_dir):
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+    from kafka_topic_analyzer_tpu.config import DispatchConfig
+
+    cfg = cpu_cfg(batch_size=256, enable_quantiles=True)
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        TpuBackend(cfg, init_now_s=10**10), 256,
+    ))
+    with FakeObjectStore(seg_dir) as store:
+        for workers in (1, 4):
+            for k in (1, 4):
+                for readahead in (0, 2):
+                    backend = TpuBackend(
+                        cfg, init_now_s=10**10,
+                        dispatch=DispatchConfig(superbatch=k),
+                    )
+                    got = run_scan(
+                        "t",
+                        SegmentFileSource(
+                            store.url, "t", fetch=fetch_cfg(readahead)
+                        ),
+                        backend, 256, ingest_workers=workers,
+                    )
+                    assert got.superbatch_k == k
+                    assert got.ingest_workers == min(workers, 3)
+                    assert scan_doc(got) == ref, (workers, k, readahead)
+    # Every per-stream read-ahead pool drained and settled: the occupancy
+    # gauge must be back at zero.
+    assert metric_total("kta_segstore_readahead_occupancy") == 0
+
+
+# ---------------------------------------------------------------------------
+# fault injection: transient → retried, persistent → degraded
+
+
+def test_mid_get_faults_are_retried_to_identity(seg_dir):
+    cfg = cpu_cfg()
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    retries0 = metric_total("kta_segstore_retries_total")
+    with FakeObjectStore(seg_dir) as store:
+        # One mid-GET connection drop, one 5xx, one stall past the client
+        # timeout — three distinct transient kinds on three chunks.
+        store.script("t-0.ktaseg", "drop")
+        store.script("t-1.ktaseg", ("status", 503))
+        store.script("t-2.ktaseg", ("stall", 1.0))
+        got = run_scan(
+            "t",
+            SegmentFileSource(
+                store.url, "t", fetch=fetch_cfg(readahead=2, timeout=0.4)
+            ),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+    assert scan_doc(got) == ref
+    assert got.degraded_partitions == {}
+    assert metric_total("kta_segstore_retries_total") - retries0 >= 3
+
+
+def test_truncated_mid_get_is_transient_not_corrupt(seg_dir):
+    cfg = cpu_cfg()
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    with FakeObjectStore(seg_dir) as store:
+        # Body cut short mid-GET (headers claim full length): must retry,
+        # not classify — the object at rest is intact.
+        store.script("t-0.ktaseg", ("truncate", 500))
+        got = run_scan(
+            "t",
+            SegmentFileSource(store.url, "t", fetch=fetch_cfg(0)),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+    assert scan_doc(got) == ref
+
+
+def test_retry_budget_exhaustion_degrades_partition(seg_dir):
+    cfg = cpu_cfg()
+    with FakeObjectStore(seg_dir) as store:
+        store.script("t-1.ktaseg", *[("status", 500)] * 32)
+        got = run_scan(
+            "t",
+            SegmentFileSource(store.url, "t", fetch=fetch_cfg(2)),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+    # Partition 1 degraded with the budget reason; the others finished.
+    assert list(got.degraded_partitions) == [1]
+    assert "consecutive transport failures" in got.degraded_partitions[1]
+    assert got.metrics.overall_count == 2 * SPEC.messages_per_partition
+    assert got.metrics.total(0) == SPEC.messages_per_partition
+    assert got.metrics.total(1) == 0
+    # The engine persists the degraded surface identically to a dead wire
+    # partition: the scan result exposes it for EXIT_DEGRADED.
+    assert metric_total("kta_retry_budget_exhaustions_total") >= 1
+
+
+# ---------------------------------------------------------------------------
+# corrupted fetches: classification + one-re-fetch disambiguation
+
+
+def _as_dict_root(seg_dir):
+    return {
+        f: open(os.path.join(seg_dir, f), "rb").read()
+        for f in os.listdir(seg_dir)
+    }
+
+
+def test_at_rest_corruption_classifies_after_one_refetch(seg_dir):
+    objects = _as_dict_root(seg_dir)
+    with FakeObjectStore(objects) as store:
+        src = SegmentFileSource(store.url, "t", fetch=fetch_cfg(0))
+        # Corrupt the OBJECT after the catalog validated its header: every
+        # fetch now returns the same damaged bytes (ETag matches them, so
+        # the MD5 check cannot save us — this is at-rest damage).
+        data = bytearray(objects["t-1.ktaseg"])
+        data[9] ^= 0xFF  # inside the header's partition field
+        objects["t-1.ktaseg"] = bytes(data)
+        refetches0 = metric_total("kta_corrupt_refetches_total")
+        with pytest.raises(MalformedSegmentError) as e:
+            for _ in src.batches(700):
+                pass
+        # Classified with the local reader's taxonomy + path context, and
+        # the disambiguating re-fetch happened exactly once.
+        assert e.value.kind == "malformed-header"
+        assert "t-1.ktaseg" in str(e.value)
+        assert metric_total("kta_corrupt_refetches_total") - refetches0 == 1
+        assert store.body_gets["t-1.ktaseg"] == 2  # fetch + one re-fetch
+
+
+def test_in_flight_corruption_is_healed_by_refetch(seg_dir):
+    cfg = cpu_cfg()
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    # (a) With ETags suppressed, a one-shot bit flip inside the header
+    # region fails classification, and the ONE structural re-fetch heals
+    # it — byte-identical scan, no corruption surfaced.
+    with FakeObjectStore(seg_dir, send_etag=False) as store:
+        store.script("t-0.ktaseg", ("flip", 9))
+        got = run_scan(
+            "t",
+            SegmentFileSource(store.url, "t", fetch=fetch_cfg(0)),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+        assert scan_doc(got) == ref
+        assert store.body_gets["t-0.ktaseg"] == 2
+    # (b) With ETags on, the SAME flip anywhere in the body is caught by
+    # the MD5 integrity check before classification ever runs, and
+    # retried as a transient.
+    retries0 = metric_total("kta_segstore_retries_total")
+    with FakeObjectStore(seg_dir) as store:
+        store.script("t-0.ktaseg", ("flip", 5000))
+        got = run_scan(
+            "t",
+            SegmentFileSource(store.url, "t", fetch=fetch_cfg(0)),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+        assert scan_doc(got) == ref
+    assert metric_total("kta_segstore_retries_total") - retries0 >= 1
+
+
+# ---------------------------------------------------------------------------
+# the local segment cache
+
+
+def test_cache_cold_fills_warm_serves_byte_identical(seg_dir, tmp_path):
+    cfg = cpu_cfg()
+    cache = str(tmp_path / "cache")
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    hits0 = metric_total("kta_segstore_cache_hits_total")
+    misses0 = metric_total("kta_segstore_cache_misses_total")
+    with FakeObjectStore(seg_dir) as store:
+        for expect_body_gets in (3, 0):  # cold fetches all 3; warm none
+            before = sum(store.body_gets.values())
+            got = run_scan(
+                "t",
+                SegmentFileSource(
+                    store.url, "t", fetch=fetch_cfg(2, cache=cache)
+                ),
+                CpuExactBackend(cfg, init_now_s=10**10), 700,
+            )
+            assert scan_doc(got) == ref
+            assert (
+                sum(store.body_gets.values()) - before == expect_body_gets
+            )
+    assert metric_total("kta_segstore_cache_misses_total") - misses0 == 3
+    assert metric_total("kta_segstore_cache_hits_total") - hits0 == 3
+
+
+def test_poisoned_cache_entry_refetched_never_served(seg_dir, tmp_path):
+    cfg = cpu_cfg()
+    cache = str(tmp_path / "cache")
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    with FakeObjectStore(seg_dir) as store:
+        fetch = fetch_cfg(2, cache=cache)
+        run_scan(
+            "t", SegmentFileSource(store.url, "t", fetch=fetch),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+        # Flip one byte inside a cached entry (bit rot at rest in the
+        # cache itself — NOT in the store).
+        entry = sorted(
+            f for f in os.listdir(cache) if f.endswith(".seg")
+        )[0]
+        path = os.path.join(cache, entry)
+        data = bytearray(open(path, "rb").read())
+        data[4321] ^= 0x10
+        open(path, "wb").write(bytes(data))
+        before = sum(store.body_gets.values())
+        poisoned0 = metric_total("kta_segstore_fallback_total")
+        got = run_scan(
+            "t", SegmentFileSource(store.url, "t", fetch=fetch),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+        # Detected, booked, re-fetched — and the results never saw the
+        # flipped bytes.
+        assert scan_doc(got) == ref
+        assert sum(store.body_gets.values()) - before == 1
+        assert metric_total("kta_segstore_fallback_total") - poisoned0 == 1
+        snap = default_registry().snapshot()["kta_segstore_fallback_total"]
+        assert any(
+            s["labels"].get("reason") == "cache-poisoned" and s["value"] >= 1
+            for s in snap["samples"]
+        )
+
+
+def test_stale_cache_entry_is_miss_not_corruption(seg_dir, tmp_path):
+    """An entry that matches its OWN sha256 sidecar but no longer matches
+    the catalog's header (the archive was re-dumped at the same name and
+    size) must be evicted and re-fetched — never classified as fatal
+    corruption."""
+    import struct
+
+    cfg = cpu_cfg()
+    cache = str(tmp_path / "cache")
+    objects = _as_dict_root(seg_dir)
+    with FakeObjectStore(objects) as store:
+        fetch = fetch_cfg(0, cache=cache)
+        run_scan(
+            "t", SegmentFileSource(store.url, "t", fetch=fetch),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+        # Re-dump the archive: same names/sizes, start offsets shifted
+        # (the header changes, the sidecar-verified cache entries do not).
+        for name in list(objects):
+            data = bytearray(objects[name])
+            data[16:24] = struct.pack("<q", 500)  # start_offset
+            objects[name] = bytes(data)
+        stale0 = metric_total("kta_segstore_fallback_total")
+        got = run_scan(
+            "t", SegmentFileSource(store.url, "t", fetch=fetch),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+        # The NEW dump's offsets — fresh bytes, not the stale entries.
+        assert got.start_offsets == {0: 500, 1: 500, 2: 500}
+        assert got.degraded_partitions == {}
+        assert metric_total("kta_segstore_fallback_total") - stale0 == 3
+        snap = default_registry().snapshot()["kta_segstore_fallback_total"]
+        assert any(
+            s["labels"].get("reason") == "cache-stale" and s["value"] >= 3
+            for s in snap["samples"]
+        )
+        # And the re-dump is now cached: a third scan hits, no body GETs.
+        before = sum(store.body_gets.values())
+        run_scan(
+            "t", SegmentFileSource(store.url, "t", fetch=fetch),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+        assert sum(store.body_gets.values()) == before
+
+
+def test_cache_lru_eviction_bounds_directory(seg_dir, tmp_path):
+    cfg = cpu_cfg()
+    cache = str(tmp_path / "cache")
+    sizes = {
+        f: os.path.getsize(os.path.join(seg_dir, f))
+        for f in os.listdir(seg_dir)
+    }
+    # Bound below two chunks: after every insert the LRU sweep keeps the
+    # newest entry and evicts back under the bound.
+    bound = max(sizes.values()) + 10
+    evict0 = metric_total("kta_segstore_cache_evictions_total")
+    with FakeObjectStore(seg_dir) as store:
+        fetch = SegmentFetchConfig(
+            readahead=0, cache_dir=cache, cache_max_bytes=bound,
+            retry=FAST_RETRY, timeout_s=5,
+        )
+        run_scan(
+            "t", SegmentFileSource(store.url, "t", fetch=fetch),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+    resident = sum(
+        os.path.getsize(os.path.join(cache, f))
+        for f in os.listdir(cache) if f.endswith(".seg")
+    )
+    assert resident <= bound
+    assert metric_total("kta_segstore_cache_evictions_total") - evict0 >= 2
+
+
+# ---------------------------------------------------------------------------
+# cross-store resume
+
+
+class _Interrupt(Exception):
+    pass
+
+
+class _InterruptingSegSource(SegmentFileSource):
+    """Raises after yielding `limit` batches on the initial pass (resume
+    passes — start_at set — run to completion)."""
+
+    def __init__(self, *a, limit=2, **kw):
+        super().__init__(*a, **kw)
+        self.limit = limit
+
+    def batches(self, batch_size, partitions=None, start_at=None, sink=None):
+        it = super().batches(batch_size, partitions, start_at, sink=sink)
+        for i, b in enumerate(it):
+            if start_at is None and i >= self.limit:
+                raise _Interrupt()
+            yield b
+
+
+def test_cross_store_resume_both_directions(seg_dir, tmp_path):
+    from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+
+    cfg = cpu_cfg(batch_size=512)
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        TpuBackend(cfg, init_now_s=77), 512,
+    ))
+    with FakeObjectStore(seg_dir) as store:
+        def remote_src(interrupting=False, **kw):
+            cls = _InterruptingSegSource if interrupting else SegmentFileSource
+            return cls(store.url, "t", fetch=fetch_cfg(2), **kw)
+
+        # local snapshot → remote completion
+        snap1 = str(tmp_path / "snap1")
+        with pytest.raises(_Interrupt):
+            run_scan(
+                "t",
+                _InterruptingSegSource(seg_dir, "t", limit=2),
+                TpuBackend(cfg, init_now_s=77), 512,
+                snapshot_dir=snap1, snapshot_every_s=0.0,
+            )
+        got = run_scan(
+            "t", remote_src(), TpuBackend(cfg, init_now_s=0), 512,
+            snapshot_dir=snap1, resume=True,
+        )
+        assert scan_doc(got) == ref
+
+        # remote snapshot → local completion
+        snap2 = str(tmp_path / "snap2")
+        with pytest.raises(_Interrupt):
+            run_scan(
+                "t", remote_src(interrupting=True, limit=2),
+                TpuBackend(cfg, init_now_s=77), 512,
+                snapshot_dir=snap2, snapshot_every_s=0.0,
+            )
+        got = run_scan(
+            "t", SegmentFileSource(seg_dir, "t"),
+            TpuBackend(cfg, init_now_s=0), 512,
+            snapshot_dir=snap2, resume=True,
+        )
+        assert scan_doc(got) == ref
+
+
+# ---------------------------------------------------------------------------
+# CLI e2e + unsupported-combination errors
+
+
+def test_cli_remote_scan_json_with_cache_and_digest(seg_dir, tmp_path, capsys):
+    from kafka_topic_analyzer_tpu.cli import main
+    from kafka_topic_analyzer_tpu.results import SegmentStats
+
+    cache = str(tmp_path / "cache")
+    before = SegmentStats.from_telemetry(default_registry().snapshot())
+    with FakeObjectStore(seg_dir) as store:
+        assert main([
+            "-t", "t", "--source", "segfile", "--segment-dir", store.url,
+            "--segment-readahead", "2", "--segment-cache", cache,
+            "--backend", "cpu", "-c", "--alive-bitmap-bits", "18",
+            "--batch-size", "700", "--json", "--quiet", "--native", "off",
+        ]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["overall"]["count"] == 3 * SPEC.messages_per_partition
+        seg = doc["segments"]
+        # The remote-tier block rides the segments digest (deltas: the
+        # registry is cumulative under pytest).
+        assert seg["store_gets"] - before.gets >= 4  # list + headers + bodies
+        assert seg["store_bytes_fetched"] > before.bytes_fetched
+        assert seg["cache_misses"] - before.cache_misses == 3
+        assert "kta_segstore_gets_total" in doc["telemetry"]
+        assert os.path.isdir(cache)
+
+
+def test_cli_degraded_remote_scan_exits_3(seg_dir, capsys):
+    from kafka_topic_analyzer_tpu.cli import EXIT_DEGRADED, main
+
+    with FakeObjectStore(seg_dir) as store:
+        store.script("t-2.ktaseg", *[("status", 503)] * 32)
+        # The remote tier honors the wire scan's retry knobs through the
+        # same --librdkafka spellings — shrink the schedule so budget
+        # exhaustion is fast.
+        rc = main([
+            "-t", "t", "--source", "segfile", "--segment-dir", store.url,
+            "--segment-readahead", "0", "--backend", "cpu",
+            "--librdkafka",
+            "retry.backoff.ms=1,reconnect.backoff.max.ms=4,"
+            "transport.retry.budget=3",
+            "--batch-size", "700", "--quiet", "--native", "off",
+        ])
+    assert rc == EXIT_DEGRADED
+    out = capsys.readouterr().out
+    assert "DEGRADED" in out
+
+
+def test_follow_and_fleet_reject_segment_stores(seg_dir, capsys):
+    from kafka_topic_analyzer_tpu.cli import main
+
+    rc = main([
+        "-t", "t", "--source", "segfile", "--segment-dir", seg_dir,
+        "--follow", "--quiet",
+    ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    # The rejection names the semantics AND the lifting path.
+    assert "immutable" in err and "moving head" in err
+    assert "--dump-segments" in err
+
+    rc = main([
+        "-t", "t", "--source", "segfile", "--segment-dir", seg_dir,
+        "--fleet", "--quiet",
+    ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "--fleet requires --source kafka" in err
+    assert "scan it solo" in err
+
+    rc = main([
+        "-t", "t", "--source", "kafka", "-b", "127.0.0.1:9",
+        "--segment-cache", "/tmp/nope", "--quiet",
+    ])
+    assert rc == 1
+    assert "--segment-cache requires --source segfile" in (
+        capsys.readouterr().err
+    )
+
+
+def test_segment_dir_error_mentions_remote_specs(capsys):
+    from kafka_topic_analyzer_tpu.cli import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["-t", "t", "--source", "segfile", "--quiet"])
+    msg = str(e.value)
+    assert "http(s)://" in msg and "s3://" in msg
+
+
+# ---------------------------------------------------------------------------
+# bench smoke
+
+
+def test_bench_segments_remote_smoke(capsys):
+    from kafka_topic_analyzer_tpu.tools.bench_segments import main as bench
+
+    assert bench([
+        "--records", "8000", "--partitions", "2", "--chunk-records", "2000",
+        "--workers", "2", "--store", "serve", "--inject-latency-ms", "1",
+        "--readahead", "0,2", "--repeat", "1", "--native", "off",
+    ]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["store"] == "serve"
+    assert set(doc["seg_msgs_per_sec"]) == {"w2.ra0", "w2.ra2"}
+    assert all(v > 0 for v in doc["seg_msgs_per_sec"].values())

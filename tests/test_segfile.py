@@ -254,8 +254,10 @@ def test_open_segment_store_and_catalog(seg_dir):
 def test_open_segment_store_rejects_unknown_scheme(tmp_path):
     from kafka_topic_analyzer_tpu.io.segstore import open_segment_store
 
-    with pytest.raises(ValueError, match="scheme 's3' is not implemented"):
-        open_segment_store("s3://bucket/prefix")
+    # Unknown schemes list what IS supported (s3://-style specs route to
+    # the remote tier now — tests/test_objstore.py).
+    with pytest.raises(ValueError, match="scheme 'gs' is not supported"):
+        open_segment_store("gs://bucket/prefix")
     with pytest.raises(ValueError, match="not a directory"):
         open_segment_store(str(tmp_path / "missing"))
     # file:// is the explicit spelling of the local store.

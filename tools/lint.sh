@@ -897,3 +897,132 @@ if failures:
     sys.exit(1)
 print("lint: OK (fleet scheduler is pure; admission decisions book reasons)")
 EOF
+
+# Eleventh rule: the remote segment tier's network I/O is confined to the
+# retry-budget wrapper.  (a) Raw HTTP request primitives (.request /
+# .getresponse) may appear ONLY inside io/objstore.py's RetryingHttp —
+# the one class that paces attempts through io/retry.Backoff and routes
+# failure streaks through the PartitionRetryBudget; any other call site
+# would be a bare retry loop (or no retry at all).  (b) No other io/
+# module may import an HTTP client (http.client, urllib.request) — the
+# object-store protocol has exactly one door.  (The Kafka wire client's
+# raw socket use is its own protocol layer, with its own PR-1 budget.)  (c) No unbooked sleeps:
+# time.sleep is forbidden in io/objstore.py, io/segstore.py and
+# io/segfile.py (pacing goes through Backoff.sleep_for, which books
+# kta_backoff_sleep_seconds_total).  (d) Every fallback-to-direct-fetch
+# path books a kta_segstore_* reason: each except handler in
+# SegmentCache's get/put must either re-raise or reference the
+# SEGSTORE_FALLBACK instrument (via _book_fallback) — a silent cache
+# bypass is a lint failure.
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PKG = pathlib.Path("kafka_topic_analyzer_tpu")
+OBJSTORE = PKG / "io" / "objstore.py"
+NO_SLEEP = [OBJSTORE, PKG / "io" / "segstore.py", PKG / "io" / "segfile.py"]
+NET_MODULES = {"http", "urllib"}
+
+failures = []
+
+# (a) request/getresponse confined to RetryingHttp.
+tree = ast.parse(OBJSTORE.read_text(encoding="utf-8"), filename=str(OBJSTORE))
+class_of = {}
+for node in ast.walk(tree):
+    if isinstance(node, ast.ClassDef):
+        for child in ast.walk(node):
+            class_of.setdefault(id(child), node.name)
+for node in ast.walk(tree):
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("request", "getresponse", "urlopen")
+    ):
+        if class_of.get(id(node)) != "RetryingHttp":
+            failures.append(
+                f"{OBJSTORE}:{node.lineno}: raw HTTP call "
+                f"{node.func.attr!r} outside RetryingHttp (the "
+                "retry-budget wrapper is the only network door)"
+            )
+
+# (b) no other io/ module imports an HTTP/socket client.
+for path in sorted((PKG / "io").glob("*.py")):
+    if path == OBJSTORE:
+        continue
+    t = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    for node in ast.walk(t):
+        mods = []
+        if isinstance(node, ast.Import):
+            mods = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            mods = [node.module]
+        for mod in mods:
+            root = mod.split(".")[0]
+            if root in NET_MODULES:
+                failures.append(
+                    f"{path}:{node.lineno}: imports {mod!r} — remote-store "
+                    "network I/O belongs to io/objstore.py's RetryingHttp"
+                )
+
+# (c) no unbooked sleeps on the remote tier.
+for path in NO_SLEEP:
+    t = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    for node in ast.walk(t):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "sleep"
+        ):
+            failures.append(
+                f"{path}:{node.lineno}: bare sleep() — pace retries via "
+                "io/retry.Backoff.sleep_for (booked) instead"
+            )
+
+# (d) cache fallback paths book their reason.
+def references_fallback(handler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Name) and "fallback" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and n.attr.startswith("SEGSTORE_"):
+            return True
+        if isinstance(n, ast.Raise):
+            return True
+    return False
+
+for node in ast.walk(tree):
+    if isinstance(node, ast.ClassDef) and node.name == "SegmentCache":
+        for item in node.body:
+            if not isinstance(item, ast.FunctionDef):
+                continue
+            if item.name not in ("get", "put"):
+                continue
+            for n in ast.walk(item):
+                if isinstance(n, ast.ExceptHandler) and not (
+                    references_fallback(n)
+                ):
+                    # Handlers that only signal a MISS (return None) are
+                    # cache-absent, not a fallback: the miss counter in
+                    # the same body books them.  Require at least the
+                    # miss/fallback instrument in the enclosing function.
+                    books = any(
+                        isinstance(m, ast.Attribute)
+                        and m.attr.startswith("SEGSTORE_")
+                        for m in ast.walk(item)
+                    )
+                    if not books:
+                        failures.append(
+                            f"{OBJSTORE}:{n.lineno}: SegmentCache."
+                            f"{item.name} swallows an error without "
+                            "booking a kta_segstore_* reason"
+                        )
+
+if failures:
+    print("lint: remote segment tier network/booking discipline violated")
+    print("lint: (HTTP only via RetryingHttp, sleeps only via Backoff,")
+    print("lint: cache bypasses always booked — DESIGN.md §21):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (remote segment tier: one network door, booked fallbacks)")
+EOF
