@@ -14,9 +14,16 @@ body.  This module is the S3-shaped HTTP client behind
   `PartitionRetryBudget` so a partition whose chunks stay unreachable is
   DEGRADED (scan continues without it, reported) instead of retried
   forever.  Transient failures are resets/timeouts/truncated bodies/5xx;
-  a 200-body whose MD5 disagrees with the response ETag is *in-flight*
-  damage by definition and retries the same way.  4xx are deterministic
-  and never retried.
+  a 200-body whose MD5 disagrees with the response ETag is presumed
+  *in-flight* damage and re-fetched — but a second fetch returning
+  byte-identical data proves the mismatch persistent (SSE-KMS/SSE-C
+  ETags are 32-hex yet not the content MD5; responses declaring such
+  encryption skip the check up front) and the body is accepted, booked,
+  and left to the downstream structural/sha256 validation.  4xx are
+  deterministic and never retried; so is a server that ignores Range
+  headers (the requested window is sliced out of its 200 response).
+  LIST pagination follows NextContinuationToken until IsTruncated
+  clears, so catalogs beyond one 1000-key page enumerate completely.
 - `SegmentCache` — the content-verified local chunk cache
   (``--segment-cache DIR``): entries are keyed by the address digest
   (store + object name + size), written tmp-file → atomic rename, carry a
@@ -119,13 +126,33 @@ class RetryingHttp:
         # must never issue GET /bucket/some/prefix/?list-type=2, which
         # is an object GET, not a bucket LIST.
         parts = [p for p in self.base.split("/") if p]
-        self.bucket_path = f"/{parts[0]}" if parts else ""
+        if not parts:
+            # A bucketless spec would LIST against `GET /?list-type=2`
+            # and GET `/name` — the user would see a confusing downstream
+            # 404/XML error instead of a spec rejection.  (Validated here,
+            # not in parse_object_store_spec: the s3:// branch parses a
+            # bare KTA_S3_ENDPOINT with an empty base legitimately.)
+            raise ValueError(
+                f"bad object store spec {spec!r}: no bucket in path — "
+                "expected http(s)://host[:port]/bucket[/prefix]"
+            )
+        self.bucket_path = f"/{parts[0]}"
         self.key_prefix = "/".join(parts[1:])
         if self.key_prefix:
             self.key_prefix += "/"
         self.timeout_s = fetch.timeout_s
         self.backoff = Backoff(fetch.retry)
         self.budget = PartitionRetryBudget(fetch.retry.retry_budget)
+        #: Latched once ONE object proves (via a byte-identical re-fetch)
+        #: that this store's ETags are not content MD5s: SSE and ETag
+        #: policy are bucket-level, so re-learning it per chunk would
+        #: download an archived year twice and sleep a backoff per chunk.
+        self.etag_not_md5 = False
+        #: Latched once ONE ranged GET comes back as a 200 full object:
+        #: Range support is server-level, so once known the catalog
+        #: fetches each chunk whole ONCE and slices its probes locally
+        #: instead of downloading the full object per probe.
+        self.range_ignored = False
         self._lock = threading.Lock()
         self._local = threading.local()
 
@@ -198,6 +225,12 @@ class RetryingHttp:
                 partition=partition,
             )
         attempt = 0
+        #: MD5 of the last body whose ETag disagreed: a SECOND fetch
+        #: returning the identical bytes proves the damage is not
+        #: in-flight — the ETag simply is not the content MD5 (SSE-KMS /
+        #: SSE-C / composite ETags), and retrying further would burn the
+        #: whole budget against a healthy encrypted archive.
+        mismatched_md5: "Optional[str]" = None
         while True:
             try:
                 try:
@@ -214,22 +247,105 @@ class RetryingHttp:
                         f"object store GET {self.url_of(path)} failed: "
                         f"HTTP {status}"
                     )
-                if expect is not None and len(body) != expect:
+                #: What actually crossed the wire — the egress metric
+                #: books this even when a range-ignored full body is
+                #: sliced down to a 32-byte window below.
+                transferred = len(body)
+                if (
+                    status == 200
+                    and rng is not None
+                    and (expect is None or len(body) != expect)
+                ):
+                    # The endpoint ignored the Range header and replied 200
+                    # with the FULL object.  That is deterministic server
+                    # behavior, not in-flight damage: slice the requested
+                    # window out (booked — every header probe against such
+                    # a server pays a whole-body download) instead of
+                    # burning the retry budget on 'truncated body'.  But a
+                    # 200 body CUT SHORT of its own Content-Length is
+                    # in-flight truncation, not range-ignoring — still
+                    # transient.
+                    try:
+                        declared = int(headers.get("content-length", ""))
+                    except ValueError:
+                        declared = None
+                    if declared is not None and len(body) < declared:
+                        self._evict_connection()
+                        raise _Transient(
+                            f"truncated body ({len(body)} of "
+                            f"{declared} bytes)"
+                        )
+                    lo, hi = rng
+                    sliced = (
+                        (body[-hi:] if hi else b"") if lo is None
+                        else body[lo : hi + 1]
+                    )
+                    if expect is not None and len(sliced) != expect:
+                        if declared is None:
+                            # Close-delimited response (no Content-Length)
+                            # cut short: indistinguishable from in-flight
+                            # truncation — retry under the budget rather
+                            # than abort the scan on one network blip.
+                            self._evict_connection()
+                            raise _Transient(
+                                f"short 200 body for ranged GET "
+                                f"({len(body)} bytes, no Content-Length)"
+                            )
+                        raise ObjectStoreError(
+                            f"object store GET {self.url_of(path)} ignored "
+                            f"Range: bytes={'' if lo is None else lo}-{hi} "
+                            f"and its {len(body)}-byte 200 response cannot "
+                            f"satisfy it — server does not support ranged "
+                            "GETs"
+                        )
+                    body = sliced
+                    self.range_ignored = True
+                    _book_fallback("range-ignored")
+                elif expect is not None and len(body) != expect:
                     self._evict_connection()
                     raise _Transient(
                         f"truncated body ({len(body)} of {expect} bytes)"
                     )
                 if status == 200 and rng is None:
-                    # Whole-object GET: S3 ETags for simple objects are the
-                    # body MD5 — a mismatch is by definition damage in
-                    # flight (or a lying server) and retries as transient.
+                    # Whole-object GET: S3 ETags for SIMPLE objects are the
+                    # body MD5, so a first mismatch is presumed damage in
+                    # flight and re-fetched.  But 32-hex ETags that are NOT
+                    # the content MD5 exist (SSE-KMS / SSE-C encrypt the
+                    # stored bytes), so the check is skipped when the
+                    # response declares such encryption — and a SECOND
+                    # fetch returning byte-identical data proves the
+                    # mismatch is persistent, not in-flight: accept the
+                    # body (booked) and let the structural / sha256
+                    # validation downstream judge it, rather than degrading
+                    # every partition of a healthy encrypted archive.
                     etag = headers.get("etag", "").strip('"')
-                    if re.fullmatch(r"[0-9a-f]{32}", etag) and (
-                        hashlib.md5(body).hexdigest() != etag
-                    ):
-                        raise _Transient("body MD5 does not match ETag")
+                    sse = headers.get(
+                        "x-amz-server-side-encryption", ""
+                    ).lower()
+                    etag_is_md5 = (
+                        not self.etag_not_md5
+                        and re.fullmatch(r"[0-9a-f]{32}", etag) is not None
+                        and "kms" not in sse
+                        and "x-amz-server-side-encryption-customer-algorithm"
+                        not in headers
+                    )
+                    if etag_is_md5:
+                        md5 = hashlib.md5(body).hexdigest()
+                        if md5 == etag:
+                            pass
+                        elif md5 == mismatched_md5:
+                            self.etag_not_md5 = True
+                            _book_fallback("etag-not-md5")
+                            obs_events.emit(
+                                "segstore_etag_not_md5",
+                                url=self.url_of(path),
+                                etag=etag,
+                            )
+                        else:
+                            mismatched_md5 = md5
+                            raise _Transient("body MD5 does not match ETag")
                 obs_metrics.SEGSTORE_GETS.labels(kind=kind).inc()
-                obs_metrics.SEGSTORE_BYTES.inc(len(body))
+                obs_metrics.SEGSTORE_BYTES.inc(transferred)
                 if partition is not None:
                     with self._lock:
                         self.budget.record_success(partition)
@@ -257,32 +373,63 @@ class RetryingHttp:
     def list_objects(self, prefix: str) -> "List[Tuple[str, int]]":
         """LIST (name, size) under ``prefix`` — ListObjectsV2-shaped:
         ``{bucket}/?list-type=2&prefix={key_prefix}{prefix}`` returning
-        ListBucketResult XML.  Keys come back as full bucket keys; the
-        basename is the store-relative name, so flat and prefixed
-        layouts enumerate identically."""
+        ListBucketResult XML, PAGINATED: S3 caps a LIST page at 1000 keys
+        and an archived year is tens of thousands of chunks, so this
+        follows NextContinuationToken until IsTruncated clears — a
+        truncated page that carries no token is a protocol violation and
+        fails loudly (a silently short catalog would scan incomplete data
+        'successfully').  Every page rides the same retry-budget ``get``.
+        Keys come back as full bucket keys; the basename is the
+        store-relative name, so flat and prefixed layouts enumerate
+        identically."""
         from urllib.parse import quote
 
-        body = self.get(
-            f"{self.bucket_path}/?list-type=2"
-            f"&prefix={quote(self.key_prefix + prefix)}",
-            kind="list",
-        )
-        try:
-            root = ElementTree.parse(io.BytesIO(body)).getroot()
-        except ElementTree.ParseError as e:
-            raise ObjectStoreError(
-                f"object store LIST {self.spec} returned unparseable XML: {e}"
-            ) from e
-        # S3 proper namespaces the document; local servers may not.
-        ns = ""
-        if root.tag.startswith("{"):
-            ns = root.tag[: root.tag.index("}") + 1]
-        out = []
-        for c in root.iter(f"{ns}Contents"):
-            key = c.findtext(f"{ns}Key") or ""
-            size = int(c.findtext(f"{ns}Size") or 0)
-            out.append((key.rsplit("/", 1)[-1], size))
-        return out
+        out: "List[Tuple[str, int]]" = []
+        token: "Optional[str]" = None
+        while True:
+            path = (
+                f"{self.bucket_path}/?list-type=2"
+                f"&prefix={quote(self.key_prefix + prefix)}"
+            )
+            if token:
+                path += f"&continuation-token={quote(token)}"
+            body = self.get(path, kind="list")
+            try:
+                root = ElementTree.parse(io.BytesIO(body)).getroot()
+            except ElementTree.ParseError as e:
+                raise ObjectStoreError(
+                    f"object store LIST {self.spec} returned unparseable "
+                    f"XML: {e}"
+                ) from e
+            # S3 proper namespaces the document; local servers may not.
+            ns = ""
+            if root.tag.startswith("{"):
+                ns = root.tag[: root.tag.index("}") + 1]
+            for c in root.iter(f"{ns}Contents"):
+                key = c.findtext(f"{ns}Key") or ""
+                size = int(c.findtext(f"{ns}Size") or 0)
+                out.append((key.rsplit("/", 1)[-1], size))
+            truncated = (
+                (root.findtext(f"{ns}IsTruncated") or "").strip().lower()
+                == "true"
+            )
+            if not truncated:
+                return out
+            next_token = root.findtext(f"{ns}NextContinuationToken")
+            if not next_token:
+                raise ObjectStoreError(
+                    f"object store LIST {self.spec} returned a truncated "
+                    "page without a NextContinuationToken — cannot "
+                    "enumerate the full catalog"
+                )
+            if next_token == token:
+                # A server that echoes the same token forever would loop
+                # this walk unboundedly while duplicating keys.
+                raise ObjectStoreError(
+                    f"object store LIST {self.spec} repeated continuation "
+                    f"token {next_token!r} — no pagination progress"
+                )
+            token = next_token
 
     def object_path(self, name: str) -> str:
         from urllib.parse import quote
@@ -407,7 +554,21 @@ class SegmentCache:
             tmp = f"{seg}.tmp.{os.getpid()}.{threading.get_ident()}"
             with open(tmp, "wb") as f:
                 f.write(data)
-            os.replace(tmp, seg)
+            # Re-inserting an existing digest (racing fetches of one
+            # chunk, a re-put after an unreadable sidecar) REPLACES its
+            # bytes: only the net growth may be added to the running
+            # total, or the inflated estimate triggers premature
+            # full-directory eviction sweeps.  The stat, the rename, and
+            # the total update must be one atom — two racing puts of the
+            # SAME digest would otherwise both stat the pre-replace state
+            # and both add the full size.  (The expensive body write
+            # above stays unlocked.)
+            with self._lock:
+                replaced = self._stat(seg)
+                os.replace(tmp, seg)
+                self._total += len(data) - (
+                    replaced.st_size if replaced is not None else 0
+                )
             mtmp = f"{meta}.tmp.{os.getpid()}.{threading.get_ident()}"
             with open(mtmp, "w", encoding="utf-8") as f:
                 json.dump(
@@ -425,7 +586,6 @@ class SegmentCache:
             _book_fallback("cache-io-error")
             return
         with self._lock:
-            self._total += len(data)
             if self._total > self.max_bytes:
                 self._evict_to_bound(keep=digest)
 

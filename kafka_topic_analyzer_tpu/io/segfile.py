@@ -412,9 +412,20 @@ class RemoteSegmentFile(SegmentFile):
 
     def release(self) -> None:
         """Drop the body reference (batch views already handed out keep
-        the underlying buffer alive; new touches re-fetch via the cache)."""
-        with self._lock:
-            self._data = None
+        the underlying buffer alive; new touches re-fetch via the cache).
+
+        BEST-EFFORT: ``ensure_body`` holds the per-chunk lock for the
+        whole fetch (socket timeout + backoff sleeps), and release is
+        called from teardown paths — the degraded-partition skip and the
+        end-of-stream sweep — that must never stall tens of seconds
+        behind a pool thread stuck in a hung request.  If the lock is
+        busy, the in-flight fetch owns the body's lifetime; memory stays
+        bounded by the pool depth either way."""
+        if self._lock.acquire(blocking=False):
+            try:
+                self._data = None
+            finally:
+                self._lock.release()
 
 
 class _ChunkReadahead:
@@ -711,11 +722,26 @@ class SegmentFileSource(RecordSource):
                 if resume is not None:
                     if resume >= seg.end_offset:
                         continue  # chunk fully below the resume point
-                    if seg.has_offsets:
-                        offs = np.asarray(seg.column("offsets"))
-                        first = int(np.searchsorted(offs, resume))
-                    else:
-                        first = min(max(resume - seg.start_offset, 0), seg.count)
+                    if resume > seg.start_offset:
+                        # Only the ONE chunk straddling the resume point
+                        # needs its offsets column (a synchronous body
+                        # fetch on remote stores); chunks entirely above
+                        # the resume point start at record 0 — probing
+                        # them too would download every remaining chunk
+                        # at plan time and pin them all in memory.
+                        if seg.has_offsets:
+                            try:
+                                offs = np.asarray(seg.column("offsets"))
+                            except SegmentFetchUnavailable as e:
+                                # Plan-time fetches degrade like consumer
+                                # ones: drop the partition, keep scanning.
+                                self._note_degraded(p, str(e))
+                                break
+                            first = int(np.searchsorted(offs, resume))
+                        else:
+                            first = min(
+                                max(resume - seg.start_offset, 0), seg.count
+                            )
                 plan.append((p, seg, first))
         pool = None
         if self.readahead > 0 and any(

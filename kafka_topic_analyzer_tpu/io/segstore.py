@@ -169,12 +169,32 @@ class ObjectSegmentStore(SegmentStore):
         # same attempt budget) rather than silently dropping partitions
         # from the catalog — the degraded surface only covers partitions
         # the scan actually admitted (body fetches, during batches()).
-        header = self.transport.get(
-            path,
-            rng=(0, HEADER_SIZE - 1),
-            kind="header",
-            expect=min(HEADER_SIZE, ref.size),
-        )
+        whole = None
+        if self.transport.range_ignored:
+            # This server answers every ranged GET with the full object
+            # (latched on first detection): issue ONE whole-object GET
+            # per chunk and slice the header/tail probes locally, instead
+            # of downloading the full object once per probe.  The cache
+            # absorbs the cost entirely when enabled — consulted before
+            # the GET (a warm catalog open downloads nothing) and seeded
+            # after it (the body fetch later is a verified hit, so the
+            # chunk crosses the wire once per scan, not twice).
+            if self.cache is not None:
+                whole = self.cache.get(ref.name, ref.size)
+            if whole is None:
+                whole = self.transport.get(
+                    path, kind="header", expect=ref.size
+                )
+                if self.cache is not None:
+                    self.cache.put(ref.name, ref.size, whole)
+            header = whole[: min(HEADER_SIZE, ref.size)]
+        else:
+            header = self.transport.get(
+                path,
+                rng=(0, HEADER_SIZE - 1),
+                kind="header",
+                expect=min(HEADER_SIZE, ref.size),
+            )
         _p, flags, _start, count = parse_segment_header(
             header, f"{self.spec}/{ref.name}"
         )
@@ -182,12 +202,15 @@ class ObjectSegmentStore(SegmentStore):
         if flags & FLAG_OFFSETS and count > 0:
             # Gappy chunk: the offset-exact end watermark is the LAST
             # offsets entry — an 8-byte suffix probe, not a body download.
-            tail = self.transport.get(
-                path,
-                rng=(ref.size - 8, ref.size - 1),
-                kind="header",
-                expect=8,
-            )
+            if whole is not None:
+                tail = whole[ref.size - 8 : ref.size]
+            else:
+                tail = self.transport.get(
+                    path,
+                    rng=(ref.size - 8, ref.size - 1),
+                    kind="header",
+                    expect=8,
+                )
             end_offset = struct.unpack("<q", tail)[0] + 1
 
         def fetch_body(validate):

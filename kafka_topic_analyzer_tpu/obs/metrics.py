@@ -166,7 +166,11 @@ SEGSTORE_FALLBACK = _REG.counter(
     "(cache-poisoned = a cached entry failed sha256 verification, "
     "cache-stale = a verified entry no longer matches the catalog's "
     "header — the archive was re-dumped at the same name and size, "
-    "cache-io-error = the cache directory was unreadable/unwritable) — "
+    "cache-io-error = the cache directory was unreadable/unwritable, "
+    "range-ignored = the endpoint answered a ranged GET with the full "
+    "object and the requested window was sliced client-side, "
+    "etag-not-md5 = a persistent ETag/MD5 mismatch was accepted after a "
+    "byte-identical re-fetch — SSE-KMS/SSE-C-shaped ETag) — "
     "a cache bypass is never silent",
     labelnames=("reason",))
 

@@ -58,6 +58,10 @@ class ObjectStoreHttpServer:
         latency_ms: float = 0.0,
         fault_hook: "Optional[FaultHook]" = None,
         send_etag: bool = True,
+        max_keys: int = 1000,
+        sse: "Optional[str]" = None,
+        etag_salt: bytes = b"",
+        ignore_range: bool = False,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -66,6 +70,21 @@ class ObjectStoreHttpServer:
         self.latency_ms = latency_ms
         self.fault_hook = fault_hook
         self.send_etag = send_etag
+        #: LIST page cap (S3 caps at 1000): pages beyond it return
+        #: IsTruncated=true + NextContinuationToken, so clients that fail
+        #: to paginate see exactly what real S3 would show them.
+        self.max_keys = max_keys
+        #: When set, object responses carry x-amz-server-side-encryption
+        #: (e.g. "aws:kms") — real KMS-encrypted objects have 32-hex
+        #: ETags that are NOT the content MD5.
+        self.sse = sse
+        #: Salts the ETag hash: a 32-hex ETag that never matches the body
+        #: MD5 (the SSE-KMS/SSE-C shape, minus the header when sse=None).
+        self.etag_salt = etag_salt
+        #: Serve every ranged GET as a 200 full-object response (servers
+        #: that don't implement Range exist; clients must not burn their
+        #: retry budget calling the full body 'truncated').
+        self.ignore_range = ignore_range
         self.requests_served = 0
         self._request_index = 0
         self._lock = threading.Lock()
@@ -175,28 +194,29 @@ class ObjectStoreHttpServer:
             return None, 0
 
     def _etag(self, key: str) -> "Optional[str]":
-        """Whole-object MD5 (S3 ETag semantics), computed once per object
-        version: keyed on (size, mtime) for file roots and on the bytes
-        object's identity for dict roots, so a mutated object re-hashes
-        and an untouched one never does."""
+        """Whole-object MD5 (S3 ETag semantics).  Dict roots hash the
+        bytes directly (cheap test data; caching under ``id(data)`` can
+        serve a STALE ETag after CPython reuses a freed address for a
+        replacement object of the same length).  File roots cache per
+        object version, keyed on (size, mtime), so a 32-byte header
+        probe never costs a full-file read + hash."""
         if isinstance(self.root, dict):
             data = self.root.get(key)
             if data is None:
                 return None
-            sig: object = ("d", id(data), len(data))
-        else:
-            try:
-                st = os.stat(os.path.join(self.root, key))
-            except OSError:
-                return None
-            sig = (st.st_size, st.st_mtime_ns)
+            return hashlib.md5(data + self.etag_salt).hexdigest()
+        try:
+            st = os.stat(os.path.join(self.root, key))
+        except OSError:
+            return None
+        sig = (st.st_size, st.st_mtime_ns)
         cached = self._etags.get(key)
         if cached is not None and cached[0] == sig:
             return cached[1]
         data, _ = self._read_range(key, None)
         if data is None:
             return None
-        etag = hashlib.md5(data).hexdigest()
+        etag = hashlib.md5(data + self.etag_salt).hexdigest()
         self._etags[key] = (sig, etag)
         return etag
 
@@ -224,18 +244,38 @@ class ObjectStoreHttpServer:
             time.sleep(self.latency_ms / 1000.0)
         query = parse_qs(parsed.query)
         if len(parts) == 1 and "list-type" in query:
-            self._handle_list(req, query.get("prefix", [""])[0])
+            self._handle_list(req, query)
             return
         if len(parts) < 2:
             self._respond(req, 400, b"missing key")
             return
         self._handle_object(req, "/".join(parts[1:]), index)
 
-    def _handle_list(self, req: BaseHTTPRequestHandler, prefix: str) -> None:
+    def _handle_list(
+        self, req: BaseHTTPRequestHandler, query: "Dict[str, list]"
+    ) -> None:
+        prefix = query.get("prefix", [""])[0]
+        token = query.get("continuation-token", [""])[0]
+        try:
+            max_keys = int(query.get("max-keys", [str(self.max_keys)])[0])
+        except ValueError:
+            max_keys = -1
+        if max_keys < 1:
+            # 0 would paginate forever without progress (page[-1] of an
+            # empty page); fail it deterministically.
+            self._respond(req, 400, b"bad max-keys")
+            return
+        # ListObjectsV2 pagination: the continuation token is the last key
+        # of the previous page (keys enumerate in lexicographic order, so
+        # strictly-greater resumes exactly after it).
+        matched = [
+            key
+            for key in self._keys()
+            if key.startswith(prefix) and (not token or key > token)
+        ]
+        page, truncated = matched[:max_keys], len(matched) > max_keys
         rows = []
-        for key in self._keys():
-            if not key.startswith(prefix):
-                continue
+        for key in page:
             size = self._size(key)
             if size is None:
                 continue
@@ -250,8 +290,14 @@ class ObjectStoreHttpServer:
             '<?xml version="1.0" encoding="UTF-8"?>'
             "<ListBucketResult>"
             f"<Name>{escape(self.bucket)}</Name>"
-            "<IsTruncated>false</IsTruncated>"
-            f"{''.join(rows)}"
+            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+            + (
+                f"<NextContinuationToken>{escape(page[-1])}"
+                "</NextContinuationToken>"
+                if truncated
+                else ""
+            )
+            + f"{''.join(rows)}"
             "</ListBucketResult>"
         ).encode()
         self._respond(req, 200, body, content_type="application/xml")
@@ -280,11 +326,14 @@ class ObjectStoreHttpServer:
         if isinstance(action, tuple) and action[0] == "status":
             self._respond(req, int(action[1]), b"injected fault")
             return
-        data, _full_size = self._read_range(key, rng)
+        # ignore_range mode answers a ranged GET with the full object and
+        # a 200 (the fault hook still sees the range the client asked for).
+        serve_rng = None if self.ignore_range else rng
+        data, _full_size = self._read_range(key, serve_rng)
         if data is None:
             self._respond(req, 404, b"no such key")
             return
-        status = 200 if rng is None else 206
+        status = 200 if serve_rng is None else 206
         claimed_len = len(data)
         if isinstance(action, tuple) and action[0] == "flip":
             flipped = bytearray(data)
@@ -293,6 +342,8 @@ class ObjectStoreHttpServer:
         elif isinstance(action, tuple) and action[0] == "truncate":
             data = data[: action[1]]
         headers = {}
+        if self.sse:
+            headers["x-amz-server-side-encryption"] = self.sse
         if self.send_etag:
             # S3 semantics: the ETag always describes the WHOLE object
             # (the TRUE object — an injected in-flight flip must not

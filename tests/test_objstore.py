@@ -176,6 +176,53 @@ def test_remote_catalog_auto_readahead_and_gappy_end_offsets(tmp_path):
         assert int(resumed.offsets[0]) == 153
 
 
+def _write_gappy_chunks(tmp_path):
+    from kafka_topic_analyzer_tpu.io.kafka_wire import records_to_batch
+    from kafka_topic_analyzer_tpu.io.segfile import SegmentDumpWriter
+
+    rows = [
+        (0, 1_600_000_000_000 + off, f"k{off % 7}".encode(), bytes(12))
+        for off in range(0, 300, 3)
+    ]
+    batch = records_to_batch(rows)
+    batch.offsets = np.arange(0, 300, 3, dtype=np.int64)
+    writer = SegmentDumpWriter(str(tmp_path), "gap", records_per_chunk=40)
+    for lo in range(0, 100, 25):
+        writer.append(batch.take(np.arange(lo, lo + 25)))
+    writer.close()
+
+
+def test_resume_plan_probes_only_the_straddling_chunk(tmp_path):
+    """Resuming mid-archive must touch exactly ONE chunk's offsets column
+    at plan time (the chunk straddling the resume point): probing every
+    remaining gappy chunk would synchronously download the rest of the
+    archive up front and pin it all in memory."""
+    _write_gappy_chunks(tmp_path)  # c0 = offsets 0..147, c1 = 150..297
+    with FakeObjectStore(str(tmp_path)) as store:
+        src = SegmentFileSource(store.url, "gap", fetch=fetch_cfg(0))
+        it = src.batches(50, start_at={0: 100})
+        got = next(it)
+        assert int(got.offsets[0]) == 102
+        it.close()
+        # c0 straddles 100 and is probed; c1 is entirely above the resume
+        # point and must not be fetched at plan time.
+        assert store.body_gets["gap-0.c0.ktaseg"] == 1
+        assert store.body_gets["gap-0.c1.ktaseg"] == 0
+
+
+def test_resume_plan_probe_failure_degrades_not_crashes(tmp_path):
+    """A plan-time offsets probe that exhausts the partition's transport
+    budget degrades that partition (the PR-1 surface) — it must not
+    escape batches() and crash the resumed scan."""
+    _write_gappy_chunks(tmp_path)
+    with FakeObjectStore(str(tmp_path)) as store:
+        store.script("gap-0.c0.ktaseg", *[("status", 503)] * 32)
+        src = SegmentFileSource(store.url, "gap", fetch=fetch_cfg(0))
+        assert list(src.batches(50, start_at={0: 100})) == []
+        assert list(src.degraded_partitions()) == [0]
+        assert "failures" in src.degraded_partitions()[0]
+
+
 def test_prefixed_store_spec_lists_and_fetches(seg_dir):
     """A /bucket/some/prefix spec must LIST against the BUCKET with the
     key prefix folded into ?prefix=, and GET prefixed keys — a prefixed
@@ -301,6 +348,160 @@ def test_retry_budget_exhaustion_degrades_partition(seg_dir):
     # The engine persists the degraded surface identically to a dead wire
     # partition: the scan result exposes it for EXIT_DEGRADED.
     assert metric_total("kta_retry_budget_exhaustions_total") >= 1
+
+
+def test_list_pagination_enumerates_full_catalog(seg_dir):
+    """S3 caps a LIST page at 1000 keys: the client must follow
+    NextContinuationToken until IsTruncated clears, or an archive larger
+    than one page silently loses its lexicographic tail."""
+    from kafka_topic_analyzer_tpu.io.objstore import RetryingHttp
+
+    def list_gets():
+        snap = default_registry().snapshot().get("kta_segstore_gets_total")
+        return sum(
+            s["value"] for s in (snap or {"samples": []})["samples"]
+            if s["labels"].get("kind") == "list"
+        )
+
+    objects = {f"t-{i}.ktaseg": b"x" * 8 for i in range(25)}
+    with FakeObjectStore(objects, max_keys=10) as store:
+        http = RetryingHttp(store.url, fetch_cfg())
+        lists0 = list_gets()
+        names = sorted(n for n, _ in http.list_objects("t-"))
+        assert names == sorted(objects)  # all 25 …
+        assert list_gets() - lists0 == 3  # … across 3 pages
+    # And end-to-end: a scan against a paginating store (3 chunks, 2-key
+    # pages) stays byte-identical to the local reference.
+    cfg = cpu_cfg()
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    with FakeObjectStore(seg_dir, max_keys=2) as store:
+        got = run_scan(
+            "t",
+            SegmentFileSource(store.url, "t", fetch=fetch_cfg(0)),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+    assert scan_doc(got) == ref
+
+
+def test_sse_kms_etag_is_not_treated_as_damage(seg_dir):
+    """SSE-KMS objects carry 32-hex ETags that are NOT the content MD5.
+    The response declares the encryption, so the MD5 check must be
+    skipped outright — a healthy encrypted archive must not burn retry
+    budget (let alone degrade) on 'body MD5 does not match ETag'."""
+    cfg = cpu_cfg()
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    retries0 = metric_total("kta_segstore_retries_total")
+    with FakeObjectStore(seg_dir, sse="aws:kms", etag_salt=b"kms") as store:
+        got = run_scan(
+            "t",
+            SegmentFileSource(store.url, "t", fetch=fetch_cfg(0)),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+        # No re-fetches at all: every chunk body downloaded exactly once.
+        assert all(v == 1 for v in store.body_gets.values())
+    assert scan_doc(got) == ref
+    assert got.degraded_partitions == {}
+    assert metric_total("kta_segstore_retries_total") - retries0 == 0
+
+
+def test_persistent_etag_mismatch_accepted_after_one_refetch(seg_dir):
+    """A 32-hex non-MD5 ETag WITHOUT the SSE header (proxy-stripped
+    headers, composite ETags): the first mismatch is presumed in-flight
+    damage and re-fetched once; byte-identical data on the second fetch
+    proves it persistent — accepted, booked, and LATCHED for the whole
+    store (ETag policy is bucket-level), so an archived year pays one
+    extra fetch total, not 2x egress."""
+    cfg = cpu_cfg()
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    booked0 = metric_total("kta_segstore_fallback_total")
+    with FakeObjectStore(seg_dir, etag_salt=b"not-md5") as store:
+        got = run_scan(
+            "t",
+            SegmentFileSource(store.url, "t", fetch=fetch_cfg(0)),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+        # ONE chunk pays the disambiguating re-fetch; the latch spares
+        # the rest of the store.
+        assert sum(store.body_gets.values()) == 4
+        assert sorted(store.body_gets.values()) == [1, 1, 2]
+    assert scan_doc(got) == ref
+    assert got.degraded_partitions == {}
+    assert metric_total("kta_segstore_fallback_total") - booked0 == 1
+    snap = default_registry().snapshot()["kta_segstore_fallback_total"]
+    assert any(
+        s["labels"].get("reason") == "etag-not-md5" and s["value"] >= 1
+        for s in snap["samples"]
+    )
+
+
+def test_range_ignoring_server_is_sliced_not_retried(seg_dir):
+    """An endpoint that answers ranged GETs with 200 + the full object:
+    the requested window is sliced out client-side (booked) — the
+    catalog's header probes must not burn the retry budget calling the
+    full body 'truncated'."""
+    cfg = cpu_cfg()
+    ref = scan_doc(run_scan(
+        "t", SegmentFileSource(seg_dir, "t"),
+        CpuExactBackend(cfg, init_now_s=10**10), 700,
+    ))
+    retries0 = metric_total("kta_segstore_retries_total")
+    with FakeObjectStore(seg_dir, ignore_range=True) as store:
+        got = run_scan(
+            "t",
+            SegmentFileSource(store.url, "t", fetch=fetch_cfg(0)),
+            CpuExactBackend(cfg, init_now_s=10**10), 700,
+        )
+    assert scan_doc(got) == ref
+    assert got.degraded_partitions == {}
+    assert metric_total("kta_segstore_retries_total") - retries0 == 0
+    snap = default_registry().snapshot()["kta_segstore_fallback_total"]
+    # >= 1, not one-per-chunk: range-ignoring LATCHES on first detection,
+    # so concurrent catalog opens may already ride the whole-object path.
+    assert any(
+        s["labels"].get("reason") == "range-ignored" and s["value"] >= 1
+        for s in snap["samples"]
+    )
+
+
+def test_range_ignoring_store_latches_one_get_per_open(tmp_path):
+    """Once a server is known to ignore Range headers, each catalog open
+    costs ONE whole-object GET with the header/tail probes sliced locally
+    — not a full download per probe (3x the archive over a catalog)."""
+    from kafka_topic_analyzer_tpu.io.segstore import ObjectSegmentStore
+
+    _write_gappy_chunks(tmp_path)
+    with FakeObjectStore(str(tmp_path), ignore_range=True) as store:
+        seg_store = ObjectSegmentStore(
+            store.url, fetch=fetch_cfg(0, cache=str(tmp_path / "cache"))
+        )
+        refs = seg_store.list_refs("gap")
+        seg_store.open(refs[0])  # detects + latches mid-open
+        assert seg_store.transport.range_ignored
+        before = store.requests_served
+        f1 = seg_store.open(refs[1])
+        assert store.requests_served - before == 1
+        assert f1.end_offset == 298  # locally-sliced tail, offset-exact
+        # The whole-object probe SEEDED the cache: materializing the body
+        # costs no additional GET — one wire crossing per chunk per scan.
+        f1.ensure_body()
+        assert store.requests_served - before == 1
+
+
+def test_bucketless_spec_rejected():
+    from kafka_topic_analyzer_tpu.io.segstore import open_segment_store
+
+    for spec in ("http://127.0.0.1:9000", "https://host/", "http://h:80//"):
+        with pytest.raises(ValueError, match="no bucket"):
+            open_segment_store(spec)
 
 
 # ---------------------------------------------------------------------------
@@ -483,6 +684,28 @@ def test_stale_cache_entry_is_miss_not_corruption(seg_dir, tmp_path):
             CpuExactBackend(cfg, init_now_s=10**10), 700,
         )
         assert sum(store.body_gets.values()) == before
+
+
+def test_cache_reinsert_does_not_double_count(tmp_path):
+    """Re-inserting an existing digest replaces its bytes: the running
+    resident-bytes estimate must grow by the NET change only, or racing
+    fetches of one chunk inflate it and trigger premature full-directory
+    eviction sweeps."""
+    from kafka_topic_analyzer_tpu.io.objstore import SegmentCache
+
+    cache = SegmentCache(str(tmp_path / "c"), 100, "store")
+    cache.put("a", 60, b"x" * 60)
+    cache.put("a", 60, b"x" * 60)
+    assert cache._total == 60
+    # A second distinct entry fits the bound exactly — no sweep runs.
+    evict0 = metric_total("kta_segstore_cache_evictions_total")
+    cache.put("b", 30, b"y" * 30)
+    assert cache._total == 90
+    assert metric_total("kta_segstore_cache_evictions_total") == evict0
+    resident = [
+        f for f in os.listdir(str(tmp_path / "c")) if f.endswith(".seg")
+    ]
+    assert len(resident) == 2
 
 
 def test_cache_lru_eviction_bounds_directory(seg_dir, tmp_path):
