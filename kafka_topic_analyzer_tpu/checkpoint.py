@@ -415,6 +415,24 @@ def snapshot_info(directory: str, scope=None) -> "Optional[dict]":
         return json.loads(str(z["__meta__"]))
 
 
+HISTORY_DIR_NAME = "_kta_history"
+
+
+def history_dir(directory: str) -> str:
+    """Where the disk-backed telemetry history (obs/history.py,
+    ``--history-bytes``) lives: a reserved subdirectory of the
+    ``--snapshot-dir``, so a SIGTERM→restart that resumes the state from
+    its checkpoint resumes the telemetry series from the same place —
+    one directory to move, back up, or delete.  The underscore-prefixed
+    reserved name keeps it out of the fleet's per-topic snapshot
+    inventory (`list_topic_snapshots` skips directories without a
+    snapshot file; a real Kafka topic named exactly ``_kta_history``
+    would collide — don't).  Process-wide: fleet runs share one history
+    (the recorder's tracks are process totals; per-topic lag lives in
+    the labeled gauges)."""
+    return os.path.join(directory, HISTORY_DIR_NAME)
+
+
 def topic_snapshot_dir(directory: str, topic: str) -> str:
     """Fleet-mode checkpoint namespacing: each topic's snapshots live in
     their own subdirectory of the fleet ``--snapshot-dir`` (Kafka topic

@@ -278,6 +278,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "and serves the ring-buffered series at /flight on "
                         "--metrics-port. The bottleneck verdict itself is "
                         "always computed — the recorder adds the timeline")
+    p.add_argument("--history-bytes", type=int, default=0, metavar="BYTES",
+                   help="Persist the flight recorder's telemetry series "
+                        "to a crash-safe, multi-resolution on-disk store "
+                        "bounded by BYTES (RRD-style: recent history at "
+                        "full resolution, older history progressively "
+                        "halved), living next to the checkpoints "
+                        "(requires --snapshot-dir) so a restarted "
+                        "service resumes its series. Serves windowed "
+                        "queries at /history on --metrics-port, feeds "
+                        "the trend doctor's TRENDS digest on --stats, "
+                        "and implies --flight-record. 0 disables "
+                        "(default)")
     p.add_argument("--fleet", action="store_true",
                    help="Cluster-wide topic discovery + scan: ask the "
                         "cluster for ALL topics (one all-topics Metadata "
@@ -612,6 +624,29 @@ def _print_stats(args, result, diagnosis=None) -> None:
             diagnosis if diagnosis is not None else _diagnose(result)
         )
     )
+    _print_health_stats()
+
+
+def _print_health_stats() -> None:
+    """--stats HEALTH + TRENDS digests (shared by the solo and fleet
+    stats paths): the alert engine's latest document, and the trend
+    doctor's findings over the history window when --history-bytes ran."""
+    from kafka_topic_analyzer_tpu.obs import health as obs_health
+    from kafka_topic_analyzer_tpu.obs import history as obs_history
+    from kafka_topic_analyzer_tpu.report import render_health, render_trends
+
+    engine = obs_health.active()
+    if engine is not None:
+        if engine.doc() is None:
+            # Sub-interval scans never hit a heartbeat boundary; the
+            # digest must still report from one real evaluation.
+            engine.evaluate()
+        sys.stderr.write(render_health(engine.doc()))
+    store = obs_history.active()
+    if store is not None:
+        from kafka_topic_analyzer_tpu.obs.doctor import diagnose_trends
+
+        sys.stderr.write(render_trends(diagnose_trends(store.window())))
 
 
 def _not_report_process(args) -> bool:
@@ -1093,6 +1128,7 @@ def run_fleet(args, topics: "list[str] | None" = None) -> int:
         sys.stderr.write(
             render_telemetry_stats(default_registry().snapshot())
         )
+        _print_health_stats()
     if args.json:
         import json
 
@@ -1139,12 +1175,32 @@ def main(argv: "list[str] | None" = None) -> int:
     from kafka_topic_analyzer_tpu.io.kafka_codec import KafkaProtocolError
     from kafka_topic_analyzer_tpu.obs import telemetry_session
 
+    history_dir = None
+    if args.history_bytes:
+        if args.history_bytes < 4096:
+            print("error: --history-bytes must be >= 4096", file=sys.stderr)
+            return 1
+        if not args.snapshot_dir:
+            print(
+                "error: --history-bytes requires --snapshot-dir (the "
+                "telemetry history lives next to the checkpoints so a "
+                "restarted service resumes both from one place)",
+                file=sys.stderr,
+            )
+            return 1
+        from kafka_topic_analyzer_tpu.checkpoint import (
+            history_dir as _history_dir,
+        )
+
+        history_dir = _history_dir(args.snapshot_dir)
     try:
         with telemetry_session(
             metrics_port=args.metrics_port,
             events_jsonl=args.events_jsonl,
             trace_json=args.trace_json,
             flight_record=args.flight_record,
+            history_dir=history_dir,
+            history_bytes=args.history_bytes,
         ):
             return _run(args)
     except (OSError, KafkaProtocolError) as e:
@@ -1306,8 +1362,16 @@ def _run(args) -> int:
     if args.json:
         import json
 
+        from kafka_topic_analyzer_tpu.obs import health as obs_health
         from kafka_topic_analyzer_tpu.report import build_json_doc
 
+        health_engine = obs_health.active()
+        if health_engine is not None and health_engine.doc() is None:
+            # Sub-interval scans never hit a heartbeat boundary; the
+            # document must still carry one real evaluation — a missing
+            # health key would be indistinguishable from "alerting
+            # never ran" (same rule as the --stats digest).
+            health_engine.evaluate()
         doc = build_json_doc(
             args.topic,
             result,
@@ -1320,6 +1384,11 @@ def _run(args) -> int:
             windows=(
                 follow_service.windows_report()
                 if follow_service is not None
+                else None
+            ),
+            health=(
+                health_engine.alerts_block()
+                if health_engine is not None
                 else None
             ),
         )

@@ -281,6 +281,77 @@ class FollowConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Alert-engine knobs (obs/health.py; DESIGN.md §22).
+
+    Like `FollowConfig`, deliberately NOT part of `AnalyzerConfig`: how
+    often health is evaluated (and what thresholds page) changes neither
+    state shapes nor fold semantics — the engine only READS registry
+    snapshots and observed windows — so none of it may churn the
+    checkpoint fingerprint, and a scan is byte-identical with alerting
+    on or off (tests/test_health.py pins it).
+    """
+
+    #: Floor between evaluations on the rate-limited ``maybe_evaluate``
+    #: path (the engine heartbeat hook).  Poll-boundary evaluations from
+    #: the follow/fleet services are not limited by it — a poll boundary
+    #: IS an evaluation point, which is what makes the /healthz flip
+    #: land within one interval of the fault (the acceptance bar).
+    eval_interval_s: float = 5.0
+    #: Default for-duration: a rule's condition must hold this long
+    #: before the alert fires (blip suppression).
+    for_s: float = 10.0
+    #: Default resolve hysteresis: the condition must stay clear this
+    #: long before a firing alert resolves (flap suppression).
+    resolve_s: float = 15.0
+    #: Lag-growth window: lag must exceed its value this far back (by at
+    #: least ``lag_min_growth`` records) to count as diverging.
+    lag_window_s: float = 30.0
+    lag_min_growth: int = 1
+    #: Corruption-storm window and the frames-per-window threshold.
+    storm_window_s: float = 60.0
+    corrupt_frames_threshold: float = 1.0
+    #: Watermark-refresh-outage window (any budget-exhausted re-poll
+    #: inside it keeps the condition true).
+    outage_window_s: float = 60.0
+    #: Throughput regression: recent window vs the trailing baseline
+    #: window; fires when recent < drop_fraction x baseline while lag
+    #: remains, and never below ``min_baseline_rate`` records/s (an
+    #: idle or tiny scan has no baseline worth defending).
+    throughput_window_s: float = 30.0
+    throughput_baseline_s: float = 180.0
+    throughput_drop_fraction: float = 0.5
+    min_baseline_rate: float = 1.0
+    #: Observed-series retention (must cover the longest rule window).
+    retention_s: float = 900.0
+
+    def __post_init__(self) -> None:
+        if self.eval_interval_s <= 0:
+            raise ValueError("health eval interval must be > 0 seconds")
+        if self.for_s < 0 or self.resolve_s < 0:
+            raise ValueError("for/resolve durations must be >= 0")
+        if self.lag_window_s <= 0 or self.storm_window_s <= 0:
+            raise ValueError("rule windows must be > 0 seconds")
+        if self.outage_window_s <= 0 or self.throughput_window_s <= 0:
+            raise ValueError("rule windows must be > 0 seconds")
+        if self.throughput_baseline_s <= self.throughput_window_s:
+            raise ValueError(
+                "throughput baseline window must exceed the recent window"
+            )
+        if not (0.0 < self.throughput_drop_fraction < 1.0):
+            raise ValueError("throughput drop fraction must be in (0, 1)")
+        if self.retention_s < max(
+            self.lag_window_s,
+            self.storm_window_s,
+            self.outage_window_s,
+            self.throughput_baseline_s,
+        ):
+            raise ValueError(
+                "health retention must cover the longest rule window"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class SegmentFetchConfig:
     """Remote-segment-tier knobs (``--segment-readahead``/``--segment-cache``;
     io/objstore.py + io/segstore.py, DESIGN.md §21).

@@ -21,6 +21,7 @@ from kafka_topic_analyzer_tpu.backends.base import MetricBackend
 from kafka_topic_analyzer_tpu.config import IngestConfig
 from kafka_topic_analyzer_tpu.io.source import RecordSource
 from kafka_topic_analyzer_tpu.obs import events as obs_events
+from kafka_topic_analyzer_tpu.obs import health as obs_health
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.obs import trace as obs_trace
 from kafka_topic_analyzer_tpu.obs.registry import (
@@ -270,6 +271,15 @@ def run_scan(
             records_per_sec=round(rate, 1),
             lag_total=lag_total,
         )
+        # Health evaluation rides the heartbeat boundary so a plain
+        # batch scan gets a live /healthz too; the engine rate-limits
+        # itself (HealthConfig.eval_interval_s) and only READS registry
+        # snapshots — the scan stays byte-identical with it on or off
+        # (tests/test_health.py).  Follow/fleet services additionally
+        # evaluate at every poll boundary.
+        health = obs_health.active()
+        if health is not None:
+            health.maybe_evaluate()
 
     # Caller-provided start offsets (e.g. --from-timestamp lookup); a
     # resumed snapshot's offsets take precedence below.

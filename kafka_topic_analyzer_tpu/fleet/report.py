@@ -32,16 +32,28 @@ def build_fleet_rollup(
     statuses: "Dict[str, object]",
     discovered: int,
     duration_secs: int,
+    health: "dict | None" = None,
 ) -> dict:
-    """``statuses`` maps topic -> fleet.service.TopicStatus."""
+    """``statuses`` maps topic -> fleet.service.TopicStatus; ``health``
+    is the alert engine's latest document (obs/health.py), riding the
+    rollup so the bare ``/report.json`` path answers "is the fleet
+    healthy" next to the totals (each topic's own alerts ride its
+    ``?topic=`` document)."""
     counts: "Dict[str, int]" = {}
+    verdicts: "Dict[str, int]" = {}
     for s in statuses.values():
         counts[s.status] = counts.get(s.status, 0) + 1
-    return {
+        if getattr(s, "verdict", ""):
+            verdicts[s.verdict] = verdicts.get(s.verdict, 0) + 1
+    doc = {
         "fleet": {
             "topics_discovered": discovered,
             "topics": len(statuses),
             "status_counts": dict(sorted(counts.items())),
+            # Per-topic doctor verdicts at a glance: how many topics
+            # attribute ingest- vs dispatch-bound right now (the
+            # per-topic label itself is in each status row below).
+            "verdict_counts": dict(sorted(verdicts.items())),
             "totals": {
                 "records": sum(s.records for s in statuses.values()),
                 "bytes": sum(s.bytes for s in statuses.values()),
@@ -59,3 +71,6 @@ def build_fleet_rollup(
         },
         "duration_secs": duration_secs,
     }
+    if health is not None:
+        doc["health"] = health
+    return doc

@@ -44,6 +44,7 @@ from kafka_topic_analyzer_tpu.fleet.scheduler import (
 )
 from kafka_topic_analyzer_tpu.io.retry import Backoff
 from kafka_topic_analyzer_tpu.obs import events as obs_events
+from kafka_topic_analyzer_tpu.obs import health as obs_health
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.serve import state as serve_state
 from kafka_topic_analyzer_tpu.utils.progress import Spinner
@@ -118,6 +119,10 @@ class _TopicScan:
         self.first = True
         self.status = TopicStatus(topic=seed.name, partitions=seed.partitions)
         self.result: "Optional[ScanResult]" = None
+        #: Last pass's doctor attribution (obs/doctor.Diagnosis) — set by
+        #: every completed pass, so the rollup's verdict column fills in
+        #: whether or not per-topic documents are being published.
+        self.diagnosis = None
         self.lag = 0
         #: Last grant a productive pass ran under — the shutdown pass
         #: (whose budget was already released) reuses it so the final
@@ -158,6 +163,7 @@ class FleetService:
         rediscover: "Optional[Callable[[], List[TopicSeed]]]" = None,
         rediscover_every: int = 16,
         heartbeat_every_s: float = 10.0,
+        health: "Optional[obs_health.HealthEngine]" = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.scans: "Dict[str, _TopicScan]" = {
@@ -177,6 +183,10 @@ class FleetService:
         self.rediscover_every = max(1, int(rediscover_every))
         self._clock = clock
         self._heartbeat = obs_events.Heartbeat(heartbeat_every_s)
+        #: Alert engine evaluated at every fleet poll/wave boundary with
+        #: per-topic lag + failure context (obs/health.py): explicit
+        #: wins, else the telemetry session's engine, else none.
+        self.health = health if health is not None else obs_health.active()
         self.state = serve_state.ServiceState()
         self._stop = threading.Event()
         self._stop_reason: "Optional[str]" = None
@@ -298,22 +308,31 @@ class FleetService:
             scan.status.status = "corrupt"
         else:
             scan.status.status = "ok"
+        # The doctor attributes EVERY completed pass — the rollup's
+        # verdict column (and the scheduler's rebalance input) must not
+        # depend on whether /report.json documents are being published.
+        from kafka_topic_analyzer_tpu.obs.doctor import diagnose_scan
+
+        scan.diagnosis = diagnose_scan(result)
+        scan.status.verdict = scan.diagnosis.verdict
         self._publish_topic(scan)
         return True
 
     def _publish_topic(self, scan: _TopicScan) -> None:
         if not self.publish_reports or scan.result is None:
             return
-        from kafka_topic_analyzer_tpu.obs.doctor import diagnose_scan
         from kafka_topic_analyzer_tpu.report import build_json_doc
 
-        diagnosis = diagnose_scan(scan.result)
-        scan.status.verdict = diagnosis.verdict
         doc = build_json_doc(
             scan.seed.name,
             scan.result,
-            diagnosis=diagnosis,
+            diagnosis=scan.diagnosis,
             fleet=scan.status.as_dict(),
+            health=(
+                self.health.alerts_block(topic=scan.seed.name)
+                if self.health is not None
+                else None
+            ),
         )
         self.state.publish(doc, topic=scan.seed.name)
 
@@ -322,10 +341,34 @@ class FleetService:
             {t: s.status for t, s in self.scans.items()},
             discovered=self.discovered,
             duration_secs=int(self._clock() - self._t0),
+            health=(
+                self.health.alerts_block()
+                if self.health is not None
+                else None
+            ),
         )
         if self.publish_reports:
             self.state.publish(rollup)
         return rollup
+
+    def _evaluate_health(self) -> None:
+        """One alert-engine pass at a fleet poll/wave boundary, with the
+        per-topic lag map (per-topic lag-growth scopes) and the failed
+        set (the fleet-topic-failure rule) as context."""
+        if self.health is None:
+            return
+        self.health.evaluate(
+            extras={
+                "topics": {
+                    t: s.lag for t, s in self.scans.items()
+                },
+                "failed_topics": [
+                    t
+                    for t, s in self.scans.items()
+                    if s.status.status == "failed"
+                ],
+            }
+        )
 
     def _checkpoint_due(self) -> bool:
         if self.snapshot_dir is None or self.follow is None:
@@ -376,6 +419,8 @@ class FleetService:
 
     def _start_banner(self) -> None:
         serve_state.set_active(self.state)
+        if self.health is not None:
+            obs_health.set_active(self.health)
         self._t0 = self._clock()
         if self.resume and self.snapshot_dir is not None:
             from kafka_topic_analyzer_tpu.checkpoint import (
@@ -454,6 +499,7 @@ class FleetService:
                         fut.result()  # _run_pass never raises
                         self.scheduler.release(t)
                 pending = [s for s in pending if s.name not in grants]
+            self._evaluate_health()
             self._publish_rollup()
         return self._finish()
 
@@ -516,6 +562,9 @@ class FleetService:
                 t: self._poll_topic(s) for t, s in list(self.scans.items())
             }
             lag_total = sum(lags.values())
+            # Poll-boundary health: the lag map just refreshed, so a
+            # diverging topic flips /healthz within one poll.
+            self._evaluate_health()
             ready = [
                 TopicSeed(
                     name=t,
@@ -579,6 +628,7 @@ class FleetService:
                         verdicts[t] = scan.status.verdict
                 if verdicts:
                     self.scheduler.rebalance(verdicts)
+                self._evaluate_health()
                 self._publish_rollup()
             else:
                 idle_streak += 1

@@ -42,6 +42,8 @@ def telemetry_session(
     events_jsonl: "Optional[str]" = None,
     trace_json: "Optional[str]" = None,
     flight_record: bool = False,
+    history_dir: "Optional[str]" = None,
+    history_bytes: int = 0,
 ) -> "Iterator[Optional[SpanTracer]]":
     """Wire up the flag-selected telemetry outputs around a scan.
 
@@ -59,6 +61,18 @@ def telemetry_session(
     need the final state; cli._diagnose does); teardown then stops the
     thread and clears ``active()``.
 
+    ``history_dir``/``history_bytes`` open the disk-backed telemetry
+    history (obs/history.py) next to the checkpoints and feed it from
+    the recorder's tick path — the recorder is started implicitly when
+    history is on, since history IS the recorder's durable sink.  The
+    session also constructs the alert engine (obs/health.py, built-in
+    rules) as the process-wide active one whenever any serving surface
+    exists to read it (``metrics_port`` set, or history on) — the
+    follow/fleet services evaluate it at their poll boundaries, the
+    engine drive loop at heartbeat cadence, and ``/healthz`` serves its
+    latest verdict.  Services may install their own engine instead
+    (tests do); last ``set_active`` wins.
+
     Output paths are opened (and truncated, for the trace) at setup so a
     bad ``--trace-json``/``--events-jsonl`` path fails before the scan,
     not after it; and each teardown step is isolated, so a failing trace
@@ -68,12 +82,16 @@ def telemetry_session(
 
     from kafka_topic_analyzer_tpu.obs import events as _events
     from kafka_topic_analyzer_tpu.obs import flight as _flight
+    from kafka_topic_analyzer_tpu.obs import health as _health
+    from kafka_topic_analyzer_tpu.obs import history as _history
     from kafka_topic_analyzer_tpu.obs import trace as _trace
 
     exporter = None
     sink = None
     tracer = None
     recorder = None
+    store = None
+    engine = None
     try:
         if metrics_port is not None:
             from kafka_topic_analyzer_tpu.obs.exporters import (
@@ -96,19 +114,44 @@ def telemetry_session(
                 pass  # fail fast on an unwritable path; write() re-opens
             tracer = SpanTracer()
             _trace.set_active(tracer)
-        if flight_record:
+        if flight_record or history_dir:
             # After the tracer: the recorder mirrors its instantaneous
             # tracks onto the active tracer as Chrome counter events.
+            # History implies the recorder — it is the durable sink of
+            # the same tick path.
             recorder = _flight.FlightRecorder()
+            if history_dir:
+                store = _history.HistoryStore(
+                    history_dir,
+                    max_bytes=max(4096, int(history_bytes)),
+                )
+                recorder.attach_history(store)
+                _history.set_active(store)
             _flight.set_active(recorder)
             recorder.start()
+        if metrics_port is not None or history_dir:
+            # The alert engine costs nothing until something evaluates
+            # it; it exists whenever a surface (the HTTP endpoints, the
+            # --stats health digest, the JSONL event bus) can read it.
+            engine = _health.HealthEngine()
+            _health.set_active(engine)
         yield tracer
     finally:
+        if engine is not None:
+            # The session is the CLI's outermost scope: whatever engine
+            # is active at teardown (ours, or a service's replacement)
+            # has no reader once the endpoint below closes.
+            _health.set_active(None)
         if recorder is not None:
             try:
                 recorder.stop()  # final sample; series stays readable
             finally:
                 _flight.set_active(None)
+        if store is not None:
+            try:
+                store.close()
+            finally:
+                _history.set_active(None)
         if tracer is not None:
             _trace.set_active(None)
         try:

@@ -18,6 +18,13 @@ loop publishes a pre-serialized document at every poll boundary
 (serve/state.py), and the handler reads only that latest snapshot — the
 rule 9 lock-discipline boundary that keeps a slow scrape from ever
 stalling ingest.
+
+``/healthz`` (obs/health.py) is the k8s-shaped liveness probe: 200
+while no alert rule is active, 503 with the firing-rule JSON otherwise
+(503 before the first evaluation; 404 without an engine).  ``/history``
+(obs/history.py) serves windowed queries over the disk-backed telemetry
+history while ``--history-bytes`` is active (404 otherwise).  Both
+follow the same rule-9 discipline: pre-published snapshots only.
 """
 
 from __future__ import annotations
@@ -39,8 +46,10 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
-    def _respond(self, body: bytes, content_type: str) -> None:
-        self.send_response(200)
+    def _respond(
+        self, body: bytes, content_type: str, code: int = 200
+    ) -> None:
+        self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -48,6 +57,65 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
         path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            # Liveness probe (obs/health.py): 200 while no alert is
+            # active, 503 with the pre-serialized firing-rule JSON
+            # otherwise, 503 before the first evaluation (an unevaluated
+            # service must not claim liveness), 404 when no alert engine
+            # runs at all.  The handler reads ONE snapshot accessor —
+            # serialization happened on the evaluating side (rule 9).
+            from kafka_topic_analyzer_tpu.obs import health as _health
+
+            eng = _health.active()
+            if eng is None:
+                self.send_error(
+                    404,
+                    "no alert engine (run a scan with --metrics-port, "
+                    "--follow, or --fleet)",
+                )
+                return
+            hz = eng.healthz()
+            if hz is None:
+                self.send_error(
+                    503, "health not yet evaluated (first evaluation "
+                    "pending)"
+                )
+                return
+            code, body = hz
+            self._respond(body, "application/json", code=code)
+            return
+        if path == "/history":
+            # Windowed telemetry-history query (obs/history.py):
+            # ``?t0=&t1=`` bound the window (epoch seconds), ``tracks=``
+            # selects a comma list.  The ``window`` accessor reads the
+            # store's in-memory mirror under the store's own lock —
+            # never a drive-loop lock (rule 9).
+            import json
+            from urllib.parse import parse_qs
+
+            from kafka_topic_analyzer_tpu.obs import history as _history
+
+            store = _history.active()
+            if store is None:
+                self.send_error(
+                    404, "no telemetry history (run with --history-bytes)"
+                )
+                return
+            qs = parse_qs(query)
+            try:
+                t0 = float(qs["t0"][0]) if "t0" in qs else None
+                t1 = float(qs["t1"][0]) if "t1" in qs else None
+            except ValueError:
+                self.send_error(400, "t0/t1 must be epoch seconds")
+                return
+            tracks = None
+            if "tracks" in qs:
+                tracks = [
+                    t for t in qs["tracks"][0].split(",") if t
+                ]
+            body = json.dumps(store.window(t0, t1, tracks)).encode()
+            self._respond(body, "application/json")
+            return
         if path == "/report.json":
             # Follow/fleet point-in-time report (serve/state.py).  The
             # handler only ever reads the latest PRE-SERIALIZED document
@@ -99,7 +167,11 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             )
             return
         if path not in ("/metrics", "/"):
-            self.send_error(404, "try /metrics, /flight, or /report.json")
+            self.send_error(
+                404,
+                "try /metrics, /flight, /history, /healthz, or "
+                "/report.json",
+            )
             return
         body = render_prometheus(self.server.registry.snapshot()).encode()
         self._respond(body, CONTENT_TYPE)

@@ -108,6 +108,24 @@ def _default_tracks() -> "List[Tuple[str, str, Callable[[], float]]]":
         ("follow_lag", "inst", lambda: m.FOLLOW_LAG.value),
         ("follow_polls", "cum", lambda: m.FOLLOW_POLLS.value),
         ("follow_passes", "cum", lambda: m.FOLLOW_PASSES.value),
+        # Service-health lanes (obs/doctor.diagnose_trends + the alert
+        # engine's longer baselines): fault/corruption/cache counters
+        # whose RATES are what the trend doctor windows verdicts over —
+        # retry storms, corruption storms, segstore fallback and
+        # cache-poison spikes, and the warm-cache verify residual.
+        ("degraded_partitions", "inst",
+         lambda: m.DEGRADED_PARTITIONS.value),
+        ("refresh_failures", "cum",
+         lambda: m.WATERMARK_REFRESH_FAILURES.value),
+        ("backoff_sleeps", "cum", lambda: m.BACKOFF_SLEEPS.value),
+        ("corrupt_frames", "cum",
+         lambda: _family_total(m.CORRUPT_FRAMES)),
+        ("segstore_fallbacks", "cum",
+         lambda: _family_total(m.SEGSTORE_FALLBACK)),
+        ("cache_verify_s", "cum",
+         lambda: m.SEGSTORE_CACHE_VERIFY_SECONDS.value),
+        ("cache_hit_bytes", "cum",
+         lambda: m.SEGSTORE_CACHE_HIT_BYTES.value),
     ]
     return tracks
 
@@ -141,6 +159,20 @@ class FlightRecorder:
         self._bufs: "List[List[float]]" = [[] for _ in self._tracks]
         self._stop = threading.Event()
         self._thread: "Optional[threading.Thread]" = None
+        #: Optional disk-backed history sink (obs/history.HistoryStore):
+        #: every tick the recorder takes also lands one history row, so
+        #: the durable series and the live ring can never disagree about
+        #: what a tick saw.  The store has its own lock and directory —
+        #: the recorder's read-only-consumer discipline is untouched.
+        self._history = None
+
+    def attach_history(self, store) -> "FlightRecorder":
+        """Persist every sample into ``store`` (obs/history.HistoryStore),
+        registering the track kinds so downsampling follows the same
+        cum/inst policy the doctor's window math assumes."""
+        store.register_kinds({name: kind for name, kind, _ in self._tracks})
+        self._history = store
+        return self
 
     # -- sampling ------------------------------------------------------------
 
@@ -160,6 +192,27 @@ class FlightRecorder:
                 self._bufs = [buf[::2] for buf in self._bufs]
                 self.interval_s *= 2.0
         obs_metrics.FLIGHT_SAMPLES.inc()
+        history = self._history
+        if history is not None:
+            try:
+                history.append(
+                    {
+                        name: row[i]
+                        for i, (name, _, _) in enumerate(self._tracks)
+                    }
+                )
+            except Exception:
+                # Telemetry is best-effort by contract (obs/events.py):
+                # a full disk or vanished directory must neither kill
+                # the sampler thread nor fail a finished scan at
+                # teardown's closing sample.  Detach the sink — one log
+                # line, not one per tick.
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "history sink failed; detaching it"
+                )
+                self._history = None
         tracer = obs_trace.active()
         if tracer is not None:
             # Counter tracks render as stacked area lanes under the stage

@@ -156,6 +156,18 @@ SEGSTORE_CACHE_MISSES = _REG.counter(
     "kta_segstore_cache_misses_total",
     "Chunk fetches the local segment cache could not serve (absent, "
     "unreadable, or poisoned entries)")
+SEGSTORE_CACHE_VERIFY_SECONDS = _REG.counter(
+    "kta_segstore_cache_verify_seconds_total",
+    "Seconds spent sha256-re-hashing cached chunk bytes on cache HITS "
+    "(--segment-cache serves nothing unverified).  The warm-re-audit "
+    "residual BENCH round 14 measured as 'sha-verify on every hit costs "
+    "2.1x' — booked so the claim is attributable from telemetry alone "
+    "and the trend doctor can flag verify-bound re-audits")
+SEGSTORE_CACHE_HIT_BYTES = _REG.counter(
+    "kta_segstore_cache_hit_bytes_total",
+    "Chunk bytes served from the local segment cache after sha256 "
+    "verification — with the verify-seconds counter, the measured "
+    "verify cost per cached byte (the warm-cache residual's ledger)")
 SEGSTORE_CACHE_EVICTIONS = _REG.counter(
     "kta_segstore_cache_evictions_total",
     "Cache entries evicted: least-recently-used past --segment-cache-bytes, "
@@ -404,3 +416,44 @@ FLIGHT_SAMPLES = _REG.counter(
     "kta_flight_samples_total",
     "Occupancy samples the flight recorder took (--flight-record) — the "
     "recorder's own cost stays auditable in the data it records")
+
+# -- telemetry history (obs/history.py) ---------------------------------------
+
+HISTORY_SAMPLES = _REG.counter(
+    "kta_history_samples_total",
+    "Sample rows appended to the disk-backed telemetry history "
+    "(--history-bytes; tier-0 appends — downsampled tier rows are "
+    "derived, not re-counted)")
+HISTORY_ROTATIONS = _REG.counter(
+    "kta_history_segment_rotations_total",
+    "History segment files sealed by atomic rotation (all tiers) — with "
+    "kta_history_bytes, the store's write/retention cadence")
+HISTORY_BYTES = _REG.gauge(
+    "kta_history_bytes",
+    "Bytes the telemetry history currently holds on disk (all tiers, "
+    "open segments included; bounded by --history-bytes)",
+    # One store per process; a fleet of processes holds disjoint stores.
+    merge="sum")
+
+# -- health / alerting (obs/health.py) ----------------------------------------
+
+HEALTH_EVALUATIONS = _REG.counter(
+    "kta_health_evaluations_total",
+    "Alert-engine evaluation passes (poll boundaries + the rate-limited "
+    "heartbeat hook) — /healthz serves 503 until this first moves")
+ALERTS_FIRING = _REG.gauge(
+    "kta_alerts_firing",
+    "Alerts currently ACTIVE (firing or in resolve hysteresis) per "
+    "rule; under fleet per-topic rules this counts the topics the rule "
+    "is firing for",
+    labelnames=("rule",),
+    # Each process's engine fires over its own scan; fleet-wide active
+    # alerts are the sum, not the worst process's.
+    merge="sum")
+ALERTS_TRANSITIONS = _REG.counter(
+    "kta_alerts_transitions_total",
+    "Alert state-machine transitions by rule and entered state "
+    "(ok/pending/firing/resolving) — every state change books exactly "
+    "one row, so the alert trace is reconstructible from the counter "
+    "alone (tools/lint.sh rule 12); no silent state changes",
+    labelnames=("rule", "state"))

@@ -233,6 +233,48 @@ def render_bottleneck(diagnosis) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_health(doc: "Optional[Dict]") -> str:
+    """``--stats`` HEALTH digest from an alert-engine document
+    (obs/health.HealthEngine.doc()): the verdict line plus one line per
+    ACTIVE alert with its evidence — the same document /healthz serves,
+    rendered once, so the operator's terminal and the liveness probe can
+    never disagree."""
+    if not doc:
+        return ""
+    firing = doc.get("firing") or []
+    if not firing:
+        return (
+            f"HEALTH: ok ({doc.get('evaluations', 0)} evaluations, "
+            "no active alerts)\n"
+        )
+    lines = [
+        f"HEALTH: {len(firing)} active alert(s) "
+        f"({doc.get('evaluations', 0)} evaluations)"
+    ]
+    for r in firing:
+        where = f" [{r['topic']}]" if r.get("topic") else ""
+        ev = r.get("evidence") or {}
+        ev_text = ", ".join(f"{k}={v}" for k, v in sorted(ev.items()))
+        lines.append(
+            f"  {r['rule']}{where}: {r['state']} "
+            f"{r.get('firing_s', 0) or 0:.0f}s — {r['summary']}"
+            + (f" ({ev_text})" if ev_text else "")
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_trends(findings: "Optional[List[dict]]") -> str:
+    """``--stats`` TRENDS digest from the trend doctor's findings
+    (obs/doctor.diagnose_trends over a history window) — empty string
+    when the window is healthy or too short to judge."""
+    if not findings:
+        return ""
+    lines = ["TRENDS:"]
+    for f in findings:
+        lines.append(f"  {f['summary']}")
+    return "\n".join(lines) + "\n"
+
+
 def render_telemetry_stats(
     snapshot: Optional[Dict],
     ingest_workers: int = 1,
@@ -453,6 +495,7 @@ def build_json_doc(
     follow: "Optional[dict]" = None,
     windows: "Optional[dict]" = None,
     fleet: "Optional[dict]" = None,
+    health: "Optional[dict]" = None,
 ) -> dict:
     """The machine-readable report document — ONE builder for every
     surface that emits it: the CLI's ``--json`` stdout, the follow
@@ -480,6 +523,8 @@ def build_json_doc(
         doc["windows"] = windows
     if fleet is not None:
         doc["fleet"] = fleet
+    if health is not None:
+        doc["health"] = health
     attach_issue_blocks(doc, result)
     return doc
 

@@ -44,6 +44,7 @@ from kafka_topic_analyzer_tpu.config import FollowConfig, TransportRetryConfig
 from kafka_topic_analyzer_tpu.engine import ScanResult, run_scan
 from kafka_topic_analyzer_tpu.io.retry import Backoff
 from kafka_topic_analyzer_tpu.obs import events as obs_events
+from kafka_topic_analyzer_tpu.obs import health as obs_health
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 from kafka_topic_analyzer_tpu.serve import state as serve_state
 from kafka_topic_analyzer_tpu.serve.windows import WindowObserver, WindowRing
@@ -82,6 +83,7 @@ class FollowService:
         ingest_workers=1,
         heartbeat_every_s: float = 10.0,
         publish_reports: bool = True,
+        health: "Optional[obs_health.HealthEngine]" = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         # Multi-CONTROLLER meshes are refused up front: the poll loop's
@@ -137,6 +139,12 @@ class FollowService:
             self.source = self._observer
         else:
             self.source = source
+        #: The alert engine this service evaluates at every poll
+        #: boundary (obs/health.py): an explicit one wins (tests inject
+        #: clock-driven engines), else whatever the telemetry session
+        #: installed, else none — alerting is opt-in observability and
+        #: the loop must not pay for an engine nobody reads.
+        self.health = health if health is not None else obs_health.active()
         #: The lock-consistent /report.json snapshot (serve/state.py).
         self.state = serve_state.ServiceState()
         self._stop = threading.Event()
@@ -190,6 +198,11 @@ class FollowService:
 
     def run(self) -> ScanResult:
         serve_state.set_active(self.state)
+        if self.health is not None:
+            # The /healthz handler discovers the engine the same way the
+            # /report.json handler discovers the state: module-level
+            # registration, last service wins.
+            obs_health.set_active(self.health)
         if self.resume and self.snapshot_dir is not None:
             # Operator banner: where will this service pick up?  Metadata
             # only — the engine's resume path pays the state load.
@@ -329,7 +342,15 @@ class FollowService:
             lag_total += lag
             obs_metrics.PARTITION_LAG.labels(partition=p).set(lag)
         obs_metrics.FOLLOW_LAG.set(lag_total)
+        self._evaluate_health()
         return lag_total
+
+    def _evaluate_health(self) -> None:
+        """One alert-engine pass at a poll boundary (DESIGN.md §22): a
+        /healthz flip lands within one poll of the fault, which is the
+        acceptance bar for the lag-divergence scenario."""
+        if self.health is not None:
+            self.health.evaluate()
 
     def _checkpoint_due(self) -> bool:
         if self.snapshot_dir is None:
@@ -412,6 +433,10 @@ class FollowService:
         if result.wire is not None:
             result.wire.bytes_total = self._wire_bytes
             result.wire.records = self._wire_records
+        # Post-pass health boundary: the lag gauges just settled against
+        # the freshest head, so a pass that healed (or worsened) the
+        # divergence is reflected before the report publishes.
+        self._evaluate_health()
         if not self.publish_reports:
             return
         from kafka_topic_analyzer_tpu.obs.doctor import diagnose_scan
@@ -423,6 +448,11 @@ class FollowService:
             diagnosis=diagnose_scan(result),
             follow=self.follow_block(result),
             windows=self.ring.report() if self.ring is not None else None,
+            health=(
+                self.health.alerts_block()
+                if self.health is not None
+                else None
+            ),
         )
         self.state.publish(doc)
 

@@ -433,6 +433,15 @@ def run(argv: "list[str] | None" = None) -> int:
                          "BENCH_NOTES ledger procedure. Also the overhead "
                          "referee: an A/B against a run without this flag "
                          "must stay within 2%% (DESIGN.md §17)")
+    ap.add_argument("--service-obs", metavar="DIR",
+                    help="run the FULL service-observability stack during "
+                         "the scan: flight recorder + the disk-backed "
+                         "telemetry history persisted under DIR + "
+                         "alert-engine evaluation at heartbeat cadence "
+                         "(DESIGN.md §22). The BENCH round 15 overhead "
+                         "referee: an A/B against a plain run must stay "
+                         "within the same 2%% bar as --flight-record "
+                         "alone")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -529,10 +538,21 @@ def run(argv: "list[str] | None" = None) -> int:
     ) as port:
         source = KafkaWireSource(f"127.0.0.1:{port}", "bench-e2e")
         recorder = None
-        if args.flight_record:
+        store = None
+        if args.flight_record or args.service_obs:
             from kafka_topic_analyzer_tpu.obs import flight as obs_flight
 
             recorder = obs_flight.FlightRecorder()
+            if args.service_obs:
+                from kafka_topic_analyzer_tpu.obs import (
+                    health as obs_health,
+                    history as obs_history,
+                )
+
+                store = obs_history.HistoryStore(args.service_obs)
+                recorder.attach_history(store)
+                obs_history.set_active(store)
+                obs_health.set_active(obs_health.HealthEngine())
             obs_flight.set_active(recorder)
             recorder.start()
         try:
@@ -555,6 +575,15 @@ def run(argv: "list[str] | None" = None) -> int:
             if recorder is not None:
                 recorder.stop()
                 obs_flight.set_active(None)
+            if store is not None:
+                from kafka_topic_analyzer_tpu.obs import (
+                    health as obs_health,
+                    history as obs_history,
+                )
+
+                store.close()
+                obs_history.set_active(None)
+                obs_health.set_active(None)
         source.close()
 
     got = int(result.metrics.overall_count)

@@ -394,9 +394,17 @@ WIRE_CFG = AnalyzerConfig(
 
 
 def _wire_scan(recorder: bool, workers=1, superbatch=1, mesh=None):
+    """Recorder-on scans run the FULL service-observability stack —
+    flight ring + disk-backed history + alert-engine evaluation — so
+    the identity matrix proves ISSUE 15's bar (history/alerts on vs
+    off byte-identical) on the same cells that proved ISSUE 10's."""
+    import tempfile
+
     from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
     from kafka_topic_analyzer_tpu.config import DispatchConfig
     from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+    from kafka_topic_analyzer_tpu.obs import health as obs_health
+    from kafka_topic_analyzer_tpu.obs import history as obs_history
     from fake_broker import FakeBroker
 
     records = {p: _mk_records(p, N_REC) for p in range(N_PARTS)}
@@ -410,8 +418,19 @@ def _wire_scan(recorder: bool, workers=1, superbatch=1, mesh=None):
         cfg = dataclasses.replace(WIRE_CFG, mesh_shape=mesh)
         backend_cls = ShardedTpuBackend
     rec = None
+    store = None
     if recorder:
+        from kafka_topic_analyzer_tpu.config import HealthConfig
+        from kafka_topic_analyzer_tpu.obs.health import HealthEngine
+        from kafka_topic_analyzer_tpu.obs.history import HistoryStore
+
         rec = FlightRecorder(interval_s=0.002)
+        store = HistoryStore(tempfile.mkdtemp(prefix="kta-hist-"))
+        rec.attach_history(store)
+        obs_history.set_active(store)
+        obs_health.set_active(
+            HealthEngine(cfg=HealthConfig(eval_interval_s=0.005))
+        )
         obs_flight.set_active(rec)
         rec.start()
     try:
@@ -432,8 +451,13 @@ def _wire_scan(recorder: bool, workers=1, superbatch=1, mesh=None):
         if rec is not None:
             rec.stop()
             obs_flight.set_active(None)
+            obs_health.set_active(None)
+        if store is not None:
+            store.close()
+            obs_history.set_active(None)
     if rec is not None:
         assert len(rec.series()["t"]) >= 1
+        assert len(store.window()["t"]) >= 1  # history rode the ticks
     return _full_doc(result)
 
 

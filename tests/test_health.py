@@ -1,0 +1,1234 @@
+"""Service health layer (ISSUE 15): disk-backed telemetry history,
+trend doctor, and the SLO alert engine.
+
+Five layers of coverage:
+
+- alert-rule units: clock-injected state machine — fire, for-duration,
+  resolve hysteresis, flap suppression — with the transitions counter,
+  firing gauge, and typed events asserted per transition;
+- history store: round-trip, downsample-tier exactness (cum=last,
+  inst=mean), SIGTERM→restart series continuity (epoch bump, reset-aware
+  deltas, pre-restart window served), byte-budget retention (RRD: coarse
+  tiers keep the long view), truncated-tail tolerance;
+- HTTP surfaces: /healthz routing (404 without an engine, 503
+  pre-first-eval, 200 healthy, 503 with the firing-rule JSON) and
+  /history (404 without a store, windowed queries, bad params);
+- service integration: a scripted lag-divergence fault flips /healthz to
+  503 within one poll and heals back to 200 after resolve hysteresis; a
+  killed FakeBroker raises the watermark-refresh-outage alert;
+- byte-identity: scans with recorder + history + alert evaluation all ON
+  produce metrics documents identical to the stack OFF (solo wire,
+  follow, and fleet) — the recorder's read-only discipline carries over.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.config import (
+    AnalyzerConfig,
+    DispatchConfig,
+    FollowConfig,
+    HealthConfig,
+)
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+from kafka_topic_analyzer_tpu.io.synthetic import SyntheticSource, SyntheticSpec
+from kafka_topic_analyzer_tpu.obs import doctor, events as obs_events
+from kafka_topic_analyzer_tpu.obs import flight as obs_flight
+from kafka_topic_analyzer_tpu.obs import health as obs_health
+from kafka_topic_analyzer_tpu.obs import history as obs_history
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.obs.flight import FlightRecorder
+from kafka_topic_analyzer_tpu.obs.health import (
+    FIRING,
+    OK,
+    PENDING,
+    RESOLVING,
+    AlertRule,
+    HealthEngine,
+    built_in_rules,
+)
+from kafka_topic_analyzer_tpu.obs.history import (
+    HistoryStore,
+    track_delta,
+    track_rate,
+)
+from kafka_topic_analyzer_tpu.obs.registry import default_registry
+from kafka_topic_analyzer_tpu.serve.follow import FollowService
+
+from fake_broker import FakeBroker
+
+pytestmark = pytest.mark.health
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    default_registry().reset()
+    yield
+    default_registry().reset()
+    obs_health.set_active(None)
+    obs_history.set_active(None)
+    obs_flight.set_active(None)
+
+
+@pytest.fixture()
+def event_log():
+    events = []
+    sink = lambda etype, fields: events.append((etype, fields))  # noqa: E731
+    obs_events.add_sink(sink)
+    yield events
+    obs_events.remove_sink(sink)
+
+
+# ---------------------------------------------------------------------------
+# alert-rule state machine (clock-injected)
+
+
+class _Cond:
+    """A scriptable rule condition."""
+
+    def __init__(self):
+        self.value = None  # evidence dict or None
+
+    def __call__(self, ctx):
+        return self.value
+
+
+def _engine(rule, clock):
+    return HealthEngine(
+        [rule], cfg=HealthConfig(eval_interval_s=0.001),
+        clock=lambda: clock["t"], wall_clock=lambda: 1234.0,
+    )
+
+
+def _transitions(rule, state) -> float:
+    return obs_metrics.ALERTS_TRANSITIONS.labels(rule=rule, state=state).value
+
+
+def _firing(rule) -> float:
+    return obs_metrics.ALERTS_FIRING.labels(rule=rule).value
+
+
+def test_rule_fires_immediately_without_for_duration(event_log):
+    cond = _Cond()
+    clock = {"t": 0.0}
+    eng = _engine(AlertRule("r", "test rule", cond), clock)
+    doc = eng.evaluate({})
+    assert doc["healthy"] and not doc["firing"]
+    cond.value = {"n": 7}
+    doc = eng.evaluate({})
+    assert not doc["healthy"]
+    assert doc["firing"][0]["rule"] == "r"
+    assert doc["firing"][0]["evidence"] == {"n": 7}
+    assert _transitions("r", FIRING) == 1
+    assert _firing("r") == 1
+    assert ("alert_firing", {"rule": "r", "state": "firing",
+                             "evidence": {"n": 7}}) in event_log
+
+
+def test_rule_for_duration_and_blip_suppression(event_log):
+    cond = _Cond()
+    clock = {"t": 0.0}
+    eng = _engine(AlertRule("r", "s", cond, for_s=5.0), clock)
+    cond.value = {"x": 1}
+    eng.evaluate({})
+    assert eng.doc()["rules"][0]["state"] == PENDING
+    assert eng.doc()["healthy"]  # pending is not yet unhealthy
+    # A blip: condition clears before for_s → back to ok, never fires.
+    clock["t"] = 2.0
+    cond.value = None
+    eng.evaluate({})
+    assert eng.doc()["rules"][0]["state"] == OK
+    assert _transitions("r", FIRING) == 0
+    assert not any(e[0] == "alert_firing" for e in event_log)
+    assert any(e[0] == "alert_cleared" for e in event_log)
+    # Sustained condition: pending at t=3, fires once t >= 3 + 5.
+    clock["t"] = 3.0
+    cond.value = {"x": 2}
+    eng.evaluate({})
+    clock["t"] = 7.9
+    eng.evaluate({})
+    assert eng.doc()["rules"][0]["state"] == PENDING
+    clock["t"] = 8.0
+    eng.evaluate({})
+    assert eng.doc()["rules"][0]["state"] == FIRING
+    assert _firing("r") == 1
+    assert _transitions("r", PENDING) == 2
+    assert _transitions("r", FIRING) == 1
+
+
+def test_rule_resolve_hysteresis_and_flap_suppression(event_log):
+    cond = _Cond()
+    clock = {"t": 0.0}
+    eng = _engine(AlertRule("r", "s", cond, resolve_s=10.0), clock)
+    cond.value = {"x": 1}
+    eng.evaluate({})
+    assert eng.doc()["rules"][0]["state"] == FIRING
+    # Condition clears → resolving, still ACTIVE (unhealthy).
+    clock["t"] = 1.0
+    cond.value = None
+    eng.evaluate({})
+    assert eng.doc()["rules"][0]["state"] == RESOLVING
+    assert not eng.doc()["healthy"]
+    assert _firing("r") == 1  # not resolved yet
+    # Flap: condition returns mid-hysteresis → re-arms firing with NO
+    # second alert_firing event and no gauge double-count.
+    clock["t"] = 5.0
+    cond.value = {"x": 2}
+    eng.evaluate({})
+    assert eng.doc()["rules"][0]["state"] == FIRING
+    assert _firing("r") == 1
+    assert sum(1 for e in event_log if e[0] == "alert_firing") == 1
+    # Clear and hold past resolve_s → resolved.
+    clock["t"] = 6.0
+    cond.value = None
+    eng.evaluate({})
+    clock["t"] = 15.9
+    eng.evaluate({})
+    assert eng.doc()["rules"][0]["state"] == RESOLVING
+    clock["t"] = 16.0
+    eng.evaluate({})
+    assert eng.doc()["rules"][0]["state"] == OK
+    assert eng.doc()["healthy"]
+    assert _firing("r") == 0
+    assert sum(1 for e in event_log if e[0] == "alert_resolved") == 1
+    # Every state change booked: firing x2 (initial + flap re-arm),
+    # resolving x2, ok x1 — reconstructible from the counter alone.
+    assert _transitions("r", FIRING) == 2
+    assert _transitions("r", RESOLVING) == 2
+    assert _transitions("r", OK) == 1
+
+
+def test_broken_rule_predicate_never_raises():
+    def boom(ctx):
+        raise RuntimeError("rule bug")
+
+    eng = _engine(AlertRule("r", "s", boom), {"t": 0.0})
+    doc = eng.evaluate({})
+    assert doc["healthy"]  # a broken rule reads as clear, not as a crash
+
+
+def test_duplicate_rule_names_rejected():
+    with pytest.raises(ValueError, match="duplicate"):
+        HealthEngine([
+            AlertRule("r", "a", lambda ctx: None),
+            AlertRule("r", "b", lambda ctx: None),
+        ])
+
+
+# ---------------------------------------------------------------------------
+# built-in rules over scripted snapshots
+
+
+def _lag_snap(lag: float) -> dict:
+    return {
+        "kta_follow_lag_records": {
+            "type": "gauge",
+            "samples": [{"labels": {}, "value": lag}],
+        }
+    }
+
+
+def _cfg_fast(**kw) -> HealthConfig:
+    base = dict(
+        eval_interval_s=0.001, for_s=2.0, resolve_s=2.0,
+        lag_window_s=2.0, lag_min_growth=10,
+    )
+    base.update(kw)
+    return HealthConfig(**base)
+
+
+def test_lag_growth_fires_and_resolves():
+    clock = {"t": 0.0}
+    eng = HealthEngine(
+        built_in_rules(_cfg_fast()), cfg=_cfg_fast(),
+        clock=lambda: clock["t"],
+    )
+    for t, lag in [(0, 0), (1, 100), (2, 300), (3, 700), (4, 1500),
+                   (5, 3000), (6, 6000)]:
+        clock["t"] = float(t)
+        doc = eng.evaluate(_lag_snap(lag))
+    assert not doc["healthy"]
+    row = [r for r in doc["firing"] if r["rule"] == "lag-growth"][0]
+    assert row["evidence"]["eta"] == "inf"
+    assert row["evidence"]["growth_per_s"] > 0
+    # Heal: lag collapses to 0 and stays there past resolve_s.
+    for t in range(7, 12):
+        clock["t"] = float(t)
+        doc = eng.evaluate(_lag_snap(0))
+    assert doc["healthy"]
+    assert _firing("lag-growth") == 0
+
+
+def test_lag_shrinking_never_fires():
+    clock = {"t": 0.0}
+    eng = HealthEngine(
+        built_in_rules(_cfg_fast()), cfg=_cfg_fast(),
+        clock=lambda: clock["t"],
+    )
+    for t, lag in enumerate([10000, 8000, 6000, 4000, 2000, 500]):
+        clock["t"] = float(t)
+        doc = eng.evaluate(_lag_snap(lag))
+    # Behind but catching up = healthy.
+    assert doc["healthy"]
+
+
+def test_degraded_partitions_rule():
+    clock = {"t": 0.0}
+    eng = HealthEngine(
+        built_in_rules(_cfg_fast(resolve_s=1.0)),
+        cfg=_cfg_fast(resolve_s=1.0), clock=lambda: clock["t"],
+    )
+
+    def snap(n):
+        return {
+            "kta_scan_degraded_partitions": {
+                "type": "gauge",
+                "samples": [{"labels": {}, "value": n}],
+            }
+        }
+
+    doc = eng.evaluate(snap(2))
+    row = [r for r in doc["firing"] if r["rule"] == "degraded-partitions"]
+    assert row and row[0]["evidence"] == {"degraded_partitions": 2}
+    # Healed partitions (follow heals them at the head) resolve it.
+    clock["t"] = 1.0
+    eng.evaluate(snap(0))
+    clock["t"] = 2.5
+    doc = eng.evaluate(snap(0))
+    assert doc["healthy"]
+
+
+def test_fleet_topic_failure_rule_and_per_topic_scopes():
+    clock = {"t": 0.0}
+    cfg = _cfg_fast(for_s=0.0, lag_min_growth=1)
+    eng = HealthEngine(
+        built_in_rules(cfg), cfg=cfg, clock=lambda: clock["t"],
+    )
+    extras = {"topics": {"a": 0, "b": 0}, "failed_topics": ["b"]}
+    doc = eng.evaluate({}, extras=extras)
+    row = [r for r in doc["firing"] if r["rule"] == "fleet-topic-failure"]
+    assert row and row[0]["evidence"]["failed_topics"] == ["b"]
+    # Per-topic lag-growth: topic "a" diverges, topic "b" does not.
+    for t, lag in [(1, 10), (2, 200), (3, 3000), (4, 30000), (5, 300000)]:
+        clock["t"] = float(t)
+        doc = eng.evaluate(
+            {}, extras={"topics": {"a": lag, "b": 5}, "failed_topics": []},
+        )
+    scoped = [r for r in doc["firing"] if r["rule"] == "lag-growth"]
+    assert [r["topic"] for r in scoped] == ["a"]
+    # ?topic= filtering: b's block is healthy, a's is not.
+    assert eng.alerts_block(topic="b")["healthy"]
+    assert not eng.alerts_block(topic="a")["healthy"]
+
+
+def test_per_topic_firing_survives_contextless_evaluation():
+    """A heartbeat-cadence evaluation (no topic extras) must not drop a
+    firing per-topic alert from the published document."""
+    clock = {"t": 0.0}
+    cfg = _cfg_fast(for_s=0.0, lag_min_growth=1)
+    eng = HealthEngine(
+        built_in_rules(cfg), cfg=cfg, clock=lambda: clock["t"],
+    )
+    for t, lag in [(0, 10), (1, 1000), (2, 100000), (3, 10000000)]:
+        clock["t"] = float(t)
+        doc = eng.evaluate(
+            {}, extras={"topics": {"a": lag}, "failed_topics": []},
+        )
+    assert any(r["rule"] == "lag-growth" and r["topic"] == "a"
+               for r in doc["firing"])
+    # The engine-drive-loop hook evaluates with NO extras: the firing
+    # scope must persist in the published document.
+    clock["t"] = 4.0
+    doc = eng.evaluate({})
+    assert any(r["rule"] == "lag-growth" and r["topic"] == "a"
+               for r in doc["firing"])
+    assert not doc["healthy"]
+
+
+def test_extras_derived_rule_survives_contextless_evaluation():
+    """fleet-topic-failure derives its condition from extras; the
+    engine-heartbeat path evaluates with none.  The last topic context
+    must carry over, or the alert flaps ok↔firing between polls."""
+    clock = {"t": 0.0}
+    cfg = _cfg_fast(for_s=0.0)
+    eng = HealthEngine(
+        built_in_rules(cfg), cfg=cfg, clock=lambda: clock["t"],
+    )
+    doc = eng.evaluate(
+        {}, extras={"topics": {"x": 0}, "failed_topics": ["x"]}
+    )
+    assert any(r["rule"] == "fleet-topic-failure" for r in doc["firing"])
+    # Heartbeat evaluation mid-pass: no extras.  Still firing.
+    clock["t"] = 1.0
+    doc = eng.evaluate({})
+    assert any(r["rule"] == "fleet-topic-failure" for r in doc["firing"])
+    assert _transitions("fleet-topic-failure", OK) == 0  # no flap
+    # The next poll boundary reports the topic recovered: resolves.
+    clock["t"] = 2.0
+    doc = eng.evaluate(
+        {}, extras={"topics": {"x": 0}, "failed_topics": []}
+    )
+    assert doc["healthy"]
+
+
+def test_throughput_regression_rate_uses_actual_span():
+    """Sparse evaluation cadence: the 'recent' observation can be older
+    than the nominal window, and the rate must divide by the real span
+    — a service folding at exactly the drop threshold must fire."""
+    clock = {"t": 0.0}
+    # A 25s window against a 10s cadence: the nearest >=25s-old point is
+    # 30s old, so dividing its delta by the nominal 25 would inflate a
+    # true 450/s (0.45x the baseline — must fire at the 0.5x threshold)
+    # to 540/s (0.54x — silently missed).
+    cfg = _cfg_fast(
+        for_s=0.0, throughput_window_s=25.0, throughput_baseline_s=120.0,
+        min_baseline_rate=10.0,
+    )
+    eng = HealthEngine(
+        built_in_rules(cfg), cfg=cfg, clock=lambda: clock["t"],
+    )
+
+    def snap(records):
+        s = _lag_snap(500)
+        s["kta_scan_records_total"] = {
+            "type": "counter",
+            "samples": [{"labels": {}, "value": records}],
+        }
+        return s
+
+    t, records = 0.0, 0.0
+    while t < 120.0:
+        eng.evaluate(snap(records))
+        t += 10.0
+        clock["t"] = t
+        records += 10_000.0
+    for _ in range(4):
+        eng.evaluate(snap(records))
+        t += 10.0
+        clock["t"] = t
+        records += 4_500.0
+    doc = eng.evaluate(snap(records))
+    rows = [r for r in doc["firing"] if r["rule"] == "throughput-regression"]
+    assert rows, doc["rules"]
+    assert rows[0]["evidence"]["recent_per_s"] == pytest.approx(450.0)
+
+
+def test_throughput_regression_requires_lag():
+    clock = {"t": 0.0}
+    cfg = _cfg_fast(
+        for_s=0.0, throughput_window_s=2.0, throughput_baseline_s=8.0,
+        min_baseline_rate=10.0,
+    )
+    eng = HealthEngine(
+        built_in_rules(cfg), cfg=cfg, clock=lambda: clock["t"],
+    )
+
+    def snap(records, lag):
+        s = _lag_snap(lag)
+        s["kta_scan_records_total"] = {
+            "type": "counter",
+            "samples": [{"labels": {}, "value": records}],
+        }
+        return s
+
+    # Healthy baseline: 1000 rec/s for 8s, then collapse to ~0 while
+    # lag remains — regression.  (Lag held constant so lag-growth stays
+    # quiet and this asserts the throughput rule alone.)
+    records = 0
+    for t in range(9):
+        clock["t"] = float(t)
+        records = t * 1000
+        doc = eng.evaluate(snap(records, lag=500))
+    for t in range(9, 12):
+        clock["t"] = float(t)
+        doc = eng.evaluate(snap(records, lag=500))
+    rows = [r for r in doc["firing"] if r["rule"] == "throughput-regression"]
+    assert rows and rows[0]["evidence"]["recent_per_s"] < 100
+    # The same collapse at the HEAD (lag 0) is a healthy idle service.
+    eng2 = HealthEngine(
+        built_in_rules(cfg), cfg=cfg, clock=lambda: clock["t"],
+    )
+    for t in range(12):
+        clock["t"] = float(t)
+        doc = eng2.evaluate(snap(min(t, 8) * 1000, lag=0))
+    assert doc["healthy"]
+
+
+# ---------------------------------------------------------------------------
+# history store
+
+
+def _store(tmp_path, clk, **kw):
+    kw.setdefault("max_bytes", 1 << 16)
+    return HistoryStore(str(tmp_path / "hist"), clock=lambda: clk["t"], **kw)
+
+
+def test_history_round_trip_and_downsample_exactness(tmp_path):
+    clk = {"t": 1000.0}
+    s = _store(tmp_path, clk)
+    s.register_kinds({"records": "cum", "depth": "inst"})
+    for i in range(8):
+        clk["t"] = 1000.0 + i
+        s.append({"records": i * 100.0, "depth": float(i)})
+    w = s.window()
+    assert w["t"] == [1000.0 + i for i in range(8)]
+    assert w["tracks"]["records"] == [i * 100.0 for i in range(8)]
+    assert w["kinds"] == {"depth": "inst", "records": "cum"}
+    # Tier 1 = pairwise downsample: cumulative keeps the LAST value
+    # (delta-exact), instantaneous averages.
+    t1 = s.tier_rows(1)
+    assert [r[2]["records"] for r in t1] == [100.0, 300.0, 500.0, 700.0]
+    assert [r[2]["depth"] for r in t1] == [0.5, 2.5, 4.5, 6.5]
+    # Windowed query bounds [t0, t1].
+    sub = s.window(t0=1002.0, t1=1004.0)
+    assert sub["t"] == [1002.0, 1003.0, 1004.0]
+    # Delta/rate algebra over the window.
+    assert track_delta(w, "records") == 700.0
+    assert track_rate(w, "records") == pytest.approx(100.0)
+    s.close()
+
+
+def test_history_restart_continuity_and_epoch_reset(tmp_path):
+    clk = {"t": 2000.0}
+    s = _store(tmp_path, clk)
+    s.register_kinds({"records": "cum"})
+    for i in range(5):
+        clk["t"] = 2000.0 + i
+        s.append({"records": 1000.0 + i * 100.0})
+    s.close()
+    # Restart after a 60s outage: the pre-restart window is served, the
+    # epoch bumps, and the process's counters restart from zero.
+    clk["t"] = 2064.0
+    s2 = _store(tmp_path, clk)
+    assert s2.epoch == 2
+    w = s2.window()
+    assert len(w["t"]) == 5  # pre-restart rows survived the reopen
+    for i in range(3):
+        clk["t"] = 2064.0 + i
+        s2.append({"records": i * 50.0})
+    w = s2.window()
+    assert len(w["t"]) == 8
+    assert set(w["epoch"]) == {1, 2}
+    # Reset-aware delta: 400 within epoch 1, 0 at the boundary row
+    # (counter restarted at 0), 100 within epoch 2 = 500 — never a
+    # negative delta from the reset.
+    assert track_delta(w, "records") == 500.0
+    # The outage gap stays IN the denominator: 500 records over the full
+    # 66s wall span, not over the ~7s of sampled time.
+    assert track_rate(w, "records") == pytest.approx(500.0 / 66.0)
+    s2.close()
+
+
+def test_history_crash_leaves_open_segment_recoverable(tmp_path):
+    clk = {"t": 3000.0}
+    s = _store(tmp_path, clk)
+    s.register_kinds({"v": "cum"})
+    for i in range(4):
+        clk["t"] = 3000.0 + i
+        s.append({"v": float(i)})
+    # Simulate SIGKILL: no close().  Truncate the open segment mid-line
+    # (the write in flight when the process died).
+    open_path = os.path.join(str(tmp_path / "hist"), "tier0", "open.jsonl")
+    data = open(open_path, "rb").read()
+    with open(open_path, "wb") as f:
+        f.write(data[:-7])  # sever the last line
+    s2 = _store(tmp_path, clk)
+    w = s2.window()
+    # All complete rows recovered; the severed one skipped, not fatal.
+    assert w["tracks"]["v"] == [0.0, 1.0, 2.0]
+    s2.close()
+
+
+def test_history_byte_budget_is_rrd_shaped(tmp_path):
+    clk = {"t": 10_000.0}
+    s = HistoryStore(
+        str(tmp_path / "hist"), max_bytes=8192, tiers=3,
+        clock=lambda: clk["t"],
+    )
+    s.register_kinds({"v": "cum"})
+    for i in range(2000):
+        clk["t"] = 10_000.0 + i
+        s.append({"v": float(i)})
+    # The store stayed within its bound (open segments included).
+    hist_dir = str(tmp_path / "hist")
+    total = sum(
+        os.path.getsize(os.path.join(root, f))
+        for root, _, files in os.walk(hist_dir)
+        for f in files
+        if f.endswith(".jsonl")
+    )
+    assert total <= 8192 * 1.3  # bound + at most one in-flight segment/tier
+    # RRD retention: the coarse tier's window reaches further back than
+    # tier 0's, and a whole-range query stitches both.
+    t0_rows = s.tier_rows(0)
+    t2_rows = s.tier_rows(2)
+    assert t2_rows[0][0] < t0_rows[0][0]
+    w = s.window()
+    assert w["t"][0] == t2_rows[0][0]
+    assert w["t"][-1] == t0_rows[-1][0]
+    assert sorted(w["tiers_used"]) == w["tiers_used"]  # fine → coarse
+    assert obs_metrics.HISTORY_ROTATIONS.value > 0
+    s.close()
+
+
+def test_telemetry_session_history_resumes_across_sessions(tmp_path):
+    """The CLI wiring end to end: --history-bytes opens the store next
+    to the checkpoints, implies the recorder, installs the alert
+    engine, and a second session (the restarted service) serves the
+    pre-restart window with a bumped epoch."""
+    from kafka_topic_analyzer_tpu.obs import telemetry_session
+
+    hist = str(tmp_path / "hist")
+    with telemetry_session(history_dir=hist, history_bytes=65536):
+        rec = obs_flight.active()
+        assert rec is not None  # history implies the recorder
+        assert obs_health.active() is not None  # serving surface exists
+        obs_metrics.SCAN_RECORDS.inc(10)
+        rec.sample_once()
+        assert len(obs_history.active().window()["t"]) >= 1
+    assert obs_history.active() is None
+    assert obs_health.active() is None
+    with telemetry_session(history_dir=hist, history_bytes=65536):
+        store = obs_history.active()
+        w = store.window()
+        assert len(w["t"]) >= 1  # the pre-restart window is served
+        assert store.epoch == 2
+        assert 1 in w["epoch"]
+
+
+def test_recorder_feeds_history(tmp_path):
+    clk = {"t": 0.0}
+    rec = FlightRecorder(interval_s=0.5, clock=lambda: clk["t"])
+    s = HistoryStore(str(tmp_path / "hist"), clock=lambda: 500.0)
+    rec.attach_history(s)
+    obs_metrics.SCAN_RECORDS.inc(42)
+    rec.sample_once()
+    w = s.window()
+    assert w["tracks"]["records"] == [42.0]
+    # The recorder registered its kind map for downsample policy.
+    assert w["kinds"]["records"] == "cum"
+    assert w["kinds"]["dispatch_inflight"] == "inst"
+    s.close()
+
+
+def test_recorder_survives_history_sink_failure(tmp_path):
+    """Telemetry is best-effort: a dying history sink (full disk,
+    vanished directory) detaches — it must not kill the sampler thread
+    or fail teardown's closing sample."""
+    rec = FlightRecorder(interval_s=0.5, clock=lambda: 0.0)
+    s = HistoryStore(str(tmp_path / "hist"))
+    rec.attach_history(s)
+
+    def boom(values, t=None):
+        raise OSError("disk full")
+
+    s.append = boom
+    rec.sample_once()  # must not raise
+    rec.sample_once()
+    assert len(rec.series()["t"]) == 2  # the live ring kept recording
+    assert rec._history is None  # sink detached after the first failure
+    s.close()
+
+
+def test_history_window_sorted_under_clock_regression(tmp_path):
+    """An NTP step backwards across a restart: the mirror keeps write
+    order (the eviction-prefix invariant) and window() sorts at query
+    time, so served rows stay a monotone time axis."""
+    clk = {"t": 5000.0}
+    s = _store(tmp_path, clk)
+    s.register_kinds({"v": "cum"})
+    for i in range(3):
+        clk["t"] = 5000.0 + i
+        s.append({"v": float(i)})
+    s.close()
+    clk["t"] = 4990.0  # the clock stepped back before the restart
+    s2 = _store(tmp_path, clk)
+    for i in range(3):
+        clk["t"] = 4990.0 + i
+        s2.append({"v": float(i)})
+    w = s2.window()
+    assert w["t"] == sorted(w["t"])
+    assert len(w["t"]) == 6
+    s2.close()
+
+
+# ---------------------------------------------------------------------------
+# trend doctor
+
+
+def _win(t, tracks, epoch=None):
+    return {
+        "t": t,
+        "epoch": epoch or [1] * len(t),
+        "tracks": tracks,
+    }
+
+
+def test_trend_throughput_droop():
+    w = _win(
+        [0.0, 10.0, 20.0, 30.0, 35.0, 40.0],
+        {"records": [0.0, 10_000.0, 20_000.0, 30_000.0, 30_050.0, 30_100.0]},
+    )
+    kinds = [f["kind"] for f in doctor.diagnose_trends(w)]
+    assert "throughput-droop" in kinds
+
+
+def test_trend_lag_divergence():
+    w = _win(
+        [0.0, 10.0, 20.0, 30.0, 40.0],
+        {"follow_lag": [100.0, 200.0, 400.0, 800.0, 1600.0]},
+    )
+    f = [x for x in doctor.diagnose_trends(w) if x["kind"] == "lag-divergence"]
+    assert f and f[0]["evidence"]["eta"] == "inf"
+    assert f[0]["evidence"]["growth_per_s"] == pytest.approx(1500 / 40.0)
+
+
+def test_trend_retry_storm_and_quiet_window():
+    quiet = _win(
+        [0.0, 10.0, 20.0, 30.0, 40.0],
+        {
+            "records": [0, 1000, 2000, 3000, 4000],
+            "backoff_sleeps": [0.0, 0.0, 0.0, 0.0, 0.0],
+            "follow_lag": [0.0, 0.0, 0.0, 0.0, 0.0],
+        },
+    )
+    assert doctor.diagnose_trends(quiet) == []
+    storm = _win(
+        [0.0, 10.0, 20.0, 30.0, 34.0, 40.0],
+        {"backoff_sleeps": [0.0, 1.0, 1.0, 1.0, 30.0, 60.0]},
+    )
+    kinds = [f["kind"] for f in doctor.diagnose_trends(storm)]
+    assert "retry-storm" in kinds
+
+
+def test_trend_verify_bound_warm_reaudit():
+    w = _win(
+        [0.0, 10.0, 20.0, 30.0, 40.0],
+        {
+            "cache_verify_s": [0.0, 4.0, 8.0, 12.0, 16.0],
+            "cache_hit_bytes": [0.0, 1e8, 2e8, 3e8, 4e8],
+        },
+    )
+    f = [x for x in doctor.diagnose_trends(w) if x["kind"] == "verify-bound"]
+    assert f and f[0]["evidence"]["verify_share"] == pytest.approx(0.4)
+
+
+def test_trend_epoch_reset_not_a_droop():
+    """A restart's counter reset must not read as negative throughput."""
+    w = _win(
+        [0.0, 10.0, 20.0, 30.0, 40.0],
+        {"records": [10_000.0, 20_000.0, 30_000.0, 2_500.0, 5_000.0]},
+        epoch=[1, 1, 1, 2, 2],
+    )
+    assert track_delta(w, "records") == pytest.approx(25_000.0)
+    assert track_rate(w, "records") == pytest.approx(25_000.0 / 40.0)
+
+
+# ---------------------------------------------------------------------------
+# cache verify instrumentation (satellite)
+
+
+def test_segment_cache_books_verify_seconds_and_hit_bytes(tmp_path):
+    from kafka_topic_analyzer_tpu.io.objstore import SegmentCache
+
+    cache = SegmentCache(str(tmp_path / "cache"), 1 << 20, "store-key")
+    data = bytes(range(256)) * 512  # 128 KiB
+    cache.put("chunk-0", len(data), data)
+    assert obs_metrics.SEGSTORE_CACHE_VERIFY_SECONDS.value == 0.0
+    got = cache.get("chunk-0", len(data))
+    assert got == data
+    assert obs_metrics.SEGSTORE_CACHE_HIT_BYTES.value == len(data)
+    assert obs_metrics.SEGSTORE_CACHE_VERIFY_SECONDS.value > 0.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces: /healthz + /history
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    )
+
+
+def test_healthz_and_history_routing(tmp_path):
+    from kafka_topic_analyzer_tpu.obs.exporters import PrometheusExporter
+
+    exporter = PrometheusExporter(0)
+    try:
+        # 404: no engine, no store.
+        for path in ("/healthz", "/history"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(exporter.port, path)
+            assert ei.value.code == 404
+        # 503 pre-first-eval: an unevaluated service must not claim
+        # liveness.
+        eng = HealthEngine([AlertRule("r", "s", lambda ctx: ctx.extras.get("on"))])
+        obs_health.set_active(eng)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exporter.port, "/healthz")
+        assert ei.value.code == 503
+        # 200 healthy, with the document body.
+        eng.evaluate({})
+        with _get(exporter.port, "/healthz") as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["healthy"] and doc["evaluations"] == 1
+        # 503 firing, with the firing-rule JSON as the body.
+        eng.evaluate({}, extras={"on": {"why": "test"}})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exporter.port, "/healthz")
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read().decode())
+        assert body["firing"][0]["rule"] == "r"
+        assert body["firing"][0]["evidence"] == {"why": "test"}
+        # /history: windowed queries over the active store.
+        clk = {"t": 100.0}
+        store = HistoryStore(str(tmp_path / "hist"), clock=lambda: clk["t"])
+        store.register_kinds({"records": "cum"})
+        for i in range(6):
+            clk["t"] = 100.0 + i
+            store.append({"records": float(i)})
+        obs_history.set_active(store)
+        with _get(exporter.port, "/history") as resp:
+            w = json.loads(resp.read().decode())
+        assert w["tracks"]["records"] == [float(i) for i in range(6)]
+        with _get(
+            exporter.port, "/history?t0=102&t1=104&tracks=records"
+        ) as resp:
+            w = json.loads(resp.read().decode())
+        assert w["t"] == [102.0, 103.0, 104.0]
+        assert list(w["tracks"]) == ["records"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exporter.port, "/history?t0=notanumber")
+        assert ei.value.code == 400
+        # The alert instruments ride the normal scrape.
+        with _get(exporter.port, "/metrics") as resp:
+            text = resp.read().decode()
+        assert "kta_health_evaluations_total 2" in text
+        assert 'kta_alerts_firing{rule="r"} 1' in text
+        assert 'kta_alerts_transitions_total{rule="r",state="firing"} 1' in text
+        store.close()
+    finally:
+        exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# service integration: lag-divergence fault → /healthz flip → heal → 200
+
+
+class _DivergingSource:
+    """A scripted topic whose head runs away while 'stalled': watermark
+    polls see a growing end offset but no records are servable, so the
+    follow cursor cannot advance — the canonical lag-divergence fault.
+    Healing serves the real (synthetic) records and pins the head."""
+
+    def __init__(self, inner: SyntheticSource):
+        self.inner = inner
+        self.stalled = True
+        self._fake_head = dict(inner.watermarks()[1])
+
+    def partitions(self):
+        return self.inner.partitions()
+
+    def is_empty(self):
+        return False
+
+    def watermarks(self):
+        start, end = self.inner.watermarks()
+        return start, dict(self._fake_head)
+
+    def refresh_watermarks(self):
+        if self.stalled:
+            for p in self._fake_head:
+                self._fake_head[p] += 50  # the head keeps moving
+        else:
+            self._fake_head = dict(self.inner.watermarks()[1])
+        return self.watermarks()
+
+    def batches(self, batch_size, partitions=None, start_at=None):
+        if self.stalled:
+            return iter(())
+        return self.inner.batches(
+            batch_size, partitions=partitions, start_at=start_at
+        )
+
+
+def test_follow_lag_divergence_flips_healthz_and_heals(event_log):
+    from kafka_topic_analyzer_tpu.obs.exporters import PrometheusExporter
+
+    spec = SyntheticSpec(
+        num_partitions=2, messages_per_partition=100, keys_per_partition=20
+    )
+    src = _DivergingSource(SyntheticSource(spec))
+    cfg = _cfg_fast(
+        for_s=0.05, resolve_s=0.05, lag_window_s=0.08, lag_min_growth=1
+    )
+    engine = HealthEngine(built_in_rules(cfg), cfg=cfg)
+    follow = FollowConfig(
+        poll_interval_s=0.02, idle_backoff_max_s=0.04, window_count=0
+    )
+    backend = CpuExactBackend(
+        AnalyzerConfig(num_partitions=2, batch_size=64), init_now_s=10**10
+    )
+    svc = FollowService(
+        "diverge.topic", src, backend, 64, follow, health=engine,
+    )
+    exporter = PrometheusExporter(0)
+
+    def probe():
+        try:
+            with _get(exporter.port, "/healthz") as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            body = e.read().decode()
+            try:
+                return e.code, json.loads(body)
+            except ValueError:
+                # send_error HTML (pre-first-eval 503): no document yet.
+                return e.code, {"firing": []}
+
+    def _wait_for(pred, what, timeout_s=20.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.01)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    errors = []
+
+    def driver():
+        try:
+            # Fault injected from the start: /healthz must flip to 503
+            # with lag-growth in the firing set.
+            _wait_for(
+                lambda: probe()[0] == 503
+                and any(
+                    r["rule"] == "lag-growth" for r in probe()[1]["firing"]
+                ),
+                "healthz 503 on lag divergence",
+            )
+            # Heal: serve the real records, pin the head, wait for 200.
+            src.stalled = False
+            _wait_for(
+                lambda: probe()[0] == 200, "healthz 200 after heal+resolve"
+            )
+        except BaseException as e:
+            errors.append(e)
+        finally:
+            svc.request_stop("test")
+
+    t = threading.Thread(target=driver)
+    t.start()
+    result = svc.run()
+    t.join()
+    exporter.close()
+    if errors:
+        raise errors[0]
+    # The service folded the real topic exactly once healed.
+    assert result.metrics.overall_count == 200
+    fired = [f for e, f in event_log if e == "alert_firing"]
+    resolved = [f for e, f in event_log if e == "alert_resolved"]
+    assert any(f["rule"] == "lag-growth" for f in fired)
+    assert any(f["rule"] == "lag-growth" for f in resolved)
+    # /report.json documents carry the health block.
+    doc = svc.state.snapshot()
+    assert doc is not None and "health" in doc and doc["health"]["healthy"]
+
+
+def test_follow_watermark_outage_alert():
+    """A killed broker: refresh give-ups accumulate and the
+    watermark-refresh-outage alert fires (the service keeps polling the
+    stale snapshot — PR 11's hardening — but /healthz says so)."""
+    records = {p: [
+        (i, 1_600_000_000_000 + i, f"k{i}".encode(), b"v" * 10)
+        for i in range(40)
+    ] for p in range(2)}
+    cfg = _cfg_fast(for_s=0.05, resolve_s=0.05, outage_window_s=30.0)
+    engine = HealthEngine(built_in_rules(cfg), cfg=cfg)
+    follow = FollowConfig(
+        poll_interval_s=0.02, idle_backoff_max_s=0.04, window_count=0
+    )
+    broker = FakeBroker("outage.topic", records).start()
+    src = KafkaWireSource(
+        f"127.0.0.1:{broker.port}", "outage.topic",
+        overrides={
+            "retry.backoff.ms": "2",
+            "reconnect.backoff.max.ms": "8",
+            "transport.retry.budget": "2",
+        },
+    )
+    backend = CpuExactBackend(
+        AnalyzerConfig(num_partitions=2, batch_size=64), init_now_s=10**10
+    )
+    svc = FollowService(
+        "outage.topic", src, backend, 64, follow, health=engine,
+    )
+    errors = []
+
+    def driver():
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                doc = engine.doc()
+                if doc is not None and svc.passes >= 1:
+                    break
+                time.sleep(0.01)
+            broker.kill()  # every re-poll now exhausts its budget
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                doc = engine.doc()
+                if doc and any(
+                    r["rule"] == "watermark-refresh-outage"
+                    for r in doc["firing"]
+                ):
+                    return
+                time.sleep(0.01)
+            raise AssertionError("watermark outage alert never fired")
+        except BaseException as e:
+            errors.append(e)
+        finally:
+            svc.request_stop("test")
+
+    t = threading.Thread(target=driver)
+    t.start()
+    svc.run()
+    t.join()
+    src.close()
+    broker.stop()
+    if errors:
+        raise errors[0]
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: full service-observability stack on vs off
+
+
+N_PARTS, N_REC = 3, 240
+
+
+def _mk_records(partition: int, n: int):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 29}".encode() if i % 5 else None,
+            bytes(20 + (i % 13)) if i % 7 else None,
+        )
+        for i in range(n)
+    ]
+
+
+def _scan_cfg():
+    return AnalyzerConfig(
+        num_partitions=N_PARTS, batch_size=64,
+        count_alive_keys=True, alive_bitmap_bits=16,
+        enable_hll=True, hll_p=8,
+    )
+
+
+def _full_doc(result) -> dict:
+    return {
+        "metrics": result.metrics.to_dict(
+            result.start_offsets, result.end_offsets
+        ),
+        "degraded": result.degraded_partitions,
+        "corrupt": result.corrupt_partitions,
+    }
+
+
+def _with_stack(tmp_path, tag):
+    """Context: recorder + history + alert engine, all active."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        rec = FlightRecorder(interval_s=0.002)
+        store = HistoryStore(str(tmp_path / f"hist-{tag}"))
+        rec.attach_history(store)
+        cfg = _cfg_fast(eval_interval_s=0.005)
+        engine = HealthEngine(built_in_rules(cfg), cfg=cfg)
+        obs_flight.set_active(rec)
+        obs_history.set_active(store)
+        obs_health.set_active(engine)
+        rec.start()
+        try:
+            yield engine
+        finally:
+            rec.stop()
+            store.close()
+            obs_flight.set_active(None)
+            obs_history.set_active(None)
+            obs_health.set_active(None)
+
+    return ctx()
+
+
+@pytest.mark.parametrize("workers,superbatch", [(1, 1), (4, 4)])
+def test_scan_identity_full_stack_wire(tmp_path, workers, superbatch):
+    records = {p: _mk_records(p, N_REC) for p in range(N_PARTS)}
+
+    def scan(stack: bool):
+        import contextlib
+
+        cm = (
+            _with_stack(tmp_path, f"w{workers}k{superbatch}-{stack}")
+            if stack
+            else contextlib.nullcontext()
+        )
+        with cm:
+            with FakeBroker("health.topic", records,
+                            max_records_per_fetch=60) as broker:
+                src = KafkaWireSource(
+                    f"127.0.0.1:{broker.port}", "health.topic",
+                    overrides={"retry.backoff.ms": "5"},
+                )
+                result = run_scan(
+                    "health.topic", src,
+                    TpuBackend(
+                        _scan_cfg(), init_now_s=10**10,
+                        dispatch=DispatchConfig(superbatch=superbatch),
+                    ),
+                    64, ingest_workers=workers,
+                )
+                src.close()
+        return _full_doc(result)
+
+    assert scan(stack=True) == scan(stack=False)
+
+
+def test_follow_identity_full_stack(tmp_path):
+    """A follow service with the whole stack on folds byte-identically
+    to the batch referee of the same records."""
+    phase1 = {p: _mk_records(p, 120) for p in range(N_PARTS)}
+    phase2 = {
+        p: _mk_records(p, 180)[120:] for p in range(N_PARTS)
+    }
+    full = {p: phase1[p] + phase2[p] for p in range(N_PARTS)}
+
+    with FakeBroker("health.follow", full, max_records_per_fetch=48) as b:
+        src = KafkaWireSource(
+            f"127.0.0.1:{b.port}", "health.follow",
+            overrides={"retry.backoff.ms": "5"},
+        )
+        referee = _full_doc(run_scan(
+            "health.follow", src,
+            TpuBackend(_scan_cfg(), init_now_s=10**10), 64,
+        ))
+        src.close()
+    default_registry().reset()
+
+    with _with_stack(tmp_path, "follow"):
+        follow = FollowConfig(
+            poll_interval_s=0.02, idle_backoff_max_s=0.05,
+            window_secs=5.0, window_count=4,
+        )
+        with FakeBroker("health.follow", phase1,
+                        max_records_per_fetch=48) as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", "health.follow",
+                overrides={"retry.backoff.ms": "5"},
+            )
+            svc = FollowService(
+                "health.follow", src,
+                TpuBackend(_scan_cfg(), init_now_s=10**10), 64, follow,
+            )
+            errors = []
+
+            def driver():
+                try:
+                    deadline = time.monotonic() + 20.0
+                    while time.monotonic() < deadline:
+                        doc = svc.state.snapshot()
+                        if doc and doc["overall"]["count"] >= N_PARTS * 120:
+                            break
+                        time.sleep(0.01)
+                    for p in range(N_PARTS):
+                        broker.produce(p, phase2[p])
+                    deadline = time.monotonic() + 20.0
+                    while time.monotonic() < deadline:
+                        doc = svc.state.snapshot()
+                        if doc and doc["overall"]["count"] >= N_PARTS * 180:
+                            break
+                        time.sleep(0.01)
+                except BaseException as e:
+                    errors.append(e)
+                finally:
+                    svc.request_stop("test")
+
+            t = threading.Thread(target=driver)
+            t.start()
+            result = svc.run()
+            t.join()
+            src.close()
+            if errors:
+                raise errors[0]
+    assert _full_doc(result) == referee
+    # The service used the session-installed engine (health block rode
+    # the published reports).
+    doc = svc.state.snapshot()
+    assert "health" in doc
+
+
+# ---------------------------------------------------------------------------
+# fleet: per-topic verdicts in the rollup (satellite) + health context
+
+
+def test_fleet_rollup_carries_verdicts_without_publishing(tmp_path):
+    """The satellite fix: a fleet run that publishes NO reports (no
+    --metrics-port) still attributes every topic's pass — the rollup's
+    verdict column and verdict_counts fill in."""
+    from kafka_topic_analyzer_tpu.fleet.scheduler import (
+        FleetScheduler,
+        TopicSeed,
+    )
+    from kafka_topic_analyzer_tpu.fleet.service import FleetService
+
+    specs = {
+        "fleet.a": SyntheticSpec(
+            num_partitions=2, messages_per_partition=150,
+            keys_per_partition=20, seed=1,
+        ),
+        "fleet.b": SyntheticSpec(
+            num_partitions=2, messages_per_partition=90,
+            keys_per_partition=10, seed=2,
+        ),
+    }
+
+    cfg = _cfg_fast(for_s=0.0)
+    engine = HealthEngine(built_in_rules(cfg), cfg=cfg)
+    svc = FleetService(
+        [TopicSeed(name=t, partitions=2) for t in specs],
+        lambda t: SyntheticSource(specs[t]),
+        lambda t, parts, grant: CpuExactBackend(
+            AnalyzerConfig(num_partitions=parts, batch_size=64),
+            init_now_s=10**10,
+        ),
+        64,
+        FleetScheduler(2, 2, 2),
+        publish_reports=False,
+        health=engine,
+    )
+    fr = svc.run_batch()
+    assert all(s.status == "ok" for s in fr.statuses.values())
+    for s in fr.statuses.values():
+        assert s.verdict  # every pass attributed, nothing published
+    statuses = fr.rollup["fleet"]["statuses"]
+    assert all(statuses[t]["verdict"] for t in specs)
+    vc = fr.rollup["fleet"]["verdict_counts"]
+    assert sum(vc.values()) == len(specs)
+    # The health engine evaluated at the wave boundary and the rollup
+    # carries its document.
+    assert fr.rollup["health"]["healthy"]
+    assert engine.evaluations >= 1

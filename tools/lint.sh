@@ -731,7 +731,14 @@ import pathlib
 import sys
 
 PKG = pathlib.Path("kafka_topic_analyzer_tpu")
-SCOPE = sorted((PKG / "serve").glob("*.py")) + [PKG / "obs" / "exporters.py"]
+#: obs/health.py and obs/history.py are in scope for the same reason
+#: exporters.py is: the /healthz and /history surfaces live behind them,
+#: and any handler code that grows there inherits the purity rule.
+SCOPE = sorted((PKG / "serve").glob("*.py")) + [
+    PKG / "obs" / "exporters.py",
+    PKG / "obs" / "health.py",
+    PKG / "obs" / "history.py",
+]
 #: Drive-loop / fold-state entry points a handler must never reach.
 DRIVE_CALLS = {
     "run", "run_scan", "run_follow",
@@ -741,10 +748,16 @@ DRIVE_CALLS = {
     "observe_batch", "observe", "merge", "merged",
     "batches", "refresh_watermarks", "watermarks",
     "publish", "request_stop",
+    # Alert-engine mutation points: a probe must never trigger an
+    # evaluation (evaluation belongs to the poll/heartbeat boundaries).
+    "evaluate", "maybe_evaluate", "append",
 }
-#: The sanctioned read-only snapshot accessors.
+#: The sanctioned read-only snapshot accessors.  /healthz reads the
+#: engine's pre-serialized verdict; /history reads the store's windowed
+#: in-memory mirror under the store's own lock.
 ACCESSORS = {"report_bytes", "snapshot", "series", "active",
-             "render_prometheus"}
+             "render_prometheus", "healthz", "window", "doc",
+             "alerts_block"}
 
 failures = []
 for path in SCOPE:
@@ -1025,4 +1038,90 @@ if failures:
         print(f"  {f}")
     sys.exit(1)
 print("lint: OK (remote segment tier: one network door, booked fallbacks)")
+EOF
+
+# Twelfth rule: no silent alert-state changes.  The health engine's rule
+# state machine (obs/health.py) may change an alert's state ONLY inside
+# HealthEngine._transition — the one method that books
+# kta_alerts_transitions_total{rule=,state=} (and moves the firing
+# gauge / emits the typed event).  AST-enforced two ways:
+# (a) every assignment to a `.state` attribute in obs/health.py sits
+#     lexically inside `_transition` (dataclass field defaults are
+#     class-body Name targets, not attribute assignments, and stay
+#     legal);
+# (b) `_transition` itself references the ALERTS_TRANSITIONS instrument
+#     and the event bus — a transition that books nothing is a lint
+#     failure, not a code-review nit.
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+HEALTH = pathlib.Path("kafka_topic_analyzer_tpu") / "obs" / "health.py"
+
+tree = ast.parse(HEALTH.read_text(encoding="utf-8"), filename=str(HEALTH))
+failures = []
+
+# Map every node to its enclosing function name.
+enclosing = {}
+
+
+def walk(node, fn_name):
+    for child in ast.iter_child_nodes(node):
+        name = fn_name
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = child.name
+        enclosing[id(child)] = name
+        walk(child, name)
+
+
+walk(tree, "<module>")
+
+transition_fn = None
+for node in ast.walk(tree):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+        node.name == "_transition"
+    ):
+        transition_fn = node
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            if isinstance(t, ast.Attribute) and t.attr == "state":
+                if enclosing.get(id(node)) != "_transition":
+                    failures.append(
+                        f"{HEALTH}:{node.lineno}: alert state assigned "
+                        f"outside HealthEngine._transition (silent state "
+                        "change) — route it through _transition"
+                    )
+
+if transition_fn is None:
+    failures.append(f"{HEALTH}: HealthEngine._transition missing")
+else:
+    names = {
+        n.attr for n in ast.walk(transition_fn)
+        if isinstance(n, ast.Attribute)
+    } | {
+        n.id for n in ast.walk(transition_fn) if isinstance(n, ast.Name)
+    }
+    if "ALERTS_TRANSITIONS" not in names:
+        failures.append(
+            f"{HEALTH}:{transition_fn.lineno}: _transition does not book "
+            "kta_alerts_transitions_total (obs/metrics ALERTS_TRANSITIONS)"
+        )
+    if "emit" not in names:
+        failures.append(
+            f"{HEALTH}:{transition_fn.lineno}: _transition emits no typed "
+            "event on the JSONL bus"
+        )
+
+if failures:
+    print("lint: alert-state transitions must all route through")
+    print("lint: HealthEngine._transition, which books the transitions")
+    print("lint: counter and emits the typed event (DESIGN.md §22):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (alert-state transitions book their reason; none silent)")
 EOF
