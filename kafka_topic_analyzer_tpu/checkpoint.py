@@ -35,6 +35,19 @@ from kafka_topic_analyzer_tpu.models.state import AnalyzerState
 SNAPSHOT_NAME = "scan_snapshot.npz"
 
 
+class StaleLeaseEpochError(ValueError):
+    """A snapshot save or load was FENCED: the caller's lease epoch is
+    older than the epoch already stamped on disk (DESIGN.md §23).
+
+    This is the zombie-writer guard of the lease layer (fleet/lease.py):
+    an instance that lost its topic lease while paused mid-pass must
+    never land a checkpoint over its successor's, and must never resume
+    FROM a successor's checkpoint as if it still owned the topic.  Named
+    (rather than a bare ValueError) the same way the mesh-pinned
+    fingerprint rejection is: the operator-facing message says who
+    fenced whom and what to do about it."""
+
+
 #: Config fields that change neither state shapes nor fold semantics —
 #: pure execution strategy, safe to flip across a resume (the pallas and
 #: lax counter paths are bit-identical, tests/test_pallas_counters.py;
@@ -212,6 +225,7 @@ def save_snapshot(
     scope=None,
     degraded: "Optional[Dict[int, str]]" = None,
     corrupt: "Optional[list]" = None,
+    lease_epoch: "Optional[int]" = None,
 ) -> str:
     """Atomically write the snapshot; returns its path.
 
@@ -230,8 +244,30 @@ def save_snapshot(
     informational: a --resume seeds the source with it
     (`load_corrupt_spans`) so re-walking an already-skipped span — the
     offset tracker cannot advance past a span that yielded no records —
-    neither re-counts nor double-quarantines it."""
+    neither re-counts nor double-quarantines it.
+
+    ``lease_epoch``: the writer's topic-ownership lease epoch under a
+    multi-instance fleet (fleet/lease.py).  The save is FENCED at write
+    time: if the on-disk snapshot already carries a NEWER epoch, a
+    successor instance owns this topic and the write raises
+    `StaleLeaseEpochError` instead of clobbering its state.  None (solo
+    scans, lease-less fleets) skips the check and stamps nothing."""
     os.makedirs(directory, exist_ok=True)
+    if lease_epoch is not None:
+        try:
+            prev = snapshot_info(directory, scope)
+        except Exception:
+            prev = None  # unreadable/truncated snapshot cannot outrank us
+        prev_epoch = int((prev or {}).get("lease_epoch", 0))
+        if prev_epoch > int(lease_epoch):
+            raise StaleLeaseEpochError(
+                f"STALE-LEASE-EPOCH: refusing to save snapshot for topic "
+                f"{topic!r}: the on-disk snapshot carries lease epoch "
+                f"{prev_epoch}, this writer holds epoch {int(lease_epoch)} "
+                "— this instance was fenced (its topic lease expired and "
+                "a successor took over; DESIGN.md §23).  Do not retry: "
+                "the successor's checkpoint is the live one"
+            )
     host_state = jax.tree.map(np.asarray, jax.device_get(state))
     if mesh_free_snapshots(config):
         # Store the canonical mesh-free layout (v4 stamp): a stacked
@@ -250,6 +286,8 @@ def save_snapshot(
         meta["degraded"] = {str(k): str(v) for k, v in degraded.items()}
     if corrupt:
         meta["corrupt_spans"] = list(corrupt)
+    if lease_epoch is not None:
+        meta["lease_epoch"] = int(lease_epoch)
     if scope is not None:
         meta["process"] = [int(scope[0]), int(scope[1])]
         meta["local_rows"] = [int(r) for r in scope[2]]
@@ -330,10 +368,19 @@ def load_snapshot(
     config: AnalyzerConfig,
     template: Optional[AnalyzerState] = None,
     scope=None,
+    lease_epoch: "Optional[int]" = None,
 ) -> Optional[Tuple[AnalyzerState, Dict[int, int], int, int]]:
     """Load (state, next_offsets, records_seen, init_now_s), or None if no
     compatible snapshot exists.  An incompatible snapshot (different config/
     topic) raises — silently restarting would double-count.
+
+    ``lease_epoch``: the loader's topic-ownership lease epoch
+    (fleet/lease.py).  A snapshot stamped with a NEWER epoch was written
+    by a successor instance — the loader was fenced, and resuming from
+    (then overwriting) the successor's state would double-count: raises
+    `StaleLeaseEpochError`.  A snapshot with an older or absent epoch
+    loads normally — that is exactly the failover path, where epoch E+1
+    resumes its predecessor's epoch-E checkpoint.
 
     ``template`` supplies the expected state shapes; it defaults to the
     single-device layout.  Sharded backends pass their freshly-initialized
@@ -349,6 +396,18 @@ def load_snapshot(
         if meta["fingerprint"] not in acceptable_fingerprints(config, topic):
             raise ValueError(
                 _fingerprint_mismatch_message(path, meta, config, topic)
+            )
+        if (
+            lease_epoch is not None
+            and int(meta.get("lease_epoch", 0)) > int(lease_epoch)
+        ):
+            raise StaleLeaseEpochError(
+                f"STALE-LEASE-EPOCH: refusing to resume topic {topic!r} "
+                f"from {path}: the snapshot was written under lease epoch "
+                f"{int(meta['lease_epoch'])}, this loader holds epoch "
+                f"{int(lease_epoch)} — this instance was fenced (a "
+                "successor owns the topic; DESIGN.md §23).  Re-acquire "
+                "the lease to get a current epoch before resuming"
             )
         if scope is not None:
             pid, nproc, rows = scope

@@ -323,6 +323,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "and topic count). The admission scheduler "
                         "defers the rest until budget returns. "
                         "Default: auto")
+    p.add_argument("--instance-id", metavar="ID", default=None,
+                   nargs="?", const="auto",
+                   help="This analyzer's identity in a multi-instance "
+                        "fleet (DESIGN §23): turns on per-topic ownership "
+                        "leases so N analyzers pointed at one cluster "
+                        "split the topics instead of double-scanning "
+                        "them, with crash failover by lease expiry. "
+                        "Stamped on lease records, kta_lease_*/kta_fleet_* "
+                        "metrics, and published report documents. "
+                        "Requires --fleet and a lease store "
+                        "(--snapshot-dir for file leases, or a remote "
+                        "--segment-dir spec with --lease-store object). "
+                        "Bare --instance-id derives HOSTNAME-PID. "
+                        "Omit the flag to run solo (the default)")
+    p.add_argument("--lease-ttl", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="Topic-lease lifetime under --instance-id: the "
+                        "failover bound (a crashed instance's topics are "
+                        "reacquirable this long after its last renewal). "
+                        "Renewals ride every poll boundary. Default: 30")
+    p.add_argument("--lease-store", default="auto",
+                   metavar="auto|file|object",
+                   help="Where lease records live: 'file' = atomic-rename "
+                        "JSON records under SNAPSHOT_DIR/_kta_leases/, "
+                        "'object' = ETag-fenced conditional writes to the "
+                        "--segment-store bucket, 'auto' (default) picks "
+                        "'object' when the segment store is remote, else "
+                        "'file'")
     p.add_argument("--follow", action="store_true",
                    help="Run as a long-lived analyzer service: after the "
                         "initial earliest→latest pass, keep re-polling "
@@ -898,6 +926,58 @@ def _fleet_exit(fleet_result) -> int:
     return 0
 
 
+def make_lease_manager(cfg, snapshot_dir=None, store_spec=None):
+    """Resolve a ``LeaseConfig`` + the run's stores into a live
+    `fleet.lease.LeaseManager` (DESIGN §23): ``object`` leases ride the
+    remote segment store's ETag-fenced conditional writes; ``file``
+    leases ride atomic renames under the checkpoint dir; ``auto`` picks
+    object exactly when the segment spec is remote."""
+    import re as _re
+
+    from kafka_topic_analyzer_tpu.config import (
+        SegmentFetchConfig,
+        TransportRetryConfig,
+    )
+    from kafka_topic_analyzer_tpu.fleet.lease import (
+        FileLeaseStore,
+        LeaseManager,
+        ObjectLeaseStore,
+    )
+    from kafka_topic_analyzer_tpu.io.retry import Backoff
+
+    remote = bool(
+        store_spec and _re.match(r"^(https?|s3)://", str(store_spec))
+    )
+    choice = cfg.store
+    if choice == "auto":
+        choice = "object" if remote else "file"
+    if choice == "object":
+        if not remote:
+            raise ValueError(
+                "--lease-store object needs a remote --segment-dir spec "
+                "(http://, https://, s3://) to host the lease records"
+            )
+        from kafka_topic_analyzer_tpu.io.objstore import RetryingHttp
+
+        store = ObjectLeaseStore(
+            RetryingHttp(str(store_spec), SegmentFetchConfig())
+        )
+    else:
+        if not snapshot_dir:
+            raise ValueError(
+                "--instance-id needs a lease store: pass --snapshot-dir "
+                "(file leases live in SNAPSHOT_DIR/_kta_leases/) or a "
+                "remote --segment-dir spec with --lease-store object"
+            )
+        store = FileLeaseStore(snapshot_dir)
+    return LeaseManager(
+        store,
+        instance=cfg.instance_id,
+        ttl_s=cfg.ttl_s,
+        backoff=Backoff(TransportRetryConfig()),
+    )
+
+
 def run_fleet(args, topics: "list[str] | None" = None) -> int:
     """Cluster-wide scan (--fleet), or an explicit multi-topic follow
     (``-t a,b --follow`` — each topic keeps its solo pass chain; the
@@ -1083,7 +1163,34 @@ def run_fleet(args, topics: "list[str] | None" = None) -> int:
                 idle_exit_s=args.follow_idle_exit,
             )
 
-    scheduler = FleetScheduler(worker_budget, dispatch_budget, max_concurrent)
+    lease_mgr = None
+    instance = "solo"
+    if getattr(args, "instance_id", None):
+        with user_input_phase():
+            from kafka_topic_analyzer_tpu.config import LeaseConfig
+
+            instance = args.instance_id
+            if instance == "auto":
+                # Bare --instance-id: HOSTNAME-PID is unique per process
+                # on a shared cluster, which is all a lease owner needs.
+                import os
+                import socket
+
+                instance = f"{socket.gethostname()}-{os.getpid()}"
+            lease_cfg = LeaseConfig(
+                instance_id=instance,
+                ttl_s=args.lease_ttl,
+                store=args.lease_store,
+            )
+            lease_mgr = make_lease_manager(
+                lease_cfg,
+                snapshot_dir=args.snapshot_dir,
+                store_spec=getattr(args, "segment_dir", None),
+            )
+
+    scheduler = FleetScheduler(
+        worker_budget, dispatch_budget, max_concurrent, instance=instance
+    )
     from kafka_topic_analyzer_tpu.utils.progress import Spinner
 
     svc = FleetService(
@@ -1100,12 +1207,18 @@ def run_fleet(args, topics: "list[str] | None" = None) -> int:
         publish_reports=args.metrics_port is not None,
         spinner=Spinner(enabled=not args.quiet),
         rediscover=rediscover,
+        leases=lease_mgr,
+        instance=instance,
     )
     print(
         f"Fleet scan of {len(seeds)} topic(s): "
         f"{worker_budget} worker(s), dispatch budget {dispatch_budget}, "
         f"concurrency {max_concurrent}"
-        + (" (follow)" if args.follow else ""),
+        + (" (follow)" if args.follow else "")
+        + (
+            f" [instance {instance}, lease TTL {args.lease_ttl:g}s]"
+            if lease_mgr is not None else ""
+        ),
         file=banner_out,
     )
     if args.follow:
@@ -1223,6 +1336,17 @@ def _run(args) -> int:
             initialize_distributed(args.distributed)
     if args.fleet:
         return run_fleet(args)
+    if getattr(args, "instance_id", None) and not (
+        "," in args.topic and args.follow
+    ):
+        with user_input_phase():
+            raise ValueError(
+                "--instance-id requires the fleet scheduler (topic "
+                "ownership leases arbitrate WHOLE topics between "
+                "analyzer instances; a solo or batch fan-in scan has "
+                "no admission to arbitrate) — add --fleet, or --follow "
+                "with a multi-topic list"
+            )
     # Kafka topic names cannot contain commas, so "-t a,b,c" unambiguously
     # selects multi-topic fan-in (new capability; BASELINE.json config 5).
     if "," in args.topic:
@@ -1230,7 +1354,8 @@ def _run(args) -> int:
             # Lifted (fleet mode): an explicit topic list under --follow
             # runs through the fleet scheduler — each topic keeps its
             # solo pass chain (NOT the fan-in's merged state), budgets
-            # are shared, and /report.json?topic= serves each document.
+            # are shared, and /report.json?topic= serves each document —
+            # so --instance-id leases compose here too.
             return run_fleet(
                 args, topics=[t for t in args.topic.split(",") if t]
             )

@@ -280,6 +280,53 @@ class FollowConfig:
             raise ValueError("window hll precision must be in [4, 16]")
 
 
+#: Valid --lease-store selections: ``auto`` derives the store from the
+#: run (the object store when --segment-store is remote, else lease
+#: files in the checkpoint dir), the other two pin it.
+LEASE_STORES = ("auto", "file", "object")
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaseConfig:
+    """Multi-instance fleet lease knobs (``--instance-id``/``--lease-ttl``;
+    fleet/lease.py, DESIGN.md §23).
+
+    Like `FollowConfig`, deliberately NOT part of `AnalyzerConfig`: who
+    owns a topic (and for how long before failover) changes neither
+    state shapes nor fold semantics — a fleet of N instances produces
+    per-topic reports byte-identical to one instance scanning the same
+    offsets — so none of it may churn the checkpoint fingerprint.  The
+    lease EPOCH does ride snapshot metadata, but as a fencing stamp
+    outside the fingerprint: any instance resumes any topic's snapshot,
+    provided its own epoch is current.
+    """
+
+    #: This analyzer's identity on every lease record, booked metric,
+    #: and published document.  Empty = leases disabled (the solo
+    #: single-owner fleet, exactly the PR-13 behavior).
+    instance_id: str = ""
+    #: Lease lifetime in seconds: the failover bound (a crashed owner's
+    #: topics are up for grabs this long after its last renewal) AND the
+    #: zombie window the epoch fence must cover.  Renewals ride every
+    #: poll boundary, so this must comfortably exceed the poll interval.
+    ttl_s: float = 30.0
+    #: Where lease records live (``LEASE_STORES``).
+    store: str = "auto"
+
+    def __post_init__(self) -> None:
+        if self.ttl_s <= 0:
+            raise ValueError("--lease-ttl must be > 0 seconds")
+        if self.store not in LEASE_STORES:
+            raise ValueError(
+                f"lease store {self.store!r} invalid "
+                f"({', '.join(LEASE_STORES)})"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.instance_id)
+
+
 @dataclasses.dataclass(frozen=True)
 class HealthConfig:
     """Alert-engine knobs (obs/health.py; DESIGN.md §22).

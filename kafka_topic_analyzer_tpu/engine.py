@@ -175,6 +175,7 @@ def run_scan(
     emit_lifecycle: bool = True,
     book_once: bool = True,
     final_snapshot: bool = False,
+    lease_epoch: "Optional[int]" = None,
 ) -> ScanResult:
     """Full earliest→latest scan of the topic through the backend.
 
@@ -214,7 +215,13 @@ def run_scan(
     ``final_snapshot`` forces
     a snapshot after the stream drains (at a superbatch boundary, by
     construction) — the follow service's checkpoint-interval and
-    clean-shutdown commits."""
+    clean-shutdown commits.
+
+    ``lease_epoch`` (fleet/lease.py, DESIGN §23): the caller's topic-
+    ownership epoch, stamped on every snapshot this pass saves and
+    checked against every snapshot it loads — a pass running under a
+    lost lease is fenced with `checkpoint.StaleLeaseEpochError` instead
+    of clobbering (or resuming over) its successor's checkpoint."""
     ingest_cfg = (
         ingest_workers
         if isinstance(ingest_workers, IngestConfig)
@@ -329,6 +336,7 @@ def run_scan(
             backend.config,
             template=snap_get(),
             scope=snap_scope,
+            lease_epoch=lease_epoch,
         )
         if snap is not None:
             state, offsets, records_seen, init_now_s = snap
@@ -393,6 +401,7 @@ def run_scan(
                     if hasattr(source, "corruption_spans")
                     else None
                 ),
+                lease_epoch=lease_epoch,
             )
         obs_metrics.SNAPSHOTS_SAVED.inc()
         obs_events.emit(

@@ -33,12 +33,18 @@ def build_fleet_rollup(
     discovered: int,
     duration_secs: int,
     health: "dict | None" = None,
+    instance: "str | None" = None,
+    instances: "List[str] | None" = None,
 ) -> dict:
     """``statuses`` maps topic -> fleet.service.TopicStatus; ``health``
     is the alert engine's latest document (obs/health.py), riding the
     rollup so the bare ``/report.json`` path answers "is the fleet
     healthy" next to the totals (each topic's own alerts ride its
-    ``?topic=`` document)."""
+    ``?topic=`` document).  ``instance`` labels which analyzer built
+    THIS rollup and ``instances`` lists every instance visible through
+    the lease store (DESIGN §23 federation): a dashboard scraping N
+    instances can attribute each document and detect a vanished peer —
+    each rollup only ever covers the topics its own instance scans."""
     counts: "Dict[str, int]" = {}
     verdicts: "Dict[str, int]" = {}
     for s in statuses.values():
@@ -71,6 +77,10 @@ def build_fleet_rollup(
         },
         "duration_secs": duration_secs,
     }
+    if instance is not None:
+        doc["fleet"]["instance"] = instance
+    if instances is not None:
+        doc["fleet"]["instances"] = list(instances)
     if health is not None:
         doc["health"] = health
     return doc

@@ -78,6 +78,7 @@ class FleetScheduler:
         worker_budget: int,
         dispatch_budget: int,
         max_concurrent: int,
+        instance: str = "solo",
     ):
         if worker_budget < 1:
             raise ValueError("fleet worker budget must be >= 1")
@@ -88,6 +89,9 @@ class FleetScheduler:
         self.worker_budget = worker_budget
         self.dispatch_budget = dispatch_budget
         self.max_concurrent = max_concurrent
+        #: Analyzer instance id carried on every booked decision ("solo"
+        #: outside a multi-instance fleet) — federation per DESIGN §23.
+        self.instance = instance
         #: topic -> live Grant (the budget ledger).
         self._grants: "Dict[str, Grant]" = {}
         #: topic -> partition count (the per-topic worker clamp).
@@ -201,10 +205,16 @@ class FleetScheduler:
                 self._grants[s.name] = g
                 self._partitions[s.name] = max(1, s.partitions)
                 admitted[s.name] = dataclasses.replace(g)
-                obs_metrics.FLEET_ADMISSIONS.labels(reason=reason).inc()
+                obs_metrics.FLEET_ADMISSIONS.labels(
+                    reason=reason, instance=self.instance
+                ).inc()
         for s in new[n:]:
-            obs_metrics.FLEET_ADMISSIONS.labels(reason="deferred-budget").inc()
-        obs_metrics.FLEET_TOPICS_ACTIVE.set(self.active)
+            obs_metrics.FLEET_ADMISSIONS.labels(
+                reason="deferred-budget", instance=self.instance
+            ).inc()
+        obs_metrics.FLEET_TOPICS_ACTIVE.labels(
+            instance=self.instance
+        ).set(self.active)
         return admitted
 
     def skip_idle(self, count: int) -> None:
@@ -212,13 +222,19 @@ class FleetScheduler:
         admission DECISION (the answer was "no work"), so it is traced
         like every other one."""
         for _ in range(max(0, int(count))):
-            obs_metrics.FLEET_ADMISSIONS.labels(reason="skipped-empty").inc()
+            obs_metrics.FLEET_ADMISSIONS.labels(
+                reason="skipped-empty", instance=self.instance
+            ).inc()
 
     def release(self, topic: str) -> None:
         """Return a finished (or caught-up, or failed) topic's budget."""
         if self._grants.pop(topic, None) is not None:
-            obs_metrics.FLEET_ADMISSIONS.labels(reason="released").inc()
-        obs_metrics.FLEET_TOPICS_ACTIVE.set(self.active)
+            obs_metrics.FLEET_ADMISSIONS.labels(
+                reason="released", instance=self.instance
+            ).inc()
+        obs_metrics.FLEET_TOPICS_ACTIVE.labels(
+            instance=self.instance
+        ).set(self.active)
 
     # -- the rebalance rule (between polls) -----------------------------------
 
@@ -271,5 +287,7 @@ class FleetScheduler:
             pool -= 1
             moves += 1
         if moves:
-            obs_metrics.FLEET_REBALANCES.inc(moves)
+            obs_metrics.FLEET_REBALANCES.labels(
+                instance=self.instance
+            ).inc(moves)
         return moves

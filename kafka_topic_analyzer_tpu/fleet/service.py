@@ -34,8 +34,10 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
+from kafka_topic_analyzer_tpu.checkpoint import StaleLeaseEpochError
 from kafka_topic_analyzer_tpu.config import FollowConfig, TransportRetryConfig
 from kafka_topic_analyzer_tpu.engine import ScanResult, run_scan
+from kafka_topic_analyzer_tpu.fleet.lease import LeaseManager
 from kafka_topic_analyzer_tpu.fleet.report import build_fleet_rollup
 from kafka_topic_analyzer_tpu.fleet.scheduler import (
     FleetScheduler,
@@ -59,6 +61,8 @@ class TopicStatus:
     topic: str
     partitions: int = 0
     #: pending | scanning | ok | empty | degraded | corrupt | failed
+    #: | fenced (lease lost to a successor — not a topic failure; the
+    #: topic scans on, under another instance's ownership)
     status: str = "pending"
     records: int = 0
     bytes: int = 0
@@ -165,6 +169,8 @@ class FleetService:
         heartbeat_every_s: float = 10.0,
         health: "Optional[obs_health.HealthEngine]" = None,
         clock: Callable[[], float] = time.monotonic,
+        leases: "Optional[LeaseManager]" = None,
+        instance: str = "solo",
     ):
         self.scans: "Dict[str, _TopicScan]" = {
             s.name: _TopicScan(s) for s in seeds
@@ -187,9 +193,25 @@ class FleetService:
         #: per-topic lag + failure context (obs/health.py): explicit
         #: wins, else the telemetry session's engine, else none.
         self.health = health if health is not None else obs_health.active()
-        self.state = serve_state.ServiceState()
+        #: Per-topic ownership leases (fleet/lease.py) — None runs the
+        #: pre-lease single-instance fleet unchanged.  With a manager,
+        #: admission is acquire-before-scan, renewal rides every poll
+        #: boundary, and budgets release WITH the lease (DESIGN §23).
+        self.leases = leases
+        self.instance = instance
+        self.state = serve_state.ServiceState(
+            instance=instance if leases is not None else None
+        )
         self._stop = threading.Event()
         self._stop_reason: "Optional[str]" = None
+        #: Chaos seams for the offline failover tests (satellite of
+        #: ISSUE 16): ``kill()`` crashes the instance — stop NOW, no
+        #: shutdown passes, no lease release, exactly what SIGKILL
+        #: leaves behind; ``pause()``/``unpause()`` freeze/thaw the loop
+        #: right after the renew step, the zombie window epoch fencing
+        #: must cover.
+        self._killed = False
+        self._pause = threading.Event()
         self.polls = 0
         self._t0 = clock()
         self._last_ckpt = clock()
@@ -210,6 +232,25 @@ class FleetService:
         if not self._stop.is_set():
             self._stop_reason = reason
         self._stop.set()
+
+    def kill(self) -> None:
+        """Crash semantics (the chaos twin of FakeBroker.kill): the
+        loop exits at the next check with NO shutdown passes and NO
+        lease release — held leases dangle until their TTL expires,
+        which is precisely the failover the two-instance tests prove."""
+        self._killed = True
+        self.request_stop("killed")
+
+    def pause(self) -> None:
+        """Freeze the follow loop at the post-renew gate (a stalled VM,
+        a long GC): leases keep their last renewal and expire while
+        paused — the zombie window."""
+        self._pause.set()
+
+    def unpause(self) -> None:
+        # Not `resume()`: the constructor's resume-from-checkpoint flag
+        # lives at `self.resume` and would shadow a method of that name.
+        self._pause.clear()
 
     def install_signal_handlers(self):
         from kafka_topic_analyzer_tpu.serve.signals import (
@@ -289,7 +330,24 @@ class FleetService:
                 emit_lifecycle=False,
                 book_once=scan.first,
                 final_snapshot=force_ckpt,
+                lease_epoch=(
+                    self.leases.epoch(topic)
+                    if self.leases is not None else None
+                ),
             )
+        except StaleLeaseEpochError as e:
+            # The zombie path: this instance's lease epoch is older than
+            # what a successor already stamped on disk — the checkpoint
+            # write was REFUSED, the topic is not ours anymore.  Not a
+            # topic failure (the topic is healthy, under new ownership):
+            # fence the lease (books kta_lease_losses_total) and step
+            # aside; a later acquire can win the topic back legitimately.
+            scan.status.status = "fenced"
+            scan.status.error = f"{type(e).__name__}: {e}"
+            if self.leases is not None:
+                self.leases.fence(topic)
+            log.warning("fleet: topic %r fenced: %s", topic, e)
+            return False
         except BaseException as e:  # noqa: BLE001 — isolation boundary
             scan.status.status = "failed"
             scan.status.error = f"{type(e).__name__}: {e}"
@@ -345,6 +403,13 @@ class FleetService:
                 self.health.alerts_block()
                 if self.health is not None
                 else None
+            ),
+            instance=(
+                self.instance if self.leases is not None else None
+            ),
+            instances=(
+                self.leases.known_instances()
+                if self.leases is not None else None
             ),
         )
         if self.publish_reports:
@@ -475,6 +540,16 @@ class FleetService:
             self.scheduler.skip_idle(
                 sum(1 for t in wave if self.scans[t].status.status == "empty")
             )
+            # Acquire-before-scan (batch form): topics another instance
+            # owns drop out of the wave — their refusals are booked by
+            # the manager, and a concurrent batch audit splits the
+            # cluster between instances instead of double-scanning it.
+            if self.leases is not None:
+                ready = [
+                    s for s in ready
+                    if self.leases.is_held(s.name)
+                    or self.leases.acquire(s.name) is not None
+                ]
             # Admission can defer part of the wave (the dispatch-token
             # budget caps concurrent device scans below the wave size);
             # re-offer the deferred remainder until the wave drains — a
@@ -498,6 +573,8 @@ class FleetService:
                     for t, fut in futures.items():
                         fut.result()  # _run_pass never raises
                         self.scheduler.release(t)
+                        if self.leases is not None:
+                            self.leases.release(t)
                 pending = [s for s in pending if s.name not in grants]
             self._evaluate_health()
             self._publish_rollup()
@@ -527,7 +604,9 @@ class FleetService:
             lag += max(0, end - scan.cursor.get(p, start_w.get(p, 0)))
         scan.lag = lag
         scan.status.lag = lag
-        obs_metrics.FLEET_TOPIC_LAG.labels(topic=scan.seed.name).set(lag)
+        obs_metrics.FLEET_TOPIC_LAG.labels(
+            topic=scan.seed.name, instance=self.instance
+        ).set(lag)
         return lag
 
     def run_follow(self) -> FleetResult:
@@ -562,6 +641,23 @@ class FleetService:
                 t: self._poll_topic(s) for t, s in list(self.scans.items())
             }
             lag_total = sum(lags.values())
+            # Poll-boundary renewal (DESIGN §23): every held lease's
+            # expiry extends here, once per poll — a store blip books
+            # "deferred" inside the manager and the loop keeps going.
+            if self.leases is not None:
+                self.leases.renew_all()
+            # The pause seam sits EXACTLY after the renew: a paused
+            # instance's leases are as fresh as they will ever be, and
+            # everything after resume runs on epochs that may have been
+            # fenced meanwhile — the window the checkpoint-epoch check
+            # must cover (tests/test_lease.py's zombie proof).
+            while self._pause.is_set() and not self._stop.is_set():
+                time.sleep(0.005)
+            if self._killed:
+                # Crash semantics: not one more admission, pass, or lease
+                # decision after kill() — leases dangle exactly as a
+                # SIGKILL would leave them.
+                break
             # Poll-boundary health: the lag map just refreshed, so a
             # diverging topic flips /healthz within one poll.
             self._evaluate_health()
@@ -579,6 +675,23 @@ class FleetService:
                     and not self.scans[t].source.is_empty()
                 )
             ]
+            # Acquire-before-scan: a topic enters admission only under
+            # a held (or just-acquired) lease.  Refusals are already
+            # booked by the manager (held-elsewhere / lost-race /
+            # store-error on kta_lease_acquisitions_total), so they are
+            # excluded from the skipped-empty count below — they had
+            # work, it just belongs to another instance.
+            not_ours: "set" = set()
+            if self.leases is not None:
+                gated = []
+                for s in ready:
+                    if self.leases.is_held(s.name) or (
+                        self.leases.acquire(s.name) is not None
+                    ):
+                        gated.append(s)
+                    else:
+                        not_ours.add(s.name)
+                ready = gated
             ready_names = {s.name for s in ready}
             self.scheduler.admit(ready)
             # "Skipped because empty" means exactly that: topics that
@@ -590,6 +703,7 @@ class FleetService:
                     1
                     for t in lags
                     if t not in ready_names
+                    and t not in not_ours
                     and self.scans[t].status.status != "failed"
                 )
             )
@@ -608,12 +722,26 @@ class FleetService:
                     }
                     for t, fut in futures.items():
                         fut.result()  # _run_pass never raises
+                if self._killed:
+                    # kill() landed while passes ran: no post-pass
+                    # bookkeeping, no caught-up lease release — the
+                    # failover tests need exactly what a crash leaves.
+                    break
                 # Post-pass bookkeeping: verdicts drive the rebalance;
                 # caught-up (or failed) topics return their budget.
                 verdicts = {}
                 for t in admitted:
                     scan = self.scans[t]
                     if scan.status.status == "failed":
+                        self.scheduler.release(t)
+                        # Let another instance try the topic — this
+                        # one's source/backend is poisoned.
+                        if self.leases is not None:
+                            self.leases.release(t)
+                        continue
+                    if scan.status.status == "fenced":
+                        # The lease itself was already fenced inside
+                        # _run_pass; only the budget comes back here.
                         self.scheduler.release(t)
                         continue
                     caught_up = all(
@@ -624,6 +752,10 @@ class FleetService:
                     scan.status.lag = scan.lag
                     if caught_up:
                         self.scheduler.release(t)
+                        # Release-on-caught-up: a topic at the head is
+                        # up for grabs again — ownership follows work.
+                        if self.leases is not None:
+                            self.leases.release(t)
                     elif scan.status.verdict:
                         verdicts[t] = scan.status.verdict
                 if verdicts:
@@ -671,17 +803,34 @@ class FleetService:
                 break
         # Shutdown boundary: one final pass per live topic commits the
         # final checkpoint (superbatch boundary by construction) and
-        # settles each status row for the closing rollup.
-        for t, scan in self.scans.items():
-            if scan.backend is None or scan.status.status == "failed":
-                continue
-            grant = (
-                self.scheduler.grant_for(t)
-                or scan.last_grant
-                or Grant(workers=1, dispatch_depth=1)
-            )
-            self._run_pass(scan, grant, final=True)
-            self.scheduler.release(t)
+        # settles each status row for the closing rollup — then every
+        # held lease is RELEASED (not just checkpointed), so a rolling
+        # restart under SIGTERM (serve/signals.py → request_stop) fails
+        # over immediately instead of waiting out the TTL.  ``kill()``
+        # skips all of it: a crash leaves leases dangling, and failover
+        # happens the honest way, by expiry.
+        if not self._killed:
+            for t, scan in self.scans.items():
+                if scan.backend is None or scan.status.status in (
+                    "failed", "fenced",
+                ):
+                    continue
+                if self.leases is not None and not self.leases.is_held(t):
+                    # No lease, no write: a final pass on a topic we
+                    # released (or never owned) would checkpoint with no
+                    # epoch stamp, bypassing the fence.  Its last
+                    # in-lease checkpoint stands; a successor rescans
+                    # the (small) tail from there.
+                    continue
+                grant = (
+                    self.scheduler.grant_for(t)
+                    or scan.last_grant
+                    or Grant(workers=1, dispatch_depth=1)
+                )
+                self._run_pass(scan, grant, final=True)
+                self.scheduler.release(t)
+            if self.leases is not None:
+                self.leases.release_all()
         obs_events.emit(
             "follow_stop",
             reason=self._stop_reason or "stop",

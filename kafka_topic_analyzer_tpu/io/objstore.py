@@ -185,21 +185,35 @@ class RetryingHttp:
             self._local.conn = None
 
     def _one_request(
-        self, path: str, rng: "Optional[Tuple[int, int]]"
+        self,
+        path: str,
+        rng: "Optional[Tuple[int, int]]",
+        method: str = "GET",
+        body: "Optional[bytes]" = None,
+        extra_headers: "Optional[Dict[str, str]]" = None,
     ) -> "Tuple[int, bytes, Dict[str, str]]":
-        """One GET on this thread's connection: (status, body, headers).
-        Raises OSError/http.client exceptions on transport failure."""
-        headers = {}
+        """One request on this thread's connection: (status, body, headers).
+        Raises OSError/http.client exceptions on transport failure.  This
+        is the ONLY place that touches the socket (lint rule 11) — the
+        lease layer's conditional PUTs ride the same connection pool,
+        eviction, and timeout discipline as segment GETs."""
+        headers: "Dict[str, str]" = {}
         if rng is not None:
             lo, hi = rng
             headers["Range"] = (
                 f"bytes=-{hi}" if lo is None else f"bytes={lo}-{hi}"
             )
+        if extra_headers:
+            headers.update(extra_headers)
         conn = self._connection()
-        conn.request("GET", path, headers=headers)
+        conn.request(method, path, body=body, headers=headers)
         resp = conn.getresponse()
-        body = resp.read()
-        return resp.status, body, {k.lower(): v for k, v in resp.getheaders()}
+        resp_body = resp.read()
+        return (
+            resp.status,
+            resp_body,
+            {k.lower(): v for k, v in resp.getheaders()},
+        )
 
     # -- the retry-budget wrapper --------------------------------------------
 
@@ -431,6 +445,113 @@ class RetryingHttp:
                     f"token {next_token!r} — no pagination progress"
                 )
             token = next_token
+
+    # -- small-object + conditional-write surface (the lease transport) -------
+
+    def get_small(
+        self, path: str
+    ) -> "Optional[Tuple[bytes, str]]":
+        """GET a small control object whole: (body, etag), or None on 404.
+
+        Unlike ``get`` this treats 404 as an ANSWER, not an error — an
+        absent lease record means "nobody has ever owned this topic",
+        which the lease layer must distinguish from a store outage.  No
+        MD5-vs-ETag integrity pass either: the ETag here is an opaque
+        fencing token for If-Match (fleet/lease.py, DESIGN §23), not a
+        content checksum to verify.  Transient failures retry on the
+        shared backoff; exhaustion raises ObjectStoreError (the caller
+        degrades, it does not guess)."""
+        attempt = 0
+        while True:
+            try:
+                try:
+                    status, body, headers = self._one_request(path, None)
+                except (OSError, http.client.HTTPException) as e:
+                    self._evict_connection()
+                    raise _Transient(f"{type(e).__name__}: {e}") from e
+                if status in (500, 502, 503, 504):
+                    raise _Transient(f"HTTP {status}")
+                if status == 404:
+                    return None
+                if status != 200:
+                    raise ObjectStoreError(
+                        f"object store GET {self.url_of(path)} failed: "
+                        f"HTTP {status}"
+                    )
+                obs_metrics.SEGSTORE_GETS.labels(kind="lease").inc()
+                obs_metrics.SEGSTORE_BYTES.inc(len(body))
+                return body, headers.get("etag", "").strip('"')
+            except _Transient as e:
+                attempt += 1
+                obs_metrics.SEGSTORE_RETRIES.inc()
+                if attempt >= self.budget.budget:
+                    raise ObjectStoreError(
+                        f"object store GET {self.url_of(path)} failed "
+                        f"after {attempt} attempts (last: {e})"
+                    ) from e
+                self.backoff.sleep_for(attempt)
+
+    def put_conditional(
+        self,
+        path: str,
+        body: bytes,
+        if_match: "Optional[str]" = None,
+        if_none_match: bool = False,
+    ) -> "Optional[str]":
+        """Conditional PUT: the fencing primitive (DESIGN §23).
+
+        ``if_match`` sends ``If-Match: "<etag>"`` (replace exactly the
+        version we read); ``if_none_match`` sends ``If-None-Match: *``
+        (create only if absent).  Returns the NEW etag on success, or
+        None on HTTP 412 — a lost compare-and-swap race, which is a
+        deterministic answer and is never retried here.  Transport
+        failures retry on the shared backoff, which makes a PUT
+        AMBIGUOUS: the first attempt may have been applied before the
+        connection died, so the retry can 412 against our own write.
+        The caller (ObjectLeaseStore) resolves that by reading the
+        record back and comparing owner/epoch — this layer stays a dumb
+        transport and reports exactly what the server said."""
+        if (if_match is None) == (not if_none_match):
+            raise ValueError(
+                "put_conditional requires exactly one of if_match / "
+                "if_none_match — an unconditional lease write would be "
+                "a fencing hole"
+            )
+        hdrs = {"Content-Length": str(len(body))}
+        if if_match is not None:
+            hdrs["If-Match"] = f'"{if_match}"'
+        else:
+            hdrs["If-None-Match"] = "*"
+        attempt = 0
+        while True:
+            try:
+                try:
+                    status, resp_body, headers = self._one_request(
+                        path, None, method="PUT", body=body,
+                        extra_headers=hdrs,
+                    )
+                except (OSError, http.client.HTTPException) as e:
+                    self._evict_connection()
+                    raise _Transient(f"{type(e).__name__}: {e}") from e
+                if status in (500, 502, 503, 504):
+                    raise _Transient(f"HTTP {status}")
+                if status == 412:
+                    return None
+                if status not in (200, 201, 204):
+                    raise ObjectStoreError(
+                        f"object store PUT {self.url_of(path)} failed: "
+                        f"HTTP {status}"
+                    )
+                return headers.get("etag", "").strip('"')
+            except _Transient as e:
+                attempt += 1
+                obs_metrics.SEGSTORE_RETRIES.inc()
+                if attempt >= self.budget.budget:
+                    raise ObjectStoreError(
+                        f"object store PUT {self.url_of(path)} failed "
+                        f"after {attempt} attempts (last: {e})"
+                    ) from e
+                self.backoff.sleep_for(attempt)
 
     def object_path(self, name: str) -> str:
         from urllib.parse import quote

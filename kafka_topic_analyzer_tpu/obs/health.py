@@ -186,6 +186,8 @@ class HealthEngine:
         ("degraded", "kta_scan_degraded_partitions"),
         ("backoff_sleeps", "kta_backoff_sleeps_total"),
         ("segstore_fallbacks", "kta_segstore_fallback_total"),
+        ("lease_losses", "kta_lease_losses_total"),
+        ("failovers", "kta_fleet_failovers_total"),
     ]
 
     def __init__(
@@ -566,6 +568,27 @@ def _fleet_topic_failure(ctx: EvalContext) -> "Optional[dict]":
     return {"failed_topics": failed, "count": len(failed)}
 
 
+def _lease_lost(ctx: EvalContext) -> "Optional[dict]":
+    """This instance lost topic leases it held (fenced by a successor,
+    or expired with renewals failing) in the trailing window — scanned
+    work is being handed over, which is news even when the handover is
+    working as designed (ISSUE 16)."""
+    d = ctx.delta("lease_losses", ctx.cfg.storm_window_s)
+    if d is None or d <= 0:
+        return None
+    return {"lease_losses": int(d), "window_s": ctx.cfg.storm_window_s}
+
+
+def _failover(ctx: EvalContext) -> "Optional[dict]":
+    """Topics changed owner in the trailing window: this instance took
+    over leases whose previous holder was a different instance — some
+    peer crashed, hung, or released (DESIGN §23)."""
+    d = ctx.delta("failovers", ctx.cfg.storm_window_s)
+    if d is None or d <= 0:
+        return None
+    return {"failovers": int(d), "window_s": ctx.cfg.storm_window_s}
+
+
 def built_in_rules(cfg: "Optional[HealthConfig]" = None) -> "List[AlertRule]":
     """The shipped rule set (ISSUE 15): lag growth, degraded-partition
     transitions, corruption storms, watermark-refresh outages,
@@ -622,6 +645,23 @@ def built_in_rules(cfg: "Optional[HealthConfig]" = None) -> "List[AlertRule]":
             _fleet_topic_failure,
             for_s=0.0,
             resolve_s=0.0,
+        ),
+        AlertRule(
+            "lease_lost",
+            "this instance was fenced off topics it owned (lease lost "
+            "to a successor or expired unrenewed) — its in-flight work "
+            "on those topics was discarded at the epoch fence",
+            _lease_lost,
+            for_s=0.0,  # a fencing is immediately actionable
+            resolve_s=cfg.resolve_s,
+        ),
+        AlertRule(
+            "failover",
+            "topics changed owner: this instance took over leases from "
+            "a crashed, hung, or departed peer (DESIGN §23)",
+            _failover,
+            for_s=0.0,
+            resolve_s=cfg.resolve_s,
         ),
     ]
 

@@ -387,28 +387,77 @@ FLEET_ADMISSIONS = _REG.counter(
     "concurrency/worker budget was spent), skipped-empty (no lag), "
     "released (scan finished, budget returned) — every decision books "
     "exactly one reason, so the admission trace is reconstructible from "
-    "the counter alone (tools/lint.sh rule 10)",
-    labelnames=("reason",))
+    "the counter alone (tools/lint.sh rule 10).  'instance' is the "
+    "analyzer instance id ('solo' outside a multi-instance fleet) so a "
+    "federated scrape attributes decisions to the instance that took them",
+    labelnames=("reason", "instance"))
 FLEET_TOPICS_ACTIVE = _REG.gauge(
     "kta_fleet_topics_active",
     "Per-topic scans currently admitted and holding budget in this "
-    "process's fleet service",
-    # One fleet service per process; a multi-process fleet would run
-    # disjoint topic sets, so the cluster-wide figure is the sum.
+    "instance's fleet service",
+    labelnames=("instance",),
+    # One fleet service per instance; instances own disjoint topic sets
+    # (lease-arbitrated), so the cluster-wide figure is the sum.
     merge="sum")
 FLEET_TOPIC_LAG = _REG.gauge(
     "kta_fleet_topic_lag_records",
     "Records between a fleet topic's cursor and its latest polled end "
     "watermarks (the per-topic twin of kta_follow_lag_records; admission "
     "weight input)",
-    labelnames=("topic",),
-    # Topics are disjoint across fleet processes: fleet-wide lag sums.
+    labelnames=("topic", "instance"),
+    # Topics are disjoint across fleet instances: fleet-wide lag sums.
     merge="sum")
 FLEET_REBALANCES = _REG.counter(
     "kta_fleet_rebalances_total",
     "Budget rebalances the fleet scheduler applied between polls "
     "(doctor-verdict driven: ingest-bound scans shed dispatch share and "
-    "gain workers freed from dispatch-bound scans)")
+    "gain workers freed from dispatch-bound scans)",
+    labelnames=("instance",))
+FLEET_FAILOVERS = _REG.counter(
+    "kta_fleet_failovers_total",
+    "Topic ownership takeovers: this instance acquired a topic lease "
+    "whose previous holder was a DIFFERENT instance (expired or "
+    "released) — the crash-failover trace (fleet/lease.py; DESIGN §23)",
+    labelnames=("instance",))
+
+# -- topic ownership leases (fleet/lease.py) ----------------------------------
+
+LEASE_ACQUISITIONS = _REG.counter(
+    "kta_lease_acquisitions_total",
+    "Lease acquisition attempts by outcome: acquired (fresh or "
+    "re-entrant grant), takeover (expired/released lease of ANOTHER "
+    "instance claimed — also books kta_fleet_failovers_total), "
+    "held-elsewhere (an unexpired lease blocks this instance), "
+    "lost-race (a competing writer landed between read and "
+    "conditional write), released (a held lease handed back; epoch "
+    "retained in the store), store-error (the lease store was "
+    "unreachable after retries) — every acquire/release decision "
+    "books exactly one outcome (tools/lint.sh rule 13); never silent",
+    labelnames=("outcome", "instance"))
+LEASE_RENEWALS = _REG.counter(
+    "kta_lease_renewals_total",
+    "Lease renewal attempts by outcome: renewed (expiry extended "
+    "through the store), deferred (transient store outage — the lease "
+    "is still locally unexpired, so the holder keeps scanning and "
+    "retries next boundary rather than self-fencing early)",
+    labelnames=("outcome", "instance"))
+LEASE_LOSSES = _REG.counter(
+    "kta_lease_losses_total",
+    "Leases this instance held and LOST without releasing: fenced (the "
+    "store shows a newer epoch/different owner, or a stale-epoch "
+    "checkpoint write was refused — checkpoint.py books the refusal "
+    "here too) or expired (the local TTL ran out before any renewal "
+    "succeeded).  The zombie-fencing trace; fires the lease_lost alert",
+    labelnames=("instance",))
+LEASE_HELD = _REG.gauge(
+    "kta_lease_held",
+    "1 while this instance holds the topic's ownership lease, 0 once "
+    "released or lost (fleet/lease.py)",
+    labelnames=("topic", "instance"),
+    # (topic, instance) label sets are disjoint across processes by
+    # construction — at most one holder per topic; sum unions them and
+    # totals the cluster's currently-owned topics.
+    merge="sum")
 
 # -- flight recorder (obs/flight.py) ------------------------------------------
 

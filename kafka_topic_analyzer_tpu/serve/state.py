@@ -35,9 +35,17 @@ class ServiceState:
     reference reads, not N ``json.dumps`` of a large document.
     """
 
-    def __init__(self, clock: Callable[[], float] = time.time):
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.time,
+        instance: "Optional[str]" = None,
+    ):
         self._lock = threading.Lock()
         self._clock = clock
+        #: Analyzer instance id stamped on every published document
+        #: (fleet federation, DESIGN §23) — None keeps solo documents
+        #: byte-identical to pre-fleet output.
+        self._instance = instance
         self._doc: "Optional[dict]" = None
         self._bytes: "Optional[bytes]" = None
         self._published_at: "Optional[float]" = None
@@ -56,6 +64,8 @@ class ServiceState:
         (single-topic report / fleet rollup) slot."""
         doc = dict(doc)
         doc["report_ts"] = round(self._clock(), 3)
+        if self._instance is not None:
+            doc["instance"] = self._instance
         body = json.dumps(doc).encode()
         with self._lock:
             if topic is not None:

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import hashlib
+import json
 import os
 import re
 import socket
@@ -46,6 +47,25 @@ from xml.sax.saxutils import escape
 #:                          a mid-GET connection drop)
 FaultHook = Callable[[str, Optional[Tuple[Optional[int], int]], int], object]
 
+#: put_fault_hook(key, body, index) -> one of:
+#:   None                   apply the PUT normally
+#:   ("status", code)       respond with that status; write NOT applied
+#:   "drop"                 close the socket, write NOT applied (a plain
+#:                          transport failure: the retry is safe)
+#:   "lost"                 APPLY the write, then close the socket with
+#:                          no response — the ambiguous-PUT case: the
+#:                          client cannot know it succeeded, and its
+#:                          conditional retry will 412 against its OWN
+#:                          write (fleet/lease.py resolves by read-back)
+#:   ("race", body2)        install ``body2`` under the key FIRST, then
+#:                          evaluate the request's conditions against it
+#:                          — a competing writer winning the CAS race
+#:                          (the stale-ETag 412 path)
+#:   ("skew", seconds)      apply the write with the lease JSON body's
+#:                          ``expires_at`` shifted by that many seconds —
+#:                          a writer whose clock disagrees with ours
+PutFaultHook = Callable[[str, bytes, int], object]
+
 
 class ObjectStoreHttpServer:
     """A threading HTTP server exposing ``root`` (a directory path, or a
@@ -57,6 +77,7 @@ class ObjectStoreHttpServer:
         bucket: str = "segments",
         latency_ms: float = 0.0,
         fault_hook: "Optional[FaultHook]" = None,
+        put_fault_hook: "Optional[PutFaultHook]" = None,
         send_etag: bool = True,
         max_keys: int = 1000,
         sse: "Optional[str]" = None,
@@ -69,6 +90,7 @@ class ObjectStoreHttpServer:
         self.bucket = bucket
         self.latency_ms = latency_ms
         self.fault_hook = fault_hook
+        self.put_fault_hook = put_fault_hook
         self.send_etag = send_etag
         #: LIST page cap (S3 caps at 1000): pages beyond it return
         #: IsTruncated=true + NextContinuationToken, so clients that fail
@@ -103,6 +125,9 @@ class ObjectStoreHttpServer:
                 pass
 
             def do_GET(self):  # noqa: N802 — http.server contract
+                outer._handle(self)
+
+            def do_PUT(self):  # noqa: N802 — http.server contract
                 outer._handle(self)
 
         class Server(ThreadingHTTPServer):
@@ -243,6 +268,12 @@ class ObjectStoreHttpServer:
         if self.latency_ms > 0:
             time.sleep(self.latency_ms / 1000.0)
         query = parse_qs(parsed.query)
+        if req.command == "PUT":
+            if len(parts) < 2:
+                self._respond(req, 400, b"missing key")
+                return
+            self._handle_put(req, "/".join(parts[1:]), index)
+            return
         if len(parts) == 1 and "list-type" in query:
             self._handle_list(req, query)
             return
@@ -354,6 +385,97 @@ class ObjectStoreHttpServer:
         self._respond(
             req, status, data, claimed_len=claimed_len, headers=headers
         )
+        with self._lock:
+            self.requests_served += 1
+
+    # -- conditional writes (the lease transport, DESIGN.md §23) -------------
+
+    def _write_key(self, key: str, body: bytes) -> str:
+        """Install ``body`` under ``key`` and return its new ETag.  File
+        roots write tmp-then-replace so a concurrent GET never reads a
+        torn object (the same discipline the clients themselves use)."""
+        with self._lock:
+            if isinstance(self.root, dict):
+                self.root[key] = body
+            else:
+                path = os.path.join(self.root, key)
+                tmp = f"{path}.put-tmp"
+                with open(tmp, "wb") as f:
+                    f.write(body)
+                os.replace(tmp, path)
+                self._etags.pop(key, None)
+        return hashlib.md5(body + self.etag_salt).hexdigest()
+
+    @staticmethod
+    def _skew_body(body: bytes, seconds: float) -> bytes:
+        """Shift ``expires_at`` in a lease JSON body (the clock-skewed
+        writer fault); non-lease bodies pass through untouched."""
+        try:
+            doc = json.loads(body.decode("utf-8"))
+            doc["expires_at"] = float(doc["expires_at"]) + seconds
+            return json.dumps(doc, sort_keys=True).encode("utf-8")
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            return body
+
+    def _handle_put(
+        self, req: BaseHTTPRequestHandler, key: str, index: int
+    ) -> None:
+        try:
+            length = int(req.headers.get("Content-Length", "0") or "0")
+        except ValueError:
+            self._respond(req, 400, b"bad content-length")
+            return
+        body = req.rfile.read(length) if length > 0 else b""
+        action = (
+            self.put_fault_hook(key, body, index)
+            if self.put_fault_hook is not None
+            else None
+        )
+        if action == "drop":
+            # Plain transport failure: the write was NOT applied, so the
+            # client's retry (same condition) is safe.
+            try:
+                req.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            req.close_connection = True
+            return
+        if isinstance(action, tuple) and action[0] == "status":
+            self._respond(req, int(action[1]), b"injected fault")
+            return
+        if isinstance(action, tuple) and action[0] == "race":
+            # A competing writer lands FIRST; this request's condition is
+            # then evaluated against the competitor's object (genuine
+            # stale-ETag 412, not an injected status).
+            self._write_key(key, bytes(action[1]))
+        if isinstance(action, tuple) and action[0] == "skew":
+            body = self._skew_body(body, float(action[1]))
+        if_match = req.headers.get("If-Match")
+        if_none_match = req.headers.get("If-None-Match")
+        current = self._etag(key)
+        if if_match is not None:
+            # If-Match against a missing object fails too: you cannot
+            # fence on a version that no longer exists.
+            if current is None or if_match.strip('"') != current:
+                self._respond(req, 412, b"precondition failed")
+                return
+        elif if_none_match is not None:
+            if current is not None:
+                self._respond(req, 412, b"precondition failed")
+                return
+        etag = self._write_key(key, body)
+        if action == "lost":
+            # The ambiguous PUT: applied server-side, but the response
+            # never reaches the client — its conditional retry will 412
+            # against its OWN write (resolved by read-back upstream).
+            try:
+                req.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            req.close_connection = True
+            return
+        headers = {"ETag": f'"{etag}"'} if self.send_etag else {}
+        self._respond(req, 200, b"", headers=headers)
         with self._lock:
             self.requests_served += 1
 

@@ -10,6 +10,10 @@ bench drives), so tests can enqueue per-object fault scripts:
 
 Scripts apply to whole-body GETs only by default (the fetch path under
 test); header/list probes stay clean unless ``body_only=False``.
+
+Conditional writes (the lease transport) script the same way through
+``script_put`` — lost-rename/ambiguous PUTs, competing-writer races, and
+clock-skewed lease bodies (see objstore_serve.PutFaultHook).
 """
 
 from __future__ import annotations
@@ -28,9 +32,15 @@ class FakeObjectStore(ObjectStoreHttpServer):
         self._script_lock = threading.Lock()
         #: key -> list of (action, body_only) consumed FIFO per matching GET.
         self._scripts: "dict[str, list]" = {}
+        #: key -> list of actions consumed FIFO per PUT of that key.
+        self._put_scripts: "dict[str, list]" = {}
         #: Whole-body GETs observed per key (fault-scripted ones included).
         self.body_gets: "Counter[str]" = Counter()
-        super().__init__(root, fault_hook=self._hook, **kw)
+        #: PUTs observed per key (fault-scripted ones included).
+        self.puts: "Counter[str]" = Counter()
+        super().__init__(
+            root, fault_hook=self._hook, put_fault_hook=self._put_hook, **kw
+        )
 
     def script(self, key: str, *actions, body_only: bool = True) -> None:
         """Enqueue fault actions for successive GETs of ``key`` (see
@@ -39,6 +49,20 @@ class FakeObjectStore(ObjectStoreHttpServer):
             self._scripts.setdefault(key, []).extend(
                 (a, body_only) for a in actions
             )
+
+    def script_put(self, key: str, *actions) -> None:
+        """Enqueue fault actions for successive PUTs of ``key`` (see
+        objstore_serve.PutFaultHook for the action vocabulary)."""
+        with self._script_lock:
+            self._put_scripts.setdefault(key, []).extend(actions)
+
+    def _put_hook(self, key: str, body: bytes, index: int):
+        with self._script_lock:
+            self.puts[key] += 1
+            queue = self._put_scripts.get(key)
+            if not queue:
+                return None
+            return queue.pop(0)
 
     def _hook(
         self,

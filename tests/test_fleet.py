@@ -846,7 +846,9 @@ def test_fleet_admissions_are_booked():
     from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
 
     def count(reason):
-        return obs_metrics.FLEET_ADMISSIONS.labels(reason=reason).value
+        return obs_metrics.FLEET_ADMISSIONS.labels(
+            reason=reason, instance="solo"
+        ).value
 
     seed0 = count("admitted-seed")
     released0 = count("released")

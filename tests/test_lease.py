@@ -1,0 +1,903 @@
+"""Lease-based multi-instance fleets (ISSUE 16): ownership, fencing, failover.
+
+The contract under test, per DESIGN.md §23:
+
+- STORES: both lease stores implement the same CAS-shaped contract —
+  FileLeaseStore (lock + atomic rename + read-back verify) and
+  ObjectLeaseStore (ETag-fenced conditional PUTs, with the ambiguous
+  retried-PUT 412 resolved by read-back);
+- EPOCH RULES: absent record → 1; released/expired/self-owned → +1;
+  live held-elsewhere → refused.  Epochs only ever grow — released
+  records are kept, never deleted;
+- FAILOVER: a killed instance leaves its leases dangling; a peer takes
+  over at expiry (booked as takeover + kta_fleet_failovers_total),
+  resumes from the dead instance's checkpoint, and the final per-topic
+  metrics are byte-identical to a solo scan — no loss, no double-count;
+- FENCING: a paused zombie's late checkpoint write is refused with the
+  named StaleLeaseEpochError, the topic goes "fenced" (not "failed"),
+  and the loss is booked on kta_lease_losses_total;
+- DEGRADATION: a store outage during renewal defers (books "deferred")
+  and the lease survives until local expiry — never an early self-fence;
+- SHUTDOWN: SIGTERM releases every held lease after the final
+  checkpoint pass, so a rolling restart fails over immediately.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.checkpoint import (
+    StaleLeaseEpochError,
+    list_topic_snapshots,
+    load_snapshot,
+    save_snapshot,
+    snapshot_info,
+    topic_snapshot_dir,
+)
+from kafka_topic_analyzer_tpu.config import (
+    AnalyzerConfig,
+    DispatchConfig,
+    FollowConfig,
+    HealthConfig,
+    LeaseConfig,
+    SegmentFetchConfig,
+    TransportRetryConfig,
+)
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.fleet.lease import (
+    FileLeaseStore,
+    Lease,
+    LeaseManager,
+    ObjectLeaseStore,
+)
+from kafka_topic_analyzer_tpu.fleet.scheduler import FleetScheduler, TopicSeed
+from kafka_topic_analyzer_tpu.fleet.service import FleetService
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+from kafka_topic_analyzer_tpu.io.objstore import RetryingHttp
+from kafka_topic_analyzer_tpu.io.retry import Backoff
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.obs.health import HealthEngine, built_in_rules
+
+from fake_broker import FakeBroker
+from fake_objstore import FakeObjectStore
+
+pytestmark = pytest.mark.lease
+
+TOPICS = ["lease.a", "lease.b"]
+N_PARTS = 2
+PHASE1_N = 96
+PHASE2_N = 48
+FULL_N = PHASE1_N + PHASE2_N
+
+FAST_RETRY = {
+    "retry.backoff.ms": "5",
+    "reconnect.backoff.max.ms": "40",
+}
+
+FAST_FOLLOW = dict(
+    poll_interval_s=0.02,
+    idle_backoff_max_s=0.05,
+)
+
+
+class _Clock:
+    """The shared fake WALL clock lease expiry runs on (the follow
+    loop's pass clock stays real/monotonic — leases only care about
+    the store-visible expiry time)."""
+
+    def __init__(self, t: float = 1_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _mk_records(salt: int, partition: int, lo: int, n: int):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{salt}-{partition}-{i % 13}".encode() if i % 5 else None,
+            bytes(11 + ((i + salt) % 7)) if i % 7 else None,
+        )
+        for i in range(lo, lo + n)
+    ]
+
+
+def _topic_records(salt: int, n: int, lo: int = 0):
+    return {p: _mk_records(salt, p, lo, n) for p in range(N_PARTS)}
+
+
+def _mk_broker(records_by_topic, **kw):
+    names = list(records_by_topic)
+    return FakeBroker(
+        names[0],
+        records_by_topic[names[0]],
+        extra_topics={t: records_by_topic[t] for t in names[1:]},
+        max_records_per_fetch=48,
+        **kw,
+    )
+
+
+def _cfg(parts=N_PARTS) -> AnalyzerConfig:
+    return AnalyzerConfig(
+        num_partitions=parts,
+        batch_size=64,
+        count_alive_keys=True,
+        alive_bitmap_bits=16,
+    )
+
+
+def _source(broker, topic):
+    return KafkaWireSource(
+        f"127.0.0.1:{broker.port}", topic, overrides=dict(FAST_RETRY)
+    )
+
+
+def _metrics_doc(result) -> dict:
+    return result.metrics.to_dict(result.start_offsets, result.end_offsets)
+
+
+def _fleet_service(
+    broker,
+    topics=TOPICS,
+    *,
+    leases=None,
+    instance="solo",
+    follow=None,
+    snapshot_dir=None,
+    resume=False,
+    max_concurrent=3,
+):
+    scheduler = FleetScheduler(3, 3, max_concurrent, instance=instance)
+
+    def source_factory(topic):
+        return _source(broker, topic)
+
+    def backend_factory(topic, parts, grant):
+        return TpuBackend(
+            _cfg(parts),
+            dispatch=DispatchConfig(
+                superbatch=1, depth=grant.dispatch_depth
+            ),
+            init_now_s=10**10,
+        )
+
+    seeds = [TopicSeed(name=t, partitions=N_PARTS) for t in topics]
+    return FleetService(
+        seeds, source_factory, backend_factory, 64, scheduler,
+        follow=follow, snapshot_dir=snapshot_dir, resume=resume,
+        leases=leases, instance=instance,
+    )
+
+
+def _wait_for(predicate, timeout_s=30.0, interval_s=0.01, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _acq(outcome: str, instance: str) -> float:
+    return obs_metrics.LEASE_ACQUISITIONS.labels(
+        outcome=outcome, instance=instance
+    ).value
+
+
+def _renewals(outcome: str, instance: str) -> float:
+    return obs_metrics.LEASE_RENEWALS.labels(
+        outcome=outcome, instance=instance
+    ).value
+
+
+def _losses(instance: str) -> float:
+    return obs_metrics.LEASE_LOSSES.labels(instance=instance).value
+
+
+def _failovers(instance: str) -> float:
+    return obs_metrics.FLEET_FAILOVERS.labels(instance=instance).value
+
+
+def _held_gauge(topic: str, instance: str) -> float:
+    return obs_metrics.LEASE_HELD.labels(
+        topic=topic, instance=instance
+    ).value
+
+
+def _fetch_cfg() -> SegmentFetchConfig:
+    return SegmentFetchConfig(
+        retry=TransportRetryConfig(
+            backoff_ms=1, backoff_max_ms=2, retry_budget=4, jitter=0.0
+        ),
+        timeout_s=5.0,
+    )
+
+
+def _obj_store(server) -> ObjectLeaseStore:
+    return ObjectLeaseStore(RetryingHttp(server.url, _fetch_cfg()))
+
+
+# ---------------------------------------------------------------------------
+# lease records
+
+
+def test_lease_record_round_trip():
+    lease = Lease(
+        topic="t", owner="i-1", epoch=3, expires_at=12.5, acquired_at=2.5
+    )
+    assert Lease.from_json(lease.to_json()) == lease
+    released = Lease(
+        topic="t", owner=None, epoch=3, expires_at=12.5, acquired_at=2.5
+    )
+    assert Lease.from_json(released.to_json()).owner is None
+
+
+# ---------------------------------------------------------------------------
+# FileLeaseStore
+
+
+def test_file_store_round_trip_and_owners(tmp_path):
+    store = FileLeaseStore(str(tmp_path))
+    assert store.read("t") == (None, None)
+    lease = Lease(
+        topic="t", owner="A", epoch=1, expires_at=10.0, acquired_at=0.0
+    )
+    token = store.write("t", lease, None)
+    assert token is not None
+    got, tok = store.read("t")
+    assert got == lease and tok is not None
+    assert store.owners() == {"A"}
+    released = Lease(
+        topic="t", owner=None, epoch=1, expires_at=0.0, acquired_at=0.0
+    )
+    assert store.write("t", released, token) is not None
+    assert store.owners() == set()  # released records name no owner
+
+
+def test_file_store_lost_race_detected_by_read_back(tmp_path):
+    """The verify seam: a competing write landing between the rename and
+    the read-back must turn OUR write into a reported lost race."""
+    racer = FileLeaseStore(str(tmp_path))
+
+    def competing_write(topic):
+        # Bypass the lock (our writer holds it): model a racer whose
+        # rename lands between our replace and our read-back.
+        theirs = Lease(
+            topic=topic, owner="B", epoch=9,
+            expires_at=99.0, acquired_at=0.0,
+        )
+        with open(racer._path(topic), "wb") as f:
+            f.write(theirs.to_json())
+
+    store = FileLeaseStore(str(tmp_path), verify_hook=competing_write)
+    mine = Lease(
+        topic="t", owner="A", epoch=1, expires_at=10.0, acquired_at=0.0
+    )
+    assert store.write("t", mine, None) is None  # racer overwrote us
+    got, _tok = store.read("t")
+    assert got.owner == "B" and got.epoch == 9
+
+
+def test_file_store_lock_contention(tmp_path):
+    store = FileLeaseStore(str(tmp_path))
+    lease = Lease(
+        topic="t", owner="A", epoch=1, expires_at=10.0, acquired_at=0.0
+    )
+    lock = store._path("t") + ".lock"
+    # A LIVE lock (a concurrent writer inside the section) = lost race.
+    with open(lock, "w"):
+        pass
+    assert store.write("t", lease, None) is None
+    # A STALE lock (a crashed writer's leavings) is broken and the
+    # write proceeds.
+    old = time.time() - FileLeaseStore.LOCK_STALE_S - 1.0
+    os.utime(lock, (old, old))
+    assert store.write("t", lease, None) is not None
+    assert not os.path.exists(lock)
+
+
+def test_file_store_corrupt_record_reads_absent(tmp_path):
+    store = FileLeaseStore(str(tmp_path))
+    with open(store._path("t"), "wb") as f:
+        f.write(b"{not json")
+    assert store.read("t") == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# conditional PUTs + ObjectLeaseStore
+
+
+def test_put_conditional_requires_exactly_one_condition():
+    http = RetryingHttp("http://127.0.0.1:9/bucket", _fetch_cfg())
+    with pytest.raises(ValueError, match="exactly one"):
+        http.put_conditional("/bucket/k", b"x")
+    with pytest.raises(ValueError, match="exactly one"):
+        http.put_conditional(
+            "/bucket/k", b"x", if_match="e", if_none_match=True
+        )
+
+
+def test_object_store_create_replace_and_stale_etag(tmp_path):
+    with FakeObjectStore({}) as server:
+        store = _obj_store(server)
+        a1 = Lease(
+            topic="t", owner="A", epoch=1, expires_at=10.0, acquired_at=0.0
+        )
+        token = store.write("t", a1, None)  # If-None-Match: * create
+        assert token
+        got, tok = store.read("t")
+        assert got == a1 and tok == token
+        # If-Match replace with the read token succeeds.
+        a1r = Lease(
+            topic="t", owner="A", epoch=1, expires_at=20.0, acquired_at=0.0
+        )
+        token2 = store.write("t", a1r, token)
+        assert token2 and token2 != token
+        # A competitor's record lands; our now-stale token is refused
+        # and the read-back shows a different owner → lost race.
+        b2 = Lease(
+            topic="t", owner="B", epoch=2, expires_at=30.0, acquired_at=0.0
+        )
+        server.root["_kta_leases/t.json"] = b2.to_json()
+        a1rr = Lease(
+            topic="t", owner="A", epoch=1, expires_at=40.0, acquired_at=0.0
+        )
+        assert store.write("t", a1rr, token2) is None
+
+
+def test_object_store_ambiguous_put_resolved_by_read_back():
+    """The lost-response PUT: applied server-side, connection dropped
+    before the response.  The transport retry 412s against our OWN
+    write; the store must recognize it and report success."""
+    with FakeObjectStore({}) as server:
+        store = _obj_store(server)
+        a1 = Lease(
+            topic="t", owner="A", epoch=1, expires_at=10.0, acquired_at=0.0
+        )
+        token = store.write("t", a1, None)
+        server.script_put("_kta_leases/t.json", "lost")
+        renewal = Lease(
+            topic="t", owner="A", epoch=1, expires_at=20.0, acquired_at=0.0
+        )
+        new_token = store.write("t", renewal, token)
+        assert new_token is not None  # our own write fenced us: resolved
+        got, _ = store.read("t")
+        assert got == renewal
+        assert server.puts["_kta_leases/t.json"] >= 2  # it DID retry
+
+
+def test_object_store_race_loses_acquire():
+    """A competing writer winning the CAS race mid-PUT is a genuine 412:
+    the manager books lost-race and does not hold."""
+    with FakeObjectStore({}) as server:
+        store = _obj_store(server)
+        clock = _Clock()
+        competitor = Lease(
+            topic="t", owner="B", epoch=1,
+            expires_at=clock() + 60.0, acquired_at=clock(),
+        )
+        server.script_put(
+            "_kta_leases/t.json", ("race", competitor.to_json())
+        )
+        mgr = LeaseManager(store, "A", ttl_s=30.0, clock=clock)
+        lost0 = _acq("lost-race", "A")
+        assert mgr.acquire("t") is None
+        assert _acq("lost-race", "A") - lost0 == 1
+        assert not mgr.is_held("t")
+        got, _ = store.read("t")
+        assert got.owner == "B"
+
+
+def test_object_store_transient_5xx_retried():
+    with FakeObjectStore({}) as server:
+        store = _obj_store(server)
+        server.script_put("_kta_leases/t.json", ("status", 503))
+        lease = Lease(
+            topic="t", owner="A", epoch=1, expires_at=10.0, acquired_at=0.0
+        )
+        assert store.write("t", lease, None) is not None
+        assert server.puts["_kta_leases/t.json"] == 2
+
+
+def test_object_store_clock_skew_expires_lease_early():
+    """A writer whose clock runs behind persists an already-stale
+    expiry: a peer sees the record expired and takes over (failover)."""
+    with FakeObjectStore({}) as server:
+        store = _obj_store(server)
+        clock = _Clock()
+        server.script_put("_kta_leases/t.json", ("skew", -100.0))
+        mgr_a = LeaseManager(store, "A", ttl_s=30.0, clock=clock)
+        assert mgr_a.acquire("t") == 1
+        got, _ = store.read("t")
+        assert got.expires_at <= clock()  # skewed into the past
+        fo0 = _failovers("B")
+        mgr_b = LeaseManager(store, "B", ttl_s=30.0, clock=clock)
+        assert mgr_b.acquire("t") == 2  # takeover without waiting a TTL
+        assert _failovers("B") - fo0 == 1
+
+
+# ---------------------------------------------------------------------------
+# LeaseManager epoch rules
+
+
+def test_acquire_epoch_rules_and_release(tmp_path):
+    store = FileLeaseStore(str(tmp_path))
+    clock = _Clock()
+    a = LeaseManager(store, "A", ttl_s=30.0, clock=clock)
+    b = LeaseManager(store, "B", ttl_s=30.0, clock=clock)
+    acq0 = _acq("acquired", "A")
+    assert a.acquire("t") == 1
+    assert a.acquire("t") == 1  # idempotent while held
+    assert _acq("acquired", "A") - acq0 == 1
+    assert a.is_held("t") and a.epoch("t") == 1
+    assert _held_gauge("t", "A") == 1
+    # Held elsewhere, unexpired → refused and booked.
+    he0 = _acq("held-elsewhere", "B")
+    assert b.acquire("t") is None
+    assert _acq("held-elsewhere", "B") - he0 == 1
+    # Clean release keeps the record (owner None, SAME epoch).
+    rel0 = _acq("released", "A")
+    a.release("t")
+    assert not a.is_held("t") and _held_gauge("t", "A") == 0
+    assert _acq("released", "A") - rel0 == 1
+    rec, _ = store.read("t")
+    assert rec.owner is None and rec.epoch == 1
+    # The successor bumps the epoch past every record ever written.
+    assert b.acquire("t") == 2
+    assert sorted(a.known_instances()) == ["A", "B"]
+    assert b.held_topics() == ["t"]
+
+
+def test_expired_lease_takeover_and_zombie_fencing(tmp_path):
+    store = FileLeaseStore(str(tmp_path))
+    clock = _Clock()
+    a = LeaseManager(store, "A", ttl_s=5.0, clock=clock)
+    b = LeaseManager(store, "B", ttl_s=5.0, clock=clock)
+    assert a.acquire("t") == 1
+    clock.advance(6.0)  # A's lease expires un-renewed
+    take0, fo0 = _acq("takeover", "B"), _failovers("B")
+    assert b.acquire("t") == 2
+    assert _acq("takeover", "B") - take0 == 1
+    assert _failovers("B") - fo0 == 1
+    # The zombie still believes it holds epoch 1; its renewal observes
+    # the successor and self-fences — booked as a loss, never a write
+    # over B's record.
+    loss0 = _losses("A")
+    assert a.is_held("t")  # stale local view, by design
+    assert a.renew("t") is False
+    assert _losses("A") - loss0 == 1
+    assert not a.is_held("t")
+    rec, _ = store.read("t")
+    assert rec.owner == "B" and rec.epoch == 2  # untouched by the zombie
+
+
+def test_renewal_outage_defers_until_local_expiry(tmp_path):
+    class FlakyStore(FileLeaseStore):
+        def __init__(self, directory):
+            super().__init__(directory)
+            self.fail_writes = False
+
+        def write(self, topic, lease, token):
+            if self.fail_writes:
+                raise OSError("injected store outage")
+            return super().write(topic, lease, token)
+
+    store = FlakyStore(str(tmp_path))
+    clock = _Clock()
+    backoff = Backoff(
+        TransportRetryConfig(backoff_ms=1, backoff_max_ms=2, jitter=0.0),
+        sleep=lambda s: None,
+    )
+    mgr = LeaseManager(
+        store, "A", ttl_s=10.0, clock=clock, backoff=backoff,
+        renew_attempts=2,
+    )
+    assert mgr.acquire("t") == 1
+    store.fail_writes = True
+    # Outage inside the TTL: deferred, still held, NO self-fence.
+    d0 = _renewals("deferred", "A")
+    clock.advance(3.0)
+    assert mgr.renew("t") is True
+    assert _renewals("deferred", "A") - d0 == 1
+    assert mgr.is_held("t")
+    # Store heals before expiry: the next renewal extends normally.
+    store.fail_writes = False
+    r0 = _renewals("renewed", "A")
+    assert mgr.renew("t") is True
+    assert _renewals("renewed", "A") - r0 == 1
+    # Outage outlasting the TTL: the lease dies at local expiry.
+    store.fail_writes = True
+    clock.advance(11.0)
+    loss0 = _losses("A")
+    assert mgr.renew("t") is False
+    assert _losses("A") - loss0 == 1
+    assert not mgr.is_held("t")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint epoch fencing (the named error)
+
+
+def test_checkpoint_epoch_fence_refuses_stale_saves_and_loads(tmp_path):
+    topic = "lease.f"
+    d = str(tmp_path / "snap")
+    records = {topic: {0: _mk_records(3, 0, 0, 40)}}
+    with _mk_broker(records) as broker:
+        src = _source(broker, topic)
+        backend = TpuBackend(_cfg(1), init_now_s=10**10)
+        res = run_scan(
+            topic, src, backend, 64,
+            snapshot_dir=d, final_snapshot=True, lease_epoch=2,
+        )
+        src.close()
+    assert snapshot_info(d)["lease_epoch"] == 2
+    # A stale writer (fenced zombie) is refused with the NAMED error.
+    with pytest.raises(StaleLeaseEpochError, match="STALE-LEASE-EPOCH"):
+        save_snapshot(
+            d, topic, backend.config, backend.get_state(),
+            res.next_offsets, int(res.metrics.overall_count),
+            backend.init_now_s, lease_epoch=1,
+        )
+    # A stale loader is refused too — resuming over a successor's state
+    # would double-count.
+    with pytest.raises(StaleLeaseEpochError, match="STALE-LEASE-EPOCH"):
+        load_snapshot(d, topic, backend.config, lease_epoch=1)
+    # The successor (newer epoch) resumes the predecessor's checkpoint:
+    # that IS the failover path.
+    assert load_snapshot(d, topic, backend.config, lease_epoch=3) is not None
+    # Epoch-less solo scans are untouched by the fence.
+    assert load_snapshot(d, topic, backend.config) is not None
+
+
+# ---------------------------------------------------------------------------
+# health rules
+
+
+def test_lease_alert_rules_fire_and_resolve():
+    clock = {"t": 0.0}
+    cfg = HealthConfig(
+        eval_interval_s=0.001, storm_window_s=2.0, resolve_s=1.0
+    )
+    eng = HealthEngine(
+        built_in_rules(cfg), cfg=cfg, clock=lambda: clock["t"]
+    )
+
+    def snap(losses, failovers):
+        return {
+            "kta_lease_losses_total": {
+                "type": "counter",
+                "samples": [{"labels": {}, "value": losses}],
+            },
+            "kta_fleet_failovers_total": {
+                "type": "counter",
+                "samples": [{"labels": {}, "value": failovers}],
+            },
+        }
+
+    eng.evaluate(snap(0, 0))
+    clock["t"] = 1.0
+    doc = eng.evaluate(snap(2, 1))
+    firing = {r["rule"]: r for r in doc["firing"]}
+    assert "lease_lost" in firing and "failover" in firing
+    assert firing["lease_lost"]["evidence"]["lease_losses"] == 2
+    assert firing["failover"]["evidence"]["failovers"] == 1
+    # Counters stable past the window + resolve time → healthy again.
+    for t in (4.0, 5.5, 7.0):
+        clock["t"] = t
+        doc = eng.evaluate(snap(2, 1))
+    assert doc["healthy"]
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+
+
+def test_lease_config_validation_and_store_selection(tmp_path):
+    from kafka_topic_analyzer_tpu import cli
+
+    assert not LeaseConfig().enabled
+    assert LeaseConfig(instance_id="i-1").enabled
+    with pytest.raises(ValueError):
+        LeaseConfig(instance_id="i", ttl_s=0.0)
+    with pytest.raises(ValueError):
+        LeaseConfig(instance_id="i", store="zookeeper")
+
+    cfg = LeaseConfig(instance_id="i-1", ttl_s=5.0)
+    mgr = cli.make_lease_manager(cfg, snapshot_dir=str(tmp_path))
+    assert isinstance(mgr.store, FileLeaseStore)
+    assert mgr.instance == "i-1" and mgr.ttl_s == 5.0
+    # auto picks the object store exactly when the segment spec is remote.
+    mgr2 = cli.make_lease_manager(
+        cfg, store_spec="http://127.0.0.1:9/bucket"
+    )
+    assert isinstance(mgr2.store, ObjectLeaseStore)
+    with pytest.raises(ValueError):  # object leases need a remote spec
+        cli.make_lease_manager(
+            LeaseConfig(instance_id="i", store="object"),
+            snapshot_dir=str(tmp_path), store_spec="./segments",
+        )
+    with pytest.raises(ValueError):  # file leases need a checkpoint dir
+        cli.make_lease_manager(LeaseConfig(instance_id="i", store="file"))
+
+
+def test_instance_id_without_fleet_is_rejected(capsys):
+    from kafka_topic_analyzer_tpu import cli
+
+    rc = cli.main(
+        [
+            "-t", "t", "--source", "synthetic",
+            "--synthetic", "partitions=1,messages=4",
+            "--backend", "cpu", "--native", "off", "--quiet",
+            "--instance-id", "i-1",
+        ]
+    )
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "--instance-id" in err and "--fleet" in err
+
+
+# ---------------------------------------------------------------------------
+# two-instance chaos: crash failover, byte-identical resumed rollup
+
+
+def test_two_instance_crash_failover_byte_identity(tmp_path):
+    snap = str(tmp_path / "snaps")
+    clock = _Clock()
+    follow = FollowConfig(**dict(FAST_FOLLOW, checkpoint_every_s=0.0))
+    full = {t: _topic_records(i, FULL_N) for i, t in enumerate(TOPICS)}
+
+    referee = {}
+    with _mk_broker(full) as broker:
+        for t in TOPICS:
+            src = _source(broker, t)
+            res = run_scan(
+                t, src, TpuBackend(_cfg(), init_now_s=10**10), 64
+            )
+            src.close()
+            referee[t] = _metrics_doc(res)
+
+    take0 = _acq("takeover", "B")
+    fo0 = _failovers("B")
+    phase1 = {t: _topic_records(i, PHASE1_N) for i, t in enumerate(TOPICS)}
+    # The response delay widens the window between lease acquisition and
+    # pass completion so kill() deterministically lands mid-pass.
+    with _mk_broker(
+        phase1, response_delay=lambda *_: 0.03
+    ) as broker:
+        mgr_a = LeaseManager(
+            FileLeaseStore(snap), "A", ttl_s=5.0, clock=clock
+        )
+        svc_a = _fleet_service(
+            broker, leases=mgr_a, instance="A",
+            follow=follow, snapshot_dir=snap,
+        )
+        th = threading.Thread(target=svc_a.run_follow)
+        th.start()
+        _wait_for(
+            lambda: set(mgr_a.held_topics()) == set(TOPICS),
+            what="instance A to hold every topic lease",
+        )
+        svc_a.kill()
+        th.join(timeout=60)
+        assert not th.is_alive()
+        # The crash left every lease dangling — still owned by A.
+        assert FileLeaseStore(snap).owners() == {"A"}
+        # ... but A's in-flight pass committed its checkpoint first.
+        inv = list_topic_snapshots(snap)
+        assert set(inv) == set(TOPICS)
+        assert all(
+            info["records_seen"] == N_PARTS * PHASE1_N
+            for info in inv.values()
+        )
+
+        # One TTL later the records are expired; B takes over, resumes
+        # A's checkpoints, and tails the phase-2 records.
+        clock.advance(5.0 + 1.0)
+        broker.response_delay = None
+        for i, t in enumerate(TOPICS):
+            for p, recs in _topic_records(
+                i, PHASE2_N, lo=PHASE1_N
+            ).items():
+                broker.produce(p, recs, topic=t)
+        mgr_b = LeaseManager(
+            FileLeaseStore(snap), "B", ttl_s=5.0, clock=clock
+        )
+        svc_b = _fleet_service(
+            broker, leases=mgr_b, instance="B",
+            follow=follow, snapshot_dir=snap, resume=True,
+        )
+
+        def published(t):
+            doc = svc_b.state.snapshot(t)
+            return doc["overall"]["count"] if doc else -1
+
+        out = {}
+        th2 = threading.Thread(
+            target=lambda: out.setdefault("fr", svc_b.run_follow())
+        )
+        th2.start()
+        _wait_for(
+            lambda: all(
+                published(t) >= N_PARTS * FULL_N for t in TOPICS
+            ),
+            what="instance B to catch up the resumed topics",
+        )
+        svc_b.request_stop("test")
+        th2.join(timeout=60)
+    fr = out["fr"]
+    # Takeover within one TTL of the crash: every topic was acquired as
+    # a takeover (the previous owner was a DIFFERENT, dead instance)
+    # and booked as a failover.
+    assert _acq("takeover", "B") - take0 == len(TOPICS)
+    assert _failovers("B") - fo0 == len(TOPICS)
+    # The acceptance proof: resumed-from-the-dead-instance results are
+    # byte-identical to the solo referee — no loss, no double-count.
+    for t in TOPICS:
+        assert _metrics_doc(fr.results[t]) == referee[t]
+    # Cross-instance federation on the rollup.
+    assert fr.rollup["fleet"]["instance"] == "B"
+    assert "B" in fr.rollup["fleet"]["instances"]
+    assert svc_b.state.snapshot(TOPICS[0])["instance"] == "B"
+
+
+# ---------------------------------------------------------------------------
+# the paused zombie: late checkpoint write refused at the epoch fence
+
+
+def test_paused_zombie_is_fenced_at_the_checkpoint(tmp_path):
+    snap = str(tmp_path / "snaps")
+    clock = _Clock()
+    follow = FollowConfig(**dict(FAST_FOLLOW, checkpoint_every_s=0.0))
+    topic = "lease.z"
+    phase1 = {topic: _topic_records(7, PHASE1_N)}
+    with _mk_broker(
+        phase1, response_delay=lambda *_: 0.05
+    ) as broker:
+        store = FileLeaseStore(snap)
+        mgr_a = LeaseManager(store, "A", ttl_s=5.0, clock=clock)
+        svc = _fleet_service(
+            broker, topics=[topic], leases=mgr_a, instance="A",
+            follow=follow, snapshot_dir=snap,
+        )
+        out = {}
+        th = threading.Thread(
+            target=lambda: out.setdefault("fr", svc.run_follow())
+        )
+        th.start()
+        _wait_for(
+            lambda: mgr_a.is_held(topic), what="A to acquire the lease"
+        )
+        svc.pause()
+        # New records land while A's first pass is still running, so the
+        # pass ends NOT caught up, the lease is kept, and the loop
+        # freezes at the post-renew pause gate still holding it.
+        for p, recs in _topic_records(7, PHASE2_N, lo=PHASE1_N).items():
+            broker.produce(p, recs, topic=topic)
+
+        def frozen():
+            polls = svc.polls
+            time.sleep(0.08)
+            return svc.polls == polls and mgr_a.is_held(topic)
+
+        _wait_for(frozen, what="A frozen at the gate holding its lease")
+        broker.response_delay = None
+
+        # The zombie window: A's lease expires while it is stalled; a
+        # successor takes over, resumes A's checkpoint, and commits its
+        # own — stamped with the NEWER epoch.
+        clock.advance(5.0 + 1.0)
+        mgr_b = LeaseManager(store, "B", ttl_s=60.0, clock=clock)
+        assert mgr_b.acquire(topic) == 2
+        src_b = _source(broker, topic)
+        res_b = run_scan(
+            topic, src_b, TpuBackend(_cfg(), init_now_s=10**10), 64,
+            snapshot_dir=topic_snapshot_dir(snap, topic),
+            resume=True, final_snapshot=True, lease_epoch=2,
+        )
+        src_b.close()
+        assert res_b.metrics.overall_count == N_PARTS * FULL_N
+
+        # More records, then the zombie wakes up and runs a pass on its
+        # stale epoch-1 lease: the checkpoint write MUST be refused with
+        # the named error, the topic goes "fenced" (not "failed"), and
+        # the loss is booked under A's label.
+        loss0 = _losses("A")
+        for p, recs in _topic_records(7, 24, lo=FULL_N).items():
+            broker.produce(p, recs, topic=topic)
+        svc.unpause()
+        _wait_for(
+            lambda: svc.scans[topic].status.status == "fenced",
+            what="the zombie's pass to be fenced",
+        )
+        svc.request_stop("test")
+        th.join(timeout=60)
+    fr = out["fr"]
+    assert svc._stop_reason == "test"  # fenced is NOT all-failed
+    assert fr.statuses[topic].status == "fenced"
+    assert "STALE-LEASE-EPOCH" in fr.statuses[topic].error
+    assert _losses("A") - loss0 == 1
+    assert not mgr_a.is_held(topic)
+    # B's checkpoint survived the zombie untouched.
+    info = snapshot_info(topic_snapshot_dir(snap, topic))
+    assert info["lease_epoch"] == 2
+    assert info["records_seen"] == N_PARTS * FULL_N
+    # The store record is still B's.
+    rec, _ = store.read(topic)
+    assert rec.owner == "B" and rec.epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM: shutdown releases every held lease (immediate failover)
+
+
+def test_sigterm_shutdown_releases_leases(tmp_path):
+    """The rolling-restart path.  max_concurrent=1 creates the state
+    release_all exists for: the lease gate acquires EVERY ready topic,
+    the scheduler admits only one — the backlogged topic's lease is
+    held with no pass running.  SIGTERM mid-pass must release it at the
+    shutdown boundary so a successor acquires instantly, no TTL wait."""
+    snap = str(tmp_path / "snaps")
+    clock = _Clock()
+    follow = FollowConfig(**dict(FAST_FOLLOW, checkpoint_every_s=0.0))
+    phase1 = {t: _topic_records(i, PHASE1_N) for i, t in enumerate(TOPICS)}
+    # The response delay stretches the admitted topic's pass so SIGTERM
+    # deterministically lands while the backlogged lease is still held.
+    with _mk_broker(
+        phase1, response_delay=lambda *_: 0.05
+    ) as broker:
+        store = FileLeaseStore(snap)
+        mgr_a = LeaseManager(store, "A", ttl_s=30.0, clock=clock)
+        svc = _fleet_service(
+            broker, leases=mgr_a, instance="A",
+            follow=follow, snapshot_dir=snap, max_concurrent=1,
+        )
+        restore = svc.install_signal_handlers()
+        out = {}
+        th = threading.Thread(
+            target=lambda: out.setdefault("fr", svc.run_follow())
+        )
+        try:
+            th.start()
+            _wait_for(
+                lambda: set(mgr_a.held_topics()) == set(TOPICS),
+                what="instance A to hold every topic lease",
+            )
+            os.kill(os.getpid(), signal.SIGTERM)
+            th.join(timeout=60)
+        finally:
+            restore()
+        assert not th.is_alive()
+        assert svc._stop_reason == "SIGTERM"
+        # Every lease was RELEASED at shutdown (owner None, epoch kept —
+        # records are never deleted): a successor acquires instantly at
+        # the SAME frozen clock, no TTL wait.
+        for t in TOPICS:
+            rec, _ = store.read(t)
+            assert rec is not None and rec.owner is None
+            assert rec.epoch == 1
+        assert store.owners() == set()
+        mgr_b = LeaseManager(store, "B", ttl_s=30.0, clock=clock)
+        for t in TOPICS:
+            assert mgr_b.acquire(t) == 2
+        # Whatever was scanned was checkpointed to the head before the
+        # release (per-pass forced checkpoints) — the successor resumes,
+        # it does not rescan.
+        inv = list_topic_snapshots(snap)
+        assert inv  # the admitted topic completed at least one pass
+        assert all(
+            info["records_seen"] == N_PARTS * PHASE1_N
+            and info["lease_epoch"] == 1
+            for info in inv.values()
+        )

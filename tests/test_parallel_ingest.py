@@ -228,23 +228,42 @@ def test_degraded_partition_in_one_worker_matches_sequential():
     (including the degraded map) matches the sequential scan under the
     same fault plan."""
 
+    class _ArmOnFirstFetch(FaultInjector):
+        """Inert until armed; arming is one atomic Event.set().  A plain
+        ``node.faults = FaultInjector()...`` hand-off from the delay
+        callback loses a GIL-preemption race: while the arming thread is
+        stalled mid-expression, other handler threads' sends still see
+        ``faults is None`` and a whole partition can drain and dodge
+        degradation.  Pre-installing the injector and gating it on an
+        Event leaves no such window — at worst one already-checked
+        in-flight response escapes per connection, which cannot finish a
+        multi-fetch partition."""
+
+        def __init__(self):
+            super().__init__()
+            self.armed = threading.Event()
+            self.drop_connection(0, times=10**6)
+            self.refuse_connections(times=10**6)
+
+        def take_drop(self):
+            return super().take_drop() if self.armed.is_set() else None
+
+        def take_refusal(self):
+            return super().take_refusal() if self.armed.is_set() else False
+
     def run(workers):
-        armed = []
+        inj = _ArmOnFirstFetch()
 
         def arm_on_first_fetch(api_key: int, node_id: int) -> float:
-            if api_key == kc.API_FETCH and node_id == 1 and not armed:
-                armed.append(True)
-                cluster.nodes[1].faults = (
-                    FaultInjector()
-                    .drop_connection(0, times=10**6)
-                    .refuse_connections(times=10**6)
-                )
+            if api_key == kc.API_FETCH and node_id == 1:
+                inj.armed.set()
             return 0.0
 
         with FakeCluster(
             TOPIC, RECORDS, n_nodes=2, max_records_per_fetch=60,
             response_delay=arm_on_first_fetch,
         ) as cluster:
+            cluster.nodes[1].faults = inj
             src = KafkaWireSource(
                 cluster.bootstrap, TOPIC,
                 overrides=dict(

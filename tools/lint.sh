@@ -1125,3 +1125,124 @@ if failures:
     sys.exit(1)
 print("lint: OK (alert-state transitions book their reason; none silent)")
 EOF
+
+# Thirteenth rule: no silent lease-ownership changes.  The fleet's
+# arbitration layer (fleet/lease.py) may change a held lease's state
+# ONLY inside LeaseManager._transition — the one method that books the
+# kta_lease_* instruments (acquisitions/held/losses, plus
+# kta_fleet_failovers_total on takeover) and emits the typed event.
+# AST-enforced three ways:
+# (a) every assignment to a `.state` attribute in fleet/lease.py sits
+#     lexically inside `_transition` (dataclass field defaults are
+#     class-body Name targets, not attribute assignments, and stay
+#     legal);
+# (b) `_transition` itself references the lease instruments and the
+#     event bus — a transition that books nothing is a lint failure;
+# (c) every acquire/renew/release/fence decision method books a reason:
+#     it must reference a LEASE_*/FLEET_FAILOVERS instrument, call
+#     `_transition`, or delegate to another decision method — no
+#     decision path is silent.
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+LEASE = pathlib.Path("kafka_topic_analyzer_tpu") / "fleet" / "lease.py"
+
+tree = ast.parse(LEASE.read_text(encoding="utf-8"), filename=str(LEASE))
+failures = []
+
+# Map every node to its enclosing function name.
+enclosing = {}
+
+
+def walk(node, fn_name):
+    for child in ast.iter_child_nodes(node):
+        name = fn_name
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = child.name
+        enclosing[id(child)] = name
+        walk(child, name)
+
+
+walk(tree, "<module>")
+
+DECISION_PREFIXES = ("acquire", "renew", "release", "fence")
+INSTRUMENTS = {
+    "LEASE_ACQUISITIONS", "LEASE_RENEWALS", "LEASE_LOSSES", "LEASE_HELD",
+    "FLEET_FAILOVERS",
+}
+
+
+def refs(fn):
+    return {
+        n.attr for n in ast.walk(fn) if isinstance(n, ast.Attribute)
+    } | {n.id for n in ast.walk(fn) if isinstance(n, ast.Name)}
+
+
+transition_fn = None
+decision_fns = []
+for node in ast.walk(tree):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if node.name == "_transition":
+            transition_fn = node
+        stripped = node.name.lstrip("_")
+        if stripped.startswith(DECISION_PREFIXES):
+            decision_fns.append(node)
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            if isinstance(t, ast.Attribute) and t.attr == "state":
+                if enclosing.get(id(node)) != "_transition":
+                    failures.append(
+                        f"{LEASE}:{node.lineno}: lease state assigned "
+                        f"outside LeaseManager._transition (silent "
+                        "ownership change) — route it through _transition"
+                    )
+
+if transition_fn is None:
+    failures.append(f"{LEASE}: LeaseManager._transition missing")
+else:
+    names = refs(transition_fn)
+    if not (INSTRUMENTS & names):
+        failures.append(
+            f"{LEASE}:{transition_fn.lineno}: _transition books no "
+            "kta_lease_* instrument (obs/metrics LEASE_*)"
+        )
+    if "emit" not in names:
+        failures.append(
+            f"{LEASE}:{transition_fn.lineno}: _transition emits no typed "
+            "event on the JSONL bus"
+        )
+
+if not decision_fns:
+    failures.append(
+        f"{LEASE}: no acquire/renew/release/fence decision methods found"
+    )
+for fn in decision_fns:
+    names = refs(fn)
+    delegates = any(
+        n.lstrip("_").startswith(DECISION_PREFIXES)
+        for n in names
+        if n != fn.name
+    )
+    if not (INSTRUMENTS & names) and "_transition" not in names and (
+        not delegates
+    ):
+        failures.append(
+            f"{LEASE}:{fn.lineno}: decision method {fn.name} books no "
+            "kta_lease_* reason (no instrument, no _transition, no "
+            "delegation to a booking decision method)"
+        )
+
+if failures:
+    print("lint: lease-ownership transitions must all route through")
+    print("lint: LeaseManager._transition, which books the kta_lease_*")
+    print("lint: instruments and emits the typed event (DESIGN.md §23):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (lease transitions book their reason; none silent)")
+EOF
