@@ -251,7 +251,16 @@ def save_snapshot(
     time: if the on-disk snapshot already carries a NEWER epoch, a
     successor instance owns this topic and the write raises
     `StaleLeaseEpochError` instead of clobbering its state.  None (solo
-    scans, lease-less fleets) skips the check and stamps nothing."""
+    scans, lease-less fleets) skips the check and stamps nothing.
+
+    The fence is check-then-act (read the stamp, then rename), so it
+    closes only once the successor's FIRST save lands: a zombie at
+    epoch N racing a successor (epoch N+1) that has acquired but not
+    yet saved still reads stamp <= N and lands one stale checkpoint.
+    The successor's save then overwrites it, bounding the damage to at
+    most one stale pass — but a crash inside that window resumes from
+    the zombie's state, and anything the zombie published during that
+    pass was double-scanned (DESIGN.md §23 failure matrix)."""
     os.makedirs(directory, exist_ok=True)
     if lease_epoch is not None:
         try:

@@ -26,11 +26,17 @@ Two store backends, one contract (``read`` → (lease, token), ``write``
 
 - `FileLeaseStore`: JSON records under a reserved ``_kta_leases/``
   subdirectory of the checkpoint dir (the ``_kta_history`` precedent),
-  written tmp-file → ``os.replace``.  Atomic rename has no CAS, so
-  writes take a short O_EXCL lock file (stale locks older than its
-  hold bound are broken) and then VERIFY by reading the record back —
-  a mismatch means a racer overwrote us between replace and read-back
-  and the write reports a lost race, never a silent double-grant.
+  written tmp-file → ``os.replace``.  Atomic rename has no native CAS,
+  so one is built: the token is the record body the caller READ, and
+  the write — inside a short O_EXCL lock file (stale locks older than
+  its hold bound are broken) — re-reads the current record and refuses
+  unless it still matches that token (None = expect absent).  Without
+  the compare, two instances that both read "absent/expired" would
+  serialize through the lock and BOTH be granted the same epoch — a
+  split-brain the checkpoint fence cannot catch, since it only rejects
+  OLDER epochs.  A read-back verify after the replace additionally
+  catches a racer that bypassed or broke the lock; either way a lost
+  race reports as None, never as a silent double-grant.
 - `ObjectLeaseStore`: ETag-fenced conditional writes through
   `io/objstore.RetryingHttp.put_conditional` (``If-Match`` to replace
   the exact version read, ``If-None-Match: *`` to create).  A PUT
@@ -124,10 +130,14 @@ def _safe_name(topic: str) -> str:
 class FileLeaseStore:
     """Lease records as JSON files under ``{directory}/_kta_leases/``.
 
-    The write path is lock → tmp → ``os.replace`` → read-back verify:
-    the O_EXCL lock serializes well-behaved writers, and the read-back
-    catches a racer that broke or ignored the lock — either way a lost
-    race reports as None, never as a silent double-grant.
+    The write path is lock → compare → tmp → ``os.replace`` →
+    read-back verify.  The token is the raw record body the caller
+    read (None = expect absent): inside the O_EXCL lock the current
+    record is re-read and a mismatch fails the CAS — this is what
+    stops two lock-serialized writers that both read "absent/expired"
+    from each being granted the same epoch.  The read-back after the
+    replace catches a racer that broke or ignored the lock — either
+    way a lost race reports as None, never as a silent double-grant.
     ``verify_hook`` is a test seam invoked between the replace and the
     read-back, where an injected competing write must be detected.
     """
@@ -155,23 +165,30 @@ class FileLeaseStore:
                 data = f.read()
         except FileNotFoundError:
             return None, None
+        # The token is the exact body read — surrogateescape so even a
+        # non-UTF-8 corrupt record round-trips byte-faithfully into the
+        # CAS comparison.
+        token = data.decode("utf-8", "surrogateescape")
         try:
-            return Lease.from_json(data), "file"
+            return Lease.from_json(data), token
         except (ValueError, KeyError):
             # A truncated/corrupt record cannot arbitrate ownership;
-            # treat it as absent (the next write re-creates it — with
-            # epoch 1, which is the honest floor when history is gone).
+            # treat it as absent, but KEEP the token: a None token means
+            # "expect absent" and the CAS would refuse the overwrite
+            # forever.  With the wreck's own bytes as the token the next
+            # write replaces it — at epoch 1, the honest floor when
+            # history is gone.
             log.warning("lease: unreadable record for %r; treating as absent",
                         topic)
-            return None, None
+            return None, token
 
     def write(
         self, topic: str, lease: Lease, token: "Optional[str]"
     ) -> "Optional[str]":
-        """Atomic-rename write with read-back verify; returns a token on
-        success, None when a competing writer won the race.  ``token``
-        is unused here (rename has no If-Match); the read-back IS the
-        compare step."""
+        """Compare-and-swap under the lock: ``token`` is the record
+        body the caller read (None = expect absent).  Returns the new
+        token on success, None when the CAS failed or a competing
+        writer won the race."""
         path = self._path(topic)
         lock = path + ".lock"
         try:
@@ -197,6 +214,19 @@ class FileLeaseStore:
             except OSError:
                 return None
         try:
+            # The compare step: the record must still be exactly what
+            # the caller saw when it DECIDED on this write.  A racer
+            # that wrote since — even one that politely waited its turn
+            # on the lock — fails the CAS here, so two instances that
+            # both read "absent/expired" can never both be granted the
+            # same epoch.
+            try:
+                with open(path, "rb") as f:
+                    current = f.read().decode("utf-8", "surrogateescape")
+            except FileNotFoundError:
+                current = None
+            if current != token:
+                return None  # the state the caller decided on is gone
             body = lease.to_json()
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
@@ -208,8 +238,8 @@ class FileLeaseStore:
                 self.verify_hook(topic)
             with open(path, "rb") as f:
                 if f.read() != body:
-                    return None  # a racer overwrote us: lost race
-            return "file"
+                    return None  # a lock-bypassing racer overwrote us
+            return body.decode("utf-8")
         finally:
             try:
                 os.unlink(lock)
@@ -266,9 +296,13 @@ class ObjectLeaseStore:
         try:
             return Lease.from_json(body), etag
         except (ValueError, KeyError):
+            # Surface the wreck's ETag: a None token would make the next
+            # write an If-None-Match create that 412s against the object
+            # forever — the topic would be permanently unacquirable.
+            # With the ETag the next write If-Match-overwrites it.
             log.warning("lease: unreadable record for %r; treating as absent",
                         topic)
-            return None, None
+            return None, etag
 
     def write(
         self, topic: str, lease: Lease, token: "Optional[str]"

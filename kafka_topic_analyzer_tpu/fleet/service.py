@@ -212,6 +212,11 @@ class FleetService:
         #: must cover.
         self._killed = False
         self._pause = threading.Event()
+        #: True exactly while the follow loop is frozen at the
+        #: post-renew pause gate — the observable the chaos tests wait
+        #: on (a polls-are-static heuristic cannot tell "at the gate"
+        #: from "mid-pass on a slow broker").
+        self.paused = False
         self.polls = 0
         self._t0 = clock()
         self._last_ckpt = clock()
@@ -604,9 +609,16 @@ class FleetService:
             lag += max(0, end - scan.cursor.get(p, start_w.get(p, 0)))
         scan.lag = lag
         scan.status.lag = lag
+        # EVERY instance polls EVERY topic (polling is how lag is
+        # discovered before acquiring), but the lag gauge merges by sum
+        # across the fleet — so only the lease holder reports a topic's
+        # lag; everyone else pins 0, or a federated scrape over-counts
+        # cluster lag ~N-fold.  The returned lag stays real either way:
+        # admission needs it to decide WHETHER to acquire.
+        held = self.leases is None or self.leases.is_held(scan.seed.name)
         obs_metrics.FLEET_TOPIC_LAG.labels(
             topic=scan.seed.name, instance=self.instance
-        ).set(lag)
+        ).set(lag if held else 0)
         return lag
 
     def run_follow(self) -> FleetResult:
@@ -652,7 +664,9 @@ class FleetService:
             # fenced meanwhile — the window the checkpoint-epoch check
             # must cover (tests/test_lease.py's zombie proof).
             while self._pause.is_set() and not self._stop.is_set():
+                self.paused = True
                 time.sleep(0.005)
+            self.paused = False
             if self._killed:
                 # Crash semantics: not one more admission, pass, or lease
                 # decision after kill() — leases dangle exactly as a

@@ -405,7 +405,9 @@ FLEET_TOPIC_LAG = _REG.gauge(
     "watermarks (the per-topic twin of kta_follow_lag_records; admission "
     "weight input)",
     labelnames=("topic", "instance"),
-    # Topics are disjoint across fleet instances: fleet-wide lag sums.
+    # Every instance POLLS every topic, but only the lease holder
+    # reports its lag (non-holders pin 0 — fleet/service._poll_topic),
+    # so the fleet-wide sum counts each topic's lag exactly once.
     merge="sum")
 FLEET_REBALANCES = _REG.counter(
     "kta_fleet_rebalances_total",

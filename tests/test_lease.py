@@ -308,11 +308,71 @@ def test_file_store_lock_contention(tmp_path):
     assert not os.path.exists(lock)
 
 
-def test_file_store_corrupt_record_reads_absent(tmp_path):
+def test_file_store_corrupt_record_carries_a_cas_token(tmp_path):
+    """A corrupt record reads as absent but its token still names the
+    bytes on disk: a write with that token overwrites the wreck, while
+    an expect-absent write (token None) fails the CAS — the topic never
+    becomes permanently unacquirable."""
     store = FileLeaseStore(str(tmp_path))
     with open(store._path("t"), "wb") as f:
         f.write(b"{not json")
-    assert store.read("t") == (None, None)
+    got, token = store.read("t")
+    assert got is None and token is not None
+    lease = Lease(
+        topic="t", owner="A", epoch=1, expires_at=10.0, acquired_at=0.0
+    )
+    assert store.write("t", lease, None) is None  # expect-absent: refused
+    assert store.write("t", lease, token) is not None
+    rec, _ = store.read("t")
+    assert rec == lease
+
+
+def test_file_store_concurrent_acquire_grants_exactly_one(tmp_path):
+    """The read->decide->write race the CAS exists for: two instances
+    both read the SAME absent record (the barrier forces the
+    interleaving) and then write — serialized through the lock or not,
+    exactly ONE may be granted epoch 1.  Without the in-lock compare
+    both writes would succeed and two owners would hold the same epoch,
+    a split-brain the checkpoint fence cannot catch."""
+    barrier = threading.Barrier(2)
+
+    class BarrierStore(FileLeaseStore):
+        def read(self, topic):
+            out = super().read(topic)
+            barrier.wait(timeout=10)
+            return out
+
+    clock = _Clock()
+    mgrs = {
+        name: LeaseManager(
+            BarrierStore(str(tmp_path)), name, ttl_s=30.0, clock=clock
+        )
+        for name in ("A", "B")
+    }
+    got = {}
+
+    def race(name):
+        try:
+            got[name] = mgrs[name].acquire("t")
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            got[name] = e
+
+    threads = [
+        threading.Thread(target=race, args=(n,)) for n in mgrs
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not any(isinstance(v, BaseException) for v in got.values()), got
+    grants = {n for n, e in got.items() if e is not None}
+    assert len(grants) == 1, f"double grant: {got}"
+    winner = grants.pop()
+    loser = ({"A", "B"} - {winner}).pop()
+    assert got[winner] == 1
+    assert mgrs[winner].is_held("t") and not mgrs[loser].is_held("t")
+    rec, _ = FileLeaseStore(str(tmp_path)).read("t")
+    assert rec.owner == winner and rec.epoch == 1
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +469,23 @@ def test_object_store_transient_5xx_retried():
         )
         assert store.write("t", lease, None) is not None
         assert server.puts["_kta_leases/t.json"] == 2
+
+
+def test_object_store_corrupt_record_is_recoverable():
+    """A corrupt lease object reads as absent but keeps its ETag as the
+    token, so the next acquire If-Match-overwrites the wreck instead of
+    If-None-Match-creating against it (a 412 loop that would leave the
+    topic permanently unacquirable)."""
+    with FakeObjectStore({}) as server:
+        store = _obj_store(server)
+        server.root["_kta_leases/t.json"] = b"{not json"
+        got, token = store.read("t")
+        assert got is None and token is not None  # the wreck's ETag
+        clock = _Clock()
+        mgr = LeaseManager(store, "A", ttl_s=30.0, clock=clock)
+        assert mgr.acquire("t") == 1  # epoch restarts: history is gone
+        rec, _ = store.read("t")
+        assert rec is not None and rec.owner == "A" and rec.epoch == 1
 
 
 def test_object_store_clock_skew_expires_lease_early():
@@ -649,6 +726,47 @@ def test_instance_id_without_fleet_is_rejected(capsys):
 
 
 # ---------------------------------------------------------------------------
+# federation: only the lease holder reports a topic's lag
+
+
+def test_topic_lag_gauge_only_counts_the_holder(tmp_path):
+    """Every instance polls every topic (that is how lag is discovered
+    before acquiring), but kta_fleet_topic_lag_records merges by SUM
+    across the fleet — a non-holder must pin 0 or a federated scrape
+    counts each topic's lag once per instance.  The returned lag stays
+    real either way: admission needs it to decide whether to acquire."""
+    topic = "lease.lag"
+    records = {topic: _topic_records(1, 24)}
+    with _mk_broker(records) as broker:
+        store = FileLeaseStore(str(tmp_path))
+        clock = _Clock()
+        mgr_b = LeaseManager(store, "B", ttl_s=60.0, clock=clock)
+        assert mgr_b.acquire(topic) == 1
+        mgr_a = LeaseManager(store, "A", ttl_s=60.0, clock=clock)
+        svc = _fleet_service(
+            broker, topics=[topic], leases=mgr_a, instance="A"
+        )
+        scan = svc.scans[topic]
+        lag = svc._poll_topic(scan)
+        assert lag == N_PARTS * 24  # the poll still measures real lag
+        assert (
+            obs_metrics.FLEET_TOPIC_LAG.labels(
+                topic=topic, instance="A"
+            ).value
+            == 0
+        )  # ... but B owns the topic, so A's gauge reports none of it
+        mgr_b.release(topic)
+        assert mgr_a.acquire(topic) == 2
+        svc._poll_topic(scan)
+        assert (
+            obs_metrics.FLEET_TOPIC_LAG.labels(
+                topic=topic, instance="A"
+            ).value
+            == lag
+        )
+
+
+# ---------------------------------------------------------------------------
 # two-instance chaos: crash failover, byte-identical resumed rollup
 
 
@@ -757,84 +875,105 @@ def test_two_instance_crash_failover_byte_identity(tmp_path):
 
 
 def test_paused_zombie_is_fenced_at_the_checkpoint(tmp_path):
+    """The zombie proof, built on a deterministic freeze.  With
+    max_concurrent=1 the lease gate acquires BOTH ready topics but
+    admission runs only the heavier one — the backlogged topic's lease
+    is held with NO pass in flight, so nothing (in particular not the
+    caught-up release at the end of a pass) can strip it before
+    pause() freezes the loop at the post-renew gate.  The lease then
+    expires mid-freeze, a successor scans the topic and stamps its
+    checkpoint with the newer epoch, and on unpause the zombie — whose
+    local view still says held-at-epoch-1, and which therefore skips
+    the acquire that would have revealed the successor — admits the
+    topic and runs a pass whose checkpoint write MUST be refused with
+    the named error: status "fenced" (not "failed"), the loss booked
+    under the zombie's label, the successor's state untouched."""
     snap = str(tmp_path / "snaps")
     clock = _Clock()
     follow = FollowConfig(**dict(FAST_FOLLOW, checkpoint_every_s=0.0))
-    topic = "lease.z"
-    phase1 = {topic: _topic_records(7, PHASE1_N)}
+    big, zombie = "lease.big", "lease.z"
+    records = {
+        # More lag on `big`: admission (heaviest-first, one slot) runs
+        # it and leaves `zombie` backlogged — lease held, no pass.
+        big: _topic_records(3, FULL_N),
+        zombie: _topic_records(7, PHASE1_N),
+    }
+    # The response delay stretches big's pass so the pause lands well
+    # before the next poll's gate.
     with _mk_broker(
-        phase1, response_delay=lambda *_: 0.05
+        records, response_delay=lambda *_: 0.05
     ) as broker:
         store = FileLeaseStore(snap)
         mgr_a = LeaseManager(store, "A", ttl_s=5.0, clock=clock)
         svc = _fleet_service(
-            broker, topics=[topic], leases=mgr_a, instance="A",
-            follow=follow, snapshot_dir=snap,
+            broker, topics=[big, zombie], leases=mgr_a, instance="A",
+            follow=follow, snapshot_dir=snap, max_concurrent=1,
         )
         out = {}
         th = threading.Thread(
             target=lambda: out.setdefault("fr", svc.run_follow())
         )
         th.start()
-        _wait_for(
-            lambda: mgr_a.is_held(topic), what="A to acquire the lease"
-        )
-        svc.pause()
-        # New records land while A's first pass is still running, so the
-        # pass ends NOT caught up, the lease is kept, and the loop
-        # freezes at the post-renew pause gate still holding it.
-        for p, recs in _topic_records(7, PHASE2_N, lo=PHASE1_N).items():
-            broker.produce(p, recs, topic=topic)
-
-        def frozen():
-            polls = svc.polls
-            time.sleep(0.08)
-            return svc.polls == polls and mgr_a.is_held(topic)
-
-        _wait_for(frozen, what="A frozen at the gate holding its lease")
-        broker.response_delay = None
-
-        # The zombie window: A's lease expires while it is stalled; a
-        # successor takes over, resumes A's checkpoint, and commits its
-        # own — stamped with the NEWER epoch.
-        clock.advance(5.0 + 1.0)
-        mgr_b = LeaseManager(store, "B", ttl_s=60.0, clock=clock)
-        assert mgr_b.acquire(topic) == 2
-        src_b = _source(broker, topic)
-        res_b = run_scan(
-            topic, src_b, TpuBackend(_cfg(), init_now_s=10**10), 64,
-            snapshot_dir=topic_snapshot_dir(snap, topic),
-            resume=True, final_snapshot=True, lease_epoch=2,
-        )
-        src_b.close()
-        assert res_b.metrics.overall_count == N_PARTS * FULL_N
-
-        # More records, then the zombie wakes up and runs a pass on its
-        # stale epoch-1 lease: the checkpoint write MUST be refused with
-        # the named error, the topic goes "fenced" (not "failed"), and
-        # the loss is booked under A's label.
         loss0 = _losses("A")
-        for p, recs in _topic_records(7, 24, lo=FULL_N).items():
-            broker.produce(p, recs, topic=topic)
-        svc.unpause()
-        _wait_for(
-            lambda: svc.scans[topic].status.status == "fenced",
-            what="the zombie's pass to be fenced",
-        )
-        svc.request_stop("test")
-        th.join(timeout=60)
+        try:
+            _wait_for(
+                lambda: mgr_a.is_held(zombie),
+                what="A to hold the backlogged lease",
+            )
+            svc.pause()
+            # `svc.paused` is the gate's own observable: a polls-are-
+            # static heuristic cannot tell "frozen at the gate" from
+            # "mid-pass on the slow broker", and only at the gate is
+            # the held-lease state guaranteed stable.
+            _wait_for(
+                lambda: svc.paused and mgr_a.is_held(zombie),
+                what="A frozen at the gate holding the backlogged lease",
+            )
+            broker.response_delay = None
+
+            # The zombie window: A's lease expires while it is stalled;
+            # a successor takes over and commits its own checkpoint —
+            # stamped with the NEWER epoch.
+            clock.advance(5.0 + 1.0)
+            mgr_b = LeaseManager(store, "B", ttl_s=60.0, clock=clock)
+            assert mgr_b.acquire(zombie) == 2
+            src_b = _source(broker, zombie)
+            res_b = run_scan(
+                zombie, src_b, TpuBackend(_cfg(), init_now_s=10**10), 64,
+                snapshot_dir=topic_snapshot_dir(snap, zombie),
+                final_snapshot=True, lease_epoch=2,
+            )
+            src_b.close()
+            assert res_b.metrics.overall_count == N_PARTS * PHASE1_N
+
+            # The zombie wakes up and admits the topic on its stale
+            # epoch-1 view (the lag that makes it ready was measured
+            # before the freeze): the checkpoint write MUST be refused.
+            svc.unpause()
+            _wait_for(
+                lambda: svc.scans[zombie].status.status == "fenced",
+                what="the zombie's pass to be fenced",
+            )
+        finally:
+            # A failed wait above must not strand the (non-daemon)
+            # follow thread at the pause gate — pytest would hang at
+            # interpreter exit instead of reporting the failure.
+            svc.unpause()
+            svc.request_stop("test")
+            th.join(timeout=60)
+        assert not th.is_alive()
     fr = out["fr"]
     assert svc._stop_reason == "test"  # fenced is NOT all-failed
-    assert fr.statuses[topic].status == "fenced"
-    assert "STALE-LEASE-EPOCH" in fr.statuses[topic].error
+    assert fr.statuses[zombie].status == "fenced"
+    assert "STALE-LEASE-EPOCH" in fr.statuses[zombie].error
     assert _losses("A") - loss0 == 1
-    assert not mgr_a.is_held(topic)
+    assert not mgr_a.is_held(zombie)
     # B's checkpoint survived the zombie untouched.
-    info = snapshot_info(topic_snapshot_dir(snap, topic))
+    info = snapshot_info(topic_snapshot_dir(snap, zombie))
     assert info["lease_epoch"] == 2
-    assert info["records_seen"] == N_PARTS * FULL_N
+    assert info["records_seen"] == N_PARTS * PHASE1_N
     # The store record is still B's.
-    rec, _ = store.read(topic)
+    rec, _ = store.read(zombie)
     assert rec.owner == "B" and rec.epoch == 2
 
 
