@@ -226,6 +226,8 @@ def save_snapshot(
     degraded: "Optional[Dict[int, str]]" = None,
     corrupt: "Optional[list]" = None,
     lease_epoch: "Optional[int]" = None,
+    lost: "Optional[list]" = None,
+    partition_meta: "Optional[Dict[int, dict]]" = None,
 ) -> str:
     """Atomically write the snapshot; returns its path.
 
@@ -245,6 +247,20 @@ def save_snapshot(
     (`load_corrupt_spans`) so re-walking an already-skipped span — the
     offset tracker cannot advance past a span that yielded no records —
     neither re-counts nor double-quarantines it.
+
+    ``lost``: the span list of offset ranges the log mutated away from the
+    scan (KafkaWireSource.lost_spans format — retention races, truncation,
+    resume-below-log-start).  Like ``corrupt``, NOT merely informational: a
+    --resume seeds the source with it (`load_lost_spans`) so the logical
+    scan's final report still names the loss, without re-booking it.
+
+    ``partition_meta``: per-partition durable-fencing facts
+    ({partition: {leader_epoch, log_start_offset}},
+    KafkaWireSource.partition_meta format).  Resume validates the saved
+    cursor against the live log with these (`load_partition_meta` →
+    validate_resume): a cursor below the live log start is a named
+    retention loss BEFORE the first fetch, and an epoch that moved since
+    the save triggers the OffsetForLeaderEpoch divergence check.
 
     ``lease_epoch``: the writer's topic-ownership lease epoch under a
     multi-instance fleet (fleet/lease.py).  The save is FENCED at write
@@ -295,6 +311,16 @@ def save_snapshot(
         meta["degraded"] = {str(k): str(v) for k, v in degraded.items()}
     if corrupt:
         meta["corrupt_spans"] = list(corrupt)
+    if lost:
+        meta["lost_spans"] = list(lost)
+    if partition_meta:
+        meta["partition_meta"] = {
+            str(k): {
+                "leader_epoch": int(v.get("leader_epoch", -1)),
+                "log_start_offset": int(v.get("log_start_offset", -1)),
+            }
+            for k, v in partition_meta.items()
+        }
     if lease_epoch is not None:
         meta["lease_epoch"] = int(lease_epoch)
     if scope is not None:
@@ -553,3 +579,33 @@ def load_corrupt_spans(directory: str, scope=None) -> list:
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
     return list(meta.get("corrupt_spans", []))
+
+
+def load_lost_spans(directory: str, scope=None) -> list:
+    """The ``lost_spans`` metadata of a snapshot, or [] when the snapshot
+    (or the list) is absent — same split-from-`load_snapshot` rationale as
+    `load_corrupt_spans`."""
+    path = _snapshot_path(directory, scope)
+    if not os.path.exists(path):
+        return []
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+    return list(meta.get("lost_spans", []))
+
+
+def load_partition_meta(directory: str, scope=None) -> "Dict[int, dict]":
+    """The ``partition_meta`` durable-fencing map of a snapshot
+    ({partition: {leader_epoch, log_start_offset}}), or {} when the
+    snapshot (or the map) is absent."""
+    path = _snapshot_path(directory, scope)
+    if not os.path.exists(path):
+        return {}
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+    return {
+        int(k): {
+            "leader_epoch": int(v.get("leader_epoch", -1)),
+            "log_start_offset": int(v.get("log_start_offset", -1)),
+        }
+        for k, v in meta.get("partition_meta", {}).items()
+    }

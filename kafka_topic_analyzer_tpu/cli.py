@@ -32,10 +32,21 @@ EXIT_DEGRADED = 3
 #: occur).
 EXIT_CORRUPT = 4
 
+#: Exit code when a scan ABORTED because the log mutated out from under it
+#: (retention race, truncation after an unclean election, resume below
+#: log-start) under ``--on-data-loss=fail``: the loss is fully booked and a
+#: fold-consistent checkpoint is written before the abort, so a --resume
+#: continues past the named gap.  Under the default ``report`` policy the
+#: scan finishes with exit 0 and the DATA-LOSS block names the loss;
+#: ``ignore`` finishes with exit 0 and no block (metrics/JSON still carry
+#: it) — loss is always accounted, the policy only picks the reaction.
+EXIT_DATA_LOSS = 5
 
-def _scan_issue_exit(result, doc=None, render=False) -> int:
-    """Shared tail of every report path: surface corrupt and degraded
-    partitions — into ``doc`` as str-keyed maps (``--json``; the one
+
+def _scan_issue_exit(result, doc=None, render=False,
+                     data_loss_policy: str = "report") -> int:
+    """Shared tail of every report path: surface corrupt, degraded, and
+    lost partitions — into ``doc`` as str-keyed maps (``--json``; the one
     block builder report.attach_issue_blocks) and/or as the post-table
     warning blocks (``render``) — and pick the exit code."""
     rc = 0
@@ -44,6 +55,11 @@ def _scan_issue_exit(result, doc=None, render=False) -> int:
         from kafka_topic_analyzer_tpu.report import attach_issue_blocks
 
         attach_issue_blocks(doc, result)
+    lost = getattr(result, "lost_partitions", None) or {}
+    if lost and render and data_loss_policy != "ignore":
+        from kafka_topic_analyzer_tpu.report import render_data_loss_block
+
+        sys.stdout.write(render_data_loss_block(lost))
     if corrupt:
         if render:
             from kafka_topic_analyzer_tpu.report import render_corrupt_block
@@ -403,6 +419,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quarantine-dir", metavar="DIR",
                    help="Directory for quarantined corrupt frames "
                         "(requires --on-corruption=quarantine)")
+    p.add_argument("--on-data-loss", choices=["fail", "report", "ignore"],
+                   default="report", metavar="POLICY",
+                   help="What to do when the log mutates out from under "
+                        "the scan (retention races past the cursor, "
+                        "truncation after an unclean leader election, "
+                        "resume below the live log start): 'fail' aborts "
+                        "with a fold-consistent checkpoint and exit code "
+                        f"{EXIT_DATA_LOSS}, 'report' (default) finishes "
+                        "with exit 0 and a DATA-LOSS block naming every "
+                        "lost range, 'ignore' finishes with exit 0 and no "
+                        "block. The loss is ALWAYS booked to metrics and "
+                        "the --json data_loss map regardless of policy")
     p.add_argument("--quiet", action="store_true", help="No progress spinner")
     return p
 
@@ -516,7 +544,10 @@ def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object
     # kafka
     if not args.bootstrap_server:
         raise SystemExit("--source kafka requires -b/--bootstrap-server")
-    from kafka_topic_analyzer_tpu.config import CorruptionConfig
+    from kafka_topic_analyzer_tpu.config import (
+        CorruptionConfig,
+        DataLossConfig,
+    )
     from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
 
     overrides = parse_kv_pairs(args.librdkafka)
@@ -534,14 +565,18 @@ def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object
             policy=getattr(args, "on_corruption", "fail"),
             quarantine_dir=getattr(args, "quarantine_dir", None),
         )
+    data_loss = None
+    if getattr(args, "on_data_loss", "report") != "report":
+        data_loss = DataLossConfig(policy=args.on_data_loss)
     return KafkaWireSource(
         bootstrap_servers=args.bootstrap_server,
         topic=topic,
         overrides=overrides,
         use_native_hashing=args.native != "off",
-        # None lets an --librdkafka on.corruption/quarantine.dir override
-        # apply; explicit flags win.
+        # None lets an --librdkafka on.corruption/quarantine.dir (or
+        # on.data.loss) override apply; explicit flags win.
         corruption=corruption,
+        data_loss=data_loss,
     )
 
 
@@ -910,7 +945,10 @@ def run_multi_topic(args, topics: "list[str]") -> int:
         )
         print(f"Message size quantiles (union): {qs}")
     print(eq)
-    return _scan_issue_exit(result, render=True)
+    return _scan_issue_exit(
+        result, render=True,
+        data_loss_policy=getattr(args, "on_data_loss", "report"),
+    )
 
 
 def _fleet_exit(fleet_result) -> int:
@@ -923,6 +961,8 @@ def _fleet_exit(fleet_result) -> int:
         return EXIT_DEGRADED
     if fleet_result.any_corrupt:
         return EXIT_CORRUPT
+    if getattr(fleet_result, "any_data_loss", False):
+        return EXIT_DATA_LOSS
     return 0
 
 
@@ -1321,6 +1361,15 @@ def main(argv: "list[str] | None" = None) -> int:
         # traceback (the reference panics here; we can do better).  Other
         # exception types — including internal ValueErrors — keep their
         # tracebacks so bugs stay diagnosable.
+        from kafka_topic_analyzer_tpu.io.kafka_wire import DataLossError
+
+        if isinstance(e, DataLossError):
+            # --on-data-loss=fail abort: the loss is booked and a
+            # fold-consistent checkpoint was written on the way out, so
+            # the distinct exit code tells automation a --resume will
+            # continue past the NAMED gap (not a hard failure).
+            print(f"error: DATA-LOSS: {e}", file=sys.stderr)
+            return EXIT_DATA_LOSS
         print(f"error: {e}", file=sys.stderr)
         return 1
     except UserInputError as e:
@@ -1534,7 +1583,10 @@ def _run(args) -> int:
         from kafka_topic_analyzer_tpu.report import render_extremes_table
 
         sys.stdout.write(render_extremes_table(result.metrics))
-    return _scan_issue_exit(result, render=True)
+    return _scan_issue_exit(
+        result, render=True,
+        data_loss_policy=getattr(args, "on_data_loss", "report"),
+    )
 
 
 if __name__ == "__main__":

@@ -526,6 +526,47 @@ class CorruptionConfig:
             )
 
 
+#: Valid --on-data-loss policies, in escalation order.
+DATA_LOSS_POLICIES = ("fail", "report", "ignore")
+
+
+@dataclasses.dataclass(frozen=True)
+class DataLossConfig:
+    """Log-mutation policy for the live Kafka scan (io/kafka_wire.py):
+    what to do when the log MOVES under the scanner and records in
+    ``[cursor, new_log_start)`` are unreachable (retention race), or a
+    leader-epoch divergence proves the log was truncated below the
+    cursor (unclean election).
+
+    Like `CorruptionConfig`, deliberately NOT part of `AnalyzerConfig`:
+    the reaction policy changes neither state shapes nor fold semantics,
+    so it must not churn the checkpoint fingerprint.  Whatever the
+    policy, every lost record is BOOKED (kta_log_lost_*) and spanned —
+    the policy only governs whether the scan continues and how the exit
+    code reflects the loss:
+
+    - ``fail``: abort the scan with the classified error; the engine's
+      failure path still writes a fold-consistent checkpoint, so a
+      resume continues from committed state;
+    - ``report``: keep scanning the surviving records, surface the loss
+      as a DATA-LOSS report block / ``data_loss`` JSON map, exit
+      `cli.EXIT_DATA_LOSS` (the default — a long-running follow service
+      must not die to ordinary retention);
+    - ``ignore``: keep scanning and exit 0 — for logs where retention
+      churn is expected; the metrics and report blocks still name the
+      loss (never-silent is not policy-dependent).
+    """
+
+    policy: str = "report"
+
+    def __post_init__(self) -> None:
+        if self.policy not in DATA_LOSS_POLICIES:
+            raise ValueError(
+                f"on-data-loss policy {self.policy!r} invalid "
+                f"({', '.join(DATA_LOSS_POLICIES)})"
+            )
+
+
 @dataclasses.dataclass(frozen=True)
 class AnalyzerConfig:
     """Static configuration for one analysis run.

@@ -80,6 +80,18 @@ class ScanResult:
     corrupt_partitions: "dict[int, dict]" = dataclasses.field(
         default_factory=dict
     )
+    #: partition -> {"records", "ranges", "reasons", "authoritative",
+    #: "spans"} for offset ranges the log mutated out from under the scan
+    #: (retention races, truncation after unclean election,
+    #: resume-below-log-start; KafkaWireSource.loss_stats format,
+    #: cumulative across a --resume chain like corrupt_partitions).
+    #: Non-empty means the metrics describe the SURVIVING records only:
+    #: the report renders a DATA-LOSS block, and ``authoritative: False``
+    #: (truncation) additionally means already-folded records were
+    #: replaced under the scan.
+    lost_partitions: "dict[int, dict]" = dataclasses.field(
+        default_factory=dict
+    )
     #: Registry snapshot taken at scan end (obs.registry format) — under
     #: multi-controller, the cluster-wide merge of every process's
     #: registry, so the report process can render fleet totals
@@ -357,6 +369,29 @@ def run_scan(
                 spans = load_corrupt_spans(snapshot_dir, scope=snap_scope)
                 if spans:
                     source.seed_corrupt_spans(spans)
+            if hasattr(source, "seed_lost_spans"):
+                from kafka_topic_analyzer_tpu.checkpoint import (
+                    load_lost_spans,
+                    load_partition_meta,
+                )
+
+                # Loss a previous run already booked: seed the source so
+                # the logical scan's final report names it without
+                # re-booking (metrics counted it when it happened).
+                lspans = load_lost_spans(snapshot_dir, scope=snap_scope)
+                if lspans:
+                    source.seed_lost_spans(lspans)
+                if hasattr(source, "validate_resume"):
+                    # Durable fencing: check each saved cursor against
+                    # the LIVE log before fetch #1 — a cursor below the
+                    # log start is a named retention loss (offsets
+                    # re-anchor in place), and an epoch that moved since
+                    # the save runs the divergence check.
+                    source.validate_resume(
+                        offsets,
+                        load_partition_meta(snapshot_dir, scope=snap_scope),
+                    )
+                    tracker.next_offsets.update(offsets)
     seq_base = seq  # resumed records predate t0; rate math excludes them
     last_snap = time.monotonic()
 
@@ -402,6 +437,16 @@ def run_scan(
                     else None
                 ),
                 lease_epoch=lease_epoch,
+                lost=(
+                    source.lost_spans()
+                    if hasattr(source, "lost_spans")
+                    else None
+                ),
+                partition_meta=(
+                    source.partition_meta()
+                    if hasattr(source, "partition_meta")
+                    else None
+                ),
             )
         obs_metrics.SNAPSHOTS_SAVED.inc()
         obs_events.emit(
@@ -980,6 +1025,11 @@ def run_scan(
         if hasattr(source, "corruption_stats")
         else {}
     )
+    lost = (
+        source.loss_stats()
+        if hasattr(source, "loss_stats")
+        else {}
+    )
     # Multi-controller: each process feeds (and can only degrade or observe
     # corruption on) its own rows, but process 0 renders the report and
     # orchestrators read every process's exit code — so "did the scan hit
@@ -1009,10 +1059,20 @@ def run_scan(
                 "note": "corrupt frame(s) on another process (see its log)",
             }
         }
-    if degraded or corrupt or final_snapshot:
+    if issue_elsewhere(bool(lost)):
+        lost = {
+            -1: {
+                "records": 0, "ranges": 0, "reasons": {},
+                "authoritative": True, "spans": [],
+                "note": "data loss on another process (see its log)",
+            }
+        }
+    if degraded or corrupt or lost or final_snapshot:
         # Degraded partitions carry an unscanned tail; corrupt ones carry
-        # skipped spans the offset tracker never saw.  Snapshot so a rerun
-        # resumes correctly (and, for corruption, re-seeds the skip list).
+        # skipped spans the offset tracker never saw; lost ones carry
+        # booked spans a resume must inherit.  Snapshot so a rerun
+        # resumes correctly (and, for corruption/loss, re-seeds the span
+        # lists).
         # ``final_snapshot`` forces the same commit for a clean drain —
         # the follow service's checkpoint/shutdown boundary.
         maybe_snapshot(force=True)
@@ -1049,6 +1109,9 @@ def run_scan(
             degraded=local_degraded,
             corrupt_frames=sum(
                 d.get("frames", 0) for p, d in corrupt.items() if p >= 0
+            ),
+            lost_records=sum(
+                d.get("records", 0) for p, d in lost.items() if p >= 0
             ),
         )
     # Close out the wire accounting before the registry gathers, so the
@@ -1089,6 +1152,7 @@ def run_scan(
         end_offsets=end_offsets,
         degraded_partitions=degraded,
         corrupt_partitions=corrupt,
+        lost_partitions=lost,
         telemetry=telemetry,
         ingest_workers=used_workers,
         ingest_workers_per_controller=workers_per_controller,

@@ -60,12 +60,17 @@ class TopicStatus:
 
     topic: str
     partitions: int = 0
-    #: pending | scanning | ok | empty | degraded | corrupt | failed
-    #: | fenced (lease lost to a successor — not a topic failure; the
-    #: topic scans on, under another instance's ownership)
+    #: pending | scanning | ok | empty | degraded | corrupt | data-loss
+    #: | failed | fenced (lease lost to a successor — not a topic
+    #: failure; the topic scans on, under another instance's ownership).
+    #: data-loss is set ONLY by an --on-data-loss=fail abort: a scan
+    #: that completes under the report policy keeps its ordinary status
+    #: (loss never changes the exit code outside the fail policy) and
+    #: carries the booked loss in `lost_records` instead.
     status: str = "pending"
     records: int = 0
     bytes: int = 0
+    lost_records: int = 0
     lag: int = 0
     verdict: str = ""
     workers: int = 0
@@ -78,6 +83,7 @@ class TopicStatus:
             "partitions": self.partitions,
             "records": self.records,
             "bytes": self.bytes,
+            "lost_records": self.lost_records,
             "lag": self.lag,
             "verdict": self.verdict,
             "workers": self.workers,
@@ -109,6 +115,10 @@ class FleetResult:
     @property
     def any_corrupt(self) -> bool:
         return any(s.status == "corrupt" for s in self.statuses.values())
+
+    @property
+    def any_data_loss(self) -> bool:
+        return any(s.status == "data-loss" for s in self.statuses.values())
 
 
 class _TopicScan:
@@ -354,6 +364,20 @@ class FleetService:
             log.warning("fleet: topic %r fenced: %s", topic, e)
             return False
         except BaseException as e:  # noqa: BLE001 — isolation boundary
+            from kafka_topic_analyzer_tpu.io.kafka_wire import DataLossError
+
+            if isinstance(e, DataLossError):
+                # --on-data-loss=fail abort: the loss is booked and the
+                # checkpoint fold-consistent — a NAMED stop, not a topic
+                # failure (the distinct status keeps _fleet_exit's
+                # EXIT_DATA_LOSS rung separate from the hard-failure 1).
+                scan.status.status = "data-loss"
+                scan.status.error = f"{type(e).__name__}: {e}"
+                log.warning(
+                    "fleet: scan of topic %r stopped on data loss: %s",
+                    topic, e,
+                )
+                return False
             scan.status.status = "failed"
             scan.status.error = f"{type(e).__name__}: {e}"
             log.exception("fleet: scan of topic %r failed", topic)
@@ -365,6 +389,11 @@ class FleetService:
         scan.status.passes += 1
         scan.status.records = result.metrics.overall_count
         scan.status.bytes = result.metrics.overall_size
+        scan.status.lost_records = sum(
+            d.get("records", 0)
+            for p, d in result.lost_partitions.items()
+            if p >= 0
+        )
         if result.degraded_partitions:
             scan.status.status = "degraded"
         elif result.corrupt_partitions:
@@ -437,6 +466,19 @@ class FleetService:
                     for t, s in self.scans.items()
                     if s.status.status == "failed"
                 ],
+                # Cumulative per-topic lost records (the lost-range
+                # rule's per-topic scopes): summed from each scan's
+                # result so one topic's retention race never fires the
+                # alert against its fleet-mates.
+                "topic_loss": {
+                    t: sum(
+                        d.get("records", 0)
+                        for p, d in s.result.lost_partitions.items()
+                        if p >= 0
+                    )
+                    for t, s in self.scans.items()
+                    if s.result is not None
+                },
             }
         )
 

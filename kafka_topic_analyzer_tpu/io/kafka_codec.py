@@ -35,6 +35,7 @@ API_LIST_OFFSETS = 2
 API_METADATA = 3
 API_SASL_HANDSHAKE = 17
 API_VERSIONS = 18
+API_OFFSET_FOR_LEADER_EPOCH = 23
 API_SASL_AUTHENTICATE = 36
 
 ERR_SASL_AUTHENTICATION_FAILED = 58
@@ -47,6 +48,11 @@ ERR_NONE = 0
 ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_UNKNOWN_TOPIC_OR_PARTITION = 3
 ERR_NOT_LEADER_FOR_PARTITION = 6
+#: KIP-320 fencing errors: the request's current_leader_epoch is older
+#: (74) or newer (75) than the leader's — the client must refresh
+#: metadata, and a *regression* means the log may have been truncated.
+ERR_FENCED_LEADER_EPOCH = 74
+ERR_UNKNOWN_LEADER_EPOCH = 75
 
 
 class KafkaProtocolError(RuntimeError):
@@ -406,6 +412,7 @@ _FLEXIBLE_FROM = {
     API_FETCH: 12,
     API_LIST_OFFSETS: 6,
     API_VERSIONS: 3,
+    API_OFFSET_FOR_LEADER_EPOCH: 4,
 }
 
 
@@ -720,31 +727,36 @@ def decode_list_offsets_request(
 
 
 def encode_list_offsets_response(
-    topic: str, results: List[Tuple[int, int, int, int]], version: int = 1
+    topic: str, results: List[Tuple[int, ...]], version: int = 1
 ) -> bytes:
-    """results: (partition, error, timestamp, offset)."""
+    """results: (partition, error, timestamp, offset[, leader_epoch])
+    — the epoch element is optional (and only carried by v4+ wires)."""
     w = ByteWriter()
     if version >= 6:
         w.i32(0)  # throttle_time_ms (v2+)
         w.compact_array_len(1).compact_string(topic)
         w.compact_array_len(len(results))
-        for pid, err, ts, off in results:
+        for item in results:
+            pid, err, ts, off = item[:4]
             w.i32(pid).i16(err).i64(ts).i64(off)
-            w.i32(-1)  # leader_epoch (v4+)
+            w.i32(item[4] if len(item) > 4 else -1)  # leader_epoch (v4+)
             w.tags()
         w.tags()
         w.tags()
         return w.done()
     w.i32(1).string(topic)
     w.i32(len(results))
-    for pid, err, ts, off in results:
+    for item in results:
+        pid, err, ts, off = item[:4]
         w.i32(pid).i16(err).i64(ts).i64(off)
     return w.done()
 
 
 def decode_list_offsets_response(
     r: ByteReader, version: int = 1
-) -> "dict[int, tuple[int, int]]":
+) -> "dict[int, tuple[int, int, int]]":
+    """{partition: (error, offset, leader_epoch)} — epoch -1 on wires
+    that do not carry it (classic v1)."""
     out = {}
     if version >= 6:
         r.i32()  # throttle_time_ms
@@ -755,9 +767,9 @@ def decode_list_offsets_response(
                 err = r.i16()
                 r.i64()  # timestamp
                 off = r.i64()
-                r.i32()  # leader_epoch
+                epoch = r.i32()  # leader_epoch (v4+)
                 r.skip_tags()
-                out[pid] = (err, off)
+                out[pid] = (err, off, epoch)
             r.skip_tags()
         r.skip_tags()
         return out
@@ -768,7 +780,7 @@ def decode_list_offsets_response(
             err = r.i16()
             r.i64()  # timestamp
             off = r.i64()
-            out[pid] = (err, off)
+            out[pid] = (err, off, -1)
     return out
 
 
@@ -778,13 +790,17 @@ def decode_list_offsets_response(
 
 def encode_fetch_request(
     topic: str,
-    partition_offsets: List[Tuple[int, int]],
+    partition_offsets: List[Tuple[int, ...]],
     max_wait_ms: int,
     min_bytes: int,
     max_bytes: int,
     partition_max_bytes: int,
     version: int = 4,
 ) -> bytes:
+    """``partition_offsets``: (partition, offset[, current_leader_epoch])
+    — the optional epoch (KIP-320 fencing) rides the v9+ wire only; the
+    classic v4 encoding has no epoch field, so fencing degrades to
+    unfenced fetches there."""
     w = ByteWriter()
     w.i32(-1)  # replica_id
     w.i32(max_wait_ms).i32(min_bytes).i32(max_bytes).i8(0)  # isolation: read_uncommitted
@@ -792,9 +808,11 @@ def encode_fetch_request(
         w.i32(0).i32(-1)  # session_id / session_epoch: sessionless (KIP-227)
         w.compact_array_len(1).compact_string(topic)
         w.compact_array_len(len(partition_offsets))
-        for pid, off in partition_offsets:
+        for item in partition_offsets:
+            pid, off = item[:2]
             w.i32(pid)
-            w.i32(-1)       # current_leader_epoch (v9+): unknown
+            # current_leader_epoch (v9+): the tracked epoch, or -1 unknown
+            w.i32(item[2] if len(item) > 2 else -1)
             w.i64(off)
             w.i32(-1)       # last_fetched_epoch (v12+): none
             w.i64(-1)       # log_start_offset (v5+): consumer
@@ -807,12 +825,16 @@ def encode_fetch_request(
         return w.done()
     w.i32(1).string(topic)
     w.i32(len(partition_offsets))
-    for pid, off in partition_offsets:
+    for item in partition_offsets:
+        pid, off = item[:2]
         w.i32(pid).i64(off).i32(partition_max_bytes)
     return w.done()
 
 
 def decode_fetch_request(r: ByteReader, version: int = 4):
+    """parts: (partition, offset, partition_max_bytes,
+    current_leader_epoch) — epoch -1 on classic wires (no field) and
+    from clients that do not track one (the fake broker validates it)."""
     r.i32()  # replica
     max_wait = r.i32()
     min_bytes = r.i32()
@@ -830,13 +852,13 @@ def decode_fetch_request(r: ByteReader, version: int = 4):
         parts = []
         for _ in range(r.compact_array_len()):
             pid = r.i32()
-            r.i32()  # current_leader_epoch
+            epoch = r.i32()  # current_leader_epoch (v9+)
             off = r.i64()
             r.i32()  # last_fetched_epoch
             r.i64()  # log_start_offset
             pmax = r.i32()
             r.skip_tags()
-            parts.append((pid, off, pmax))
+            parts.append((pid, off, pmax, epoch))
         r.skip_tags()  # topic
         for _ in range(r.compact_array_len()):  # forgotten topics
             r.compact_string()
@@ -857,14 +879,16 @@ def decode_fetch_request(r: ByteReader, version: int = 4):
         pid = r.i32()
         off = r.i64()
         pmax = r.i32()
-        parts.append((pid, off, pmax))
+        parts.append((pid, off, pmax, -1))
     return topic, parts, max_wait, min_bytes, max_bytes
 
 
 def encode_fetch_response(
-    topic: str, partitions: List[Tuple[int, int, int, bytes]], version: int = 4
+    topic: str, partitions: List[Tuple[int, ...]], version: int = 4
 ) -> bytes:
-    """partitions: (partition, error, high_watermark, record_set_bytes)."""
+    """partitions: (partition, error, high_watermark, record_set_bytes
+    [, log_start_offset]) — log_start rides the v5+ wire only (the
+    classic v4 encoding has no field for it)."""
     w = ByteWriter()
     w.i32(0)  # throttle_time_ms
     if version >= 12:
@@ -872,10 +896,11 @@ def encode_fetch_response(
         w.i32(0)  # session_id (v7+)
         w.compact_array_len(1).compact_string(topic)
         w.compact_array_len(len(partitions))
-        for pid, err, hw, records in partitions:
+        for item in partitions:
+            pid, err, hw, records = item[:4]
             w.i32(pid).i16(err).i64(hw)
             w.i64(hw)   # last_stable_offset (v4+)
-            w.i64(0)    # log_start_offset (v5+)
+            w.i64(item[4] if len(item) > 4 else 0)  # log_start_offset (v5+)
             w.compact_array_len(0)  # aborted_transactions
             w.i32(-1)   # preferred_read_replica (v11+)
             w.compact_bytes(records)
@@ -885,7 +910,8 @@ def encode_fetch_response(
         return w.done()
     w.i32(1).string(topic)
     w.i32(len(partitions))
-    for pid, err, hw, records in partitions:
+    for item in partitions:
+        pid, err, hw, records = item[:4]
         w.i32(pid).i16(err).i64(hw)
         w.i64(hw)  # last_stable_offset
         w.i32(0)   # aborted_transactions: empty
@@ -899,6 +925,10 @@ class FetchedPartition:
     error: int
     high_watermark: int
     records: bytes
+    #: Broker-reported first retained offset (v5+ wires; -1 when the wire
+    #: does not carry it) — the retention-race accounting compares it
+    #: against the cursor without an extra ListOffsets round trip.
+    log_start_offset: int = -1
 
 
 def decode_fetch_response(r: ByteReader, version: int = 4) -> List[FetchedPartition]:
@@ -916,7 +946,7 @@ def decode_fetch_response(r: ByteReader, version: int = 4) -> List[FetchedPartit
                 err = r.i16()
                 hw = r.i64()
                 r.i64()  # last_stable_offset
-                r.i64()  # log_start_offset
+                log_start = r.i64()  # log_start_offset (v5+)
                 for _ in range(r.compact_array_len()):  # aborted txns
                     r.i64()
                     r.i64()
@@ -926,7 +956,9 @@ def decode_fetch_response(r: ByteReader, version: int = 4) -> List[FetchedPartit
                 r.skip_tags()
                 out.append(
                     FetchedPartition(
-                        pid, err, hw, records if records is not None else b""
+                        pid, err, hw,
+                        records if records is not None else b"",
+                        log_start_offset=log_start,
                     )
                 )
             r.skip_tags()
@@ -1014,6 +1046,135 @@ def decode_api_versions_response(
         vmin = r.i16()
         vmax = r.i16()
         out[api_key] = (vmin, vmax)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# OffsetForLeaderEpoch v3 (classic) / v4 (flexible) — KIP-320's divergence
+# check: "what is the end offset of epoch E?"  The broker answers with the
+# end offset of the largest epoch <= E; an answer BELOW the client's cursor
+# means the log was truncated (unclean election) and everything from the
+# answer to the cursor no longer exists.
+
+
+def encode_offset_for_leader_epoch_request(
+    topic: str,
+    partitions: List[Tuple[int, int, int]],
+    version: int = 3,
+) -> bytes:
+    """partitions: (partition, current_leader_epoch, leader_epoch) — the
+    fencing epoch the client believes is current, and the epoch whose end
+    offset it asks for."""
+    w = ByteWriter()
+    if version >= 3:
+        w.i32(-1)  # replica_id (v3+): consumer
+    if version >= 4:
+        w.compact_array_len(1).compact_string(topic)
+        w.compact_array_len(len(partitions))
+        for pid, cur_epoch, epoch in partitions:
+            w.i32(pid).i32(cur_epoch).i32(epoch)
+            w.tags()
+        w.tags()  # topic
+        w.tags()  # request
+        return w.done()
+    w.i32(1).string(topic)
+    w.i32(len(partitions))
+    for pid, cur_epoch, epoch in partitions:
+        w.i32(pid)
+        w.i32(cur_epoch)  # current_leader_epoch (v2+)
+        w.i32(epoch)
+    return w.done()
+
+
+def decode_offset_for_leader_epoch_request(
+    r: ByteReader, version: int = 3
+) -> Tuple[str, List[Tuple[int, int, int]]]:
+    if version >= 3:
+        r.i32()  # replica_id
+    if version >= 4:
+        ntopics = r.compact_array_len()
+        if ntopics != 1:
+            raise KafkaProtocolError(
+                f"single-topic request invariant: got {ntopics} topics"
+            )
+        topic = r.compact_string() or ""
+        out = []
+        for _ in range(r.compact_array_len()):
+            pid = r.i32()
+            cur_epoch = r.i32()
+            epoch = r.i32()
+            r.skip_tags()
+            out.append((pid, cur_epoch, epoch))
+        r.skip_tags()
+        r.skip_tags()
+        return topic, out
+    ntopics = r.i32()
+    if ntopics != 1:
+        raise KafkaProtocolError(
+            f"single-topic request invariant: got {ntopics} topics"
+        )
+    topic = r.string() or ""
+    out = []
+    for _ in range(r.i32()):
+        pid = r.i32()
+        cur_epoch = r.i32()
+        epoch = r.i32()
+        out.append((pid, cur_epoch, epoch))
+    return topic, out
+
+
+def encode_offset_for_leader_epoch_response(
+    topic: str,
+    results: List[Tuple[int, int, int, int]],
+    version: int = 3,
+) -> bytes:
+    """results: (partition, error, leader_epoch, end_offset)."""
+    w = ByteWriter()
+    w.i32(0)  # throttle_time_ms (v2+)
+    if version >= 4:
+        w.compact_array_len(1).compact_string(topic)
+        w.compact_array_len(len(results))
+        for pid, err, epoch, end_off in results:
+            w.i16(err).i32(pid).i32(epoch).i64(end_off)
+            w.tags()
+        w.tags()
+        w.tags()
+        return w.done()
+    w.i32(1).string(topic)
+    w.i32(len(results))
+    for pid, err, epoch, end_off in results:
+        w.i16(err).i32(pid).i32(epoch).i64(end_off)
+    return w.done()
+
+
+def decode_offset_for_leader_epoch_response(
+    r: ByteReader, version: int = 3
+) -> "dict[int, tuple[int, int, int]]":
+    """{partition: (error, leader_epoch, end_offset)} — end_offset is the
+    first offset AFTER the requested epoch's last record (-1 on error)."""
+    r.i32()  # throttle_time_ms
+    out = {}
+    if version >= 4:
+        for _ in range(r.compact_array_len()):
+            r.compact_string()  # topic
+            for _ in range(r.compact_array_len()):
+                err = r.i16()
+                pid = r.i32()
+                epoch = r.i32()
+                end_off = r.i64()
+                r.skip_tags()
+                out[pid] = (err, epoch, end_off)
+            r.skip_tags()
+        r.skip_tags()
+        return out
+    for _ in range(r.i32()):
+        r.string()  # topic
+        for _ in range(r.i32()):
+            err = r.i16()
+            pid = r.i32()
+            epoch = r.i32()
+            end_off = r.i64()
+            out[pid] = (err, epoch, end_off)
     return out
 
 
@@ -1297,10 +1458,13 @@ def encode_record_batch(
     records: List[OffsetRecord],
     compression: int = COMPRESSION_NONE,
     last_offset: Optional[int] = None,
+    leader_epoch: int = -1,
 ) -> bytes:
     """``last_offset`` overrides the batch header's covered range (default:
     the last record's offset) — a compacted log's batches keep their
-    original last_offset_delta even when the tail records were removed."""
+    original last_offset_delta even when the tail records were removed.
+    ``leader_epoch`` stamps the header's partition_leader_epoch (outside
+    the CRC, like a real broker, which rewrites it on leader change)."""
     if not records:
         return b""
     base_offset = records[0][0]
@@ -1352,7 +1516,7 @@ def encode_record_batch(
     head = ByteWriter()
     head.i64(base_offset)
     head.i32(4 + 1 + 4 + len(crc_part))  # batch_length: from leader_epoch on
-    head.i32(-1)  # partition_leader_epoch
+    head.i32(leader_epoch)  # partition_leader_epoch (outside the CRC)
     head.i8(2)  # magic
     head.u32(crc)
     return head.done() + crc_part
@@ -1539,6 +1703,11 @@ class BatchFrame:
     #: for quarantine from these.
     byte_start: int = -1
     byte_end: int = -1
+    #: The header's partition_leader_epoch (v2 frames; -1 on legacy
+    #: MessageSets, which predate epochs) — the wire layer tracks the max
+    #: seen per partition for KIP-320 fencing, and a REGRESSION signals a
+    #: stale replica / truncated log.
+    leader_epoch: int = -1
 
 
 def _decode_legacy_entry(
@@ -1726,6 +1895,7 @@ def _parse_frame_at(
             base_offset=base_offset,
             span=(pos, end),
         )
+    leader_epoch = struct.unpack_from(">i", buf, pos + 12)[0]
     r = ByteReader(buf, pos + 17)
     crc = r.u32()
     crc_start = r.pos
@@ -1771,6 +1941,7 @@ def _parse_frame_at(
             end_offset=claimed_end,
             byte_start=pos,
             byte_end=end,
+            leader_epoch=leader_epoch,
         )
     codec = attributes & 0x07
     if codec != COMPRESSION_NONE:
@@ -1796,6 +1967,7 @@ def _parse_frame_at(
         end_offset=claimed_end,
         byte_start=pos,
         byte_end=end,
+        leader_epoch=leader_epoch,
     )
 
 

@@ -60,7 +60,11 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from kafka_topic_analyzer_tpu.config import CorruptionConfig, TransportRetryConfig
+from kafka_topic_analyzer_tpu.config import (
+    CorruptionConfig,
+    DataLossConfig,
+    TransportRetryConfig,
+)
 from kafka_topic_analyzer_tpu.io import kafka_codec as kc
 from kafka_topic_analyzer_tpu.io.retry import (
     Backoff,
@@ -447,6 +451,21 @@ def discover_cluster_topics(
     )
 
 
+class DataLossError(kc.KafkaProtocolError):
+    """The log mutated out from under the scan (retention race, truncation
+    after an unclean election, resume below log-start) and the data-loss
+    policy is ``fail``.  The loss is fully booked (metrics + lost span)
+    BEFORE this raises, and the engine's fault path writes a
+    fold-consistent checkpoint on the way out; the CLI maps it to
+    ``EXIT_DATA_LOSS`` instead of the generic protocol-error exit."""
+
+    def __init__(self, message: str, span: dict):
+        super().__init__(message)
+        #: The lost-span record ({partition, start, end, records, reason})
+        #: that tripped the policy.
+        self.span = span
+
+
 class _TransportFailure:
     """Phase-1 fetch result when a leader's transport died mid-round: the
     serial phase books the failure against the leader's partitions instead
@@ -469,6 +488,7 @@ class KafkaWireSource(RecordSource):
         timeout_s: float = 10.0,
         use_native_hashing: bool = True,
         corruption: Optional[CorruptionConfig] = None,
+        data_loss: Optional[DataLossConfig] = None,
     ):
         self.topic = topic
         self.use_native_hashing = use_native_hashing
@@ -501,6 +521,19 @@ class KafkaWireSource(RecordSource):
             from kafka_topic_analyzer_tpu.io.quarantine import QuarantineStore
 
             self._quarantine = QuarantineStore(self.corruption.quarantine_dir)
+        #: Log-mutation policy (--on-data-loss; also reachable as the
+        #: on.data.loss override).  Unlike corruption, loss is ALWAYS
+        #: booked — the policy only decides whether the scan keeps going.
+        loss_override = overrides.pop("on.data.loss", "report")
+        if data_loss is not None:
+            if loss_override != "report":
+                log.warning(
+                    "on.data.loss override ignored: an explicit data-loss "
+                    "config (--on-data-loss) takes precedence"
+                )
+            self.data_loss = data_loss
+        else:
+            self.data_loss = DataLossConfig(policy=loss_override)
         #: (partition, anchor) -> span record, for every poisoned span this
         #: scan skipped (or, seeded from a snapshot, a previous run
         #: skipped).  Guarded by _corrupt_lock: sharded scans run several
@@ -515,6 +548,26 @@ class KafkaWireSource(RecordSource):
         #: under _corrupt_lock like the spans map.
         self._corrupt_suspects: "Dict[int, Tuple[int, str, int]]" = {}
         self._corrupt_lock = threading.Lock()
+        #: (partition, start) -> lost-span record, for every offset range
+        #: the log mutated out from under this scan (retention race,
+        #: truncation after unclean election, resume below log-start) —
+        #: or, seeded from a snapshot, out from under a previous run.
+        #: Same sharing discipline as _corrupt_spans.
+        self._lost_spans: "Dict[Tuple[int, int], dict]" = {}
+        self._lost_lock = threading.Lock()
+        #: partition -> highest partition_leader_epoch observed (record-batch
+        #: headers, ListOffsets v4+ responses, checkpoint seeds).  Sent as
+        #: current_leader_epoch on flexible Fetch/ListOffsets so a stale
+        #: leader fences us instead of silently serving a truncated log;
+        #: a REGRESSION in observed epochs triggers the OffsetForLeaderEpoch
+        #: divergence check.  Guarded by _epoch_lock (shared across worker
+        #: streams, same as the spans maps).
+        self._leader_epochs: Dict[int, int] = {}
+        #: partition -> highest broker-reported log_start_offset (Fetch v5+
+        #: responses, ListOffsets earliest probes) — checkpointed so resume
+        #: can detect a cursor below the live log start before fetch #1.
+        self._log_starts: Dict[int, int] = {}
+        self._epoch_lock = threading.Lock()
         # librdkafka-name knobs this client honors (others warned+ignored).
         self.max_wait_ms = int(overrides.pop("fetch.wait.max.ms", 100))
         self.min_bytes = int(overrides.pop("fetch.min.bytes", 1))
@@ -799,6 +852,245 @@ class KafkaWireSource(RecordSource):
             self._corrupt_spans[key] = span_rec
         return span_rec["skip_to"]
 
+    # -- log-mutation (data-loss) accounting ---------------------------------
+
+    def lost_spans(self) -> "List[dict]":
+        """Every offset range the log mutated out from under this scan (or,
+        seeded, a predecessor's scan), as JSON-safe dicts."""
+        with self._lost_lock:
+            return [dict(s) for s in self._lost_spans.values()]
+
+    def loss_stats(self) -> Dict[int, dict]:
+        """Per-partition data-loss rollup, shaped like corruption_stats():
+        {partition: {records, ranges, reasons, authoritative, spans}}.
+        ``authoritative`` is False when any span came from truncation —
+        records already folded at those offsets were replaced, so the
+        partition's counts describe a log that no longer exists."""
+        out: Dict[int, dict] = {}
+        with self._lost_lock:
+            spans = [dict(s) for s in self._lost_spans.values()]
+        for s in sorted(spans, key=lambda s: (s["partition"], s["start"])):
+            d = out.setdefault(
+                s["partition"],
+                {
+                    "records": 0,
+                    "ranges": 0,
+                    "reasons": {},
+                    "authoritative": True,
+                    "spans": [],
+                },
+            )
+            d["records"] += s["records"]
+            d["ranges"] += 1
+            d["reasons"][s["reason"]] = d["reasons"].get(s["reason"], 0) + 1
+            if s["reason"] == "truncation":
+                d["authoritative"] = False
+            d["spans"].append(s)
+        return out
+
+    def seed_lost_spans(self, spans: "List[dict]") -> None:
+        """Adopt lost spans recorded by a previous run (snapshot resume) so
+        the final report covers the whole logical scan.  Seeded spans are
+        NOT re-booked to metrics — the run that lost them already counted
+        them — and they never re-trip the fail policy."""
+        with self._lost_lock:
+            for s in spans:
+                key = (int(s["partition"]), int(s["start"]))
+                self._lost_spans.setdefault(key, dict(s, seeded=True))
+
+    def _note_lost(
+        self, p: int, start: int, end: int, reason: str
+    ) -> None:
+        """Book the lost range [start, end) on partition ``p``: per-reason
+        metrics, a lost-span record, a ``log_lost`` event — and, under the
+        ``fail`` policy, the classified abort.  Idempotent per (partition,
+        start): a re-detected span (seeded from a checkpoint, or re-entered
+        after a metadata reload) is never double-counted."""
+        records = int(end) - int(start)
+        if records <= 0:
+            return
+        span_rec = {
+            "partition": int(p),
+            "start": int(start),
+            "end": int(end),
+            "records": records,
+            "reason": reason,
+        }
+        with self._lost_lock:
+            key = (int(p), int(start))
+            if key in self._lost_spans:
+                return
+            self._lost_spans[key] = span_rec
+        obs_metrics.LOG_LOST_RECORDS.labels(reason=reason).inc(records)
+        obs_metrics.LOG_LOST_RANGES.labels(reason=reason).inc()
+        obs_events.emit(
+            "log_lost",
+            partition=int(p),
+            start=int(start),
+            end=int(end),
+            records=records,
+            reason=reason,
+            action=self.data_loss.policy,
+        )
+        log.error(
+            "partition %d: %d record(s) at [%d, %d) lost to %s — %s",
+            p, records, start, end, reason,
+            "aborting (--on-data-loss fail)"
+            if self.data_loss.policy == "fail" else "continuing",
+        )
+        if self.data_loss.policy == "fail":
+            raise DataLossError(
+                f"partition {p}: {records} record(s) at [{start}, {end}) "
+                f"lost to {reason} (--on-data-loss fail)",
+                span_rec,
+            )
+
+    # -- leader-epoch fencing (KIP-320) --------------------------------------
+
+    def _observe_epoch(self, p: int, epoch: int) -> bool:
+        """Track the highest leader epoch seen for ``p``.  Returns True when
+        ``epoch`` REGRESSES below the tracked one — data from a stale
+        replica / pre-election log, which callers answer with the
+        OffsetForLeaderEpoch divergence check."""
+        if epoch < 0:
+            return False
+        with self._epoch_lock:
+            cur = self._leader_epochs.get(p, -1)
+            if epoch > cur:
+                self._leader_epochs[p] = epoch
+            return epoch < cur
+
+    def _observe_log_start(self, p: int, offset: int) -> None:
+        """Track the highest broker-reported log start (retention floor)."""
+        if offset < 0:
+            return
+        with self._epoch_lock:
+            if offset > self._log_starts.get(p, -1):
+                self._log_starts[p] = offset
+
+    def _epoch_for(self, p: int) -> int:
+        """Tracked epoch to send as current_leader_epoch (-1 = unknown)."""
+        with self._epoch_lock:
+            return self._leader_epochs.get(p, -1)
+
+    def _clear_epoch(self, p: int) -> None:
+        """Forget a fenced epoch so the next fetch sends -1 (unfenced) and
+        re-learns the post-election epoch from the data it returns."""
+        with self._epoch_lock:
+            self._leader_epochs.pop(p, None)
+
+    def partition_meta(self) -> Dict[int, dict]:
+        """Per-partition durable-fencing facts for checkpoints:
+        {partition: {leader_epoch, log_start_offset}}."""
+        with self._epoch_lock:
+            parts = set(self._leader_epochs) | set(self._log_starts)
+            return {
+                int(p): {
+                    "leader_epoch": int(self._leader_epochs.get(p, -1)),
+                    "log_start_offset": int(self._log_starts.get(p, -1)),
+                }
+                for p in parts
+            }
+
+    def check_divergence(
+        self, p: int, cursor: int, ask_epoch: int
+    ) -> Optional[int]:
+        """OffsetForLeaderEpoch (API 23) probe: where does the broker's log
+        for ``ask_epoch`` end?  Returns that end offset when it falls BELOW
+        ``cursor`` (the log we scanned was truncated there), else None —
+        also None when the probe cannot run (broker predates API 23, or the
+        round trip fails): an unverifiable cursor is reported, not guessed
+        at."""
+        if ask_epoch < 0 or p not in self._leaders:
+            return None
+        obs_metrics.LOG_DIVERGENCE_CHECKS.inc()
+        try:
+            conn = self._leader_conn(p)
+            v = self._version(conn, kc.API_OFFSET_FOR_LEADER_EPOCH)
+            if (
+                conn.api_versions is not None
+                and kc.API_OFFSET_FOR_LEADER_EPOCH not in conn.api_versions
+            ):
+                log.warning(
+                    "partition %d: broker does not speak "
+                    "OffsetForLeaderEpoch; cannot verify cursor %d against "
+                    "epoch %d", p, cursor, ask_epoch,
+                )
+                return None
+            r = conn.request(
+                kc.API_OFFSET_FOR_LEADER_EPOCH,
+                v,
+                kc.encode_offset_for_leader_epoch_request(
+                    self.topic,
+                    [(p, self._epoch_for(p), ask_epoch)],
+                    v,
+                ),
+            )
+            decoded = kc.decode_offset_for_leader_epoch_response(r, v)
+        except (OSError, kc.KafkaProtocolError) as e:
+            log.warning(
+                "partition %d: OffsetForLeaderEpoch probe failed: %s", p, e
+            )
+            return None
+        got = decoded.get(p)
+        if got is None:
+            return None
+        err, end_epoch, end_offset = got
+        if err or end_offset < 0:
+            log.warning(
+                "partition %d: OffsetForLeaderEpoch error %d "
+                "(epoch %d)", p, err, ask_epoch,
+            )
+            return None
+        obs_events.emit(
+            "divergence_check",
+            partition=int(p),
+            ask_epoch=int(ask_epoch),
+            end_epoch=int(end_epoch),
+            end_offset=int(end_offset),
+            cursor=int(cursor),
+            diverged=bool(end_offset < cursor),
+        )
+        if end_offset < cursor:
+            return int(end_offset)
+        return None
+
+    def validate_resume(
+        self, offsets: Dict[int, int], saved_meta: Dict[int, dict]
+    ) -> None:
+        """Resumed-scan honesty gate, run before fetch #1.  Seeds the
+        tracked epochs/log-starts from the checkpoint, then checks each
+        saved cursor against the live log: a cursor below the live log
+        start is a named retention loss (and the cursor re-anchors forward,
+        in place, so the first fetch doesn't re-detect it); a leader epoch
+        that moved since the checkpoint runs the OffsetForLeaderEpoch
+        divergence check, and truncation below the cursor is a named
+        truncation loss with the fold marked non-authoritative."""
+        saved_epochs: Dict[int, int] = {}
+        for p, m in (saved_meta or {}).items():
+            saved_epochs[int(p)] = int(m.get("leader_epoch", -1))
+        live_start, _live_end = self.watermarks()
+        live_epochs = dict(self._leader_epochs)
+        for p in sorted(offsets):
+            cursor = int(offsets[p])
+            start = live_start.get(p)
+            if start is not None and cursor < start:
+                self._note_lost(p, cursor, start, "resume-below-log-start")
+                offsets[p] = start
+                continue
+            saved_epoch = saved_epochs.get(p, -1)
+            if saved_epoch < 0:
+                continue
+            live_epoch = live_epochs.get(p, -1)
+            if live_epoch >= 0 and live_epoch != saved_epoch:
+                div = self.check_divergence(p, cursor, saved_epoch)
+                if div is not None:
+                    self._note_lost(p, div, cursor, "truncation")
+            else:
+                # Broker didn't report an epoch at watermark time (classic
+                # wire): trust the checkpoint's view until data says more.
+                self._observe_epoch(p, saved_epoch)
+
     # -- connections ---------------------------------------------------------
 
     def _connect(self, host: str, port: int) -> BrokerConnection:
@@ -851,6 +1143,7 @@ class KafkaWireSource(RecordSource):
         kc.API_METADATA: ("Metadata", (12, 5, 1)),
         kc.API_LIST_OFFSETS: ("ListOffsets", (7, 1)),
         kc.API_FETCH: ("Fetch", (12, 4)),
+        kc.API_OFFSET_FOR_LEADER_EPOCH: ("OffsetForLeaderEpoch", (4, 3)),
     }
 
     def _evict(self, conn: BrokerConnection) -> None:
@@ -1058,11 +1351,14 @@ class KafkaWireSource(RecordSource):
                 raise kc.KafkaProtocolError(
                     f"ListOffsets on {host}:{port} failed: {e}"
                 ) from e
-            for pid, (err, off) in decoded.items():
+            for pid, (err, off, epoch) in decoded.items():
                 if err:
                     raise kc.KafkaProtocolError(
                         f"ListOffsets error {err} for partition {pid}"
                     )
+                self._observe_epoch(pid, epoch)
+                if ts == kc.EARLIEST_TIMESTAMP:
+                    self._observe_log_start(pid, off)
                 out[pid] = off
         return out
 
@@ -1194,7 +1490,21 @@ class KafkaWireSource(RecordSource):
         if start_at:
             for p in parts:
                 if p in start_at:
-                    next_offset[p] = max(next_offset[p], start_at[p])
+                    cursor = int(start_at[p])
+                    if cursor < next_offset[p]:
+                        # The log start passed the caller's cursor before
+                        # this stream's first fetch (retention between
+                        # follow polls, or between cursor save and stream
+                        # open).  The gap [cursor, start) was never
+                        # readable here — book it, never skip silently.
+                        # Idempotent with the resume gate: validate_resume
+                        # re-anchors its offsets in place, and _note_lost
+                        # dedups on (partition, start) regardless.
+                        self._note_lost(
+                            p, cursor, next_offset[p], "retention"
+                        )
+                    else:
+                        next_offset[p] = cursor
         remaining = {p for p in parts if next_offset[p] < end[p]}
 
         # Accumulate RecordBatch *chunks* (one per accepted wire frame) and
@@ -1418,7 +1728,10 @@ class KafkaWireSource(RecordSource):
                     fetch_v,
                     kc.encode_fetch_request(
                         self.topic,
-                        [(p, next_offset[p]) for p in order],
+                        [
+                            (p, next_offset[p], self._epoch_for(p))
+                            for p in order
+                        ],
                         self.max_wait_ms,
                         self.min_bytes,
                         self.max_bytes,
@@ -1494,7 +1807,10 @@ class KafkaWireSource(RecordSource):
                             fetch_v2,
                             kc.encode_fetch_request(
                                 self.topic,
-                                [(p, spec[p]) for p in order2],
+                                [
+                                    (p, spec[p], self._epoch_for(p))
+                                    for p in order2
+                                ],
                                 self.max_wait_ms,
                                 self.min_bytes,
                                 self.max_bytes,
@@ -1649,9 +1965,52 @@ class KafkaWireSource(RecordSource):
                         error_streak[p] += 1
                         if fp.error == kc.ERR_NOT_LEADER_FOR_PARTITION:
                             self._reload_metadata()
+                        elif fp.error in (
+                            kc.ERR_FENCED_LEADER_EPOCH,
+                            kc.ERR_UNKNOWN_LEADER_EPOCH,
+                        ):
+                            # KIP-320 fence: the leader's epoch moved past
+                            # the one we tracked (election).  Verify the
+                            # cursor against the post-election log before
+                            # fetching on.
+                            obs_metrics.LOG_EPOCH_FENCES.inc()
+                            fenced_epoch = self._epoch_for(p)
+                            obs_events.emit(
+                                "epoch_fence",
+                                partition=p,
+                                code=fp.error,
+                                epoch=fenced_epoch,
+                            )
+                            # Unfence first: neither the divergence probe
+                            # nor the next fetch may re-fence on the stale
+                            # epoch (it re-learns the new one from the
+                            # next response's batch headers).
+                            self._clear_epoch(p)
+                            self._reload_metadata()
+                            div = self.check_divergence(
+                                p, next_offset[p], fenced_epoch
+                            )
+                            if div is not None:
+                                # The log diverged BELOW the cursor: the
+                                # folded prefix [div, cursor) described
+                                # batches the election threw away (the
+                                # span marks the fold non-authoritative),
+                                # and the window tail [cursor, end) no
+                                # longer exists to read.  Book the whole
+                                # destroyed range and finish the partition
+                                # — never rewind the cursor into the
+                                # replacement log, which would
+                                # double-count offsets [div, cursor).
+                                self._note_lost(
+                                    p, div, end[p], "truncation"
+                                )
+                                next_offset[p] = end[p]
+                                remaining.discard(p)
+                            progressed = True
                         elif fp.error == kc.ERR_OFFSET_OUT_OF_RANGE:
-                            # Retention advanced past our offset: resume at
-                            # the new earliest (scan window stays [.., end)).
+                            # Retention advanced past our offset: account
+                            # for the lost range [old_next, new_earliest),
+                            # then resume there (window stays [.., end)).
                             try:
                                 new_start = self._earliest_offset(p)
                             except (OSError, kc.KafkaProtocolError) as e:
@@ -1664,8 +2023,30 @@ class KafkaWireSource(RecordSource):
                                 )
                                 new_start = next_offset[p]
                             if new_start > next_offset[p]:
+                                self._note_lost(
+                                    p, next_offset[p], new_start,
+                                    "retention",
+                                )
                                 next_offset[p] = new_start
                                 progressed = True
+                            else:
+                                # Lookup failed, or the broker answered a
+                                # log start at/below our cursor (stale
+                                # replica, or out-of-range from the HEAD
+                                # side after a truncation).  Clamp
+                                # monotone — never rewind — book the
+                                # non-advance, and leave the round
+                                # non-progressing so the streak/budget
+                                # bounds engage deterministically.
+                                obs_metrics.LOG_LOST_RANGES.labels(
+                                    reason="re-anchor-regressed"
+                                ).inc()
+                                obs_events.emit(
+                                    "re_anchor_regressed",
+                                    partition=p,
+                                    cursor=next_offset[p],
+                                    answered=new_start,
+                                )
                         if error_streak[p] >= max_error_streak:
                             degrade(
                                 p,
@@ -1674,6 +2055,25 @@ class KafkaWireSource(RecordSource):
                             )
                         continue
                     error_streak[p] = 0
+                    self._observe_log_start(p, fp.log_start_offset)
+                    # KIP-320: peek the leading batch header's
+                    # partition_leader_epoch (fixed at byte 12 of a v2
+                    # frame, independent of the native/python decode
+                    # split).  A REGRESSION means this response came from
+                    # a pre-election log — verify the cursor before
+                    # folding past it.
+                    if len(fp.records) >= 17 and fp.records[16] == 2:
+                        frame_epoch = struct.unpack_from(
+                            ">i", fp.records, 12
+                        )[0]
+                        if self._observe_epoch(p, frame_epoch):
+                            div = self.check_divergence(
+                                p, next_offset[p], frame_epoch
+                            )
+                            if div is not None:
+                                self._note_lost(
+                                    p, div, next_offset[p], "truncation"
+                                )
                     consumed = 0
                     # One past the highest offset COVERED by a complete
                     # frame (batch headers keep last_offset_delta across

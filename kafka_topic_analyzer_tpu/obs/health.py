@@ -188,6 +188,9 @@ class HealthEngine:
         ("segstore_fallbacks", "kta_segstore_fallback_total"),
         ("lease_losses", "kta_lease_losses_total"),
         ("failovers", "kta_fleet_failovers_total"),
+        ("lost_records", "kta_log_lost_records_total"),
+        ("lost_ranges", "kta_log_lost_ranges_total"),
+        ("watermark_regressions", "kta_log_watermark_regressions_total"),
     ]
 
     def __init__(
@@ -311,8 +314,26 @@ class HealthEngine:
         ctx = EvalContext(self, snapshot, now)
         for name, metric in self.SERIES:
             push(name, ctx.total(metric))
+        # Truncation is one REASON of the lost-records counter — the
+        # truncation rule needs it split out (a retention race is routine
+        # under short retention; a truncation never is).
+        lost_metric = snapshot.get("kta_log_lost_records_total") or {}
+        push(
+            "truncated_records",
+            float(
+                sum(
+                    s.get("value", 0.0)
+                    for s in lost_metric.get("samples", [])
+                    if s.get("labels", {}).get("reason") == "truncation"
+                )
+            ),
+        )
         for topic, lag in ((extras or {}).get("topics") or {}).items():
             push(f"topic:{topic}:lag", float(lag))
+        for topic, records in (
+            (extras or {}).get("topic_loss") or {}
+        ).items():
+            push(f"topic:{topic}:lost", float(records))
 
     def _eval_rule(
         self,
@@ -589,6 +610,49 @@ def _failover(ctx: EvalContext) -> "Optional[dict]":
     return {"failovers": int(d), "window_s": ctx.cfg.storm_window_s}
 
 
+def _loss_series(ctx: EvalContext) -> str:
+    return (
+        f"topic:{ctx.topic}:lost" if ctx.topic is not None else "lost_records"
+    )
+
+
+def _lost_range(ctx: EvalContext) -> "Optional[dict]":
+    """The log mutated records out from under the scanner in the trailing
+    window (retention races past the cursor, resume below log-start) —
+    the counts are honest but incomplete, which an operator must hear
+    about before trusting a dashboard built on them (ISSUE 18)."""
+    d = ctx.delta(_loss_series(ctx), ctx.cfg.storm_window_s)
+    if d is None or d <= 0:
+        return None
+    evidence = {
+        "lost_records": int(d),
+        "window_s": ctx.cfg.storm_window_s,
+    }
+    ranges = ctx.delta("lost_ranges", ctx.cfg.storm_window_s)
+    if ranges:
+        evidence["lost_ranges"] = int(ranges)
+    return evidence
+
+
+def _truncation(ctx: EvalContext) -> "Optional[dict]":
+    """The log was TRUNCATED under the scanner (unclean leader election
+    replacing already-counted records) in the trailing window — unlike a
+    retention race, this marks folds non-authoritative and is never
+    routine.  Watermark regressions ride along as evidence only: a held
+    stale-replica answer heals by itself and must not page."""
+    d = ctx.delta("truncated_records", ctx.cfg.storm_window_s)
+    if d is None or d <= 0:
+        return None
+    evidence = {
+        "truncated_records": int(d),
+        "window_s": ctx.cfg.storm_window_s,
+    }
+    w = ctx.delta("watermark_regressions", ctx.cfg.storm_window_s)
+    if w:
+        evidence["watermark_regressions"] = int(w)
+    return evidence
+
+
 def built_in_rules(cfg: "Optional[HealthConfig]" = None) -> "List[AlertRule]":
     """The shipped rule set (ISSUE 15): lag growth, degraded-partition
     transitions, corruption storms, watermark-refresh outages,
@@ -660,6 +724,25 @@ def built_in_rules(cfg: "Optional[HealthConfig]" = None) -> "List[AlertRule]":
             "topics changed owner: this instance took over leases from "
             "a crashed, hung, or departed peer (DESIGN §23)",
             _failover,
+            for_s=0.0,
+            resolve_s=cfg.resolve_s,
+        ),
+        AlertRule(
+            "lost-range",
+            "the log mutated records out from under the scanner "
+            "(retention race / resume below log-start) — counts are "
+            "honest for the surviving records but name a lost range",
+            _lost_range,
+            for_s=0.0,  # every lost record is immediately actionable
+            resolve_s=cfg.resolve_s,
+            per_topic=True,
+        ),
+        AlertRule(
+            "truncation",
+            "the log was truncated under the scanner (unclean election "
+            "or watermark regression) — affected folds are "
+            "non-authoritative until rescanned",
+            _truncation,
             for_s=0.0,
             resolve_s=cfg.resolve_s,
         ),

@@ -286,6 +286,38 @@ CORRUPT_REFETCHES = _REG.counter(
     "kta_corrupt_refetches_total",
     "Suspect spans re-fetched once to rule out an in-flight bit flip")
 
+# -- log mutation (io/kafka_wire + checkpoint resume) -------------------------
+
+LOG_LOST_RECORDS = _REG.counter(
+    "kta_log_lost_records_total",
+    "Records the mutating log made unreachable before the scan read them "
+    "(reason: retention = expired below the cursor, truncation = removed "
+    "by an unclean leader election, resume-below-log-start = expired "
+    "while the scan was checkpointed)",
+    labelnames=("reason",))
+LOG_LOST_RANGES = _REG.counter(
+    "kta_log_lost_ranges_total",
+    "Contiguous lost offset ranges booked on kta_log_lost_records_total, "
+    "plus re-anchor-regressed: OFFSET_OUT_OF_RANGE recoveries whose "
+    "earliest-offset lookup failed or regressed (no records booked — the "
+    "cursor holds and the round counts as non-progressing)",
+    labelnames=("reason",))
+LOG_EPOCH_FENCES = _REG.counter(
+    "kta_log_epoch_fences_total",
+    "FENCED_LEADER_EPOCH / UNKNOWN_LEADER_EPOCH fetch errors (the broker "
+    "rejected our tracked leader epoch; metadata is refreshed and the "
+    "divergence check runs before the cursor moves)")
+LOG_DIVERGENCE_CHECKS = _REG.counter(
+    "kta_log_divergence_checks_total",
+    "OffsetForLeaderEpoch divergence probes issued on epoch regression "
+    "or resume-epoch mismatch (each either clears the cursor or books a "
+    "truncation loss)")
+LOG_WATERMARK_REGRESSIONS = _REG.counter(
+    "kta_log_watermark_regressions_total",
+    "Follow-mode end-watermark regressions (stale replica / unclean "
+    "election): the service holds the previous head instead of scanning "
+    "backwards")
+
 # -- io/retry -----------------------------------------------------------------
 
 BACKOFF_SLEEPS = _REG.counter(

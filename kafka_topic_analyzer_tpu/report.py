@@ -150,6 +150,50 @@ def render_corrupt_block(corrupt: "Dict[int, dict]") -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_data_loss_block(lost: "Dict[int, dict]") -> str:
+    """Post-table block for offset ranges the log mutated out from under
+    the scan (retention races, truncation after unclean election,
+    resume-below-log-start).  Like the corrupt block, rendered OUTSIDE
+    the reference-compatible report: the metrics describe exactly the
+    surviving records, and the reader must see what the log took back —
+    a truncation additionally marks the partition's fold
+    non-authoritative (records already folded were replaced under the
+    scan)."""
+    if not lost:
+        return ""
+    bar = "#" * 120
+    records = sum(d.get("records", 0) for d in lost.values())
+    lines = [
+        bar,
+        f"DATA-LOSS: {records} record(s) across {len(lost)} partition(s) "
+        "mutated out from under the scan — metrics cover exactly the "
+        "surviving records",
+    ]
+    for p in sorted(lost):
+        d = lost[p]
+        reasons = ", ".join(
+            f"{k} x{n}" for k, n in sorted(d.get("reasons", {}).items())
+        )
+        where = f"partition {p}" if p >= 0 else "another process"
+        spans = ", ".join(
+            f"[{s['start']}, {s['end']})" for s in d.get("spans", [])
+        )
+        lines.append(
+            f"  {where}: {d.get('records', 0)} record(s) in "
+            f"{d.get('ranges', 0)} range(s)"
+            + (f" [{reasons}]" if reasons else "")
+            + (f" at {spans}" if spans else "")
+            + (
+                ""
+                if d.get("authoritative", True)
+                else " — FOLD NON-AUTHORITATIVE (truncation replaced "
+                     "already-counted records)"
+            )
+        )
+    lines.append(bar)
+    return "\n".join(lines) + "\n"
+
+
 def _metric_total(snapshot: Dict, name: str) -> float:
     """Sum of a metric's sample values across label sets (0 if absent)."""
     metric = snapshot.get(name)
@@ -326,6 +370,25 @@ def render_telemetry_stats(
             f"partitions"
         ),
     ]
+    # Log-mutation digest: only rendered when the log actually moved (or
+    # the fencing machinery fired) — stable-log scans keep the classic
+    # digest byte-identical.
+    from kafka_topic_analyzer_tpu.results import LossStats
+
+    loss = LossStats.from_telemetry(snapshot)
+    if loss.ranges or loss.fences or loss.divergence_checks \
+            or loss.watermark_regressions:
+        reasons = ", ".join(
+            f"{k}={v:,}" for k, v in sorted(loss.by_reason.items())
+        )
+        lines.append(
+            f"  log-mutation: {loss.records:,} records lost in "
+            f"{loss.ranges:,} range(s)"
+            + (f" ({reasons})" if reasons else "")
+            + f", {loss.fences:,} epoch fences, "
+            f"{loss.divergence_checks:,} divergence checks, "
+            f"{loss.watermark_regressions:,} watermark regressions"
+        )
     # Cold-path digest: what the segment catalog opened/mapped and how many
     # records came off the mapped chunks.  Only rendered when the scan
     # actually read segments (broker scans never touch these instruments).
@@ -477,8 +540,9 @@ def attach_scan_digests(doc: dict, result, diagnosis=None) -> None:
 
 
 def attach_issue_blocks(doc: dict, result) -> None:
-    """The str-keyed ``corrupt_partitions``/``degraded_partitions`` maps
-    (shared by every --json surface and cli._scan_issue_exit)."""
+    """The str-keyed ``corrupt_partitions``/``degraded_partitions``/
+    ``data_loss`` maps (shared by every --json surface and
+    cli._scan_issue_exit)."""
     corrupt = getattr(result, "corrupt_partitions", None) or {}
     if corrupt:
         doc["corrupt_partitions"] = {str(p): d for p, d in corrupt.items()}
@@ -486,6 +550,9 @@ def attach_issue_blocks(doc: dict, result) -> None:
         doc["degraded_partitions"] = {
             str(p): r for p, r in result.degraded_partitions.items()
         }
+    lost = getattr(result, "lost_partitions", None) or {}
+    if lost:
+        doc["data_loss"] = {str(p): d for p, d in lost.items()}
 
 
 def build_json_doc(

@@ -313,6 +313,68 @@ class FollowStats:
 
 
 @dataclasses.dataclass
+class LossStats:
+    """Log-mutation accounting extracted from a telemetry snapshot
+    (`ScanResult.telemetry`): records/ranges the log mutated out from
+    under the scan, split by reason (retention, truncation,
+    resume-below-log-start, re-anchor-regressed), plus the epoch-fencing
+    machinery's activity.  Consumed by the ``--stats`` digest (report.py);
+    empty (``ranges == 0 and fences == 0``) for scans of a stable log."""
+
+    #: Records lost, total across reasons.
+    records: int
+    #: Lost ranges booked, total across reasons (includes zero-record
+    #: re-anchor-regressed bookings).
+    ranges: int
+    #: reason -> records lost to it.
+    by_reason: "Dict[str, int]"
+    #: FENCED/UNKNOWN_LEADER_EPOCH fetch answers (KIP-320 fences).
+    fences: int
+    #: OffsetForLeaderEpoch divergence probes run.
+    divergence_checks: int
+    #: Follow-mode end-watermark regressions held (stale replica heads).
+    watermark_regressions: int
+
+    @classmethod
+    def from_telemetry(cls, snapshot: "Optional[dict]") -> "LossStats":
+        def total(name: str) -> int:
+            metric = (snapshot or {}).get(name)
+            if not metric:
+                return 0
+            return int(sum(s.get("value", 0.0) for s in metric["samples"]))
+
+        def by_label(name: str, label: str) -> "Dict[str, int]":
+            metric = (snapshot or {}).get(name)
+            out: "Dict[str, int]" = {}
+            for s in (metric or {}).get("samples", []):
+                key = s.get("labels", {}).get(label)
+                if key is not None:
+                    out[key] = out.get(key, 0) + int(s.get("value", 0.0))
+            return out
+
+        return cls(
+            records=total("kta_log_lost_records_total"),
+            ranges=total("kta_log_lost_ranges_total"),
+            by_reason=by_label("kta_log_lost_records_total", "reason"),
+            fences=total("kta_log_epoch_fences_total"),
+            divergence_checks=total("kta_log_divergence_checks_total"),
+            watermark_regressions=total(
+                "kta_log_watermark_regressions_total"
+            ),
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "records": self.records,
+            "ranges": self.ranges,
+            "by_reason": dict(self.by_reason),
+            "epoch_fences": self.fences,
+            "divergence_checks": self.divergence_checks,
+            "watermark_regressions": self.watermark_regressions,
+        }
+
+
+@dataclasses.dataclass
 class DispatchStats:
     """Superbatch-dispatch accounting extracted from a telemetry snapshot
     (`ScanResult.telemetry`): device dispatches, batches folded through

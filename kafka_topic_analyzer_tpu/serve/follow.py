@@ -169,6 +169,9 @@ class FollowService:
         self._seq_total = 0
         self._service_start_offsets: "Optional[Dict[int, int]]" = None
         self._last_end: "Dict[int, int]" = {}
+        #: Partitions whose regressed end watermark was held for one poll
+        #: (a second consecutive regression is adopted as truncation).
+        self._regress_held: "Dict[int, bool]" = {}
         self._t0 = clock()  # re-anchored at run() start
         self._last_ckpt = clock()
         self._wire_bytes = 0
@@ -335,6 +338,35 @@ class FollowService:
         start_w, end_w = self.source.refresh_watermarks()
         self.polls += 1
         obs_metrics.FOLLOW_POLLS.inc()
+        # End-watermark REGRESSION (stale replica answering the re-poll,
+        # or a truncation the epoch fence hasn't classified yet): hold
+        # the previous head for one poll instead of scanning backwards.
+        # A transient stale answer recovers by the next refresh; a
+        # regression that PERSISTS is the log's new truth (truncation),
+        # so the second poll adopts it — the follow cursor never rewinds,
+        # so an adopted shorter head drains the partition rather than
+        # re-reading offsets (no double-count), and the fetch path's
+        # epoch fence owns the loss accounting.  Booked
+        # (kta_log_watermark_regressions_total) + emitted, never silent.
+        for p, end in list(end_w.items()):
+            prev = self._last_end.get(p)
+            if prev is not None and end < prev:
+                held = not self._regress_held.get(p, False)
+                obs_metrics.LOG_WATERMARK_REGRESSIONS.inc()
+                obs_events.emit(
+                    "watermark_regression",
+                    partition=int(p),
+                    previous_end=int(prev),
+                    answered_end=int(end),
+                    held=bool(held),
+                )
+                if held:
+                    self._regress_held[p] = True
+                    end_w[p] = prev
+                else:
+                    self._regress_held.pop(p, None)
+            else:
+                self._regress_held.pop(p, None)
         self._last_end = dict(end_w)
         lag_total = 0
         for p, end in end_w.items():

@@ -222,7 +222,7 @@ class TemplateBroker:
         plan = []  # (pid, err, first_window, n_windows, rs_bytes)
         budget = max_bytes
         served_any = False
-        for pid, fetch_offset, pmax in parts:
+        for pid, fetch_offset, pmax, _epoch in parts:
             if pid not in self.partition_set:
                 plan.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, 0, 0, 0))
                 continue

@@ -269,11 +269,13 @@ class FakeBroker:
                 kc.API_LIST_OFFSETS: (1, 7),
                 kc.API_METADATA: (1, 12),
                 kc.API_VERSIONS: (0, 3),
+                kc.API_OFFSET_FOR_LEADER_EPOCH: (0, 4),
             }
         self.api_ranges = api_ranges or {
             kc.API_FETCH: (0, 4),
             kc.API_LIST_OFFSETS: (0, 1),
             kc.API_METADATA: (0, 5),
+            kc.API_OFFSET_FOR_LEADER_EPOCH: (0, 3),
         }
         #: Pretend to be an ancient broker with no ApiVersions support.
         self.no_api_versions = no_api_versions
@@ -374,7 +376,8 @@ class FakeBroker:
                         if run:
                             pieces.append(
                                 kc.encode_record_batch(
-                                    list(run), self.compression
+                                    list(run), self.compression,
+                                    leader_epoch=0,
                                 )
                             )
                             run.clear()
@@ -391,7 +394,8 @@ class FakeBroker:
                     encoded = b"".join(pieces)
                 elif self.message_magic == 2:
                     encoded = kc.encode_record_batch(
-                        part, self.compression, last_offset=last
+                        part, self.compression, last_offset=last,
+                        leader_epoch=0,
                     )
                 else:
                     encoded = kc.encode_message_set(
@@ -409,6 +413,15 @@ class FakeBroker:
             "end_offsets": end_offsets,
             "chunks": chunks_by_p,
             "chunk_last": chunk_last,
+            # KIP-320 leader-epoch state: the current epoch per partition
+            # and the epoch history [(epoch, first_offset_of_epoch), ...]
+            # OffsetForLeaderEpoch answers from.  Batches are stamped with
+            # the epoch in effect when they were written (epoch 0 at
+            # build; bumped by unclean_elect()).
+            "epoch": {p: 0 for p in records},
+            "epoch_starts": {
+                p: [(0, start_offsets[p])] for p in records
+            },
         }
 
     def create_topic(
@@ -470,7 +483,10 @@ class FakeBroker:
         if rs and records[0][0] <= rs[-1][0]:
             raise AssertionError("produced offsets must extend the log")
         if self.message_magic == 2:
-            encoded = kc.encode_record_batch(records, self.compression)
+            encoded = kc.encode_record_batch(
+                records, self.compression,
+                leader_epoch=store["epoch"].get(partition, 0),
+            )
         else:
             encoded = kc.encode_message_set(
                 records, magic=self.message_magic,
@@ -486,6 +502,117 @@ class FakeBroker:
         )
         store["chunk_last"][partition].append(records[-1][0])
         store["end_offsets"][partition] = records[-1][0] + 1
+
+    # -- log-mutation seams (retention / truncation / unclean election) -------
+
+    def _mut_store(self, topic: "Optional[str]") -> dict:
+        name = topic if topic is not None else self.topic
+        store = self._stores.get(name)
+        if store is None:
+            raise AssertionError(f"mutation targets unknown topic {name!r}")
+        return store
+
+    def _epoch_at(self, store: dict, partition: int, offset: int) -> int:
+        """Leader epoch in effect at ``offset`` (from the epoch history)."""
+        epoch = 0
+        for ep, start in store["epoch_starts"].get(partition, []):
+            if start <= offset:
+                epoch = ep
+        return epoch
+
+    def _rebuild_chunks(
+        self, store: dict, partition: int, rs: "List[Record]"
+    ) -> None:
+        """Re-encode a partition's surviving records into fetch chunks,
+        each stamped with the epoch in effect at its first offset.  The
+        mutation seams re-segment the log, so corruption plans (keyed by
+        chunk index) do not compose with them — chaos tests pick one."""
+        chunks: "list[tuple[int, int, bytes]]" = []
+        last: "list[int]" = []
+        for lo in range(0, len(rs), self.max_records_per_fetch):
+            part = rs[lo : lo + self.max_records_per_fetch]
+            if self.message_magic == 2:
+                encoded = kc.encode_record_batch(
+                    part, self.compression,
+                    leader_epoch=self._epoch_at(store, partition, part[0][0]),
+                )
+            else:
+                encoded = kc.encode_message_set(
+                    part, magic=self.message_magic,
+                    compression=self.compression,
+                )
+            chunks.append((part[0][0], part[-1][0], encoded))
+            last.append(part[-1][0])
+        store["chunks"][partition] = chunks
+        store["chunk_last"][partition] = last
+
+    def expire_to(
+        self, partition: int, offset: int, topic: "Optional[str]" = None
+    ) -> None:
+        """Retention fired WHILE the broker serves: every record below
+        ``offset`` is deleted and the log start advances to ``offset``.
+        Whole chunks that fell below the new start are dropped; a chunk
+        straddling the boundary stays (a segment whose tail survives —
+        clients filter fetched records below their position).  Fetches at
+        a now-expired position answer OFFSET_OUT_OF_RANGE, exactly like a
+        real broker whose retention ran mid-scan."""
+        store = self._mut_store(topic)
+        if partition not in store["records"]:
+            raise AssertionError(f"expire_to() unknown partition {partition}")
+        rs = [r for r in store["records"][partition] if r[0] >= offset]
+        keep = [c for c in store["chunks"][partition] if c[1] >= offset]
+        store["chunks"][partition] = keep
+        store["chunk_last"][partition] = [c[1] for c in keep]
+        store["records"][partition] = rs
+        if offset > store["start_offsets"][partition]:
+            store["start_offsets"][partition] = offset
+        if offset > store["end_offsets"][partition]:
+            store["end_offsets"][partition] = offset
+
+    def truncate_to(
+        self, partition: int, offset: int, topic: "Optional[str]" = None
+    ) -> None:
+        """Log truncation WHILE the broker serves: every record at or
+        after ``offset`` is deleted and the end watermark pulls BACK to
+        ``offset`` — the follower-made-leader shape of an unclean
+        election (pair with unclean_elect() for the epoch bump)."""
+        store = self._mut_store(topic)
+        if partition not in store["records"]:
+            raise AssertionError(f"truncate_to() unknown partition {partition}")
+        if offset >= store["end_offsets"][partition]:
+            return
+        rs = [r for r in store["records"][partition] if r[0] < offset]
+        self._rebuild_chunks(store, partition, rs)
+        store["records"][partition] = rs
+        store["end_offsets"][partition] = max(
+            offset, store["start_offsets"][partition]
+        )
+
+    def unclean_elect(
+        self,
+        partition: int,
+        truncate_to: "Optional[int]" = None,
+        topic: "Optional[str]" = None,
+    ) -> int:
+        """Unclean leader election: optionally truncate the log to
+        ``truncate_to`` (the new leader's shorter log), then bump the
+        partition's leader epoch.  Batches produced afterwards carry the
+        new epoch; fetches sending the old current_leader_epoch answer
+        FENCED_LEADER_EPOCH; OffsetForLeaderEpoch answers the old epoch's
+        end offset from the history.  Returns the new epoch."""
+        store = self._mut_store(topic)
+        if partition not in store["records"]:
+            raise AssertionError(
+                f"unclean_elect() unknown partition {partition}"
+            )
+        if truncate_to is not None:
+            self.truncate_to(partition, truncate_to, topic=topic)
+        new_epoch = store["epoch"][partition] + 1
+        store["epoch"][partition] = new_epoch
+        store["epoch_starts"][partition].append(
+            (new_epoch, store["end_offsets"][partition])
+        )
+        return new_epoch
 
     def stop(self) -> None:
         self._stop.set()
@@ -742,9 +869,15 @@ class FakeBroker:
                 if pid not in records:
                     results.append((pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, -1))
                 elif ts == kc.EARLIEST_TIMESTAMP:
-                    results.append((pid, 0, -1, store["start_offsets"][pid]))
+                    start = store["start_offsets"][pid]
+                    results.append(
+                        (pid, 0, -1, start, self._epoch_at(store, pid, start))
+                    )
                 elif ts == kc.LATEST_TIMESTAMP:
-                    results.append((pid, 0, -1, store["end_offsets"][pid]))
+                    results.append((
+                        pid, 0, -1, store["end_offsets"][pid],
+                        store["epoch"].get(pid, 0),
+                    ))
                 else:
                     # Timestamp lookup: earliest offset whose record ts >= query
                     # (-1 when no such record), like a real broker.
@@ -752,7 +885,8 @@ class FakeBroker:
                         (off for off, rts, _k, _v in records[pid] if rts >= ts),
                         -1,
                     )
-                    results.append((pid, 0, ts, hit))
+                    epoch = self._epoch_at(store, pid, hit) if hit >= 0 else -1
+                    results.append((pid, 0, ts, hit, epoch))
             return kc.encode_list_offsets_response(
                 req_topic, results, api_version
             )
@@ -763,7 +897,7 @@ class FakeBroker:
             out = []
             budget = _xb if self.honor_max_bytes else None
             served_any = False
-            for pid, fetch_offset, _pmax in parts:
+            for pid, fetch_offset, _pmax, req_epoch in parts:
                 if self.faults is not None:
                     code = self.faults.take_fetch_error()
                     if code is not None:
@@ -781,7 +915,26 @@ class FakeBroker:
                     # not lead.
                     out.append((pid, kc.ERR_NOT_LEADER_FOR_PARTITION, -1, b""))
                     continue
+                # KIP-320 fencing: a client-sent current_leader_epoch that
+                # disagrees with the partition's epoch is rejected — below
+                # means the client's view predates an election (FENCED),
+                # above means it is from the future (UNKNOWN).
+                cur_epoch = store["epoch"].get(pid, 0)
+                if req_epoch >= 0 and req_epoch != cur_epoch:
+                    err = (
+                        kc.ERR_FENCED_LEADER_EPOCH
+                        if req_epoch < cur_epoch
+                        else kc.ERR_UNKNOWN_LEADER_EPOCH
+                    )
+                    out.append((pid, err, -1, b""))
+                    continue
                 hw = store["end_offsets"][pid]
+                log_start = store["start_offsets"][pid]
+                if fetch_offset < log_start or fetch_offset > hw:
+                    # The requested position no longer exists (retention
+                    # expired it) or never did (beyond the log end).
+                    out.append((pid, kc.ERR_OFFSET_OUT_OF_RANGE, -1, b""))
+                    continue
                 # First pre-encoded chunk whose last offset reaches the fetch
                 # position (it may start earlier; clients filter by offset,
                 # exactly as with real compacted batches).
@@ -808,8 +961,47 @@ class FakeBroker:
                     budget -= len(record_set)
                 if record_set:
                     served_any = True
-                out.append((pid, 0, hw, record_set))
+                out.append((pid, 0, hw, record_set, log_start))
             return kc.encode_fetch_response(req_topic, out, api_version)
+        if api_key == kc.API_OFFSET_FOR_LEADER_EPOCH:
+            req_topic, parts = kc.decode_offset_for_leader_epoch_request(
+                r, api_version
+            )
+            store = self._stores.get(req_topic, None)
+            results = []
+            for pid, cur_epoch, ask_epoch in parts:
+                if store is None or pid not in store["records"]:
+                    results.append(
+                        (pid, kc.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, -1)
+                    )
+                    continue
+                broker_epoch = store["epoch"].get(pid, 0)
+                if cur_epoch >= 0 and cur_epoch != broker_epoch:
+                    err = (
+                        kc.ERR_FENCED_LEADER_EPOCH
+                        if cur_epoch < broker_epoch
+                        else kc.ERR_UNKNOWN_LEADER_EPOCH
+                    )
+                    results.append((pid, err, -1, -1))
+                    continue
+                # End offset of the largest epoch <= ask: the next epoch's
+                # first offset, or the live log end for the latest epoch.
+                history = store["epoch_starts"].get(pid) or [
+                    (0, store["start_offsets"][pid])
+                ]
+                ans_epoch, ans_end = -1, -1
+                for i, (ep, _start) in enumerate(history):
+                    if ep <= ask_epoch:
+                        ans_epoch = ep
+                        ans_end = (
+                            history[i + 1][1]
+                            if i + 1 < len(history)
+                            else store["end_offsets"][pid]
+                        )
+                results.append((pid, 0, ans_epoch, ans_end))
+            return kc.encode_offset_for_leader_epoch_response(
+                req_topic, results, api_version
+            )
         raise AssertionError(f"fake broker: unsupported api {api_key}")
 
     def _leader(self, partition: int) -> int:

@@ -516,10 +516,10 @@ def test_golden_metadata_bodies_decode():
 def test_golden_list_offsets_bodies_decode():
     assert kc.decode_list_offsets_response(
         kc.ByteReader(list_offsets_v1_body(3)), 1
-    ) == {0: (0, 3)}
+    ) == {0: (0, 3, -1)}
     assert kc.decode_list_offsets_response(
         kc.ByteReader(list_offsets_v7_body(3)), 7
-    ) == {0: (0, 3)}
+    ) == {0: (0, 3, 0)}
 
 
 def test_golden_fetch_bodies_decode():

@@ -546,7 +546,12 @@ def test_list_offsets_v7_roundtrip():
         "t", [(0, 0, -1, 17), (3, 0, -1, 99)], 7
     )
     out = kc.decode_list_offsets_response(kc.ByteReader(resp), 7)
-    assert out == {0: (0, 17), 3: (0, 99)}
+    assert out == {0: (0, 17, -1), 3: (0, 99, -1)}
+    resp = kc.encode_list_offsets_response(
+        "t", [(0, 0, -1, 17, 4), (3, 0, -1, 99, 7)], 7
+    )
+    out = kc.decode_list_offsets_response(kc.ByteReader(resp), 7)
+    assert out == {0: (0, 17, 4), 3: (0, 99, 7)}
 
 
 def test_fetch_v12_roundtrip():
@@ -554,7 +559,11 @@ def test_fetch_v12_roundtrip():
                                   1 << 16, 12)
     topic, parts, mw, mb, xb = kc.decode_fetch_request(kc.ByteReader(req), 12)
     assert (topic, mw, mb, xb) == ("t", 100, 1, 1 << 20)
-    assert parts == [(0, 5, 1 << 16), (2, 11, 1 << 16)]
+    assert parts == [(0, 5, 1 << 16, -1), (2, 11, 1 << 16, -1)]
+    req = kc.encode_fetch_request("t", [(0, 5, 3)], 100, 1, 1 << 20,
+                                  1 << 16, 12)
+    _t, parts, _mw, _mb, _xb = kc.decode_fetch_request(kc.ByteReader(req), 12)
+    assert parts == [(0, 5, 1 << 16, 3)]
     records = kc.encode_record_batch([(5, 1000, b"k", b"v")])
     resp = kc.encode_fetch_response("t", [(0, 0, 6, records)], 12)
     fps = kc.decode_fetch_response(kc.ByteReader(resp), 12)

@@ -1,0 +1,580 @@
+"""Scan honesty under a mutating log (ISSUE 18; DESIGN.md §24).
+
+The log is not frozen while we scan it: retention deletes from the tail
+we have not reached, unclean elections replace batches we already folded.
+The contract under test:
+
+- ACCOUNTING: every record the log takes back mid-scan is booked as a
+  lost span — [old cursor, new log start) for a retention race,
+  [divergence, end watermark) for a truncation — and the scan's metrics
+  are BYTE-IDENTICAL to a clean scan of exactly the surviving records.
+  Nothing is lost silently, nothing is double-counted, across ingest
+  workers × superbatch K.
+- FENCING: the client tracks partition_leader_epoch from batch headers
+  and sends it on flexible fetches; FENCED/UNKNOWN_LEADER_EPOCH answers
+  run the OffsetForLeaderEpoch divergence probe, and truncation below
+  the cursor marks the fold non-authoritative instead of rewinding into
+  the replacement log.  A clean election (no truncation) costs fence
+  round-trips but never records or loss.
+- POLICY: --on-data-loss decides the exit alone — fail aborts with exit
+  5, report exits 0 WITH the DATA-LOSS block, ignore exits 0 without
+  it.  Loss never changes the exit code outside the fail policy.
+- DURABILITY: checkpoints carry the lost spans and per-partition
+  {leader_epoch, log_start_offset}; a resume below the live log start
+  is a named loss, and a successor instance INHERITS its predecessor's
+  booked loss without re-counting it (fleet failover).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.cpu import CpuExactBackend
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.checkpoint import (
+    load_lost_spans,
+    load_partition_meta,
+)
+from kafka_topic_analyzer_tpu.cli import EXIT_DATA_LOSS, main
+from kafka_topic_analyzer_tpu.config import (
+    AnalyzerConfig,
+    DispatchConfig,
+    FollowConfig,
+)
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.fleet.scheduler import FleetScheduler, TopicSeed
+from kafka_topic_analyzer_tpu.fleet.service import FleetService
+from kafka_topic_analyzer_tpu.io import kafka_codec as kc
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.serve.follow import FollowService
+
+from fake_broker import FakeBroker
+
+pytestmark = pytest.mark.logmut
+
+TOPIC = "logmut.topic"
+
+FAST_RETRY = {
+    "retry.backoff.ms": "5",
+    "reconnect.backoff.max.ms": "40",
+}
+
+#: Tight service pacing so the follow-churn test stays inside tier-1.
+FAST_FOLLOW = dict(
+    poll_interval_s=0.02,
+    idle_backoff_max_s=0.05,
+    window_secs=5.0,
+    window_count=4,
+)
+
+
+def _rows(partition: int, n: int, lo: int = 0):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 31}".encode() if i % 5 else None,
+            bytes(20 + (i % 13)) if i % 7 else None,
+        )
+        for i in range(lo, lo + n)
+    ]
+
+
+def _cfg(parts: int = 1, **kw) -> AnalyzerConfig:
+    base = dict(
+        num_partitions=parts, batch_size=128,
+        count_alive_keys=True, alive_bitmap_bits=16,
+    )
+    base.update(kw)
+    return AnalyzerConfig(**base)
+
+
+def _metrics_doc(result) -> dict:
+    return result.metrics.to_dict(result.start_offsets, result.end_offsets)
+
+
+def _loss_counters(reason: str):
+    return (
+        obs_metrics.LOG_LOST_RECORDS.labels(reason=reason).value,
+        obs_metrics.LOG_LOST_RANGES.labels(reason=reason).value,
+    )
+
+
+class _FetchHook:
+    """response_delay hook that fires ``action`` right after the broker
+    ENCODES its ``fire_at``-th fetch response (the hook runs between
+    _dispatch and the socket send), so the mutation lands before the
+    client can have acted on that response — the cursor positions at the
+    next fetch are deterministic for a sequential stream."""
+
+    def __init__(self, fire_at: int, action):
+        self.fire_at = fire_at
+        self.action = action
+        self.fetches = 0
+        self.fired = False
+
+    def __call__(self, api_key: int, node_id: int) -> float:
+        if api_key == kc.API_FETCH:
+            self.fetches += 1
+            if self.fetches == self.fire_at and not self.fired:
+                self.fired = True
+                self.action()
+        return 0.0
+
+
+class _Interrupt(Exception):
+    pass
+
+
+def _wait_for(predicate, timeout_s=20.0, interval_s=0.01, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _published_count(svc) -> int:
+    doc = svc.state.snapshot()
+    return doc["overall"]["count"] if doc else -1
+
+
+# ---------------------------------------------------------------------------
+# retention race: the log's tail expires while the scan is mid-flight
+
+
+def test_mid_scan_retention_books_exact_range():
+    """Retention fires while the cursor is at 150: the re-anchor books
+    EXACTLY [150, 200) and the metrics equal a clean scan of the
+    survivors — chunk math: 50-record fetches, expiry after response #3
+    (covering [100, 150)) pins the next fetch at offset 150."""
+    rows = _rows(0, 400)
+    before = _loss_counters("retention")
+    with FakeBroker(TOPIC, {0: list(rows)}, max_records_per_fetch=50) as broker:
+        hook = _FetchHook(3, lambda: broker.expire_to(0, 200))
+        broker.response_delay = hook
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        result = run_scan(TOPIC, src, CpuExactBackend(_cfg(), init_now_s=10**10), 128)
+        src.close()
+    assert hook.fired
+    assert not result.degraded_partitions
+    assert set(result.lost_partitions) == {0}
+    d = result.lost_partitions[0]
+    assert d["records"] == 50
+    assert d["ranges"] == 1
+    assert d["authoritative"] is True
+    assert d["reasons"] == {"retention": 1}
+    (span,) = d["spans"]
+    assert (span["start"], span["end"], span["reason"]) == (150, 200, "retention")
+    after = _loss_counters("retention")
+    assert after[0] - before[0] == 50
+    assert after[1] - before[1] == 1
+
+    survivors = [r for r in rows if not (150 <= r[0] < 200)]
+    with FakeBroker(TOPIC, {0: survivors}, max_records_per_fetch=50) as ref_broker:
+        ref_src = KafkaWireSource(
+            f"127.0.0.1:{ref_broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        referee = run_scan(
+            TOPIC, ref_src, CpuExactBackend(_cfg(), init_now_s=10**10), 128
+        )
+        ref_src.close()
+    assert not referee.lost_partitions
+    assert _metrics_doc(result) == _metrics_doc(referee)
+    assert result.metrics.to_dict({0: 0}, {0: 400})["overall"]["count"] == 350
+
+
+@pytest.mark.parametrize("workers,k,d", [(2, 1, 1), (3, 2, 2)])
+def test_retention_race_under_workers_and_superbatch(workers, k, d):
+    """The accounting contract holds when partitions are sharded across
+    ingest workers and batches fold through a superbatch window: the
+    cursor positions at expiry are nondeterministic, so the referee is
+    RECONSTRUCTED from the booked spans — survivors = log minus spans —
+    and byte-identity plus per-partition conservation (folded + lost ==
+    produced) proves every expired record was either folded first or
+    booked, never silently skipped."""
+    records = {p: _rows(p, 400) for p in range(3)}
+    cfg = _cfg(parts=3)
+    with FakeBroker(
+        TOPIC, {p: list(r) for p, r in records.items()}, max_records_per_fetch=50
+    ) as broker:
+        hook = _FetchHook(
+            2, lambda: [broker.expire_to(p, 300) for p in range(3)]
+        )
+        broker.response_delay = hook
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        backend = TpuBackend(
+            cfg, init_now_s=10**10, dispatch=DispatchConfig(superbatch=k, depth=d)
+        )
+        result = run_scan(TOPIC, src, backend, 128, ingest_workers=workers)
+        src.close()
+    assert hook.fired
+    assert not result.degraded_partitions
+    # Expiry landed before any cursor could reach 300, so every partition
+    # lost a range ending exactly at the new log start.
+    assert set(result.lost_partitions) == {0, 1, 2}
+    survivors = {}
+    for p in range(3):
+        d_p = result.lost_partitions[p]
+        assert d_p["authoritative"] is True
+        (span,) = d_p["spans"]
+        assert span["reason"] == "retention"
+        assert span["end"] == 300
+        assert 0 <= span["start"] < 300
+        assert span["records"] == span["end"] - span["start"]
+        gone = set(range(span["start"], span["end"]))
+        survivors[p] = [r for r in records[p] if r[0] not in gone]
+        assert len(survivors[p]) + len(gone) == 400  # conservation
+    with FakeBroker(TOPIC, survivors, max_records_per_fetch=50) as ref_broker:
+        ref_src = KafkaWireSource(
+            f"127.0.0.1:{ref_broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        referee = run_scan(
+            TOPIC,
+            ref_src,
+            TpuBackend(
+                cfg, init_now_s=10**10,
+                dispatch=DispatchConfig(superbatch=k, depth=d),
+            ),
+            128,
+            ingest_workers=workers,
+        )
+        ref_src.close()
+    S = {p: 0 for p in range(3)}
+    E = {p: 400 for p in range(3)}
+    assert result.metrics.to_dict(S, E) == referee.metrics.to_dict(S, E)
+
+
+# ---------------------------------------------------------------------------
+# leader-epoch fencing: elections mid-scan
+
+
+def test_unclean_election_truncation_is_non_authoritative():
+    """An unclean election truncates to 100 while the cursor is at 150:
+    the next fetch (sending the tracked epoch 0) is FENCED, the
+    OffsetForLeaderEpoch probe finds epoch 0's log ends at 100 < cursor,
+    and the WHOLE destroyed range [100, 400) is booked as truncation —
+    the fold keeps the 150 records it already made (marked
+    non-authoritative), and the cursor never rewinds into the
+    replacement log (no double count)."""
+    rows = _rows(0, 400)
+    before = _loss_counters("truncation")
+    fences0 = obs_metrics.LOG_EPOCH_FENCES.value
+    checks0 = obs_metrics.LOG_DIVERGENCE_CHECKS.value
+    with FakeBroker(
+        TOPIC, {0: list(rows)}, max_records_per_fetch=50, modern=True
+    ) as broker:
+        hook = _FetchHook(3, lambda: broker.unclean_elect(0, truncate_to=100))
+        broker.response_delay = hook
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        result = run_scan(TOPIC, src, CpuExactBackend(_cfg(), init_now_s=10**10), 128)
+        src.close()
+    assert hook.fired
+    assert not result.degraded_partitions
+    assert obs_metrics.LOG_EPOCH_FENCES.value - fences0 >= 1
+    assert obs_metrics.LOG_DIVERGENCE_CHECKS.value - checks0 >= 1
+    d = result.lost_partitions[0]
+    assert d["authoritative"] is False
+    (span,) = d["spans"]
+    assert (span["start"], span["end"], span["reason"]) == (100, 400, "truncation")
+    assert span["records"] == 300
+    after = _loss_counters("truncation")
+    assert after[0] - before[0] == 300
+    assert after[1] - before[1] == 1
+
+    # The fold covers exactly the 150 records read before the election.
+    with FakeBroker(TOPIC, {0: rows[:150]}, max_records_per_fetch=50) as ref_broker:
+        ref_src = KafkaWireSource(
+            f"127.0.0.1:{ref_broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        referee = run_scan(
+            TOPIC, ref_src, CpuExactBackend(_cfg(), init_now_s=10**10), 128
+        )
+        ref_src.close()
+    assert result.metrics.to_dict({0: 0}, {0: 400}) == referee.metrics.to_dict(
+        {0: 0}, {0: 400}
+    )
+
+
+def test_clean_election_costs_fences_but_never_records():
+    """A leadership change WITHOUT truncation: the fenced fetch runs the
+    divergence probe, finds epoch 0's log intact at/above the cursor,
+    and the scan finishes byte-identical to an undisturbed run — fences
+    and divergence checks are booked, loss is not."""
+    rows = _rows(0, 400)
+    fences0 = obs_metrics.LOG_EPOCH_FENCES.value
+    with FakeBroker(
+        TOPIC, {0: list(rows)}, max_records_per_fetch=50, modern=True
+    ) as broker:
+        hook = _FetchHook(3, lambda: broker.unclean_elect(0))
+        broker.response_delay = hook
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        result = run_scan(TOPIC, src, CpuExactBackend(_cfg(), init_now_s=10**10), 128)
+        src.close()
+    assert hook.fired
+    assert not result.degraded_partitions
+    assert not result.lost_partitions
+    assert obs_metrics.LOG_EPOCH_FENCES.value - fences0 >= 1
+
+    with FakeBroker(
+        TOPIC, {0: list(rows)}, max_records_per_fetch=50, modern=True
+    ) as ref_broker:
+        ref_src = KafkaWireSource(
+            f"127.0.0.1:{ref_broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        referee = run_scan(
+            TOPIC, ref_src, CpuExactBackend(_cfg(), init_now_s=10**10), 128
+        )
+        ref_src.close()
+    assert _metrics_doc(result) == _metrics_doc(referee)
+    assert _metrics_doc(result)["overall"]["count"] == 400
+
+
+# ---------------------------------------------------------------------------
+# --on-data-loss policy: the exit-code contract
+
+
+def _cli_args(broker, *extra):
+    return [
+        "-t", TOPIC,
+        "-b", f"127.0.0.1:{broker.port}",
+        "--librdkafka", "retry.backoff.ms=5,reconnect.backoff.max.ms=40",
+        "--backend", "cpu", "-c", "--alive-bitmap-bits", "16",
+        "--quiet", "--native", "off",
+        *extra,
+    ]
+
+
+@pytest.mark.parametrize(
+    "policy,rc,has_block",
+    [("fail", EXIT_DATA_LOSS, None), ("report", 0, True), ("ignore", 0, False)],
+)
+def test_cli_on_data_loss_policy_exits(policy, rc, has_block, capsys):
+    """fail aborts with exit 5; report finishes with exit 0 AND the
+    DATA-LOSS block; ignore finishes with exit 0 and no block.  The
+    exit code outside the fail policy never reflects loss."""
+    with FakeBroker(TOPIC, {0: _rows(0, 400)}, max_records_per_fetch=50) as broker:
+        broker.response_delay = _FetchHook(3, lambda: broker.expire_to(0, 200))
+        assert main(_cli_args(broker, "--on-data-loss", policy)) == rc
+    out = capsys.readouterr().out
+    if has_block is True:
+        assert "DATA-LOSS" in out
+    elif has_block is False:
+        assert "DATA-LOSS" not in out
+
+
+def test_cli_json_carries_data_loss_map(capsys):
+    """--json under the default report policy: exit 0, parseable doc,
+    and a data_loss map with the exact booked span."""
+    with FakeBroker(TOPIC, {0: _rows(0, 400)}, max_records_per_fetch=50) as broker:
+        broker.response_delay = _FetchHook(3, lambda: broker.expire_to(0, 200))
+        assert main(_cli_args(broker, "--json", "--on-data-loss", "report")) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["overall"]["count"] == 350
+    loss = doc["data_loss"]["0"]
+    assert loss["records"] == 50
+    assert loss["authoritative"] is True
+    (span,) = loss["spans"]
+    assert (span["start"], span["end"], span["reason"]) == (150, 200, "retention")
+
+
+# ---------------------------------------------------------------------------
+# durability: checkpoints carry the loss facts across lives
+
+
+def test_resume_below_log_start_books_named_loss(tmp_path):
+    """Retention outruns a checkpoint: session 1 stops at offset 256,
+    the log start advances to 300, and the resumed session books the gap
+    [256, 300) as resume-below-log-start BEFORE its first fetch — then
+    finishes byte-identical to a clean scan of what survived both lives.
+    The final snapshot re-exports the span and the partition meta for
+    the next life."""
+    rows = _rows(0, 400)
+    cfg = _cfg()
+    before = _loss_counters("resume-below-log-start")
+    with FakeBroker(TOPIC, {0: list(rows)}, max_records_per_fetch=50) as broker:
+        bootstrap = f"127.0.0.1:{broker.port}"
+        src1 = KafkaWireSource(bootstrap, TOPIC, overrides=dict(FAST_RETRY))
+
+        class Half:
+            def __getattr__(self, name):
+                return getattr(src1, name)
+
+            def batches(self, batch_size, partitions=None, start_at=None):
+                it = src1.batches(batch_size, partitions, start_at)
+                for i, b in enumerate(it):
+                    if i >= 2:
+                        raise _Interrupt()
+                    yield b
+
+        with pytest.raises(_Interrupt):
+            run_scan(
+                TOPIC, Half(), TpuBackend(cfg, init_now_s=10**10), 128,
+                snapshot_dir=str(tmp_path), snapshot_every_s=0.0,
+            )
+        src1.close()
+
+        broker.expire_to(0, 300)
+        src2 = KafkaWireSource(bootstrap, TOPIC, overrides=dict(FAST_RETRY))
+        result = run_scan(
+            TOPIC, src2, TpuBackend(cfg, init_now_s=10**10), 128,
+            snapshot_dir=str(tmp_path), resume=True,
+        )
+        src2.close()
+    d = result.lost_partitions[0]
+    (span,) = d["spans"]
+    assert (span["start"], span["end"], span["reason"]) == (
+        256, 300, "resume-below-log-start",
+    )
+    after = _loss_counters("resume-below-log-start")
+    assert after[0] - before[0] == 44
+    assert after[1] - before[1] == 1
+
+    # The loss-carrying final snapshot: spans + partition meta round-trip.
+    saved = load_lost_spans(str(tmp_path))
+    assert any(
+        s["start"] == 256 and s["end"] == 300
+        and s["reason"] == "resume-below-log-start"
+        for s in saved
+    )
+    meta = load_partition_meta(str(tmp_path))
+    assert meta and meta[0]["log_start_offset"] >= 300
+
+    survivors = rows[:256] + rows[300:]
+    with FakeBroker(TOPIC, {0: survivors}, max_records_per_fetch=50) as ref_broker:
+        ref_src = KafkaWireSource(
+            f"127.0.0.1:{ref_broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        referee = run_scan(
+            TOPIC, ref_src, TpuBackend(cfg, init_now_s=10**10), 128
+        )
+        ref_src.close()
+    assert result.metrics.to_dict({0: 0}, {0: 400}) == referee.metrics.to_dict(
+        {0: 0}, {0: 400}
+    )
+
+
+def test_follow_retention_churn_across_polls():
+    """Two retention cycles land between follow polls, each expiring past
+    the follower's cursor: every cycle books its exact never-served gap
+    [cursor, new start), the cursor re-anchors forward, and the final
+    fold counts exactly the records that were ever fetchable."""
+    follow = FollowConfig(**FAST_FOLLOW)
+    with FakeBroker(TOPIC, {0: _rows(0, 150)}, max_records_per_fetch=50) as broker:
+        src = KafkaWireSource(
+            f"127.0.0.1:{broker.port}", TOPIC, overrides=dict(FAST_RETRY)
+        )
+        svc = FollowService(
+            TOPIC, src, CpuExactBackend(_cfg(batch_size=64), init_now_s=10**10),
+            64, follow,
+        )
+        errors = []
+
+        def driver():
+            try:
+                _wait_for(
+                    lambda: _published_count(svc) >= 150, what="phase-1 fold"
+                )
+                # Cycle 1: retention jumps the log to [200, ..) — the
+                # follower (at 150) never saw [150, 200).
+                broker.expire_to(0, 200)
+                broker.produce(0, _rows(0, 100, lo=200))
+                _wait_for(
+                    lambda: _published_count(svc) >= 250, what="cycle-1 fold"
+                )
+                # Cycle 2: again, from [300, ..) to [350, ..).
+                broker.expire_to(0, 350)
+                broker.produce(0, _rows(0, 50, lo=350))
+                _wait_for(
+                    lambda: _published_count(svc) >= 300, what="cycle-2 fold"
+                )
+            except BaseException as e:  # surfaced after join
+                errors.append(e)
+            finally:
+                svc.request_stop("test")
+
+        t = threading.Thread(target=driver)
+        t.start()
+        result = svc.run()
+        t.join()
+        src.close()
+        if errors:
+            raise errors[0]
+    d = result.lost_partitions[0]
+    assert d["records"] == 100
+    assert d["ranges"] == 2
+    assert d["reasons"] == {"retention": 2}
+    got = sorted((s["start"], s["end"]) for s in d["spans"])
+    assert got == [(150, 200), (300, 350)]
+    assert result.metrics.to_dict({0: 0}, {0: 400})["overall"]["count"] == 300
+
+
+def test_fleet_failover_inherits_loss_from_checkpoint(tmp_path):
+    """Instance A books a retention loss and checkpoints it; instance B
+    resumes the fleet from A's snapshots and must INHERIT the booked
+    loss — same per-topic lost_records in the rollup, spans marked
+    seeded — without re-incrementing the global loss counters, and
+    without tripping any_data_loss (loss under the report policy never
+    changes the fleet exit)."""
+    topics = ["logmut.a", "logmut.b"]
+    recs = {t: {0: _rows(i, 400)} for i, t in enumerate(topics)}
+
+    def mk_fleet(broker, resume):
+        def source_factory(topic):
+            return KafkaWireSource(
+                f"127.0.0.1:{broker.port}", topic, overrides=dict(FAST_RETRY)
+            )
+
+        def backend_factory(topic, parts, grant):
+            # Snapshot-capable backend: the inheritance under test rides
+            # the per-topic checkpoints.
+            return TpuBackend(_cfg(batch_size=64), init_now_s=10**10)
+
+        seeds = [TopicSeed(name=t, partitions=1) for t in topics]
+        return FleetService(
+            seeds, source_factory, backend_factory, 64,
+            FleetScheduler(2, 2, 2),
+            snapshot_dir=str(tmp_path), resume=resume,
+        )
+
+    with FakeBroker(
+        topics[0], recs[topics[0]],
+        extra_topics={topics[1]: recs[topics[1]]},
+        max_records_per_fetch=50,
+    ) as broker:
+        hook = _FetchHook(
+            2, lambda: [broker.expire_to(0, 300, topic=t) for t in topics]
+        )
+        broker.response_delay = hook
+        fr_a = mk_fleet(broker, resume=False).run_batch()
+        assert hook.fired
+        lost_a = {t: fr_a.statuses[t].lost_records for t in topics}
+        assert sum(lost_a.values()) > 0
+        assert not fr_a.any_data_loss
+        assert all(fr_a.statuses[t].status == "ok" for t in topics)
+
+        before = _loss_counters("retention")
+        svc_b = mk_fleet(broker, resume=True)
+        fr_b = svc_b.run_batch()
+    # Inherited, not re-counted.
+    assert _loss_counters("retention") == before
+    assert not fr_b.any_data_loss
+    for t in topics:
+        assert fr_b.statuses[t].lost_records == lost_a[t]
+        if lost_a[t]:
+            spans = svc_b.scans[t].result.lost_partitions[0]["spans"]
+            assert spans and all(s.get("seeded") for s in spans)

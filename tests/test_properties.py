@@ -182,6 +182,7 @@ def test_response_decoders_total_on_garbage(buf):
         kc.decode_list_offsets_response,
         kc.decode_fetch_response,
         kc.decode_api_versions_response,
+        kc.decode_offset_for_leader_epoch_response,
     ):
         # Classic AND flexible wire formats: both read untrusted bytes.
         for version in (1, 4, 7, 12):

@@ -1246,3 +1246,174 @@ if failures:
     sys.exit(1)
 print("lint: OK (lease transitions book their reason; none silent)")
 EOF
+
+# Fourteenth rule: no silent cursor jumps past unread log.  The wire
+# source (io/kafka_wire.py) may advance a partition cursor past offsets
+# it never read ONLY on a path that books the skip: the kta_log_*
+# family through KafkaWireSource._note_lost (retention races, epoch
+# fences, truncation, resume-below-log-start) or the corruption ledger
+# through _note_corrupt/book_corruption (poison-frame skips).
+# AST-enforced four ways:
+# (a) _note_lost is the one loss choke point: it books BOTH kta_log_*
+#     counters, emits the typed event, and carries the --on-data-loss
+#     fail abort (DataLossError) — booking that cannot meter or abort
+#     is a lint failure;
+# (b) every function classifying a log-mutation signal (referencing
+#     ERR_OFFSET_OUT_OF_RANGE / ERR_FENCED_LEADER_EPOCH /
+#     ERR_UNKNOWN_LEADER_EPOCH) must reach _note_lost or a LOG_*
+#     instrument — no mutation-classified path is silent;
+# (c) every subscript assignment to a cursor map (next_offset/offsets)
+#     whose value is NOT a read-derived progression (last+1, covered,
+#     frame_next, max_frame_end — values bounded by frames actually
+#     read) sits in a function that references a booking helper or a
+#     LOG_*/CORRUPT* instrument;
+# (d) the follow service's watermark poll (serve/follow.py _poll) books
+#     kta_log_watermark_regressions_total and emits the event before it
+#     holds or adopts a regressed head.
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+WIRE = pathlib.Path("kafka_topic_analyzer_tpu") / "io" / "kafka_wire.py"
+FOLLOW = pathlib.Path("kafka_topic_analyzer_tpu") / "serve" / "follow.py"
+
+failures = []
+
+MUTATION_SIGNALS = {
+    "ERR_OFFSET_OUT_OF_RANGE",
+    "ERR_FENCED_LEADER_EPOCH",
+    "ERR_UNKNOWN_LEADER_EPOCH",
+}
+BOOKERS = {"_note_lost", "_note_corrupt", "book_corruption"}
+CURSOR_MAPS = {"next_offset", "offsets"}
+#: Value leaves that prove the advance is bounded by frames actually
+#: read (batch-header progression), not by watermarks or probes.
+PROGRESSION_NAMES = {"last", "covered", "frame_next", "max_frame_end"}
+
+
+def refs(fn):
+    return {
+        n.attr for n in ast.walk(fn) if isinstance(n, ast.Attribute)
+    } | {n.id for n in ast.walk(fn) if isinstance(n, ast.Name)}
+
+
+def books(names):
+    return bool(
+        BOOKERS & names
+        or any(n.startswith(("LOG_", "CORRUPT")) for n in names)
+    )
+
+
+def nearest_functions(tree):
+    enclosing = {}
+
+    def walk(node, fn):
+        for child in ast.iter_child_nodes(node):
+            f = fn
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f = child
+            enclosing[id(child)] = f
+            walk(child, f)
+
+    walk(tree, None)
+    return enclosing
+
+
+wire_tree = ast.parse(WIRE.read_text(encoding="utf-8"), filename=str(WIRE))
+enclosing = nearest_functions(wire_tree)
+
+# (a) the choke point itself.
+note_lost = None
+for node in ast.walk(wire_tree):
+    if isinstance(node, ast.FunctionDef) and node.name == "_note_lost":
+        note_lost = node
+if note_lost is None:
+    failures.append(f"{WIRE}: KafkaWireSource._note_lost missing")
+else:
+    names = refs(note_lost)
+    for need in ("LOG_LOST_RECORDS", "LOG_LOST_RANGES", "emit",
+                 "DataLossError"):
+        if need not in names:
+            failures.append(
+                f"{WIRE}:{note_lost.lineno}: _note_lost does not "
+                f"reference {need} — loss booking must meter both "
+                "kta_log_* counters, emit the event, and carry the "
+                "--on-data-loss fail abort"
+            )
+
+# (b) mutation-signal classification is never silent.
+for node in ast.walk(wire_tree):
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        continue
+    names = refs(node)
+    if MUTATION_SIGNALS & names and node.name != "_note_lost":
+        if "_note_lost" not in names and not any(
+            n.startswith("LOG_") for n in names
+        ):
+            failures.append(
+                f"{WIRE}:{node.lineno}: {node.name} classifies a "
+                "log-mutation signal but never reaches _note_lost or a "
+                "kta_log_* instrument"
+            )
+
+# (c) cursor jumps book their reason.
+for node in ast.walk(wire_tree):
+    if not isinstance(node, ast.Assign):
+        continue
+    for t in node.targets:
+        if not (
+            isinstance(t, ast.Subscript)
+            and isinstance(t.value, ast.Name)
+            and t.value.id in CURSOR_MAPS
+        ):
+            continue
+        value_leaves = {
+            n.id for n in ast.walk(node.value) if isinstance(n, ast.Name)
+        }
+        if value_leaves & PROGRESSION_NAMES:
+            continue  # read-derived advance: always legal
+        fn = enclosing.get(id(node))
+        fn_names = refs(fn) if fn is not None else set()
+        if not books(fn_names):
+            failures.append(
+                f"{WIRE}:{node.lineno}: cursor jump "
+                f"({t.value.id}[...] = non-progression value) in "
+                f"{getattr(fn, 'name', '<module>')} books no kta_log_*/"
+                "corruption reason — a skip past unread offsets must be "
+                "accounted"
+            )
+
+# (d) the follow poll books watermark regressions.
+follow_tree = ast.parse(
+    FOLLOW.read_text(encoding="utf-8"), filename=str(FOLLOW)
+)
+poll = None
+for node in ast.walk(follow_tree):
+    if isinstance(node, ast.FunctionDef) and node.name == "_poll":
+        poll = node
+if poll is None:
+    failures.append(f"{FOLLOW}: FollowService._poll missing")
+else:
+    names = refs(poll)
+    if "LOG_WATERMARK_REGRESSIONS" not in names:
+        failures.append(
+            f"{FOLLOW}:{poll.lineno}: _poll handles end-watermark "
+            "regression without booking "
+            "kta_log_watermark_regressions_total"
+        )
+    if "emit" not in names:
+        failures.append(
+            f"{FOLLOW}:{poll.lineno}: _poll emits no typed event for "
+            "watermark regression"
+        )
+
+if failures:
+    print("lint: cursor advances past unread log must book a kta_log_*")
+    print("lint: (or corruption) reason — the scan never skips offsets")
+    print("lint: silently (DESIGN.md §24):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (cursor jumps book their loss reason; none silent)")
+EOF
