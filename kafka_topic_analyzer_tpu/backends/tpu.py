@@ -205,7 +205,18 @@ class TpuBackend(MetricBackend):
             _checked_devices.add(key)
         with jax.default_device(self.device):
             self.state = AnalyzerState.init(config)
-        self._step = jax.jit(make_packed_step(config), donate_argnums=(0,))
+        # State donation is an accelerator-memory optimization only.  On
+        # the host-CPU platform it is actively UNSAFE under the fleet's
+        # concurrent per-topic scan threads: concurrent dispatches of a
+        # donated-state executable race XLA:CPU's donation bookkeeping,
+        # and a live state buffer can be freed while still referenced —
+        # the resumed fold then reads recycled heap memory (pointer-sized
+        # garbage in counts/HLL registers).  States are KBs on CPU, so
+        # the extra copy per step costs nothing measurable there.
+        self._donate = (0,) if self.device.platform != "cpu" else ()
+        self._step = jax.jit(
+            make_packed_step(config), donate_argnums=self._donate
+        )
         # Superbatch dispatch layer (config.DispatchConfig): K packed
         # buffers per jitted scan dispatch, up to `depth` superbatches in
         # flight.  K=1 keeps the classic one-dispatch-per-batch path
@@ -229,7 +240,7 @@ class TpuBackend(MetricBackend):
             )
             self._superstep = jax.jit(
                 make_packed_superstep(config, self.superbatch_k),
-                donate_argnums=(0,),
+                donate_argnums=self._donate,
             )
             self._stager = SuperbatchStager(
                 (packed_nbytes(config, config.batch_size),),

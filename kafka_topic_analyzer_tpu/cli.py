@@ -153,19 +153,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "counts), --superbatch, --segment-readahead and "
                         "--segment-cache")
     p.add_argument("--segment-readahead", default="auto", metavar="N|auto",
-                   help="Remote chunks prefetched ahead of each ingest "
-                        "stream (per --ingest-workers worker), so per-GET "
-                        "wire latency overlaps the running decode→pack "
-                        "pass instead of serializing with it. 'auto' = 4 "
-                        "for remote stores, 0 (synchronous) for local "
-                        "directories. Results are byte-identical at any "
-                        "depth. Default: auto")
+                   help="Remote chunks kept in flight ahead of each ingest "
+                        "stream (the per-stream window over the shared "
+                        "fetch scheduler), so per-GET wire latency "
+                        "overlaps the running decode→pack pass instead "
+                        "of serializing with it. 'auto' = 4 for remote "
+                        "stores, 0 (demand-only) for local directories. "
+                        "Results are byte-identical at any depth. "
+                        "Default: auto")
+    p.add_argument("--fetch-concurrency", default="auto", metavar="N|auto",
+                   help="Worker count of the ONE process-wide fetch "
+                        "scheduler every remote segment byte is admitted "
+                        "through (catalog header probes, demand fetches, "
+                        "read-ahead) — sized once per process, not per "
+                        "stream, so connection count stays fixed while "
+                        "--ingest-workers scales. Demand requests outrank "
+                        "speculative read-ahead; streams share the pool "
+                        "fairly. 'auto' sizes from the host and grows "
+                        "with the resolved stream count. Default: auto")
     p.add_argument("--segment-cache", metavar="DIR",
                    help="Local chunk cache for remote segment stores: "
                         "fetched chunks land here (atomic rename-in, "
                         "sha256 sidecar) and repeated audits of the same "
                         "archive run at local-disk speed. Entries are "
-                        "verified on every hit — a flipped byte is "
+                        "sha256-verified at first touch each process "
+                        "(then latched as trusted and served as "
+                        "zero-copy mmap views) — a flipped byte is "
                         "detected, booked and re-fetched, never served")
     p.add_argument("--segment-cache-bytes", type=int, default=1 << 30,
                    metavar="BYTES",
@@ -530,6 +543,7 @@ def make_source(args, topic: "str | None" = None, seed_salt: int = 0) -> "object
             readahead=getattr(args, "segment_readahead", "auto"),
             cache_dir=getattr(args, "segment_cache", None),
             cache_max_bytes=getattr(args, "segment_cache_bytes", 1 << 30),
+            fetch_concurrency=getattr(args, "fetch_concurrency", "auto"),
         )
         # The remote tier runs the SAME retry substrate as the wire scan,
         # so the same --librdkafka knobs tune it (retry.backoff.ms,

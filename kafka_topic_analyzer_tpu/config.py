@@ -412,15 +412,24 @@ class SegmentFetchConfig:
     other store holding the same segments (cross-store resume).
     """
 
-    #: Chunks prefetched ahead of the consuming stream, PER ingest stream
-    #: (each ``--ingest-workers`` worker runs its own pool, so in-flight
-    #: chunk memory is bounded by workers × (readahead + 1) chunks).
-    #: ``"auto"`` resolves per store: 0 for local directories (the memmap
-    #: faults pages in for free) and 4 for remote stores (enough streams
-    #: in flight to hide tens of ms of per-GET latency behind the fused
-    #: decode→pack pass).  0 disables the pool: every chunk fetch is
-    #: synchronous at first touch.
+    #: Chunks kept in flight ahead of each consuming ingest stream (the
+    #: per-stream WINDOW; the process-wide fetch scheduler in
+    #: io/fetchsched.py supplies the workers).  In-flight chunk memory
+    #: stays bounded by streams × (readahead + 1) chunks.  ``"auto"``
+    #: resolves per store: 0 for local directories (the memmap faults
+    #: pages in for free) and 4 for remote stores (enough speculation in
+    #: flight to hide tens of ms of per-GET latency behind the fused
+    #: decode→pack pass).  0 disables speculation: every chunk is a
+    #: demand fetch at first touch — still admitted through the
+    #: scheduler.
     readahead: "int | str" = "auto"
+    #: Worker count of the ONE process-wide fetch scheduler
+    #: (``--fetch-concurrency N|auto``) — the single admission point for
+    #: every remote byte.  Sized once per process, NOT per stream: total
+    #: connection count no longer multiplies with ingest workers.
+    #: ``"auto"`` sizes from the host (min(16, max(4, cpu_count))) and
+    #: grows with the resolved ingest-stream count; an explicit N pins it.
+    fetch_concurrency: "int | str" = "auto"
     #: Local chunk-cache directory (``--segment-cache``); None disables.
     #: Remote stores only — caching a local directory would just copy it.
     cache_dir: "str | None" = None
@@ -446,6 +455,14 @@ class SegmentFetchConfig:
                 )
         elif self.readahead < 0:
             raise ValueError("segment readahead must be >= 0")
+        if isinstance(self.fetch_concurrency, str):
+            if self.fetch_concurrency != "auto":
+                raise ValueError(
+                    f"fetch concurrency {self.fetch_concurrency!r} invalid "
+                    "(an integer >= 1, or 'auto')"
+                )
+        elif self.fetch_concurrency < 1:
+            raise ValueError("fetch concurrency must be >= 1")
         if self.cache_max_bytes < 1:
             raise ValueError("--segment-cache-bytes must be >= 1")
         if self.timeout_s <= 0:
@@ -457,8 +474,10 @@ class SegmentFetchConfig:
         readahead: str = "auto",
         cache_dir: "str | None" = None,
         cache_max_bytes: int = 1 << 30,
+        fetch_concurrency: str = "auto",
     ) -> "SegmentFetchConfig":
-        """CLI spelling: ``--segment-readahead N|auto`` + cache flags."""
+        """CLI spelling: ``--segment-readahead N|auto``,
+        ``--fetch-concurrency N|auto``, + cache flags."""
         text = str(readahead).strip().lower()
         if text == "auto":
             ra: "int | str" = "auto"
@@ -470,8 +489,20 @@ class SegmentFetchConfig:
                     f"bad --segment-readahead {readahead!r}: expected an "
                     "integer >= 0 or 'auto'"
                 ) from None
+        fc_text = str(fetch_concurrency).strip().lower()
+        if fc_text == "auto":
+            fc: "int | str" = "auto"
+        else:
+            try:
+                fc = int(fc_text)
+            except ValueError:
+                raise ValueError(
+                    f"bad --fetch-concurrency {fetch_concurrency!r}: "
+                    "expected an integer >= 1 or 'auto'"
+                ) from None
         return cls(
-            readahead=ra, cache_dir=cache_dir, cache_max_bytes=cache_max_bytes
+            readahead=ra, cache_dir=cache_dir, cache_max_bytes=cache_max_bytes,
+            fetch_concurrency=fc,
         )
 
     def resolve_readahead(self, remote: bool) -> int:
@@ -481,6 +512,14 @@ class SegmentFetchConfig:
         if self.readahead == "auto":
             return 4 if remote else 0
         return int(self.readahead)
+
+    def resolve_concurrency(self) -> "int | None":
+        """Concrete scheduler pool size, or None for ``auto`` (the
+        scheduler sizes itself from the host and the engine's resolved
+        ingest-stream count — io/fetchsched.py)."""
+        if self.fetch_concurrency == "auto":
+            return None
+        return int(self.fetch_concurrency)
 
 
 #: Valid --on-corruption policies, in escalation order.

@@ -168,6 +168,19 @@ class _ProgressTracker:
             self.next_offsets[p] = self.next_offsets.get(p, 0) + c
 
 
+def _note_fetch_streams(source, workers: int) -> None:
+    """Tell the process-wide fetch scheduler how many ingest streams this
+    scan just resolved: under ``--fetch-concurrency auto`` the shared
+    pool grows so every stream can keep a demand fetch plus some
+    speculation in flight (an explicit size is never overridden).  Only
+    remote segment sources feed the scheduler — everything else is a
+    no-op."""
+    if bool(getattr(getattr(source, "store", None), "is_remote", False)):
+        from kafka_topic_analyzer_tpu.io import fetchsched
+
+        fetchsched.note_streams(workers)
+
+
 def run_scan(
     topic: str,
     source: RecordSource,
@@ -712,6 +725,7 @@ def run_scan(
             # Recorded per process so the gather below can report the
             # RESOLVED per-controller counts, not just a global scalar.
             obs_metrics.INGEST_RESOLVED_WORKERS.set(used_workers)
+            _note_fetch_streams(source, used_workers)
             # Cold sources (segment catalogs) know per-partition record
             # counts: balance each row's worker groups by records
             # (greedy-LPT), exactly like the single-device path below.
@@ -845,6 +859,7 @@ def run_scan(
             )
             used_workers = ingest_cfg.resolve(len(pindex))
             obs_metrics.INGEST_RESOLVED_WORKERS.set(used_workers)
+            _note_fetch_streams(source, used_workers)
             if used_workers > 1:
                 # Partition-sharded parallel ingest (--ingest-workers): N
                 # private fetch→decode→pack streams, merged through a

@@ -298,6 +298,23 @@ class FleetService:
             log.exception("fleet: source for topic %r failed", scan.seed.name)
             return False
 
+    def _release_source(self, scan: _TopicScan) -> None:
+        """Close and drop a stopped topic's source.  Shared-pool hygiene:
+        remote segment sources hold chunk bodies and fetch-scheduler
+        streams, and the scheduler pool is ONE per process — a fenced or
+        failed topic must stop competing for its workers the moment it
+        stops scanning, not at fleet teardown.  A later pass (re-acquire
+        after fencing, batch retry) rebuilds through _ensure_source."""
+        source, scan.source = scan.source, None
+        if source is not None and hasattr(source, "close"):
+            try:
+                source.close()
+            except BaseException:  # noqa: BLE001 — teardown best-effort
+                log.exception(
+                    "fleet: closing source for topic %r failed",
+                    scan.seed.name,
+                )
+
     def _run_pass(
         self, scan: _TopicScan, grant: Grant, final: bool = False
     ) -> bool:
@@ -362,6 +379,7 @@ class FleetService:
             if self.leases is not None:
                 self.leases.fence(topic)
             log.warning("fleet: topic %r fenced: %s", topic, e)
+            self._release_source(scan)
             return False
         except BaseException as e:  # noqa: BLE001 — isolation boundary
             from kafka_topic_analyzer_tpu.io.kafka_wire import DataLossError
@@ -377,10 +395,12 @@ class FleetService:
                     "fleet: scan of topic %r stopped on data loss: %s",
                     topic, e,
                 )
+                self._release_source(scan)
                 return False
             scan.status.status = "failed"
             scan.status.error = f"{type(e).__name__}: {e}"
             log.exception("fleet: scan of topic %r failed", topic)
+            self._release_source(scan)
             return False
         scan.first = False
         scan.result = result

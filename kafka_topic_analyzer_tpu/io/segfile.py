@@ -308,15 +308,18 @@ class RemoteSegmentFile(SegmentFile):
     ``column`` views, ``read_batch`` zero-copy semantics, the fused
     ``append_columns`` feed — works byte-for-byte like the memory-mapped
     local file, because ``_mm`` is the same uint8 array shape over the
-    same bytes.  ``release()`` drops the body reference once the stream
-    has consumed the chunk (outstanding batch views keep the buffer alive
-    through numpy's base refcount), bounding a stream's resident memory
-    to readahead + 1 chunks.
+    same bytes (a verified cache hit arrives as the cache file's memmap —
+    zero-copy straight through).  ``release()`` drops the body reference
+    once the stream has consumed the chunk (outstanding batch views keep
+    the buffer alive through numpy's base refcount) and best-effort
+    cancels a scheduler request for it that never started — degraded-skip
+    paths must not pay for bytes nobody will read — bounding a stream's
+    resident memory to readahead + 1 chunks.
 
-    Acquisition failures are CACHED on the file: a read-ahead pool thread
-    that hit a deterministic failure (classified corruption, exhausted
-    retry budget) must hand the consumer exactly that failure, not
-    trigger a second fetch cycle.
+    Acquisition failures are CACHED on the file: a scheduler worker that
+    hit a deterministic failure (classified corruption, exhausted retry
+    budget) must hand the consumer exactly that failure, not trigger a
+    second fetch cycle.
     """
 
     def __init__(
@@ -347,6 +350,10 @@ class RemoteSegmentFile(SegmentFile):
         self._lock = threading.Lock()
         self._data: "Optional[np.ndarray]" = None
         self._failure: "Optional[BaseException]" = None
+        #: The fetch scheduler ticket covering this chunk's body, while
+        #: one is queued or in flight (set by the read-ahead window so
+        #: release() can cancel a fetch that never started).
+        self._pending = None
         self._const_partition = None
         self._const_valid = None
 
@@ -364,9 +371,9 @@ class RemoteSegmentFile(SegmentFile):
 
     def ensure_body(self) -> np.ndarray:
         """The chunk's bytes, fetching (cache → store, verified) on first
-        touch.  Thread-safe: a read-ahead pool thread and the consuming
-        stream serialize on the per-chunk lock, so the consumer blocks on
-        an in-flight prefetch instead of fetching twice."""
+        touch.  Thread-safe: a scheduler worker and the consuming stream
+        serialize on the per-chunk lock, so the consumer blocks on an
+        in-flight prefetch instead of fetching twice."""
         with self._lock:
             if self._failure is not None:
                 raise self._failure
@@ -376,7 +383,12 @@ class RemoteSegmentFile(SegmentFile):
                 except (CorruptSegmentError, SegmentFetchUnavailable) as e:
                     self._failure = e  # deterministic: replay, don't refetch
                     raise
-                self._data = np.frombuffer(raw, dtype=np.uint8)
+                # A verified cache hit is already a uint8 memmap view —
+                # keep it zero-copy; a transport body is bytes.
+                self._data = (
+                    raw if isinstance(raw, np.ndarray)
+                    else np.frombuffer(raw, dtype=np.uint8)
+                )
             return self._data
 
     def _validate_body(self, raw: bytes) -> None:
@@ -412,15 +424,22 @@ class RemoteSegmentFile(SegmentFile):
 
     def release(self) -> None:
         """Drop the body reference (batch views already handed out keep
-        the underlying buffer alive; new touches re-fetch via the cache).
+        the underlying buffer alive; new touches re-fetch via the cache),
+        and cancel a scheduler request for this chunk that has not
+        started yet (booked on ``kta_fetch_sched_cancelled_total``) —
+        the degraded-skip and teardown paths must not pay for bytes
+        nobody will read.
 
         BEST-EFFORT: ``ensure_body`` holds the per-chunk lock for the
         whole fetch (socket timeout + backoff sleeps), and release is
         called from teardown paths — the degraded-partition skip and the
         end-of-stream sweep — that must never stall tens of seconds
-        behind a pool thread stuck in a hung request.  If the lock is
-        busy, the in-flight fetch owns the body's lifetime; memory stays
-        bounded by the pool depth either way."""
+        behind a scheduler worker stuck in a hung request.  If the lock
+        is busy, the in-flight fetch owns the body's lifetime; memory
+        stays bounded by the read-ahead window either way."""
+        ticket, self._pending = self._pending, None
+        if ticket is not None:
+            ticket.cancel()  # no-op once running/done; booked if it lands
         if self._lock.acquire(blocking=False):
             try:
                 self._data = None
@@ -428,26 +447,34 @@ class RemoteSegmentFile(SegmentFile):
                 self._lock.release()
 
 
-class _ChunkReadahead:
-    """Bounded per-stream read-ahead pool (``--segment-readahead N``).
+class _ScheduledReadahead:
+    """One stream's read-ahead WINDOW over the process-wide fetch
+    scheduler (``--segment-readahead N`` · io/fetchsched.py).
 
-    While the consuming stream runs chunk i through decode→pack, up to N
-    further chunks of the SAME stream are fetching on pool threads
-    (``RemoteSegmentFile.ensure_body`` — cache-aware, failure-caching),
-    so per-GET wire latency overlaps compute instead of serializing with
-    it.  Pool threads never surface errors: a failed prefetch parks the
-    failure on its chunk, and the consumer re-raises it at the chunk's
+    The stream no longer owns a thread pool: it registers a `FetchStream`
+    with the shared scheduler and keeps chunks [i, i+N] of its plan
+    *submitted* — the head of the window (the chunk the decoder will need
+    next) at DEMAND class, the rest speculative.  The scheduler's
+    admission policy does the rest: demand beats speculation
+    process-wide, streams round-robin within a class, and the worker
+    count is ``--fetch-concurrency`` no matter how many streams run.
+    Workers never surface errors: a failed prefetch parks the failure on
+    its chunk (``RemoteSegmentFile.ensure_body`` — cache-aware,
+    failure-caching), and the consumer re-raises it at the chunk's
     position in the stream — ordering, degradation, and corruption
     semantics are exactly the synchronous path's.
+
+    In-flight chunk memory stays bounded at (N + 1) chunks per stream:
+    only submitted-window bodies can materialize, and the consumer
+    releases each chunk as it passes.
     """
 
     def __init__(self, depth: int):
-        import concurrent.futures
+        from kafka_topic_analyzer_tpu.io.fetchsched import get_scheduler
 
         self.depth = depth
-        self._ex = concurrent.futures.ThreadPoolExecutor(
-            max_workers=depth, thread_name_prefix="kta-seg-readahead"
-        )
+        self._stream = get_scheduler().stream()
+        self._tickets: "Dict[int, object]" = {}
         self._submitted: "set[int]" = set()
         self._consumed: "set[int]" = set()
 
@@ -459,8 +486,10 @@ class _ChunkReadahead:
             pass  # parked on the segment; the consumer re-raises in order
 
     def schedule(self, plan, i: int, degraded: "Dict[int, str]") -> None:
-        """Keep chunks [i, i+N] of the plan in flight (skipping local
-        chunks and partitions already degraded this scan)."""
+        """Keep chunks [i, i+N] of the plan submitted (skipping local
+        chunks and partitions already degraded this scan).  Chunk i — the
+        one the consumer is about to block on — submits at DEMAND class;
+        the look-ahead tail is speculative."""
         for j in range(i, min(i + self.depth + 1, len(plan))):
             if j in self._submitted:
                 continue
@@ -470,19 +499,39 @@ class _ChunkReadahead:
                 self._consumed.add(j)
                 continue
             obs_metrics.SEGSTORE_READAHEAD.inc(1)
-            self._ex.submit(self._prefetch, seg)
+            ticket = self._stream.submit(
+                lambda s=seg: self._prefetch(s),
+                seq=j,
+                speculative=(j != i),
+            )
+            self._tickets[j] = ticket
+            seg._pending = ticket  # so release() can cancel a queued fetch
+
+    def claim(self, i: int) -> None:
+        """The consumer is blocked on chunk i NOW: promote its request to
+        DEMAND if it is still queued behind speculative work (booked as a
+        deadline reorder) and wait for the worker to finish it.  The
+        subsequent ``ensure_body`` then finds the body — or the parked
+        failure — without fetching twice (per-chunk lock)."""
+        ticket = self._tickets.get(i)
+        if ticket is not None:
+            self._stream.demand(ticket)
 
     def done(self, i: int) -> None:
         """The consumer reached chunk i: it no longer counts as ahead."""
         if i in self._submitted and i not in self._consumed:
             self._consumed.add(i)
             obs_metrics.SEGSTORE_READAHEAD.inc(-1)
+        self._tickets.pop(i, None)
 
     def close(self) -> None:
         for j in self._submitted - self._consumed:
             self._consumed.add(j)
             obs_metrics.SEGSTORE_READAHEAD.inc(-1)
-        self._ex.shutdown(wait=False, cancel_futures=True)
+        self._tickets.clear()
+        # Unregisters the stream from the scheduler: queued requests are
+        # cancelled (booked), in-flight ones finish on their workers.
+        self._stream.close()
 
 
 class SegmentDumpWriter:
@@ -654,13 +703,23 @@ class SegmentFileSource(RecordSource):
             store = open_segment_store(store, fetch=fetch)
         self.store = store
         self.topic = topic
+        remote = bool(getattr(store, "is_remote", False))
+        if remote:
+            # Size the ONE process-wide fetch scheduler before the catalog
+            # fans out its header probes through it.  An explicit
+            # --fetch-concurrency pins the pool; auto lets the engine's
+            # resolved stream count grow it (fetchsched.note_streams).
+            from kafka_topic_analyzer_tpu.io import fetchsched
+
+            concurrency = fetch.resolve_concurrency()
+            if concurrency is not None:
+                fetchsched.configure(concurrency, explicit=True)
         self.catalog = SegmentCatalog(store, topic)
         self.segments: Dict[int, List[SegmentFile]] = self.catalog.segments
-        #: Per-stream read-ahead depth (0 = synchronous-at-first-touch;
-        #: resolves to 0 for local stores, where there is nothing to hide).
-        self.readahead = fetch.resolve_readahead(
-            bool(getattr(store, "is_remote", False))
-        )
+        #: Per-stream read-ahead WINDOW (0 = demand-only, no speculation;
+        #: resolves to 0 for local stores, where there is nothing to
+        #: hide).  The process-wide fetch scheduler supplies the workers.
+        self.readahead = fetch.resolve_readahead(remote)
         #: partition -> reason, for partitions dropped mid-scan after their
         #: chunk fetches exhausted the transport retry budget (the PR-1
         #: degraded surface, shared across parallel-ingest worker streams).
@@ -673,6 +732,17 @@ class SegmentFileSource(RecordSource):
 
     def partitions(self) -> List[int]:
         return sorted(self.segments)
+
+    def close(self) -> None:
+        """Release every remote chunk body this catalog still holds (and
+        cancel their queued scheduler requests) — fleet teardown and
+        per-topic failure paths must stop a finished source from pinning
+        memory or competing for the shared fetch pool.  Local memmaps
+        need nothing: pages un-fault on their own."""
+        for chunks in self.segments.values():
+            for seg in chunks:
+                if isinstance(seg, RemoteSegmentFile):
+                    seg.release()
 
     def degraded_partitions(self) -> Dict[int, str]:
         return dict(self._degraded)
@@ -725,12 +795,22 @@ class SegmentFileSource(RecordSource):
                     if resume > seg.start_offset:
                         # Only the ONE chunk straddling the resume point
                         # needs its offsets column (a synchronous body
-                        # fetch on remote stores); chunks entirely above
+                        # fetch on remote stores — admitted through the
+                        # shared scheduler as a demand request, like
+                        # every other remote byte); chunks entirely above
                         # the resume point start at record 0 — probing
                         # them too would download every remaining chunk
                         # at plan time and pin them all in memory.
                         if seg.has_offsets:
                             try:
+                                if isinstance(seg, RemoteSegmentFile):
+                                    from kafka_topic_analyzer_tpu.io import (
+                                        fetchsched,
+                                    )
+
+                                    fetchsched.get_scheduler().run(
+                                        seg.ensure_body
+                                    )
                                 offs = np.asarray(seg.column("offsets"))
                             except SegmentFetchUnavailable as e:
                                 # Plan-time fetches degrade like consumer
@@ -744,10 +824,11 @@ class SegmentFileSource(RecordSource):
                             )
                 plan.append((p, seg, first))
         pool = None
-        if self.readahead > 0 and any(
-            isinstance(seg, RemoteSegmentFile) for _, seg, _ in plan
-        ):
-            pool = _ChunkReadahead(self.readahead)
+        if any(isinstance(seg, RemoteSegmentFile) for _, seg, _ in plan):
+            # EVERY remote plan routes through the shared scheduler —
+            # readahead 0 just shrinks the window to demand-only
+            # (chunk i submits at DEMAND class, nothing speculates).
+            pool = _ScheduledReadahead(self.readahead)
         try:
             for i, (p, seg, first) in enumerate(plan):
                 if p in self._degraded:
@@ -766,6 +847,12 @@ class SegmentFileSource(RecordSource):
                         # Materialize the body HERE, before any records are
                         # booked or appended: a chunk either enters the
                         # scan whole or degrades its partition cleanly.
+                        # claim() first — if the chunk's request is still
+                        # queued behind speculative work, promote it to
+                        # demand class (the deadline rule) and ride the
+                        # worker's fetch instead of starting a second one.
+                        if pool is not None:
+                            pool.claim(i)
                         seg.ensure_body()
                 except SegmentFetchUnavailable as e:
                     # The transport budget for this partition ran out:

@@ -12,7 +12,8 @@ chunks and open one for reading.  This module is that seam:
   HTTP client (LIST + ranged GET via io/objstore.py's retry-budget
   transport) whose catalog validation runs off ranged HEADER probes —
   never a chunk body — and whose chunk bodies arrive lazily through the
-  read-ahead pool and the sha256-verified local cache.
+  process-wide fetch scheduler (io/fetchsched.py) and the sha256-verified
+  local cache.
   `open_segment_store` is the factory: plain paths and ``file://`` are
   local, ``http(s)://`` / ``s3://`` are remote.
 - `SegmentCatalog` — a validated view of one topic's chunks: header↔name
@@ -187,7 +188,9 @@ class ObjectSegmentStore(SegmentStore):
                 )
                 if self.cache is not None:
                     self.cache.put(ref.name, ref.size, whole)
-            header = whole[: min(HEADER_SIZE, ref.size)]
+            # bytes(), not a view: a cache hit is a memmap and the header
+            # is stored for bytes-equality checks downstream.
+            header = bytes(whole[: min(HEADER_SIZE, ref.size)])
         else:
             header = self.transport.get(
                 path,
@@ -203,7 +206,7 @@ class ObjectSegmentStore(SegmentStore):
             # Gappy chunk: the offset-exact end watermark is the LAST
             # offsets entry — an 8-byte suffix probe, not a body download.
             if whole is not None:
-                tail = whole[ref.size - 8 : ref.size]
+                tail = bytes(whole[ref.size - 8 : ref.size])
             else:
                 tail = self.transport.get(
                     path,
@@ -224,21 +227,24 @@ class ObjectSegmentStore(SegmentStore):
         """Open many refs with their header probes in flight concurrently
         (order-preserving).  An archived year is tens of thousands of
         chunks; serial round-trips would put a wire RTT in front of every
-        one before the scan even starts."""
+        one before the scan even starts.  The probes run as DEMAND
+        requests on the process-wide fetch scheduler — the catalog no
+        longer brings its own pool, so its burst shares (and is bounded
+        by) the same ``--fetch-concurrency`` admission as every other
+        remote byte."""
         if len(refs) <= 1:
             return [self.open(r) for r in refs]
-        import concurrent.futures
+        from kafka_topic_analyzer_tpu.io.fetchsched import get_scheduler
 
-        with concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(8, len(refs)),
-            thread_name_prefix="kta-seg-catalog",
-        ) as ex:
-            return list(ex.map(self.open, refs))
+        return get_scheduler().run_all(
+            [lambda r=r: self.open(r) for r in refs]
+        )
 
-    def fetch_chunk(self, ref: SegmentRef, validate) -> bytes:
+    def fetch_chunk(self, ref: SegmentRef, validate):
         """One whole verified chunk body (RemoteSegmentFile.ensure_body's
-        acquisition path): cache hit (sha256-checked) → else a
-        budget-retried GET, classified by ``validate`` with one
+        acquisition path): cache hit (sha256-checked once per process,
+        then latched; served as a zero-copy memmap view) → else a
+        budget-retried GET (bytes), classified by ``validate`` with one
         disambiguating re-fetch, then written back to the cache."""
         from kafka_topic_analyzer_tpu.io.segfile import CorruptSegmentError
 

@@ -355,6 +355,10 @@ STORM_RATIO = 3.0
 MIN_STORM_EVENTS = 3
 #: Verify-bound: sha-verify seconds per wall second above this share.
 VERIFY_BOUND_SHARE = 0.25
+#: Fetch-bound: scheduler queue-wait seconds per wall second above this
+#: share flags the remote tier; queue depth vs in-flight then attributes
+#: it (starved pool vs saturated wire).
+FETCH_WAIT_SHARE = 0.25
 
 
 def _split_window(window: dict) -> "Optional[tuple]":
@@ -519,6 +523,51 @@ def diagnose_trends(window: dict) -> "List[dict]":
                 "verify_seconds": round(verify_s, 3),
                 "verify_share": round(verify_s / wall, 4),
                 "hit_bytes": int(hit_bytes),
+            },
+        })
+
+    # Fetch-bound attribution (io/fetchsched.py): requests spending a
+    # material share of the window queued in the scheduler.  The queue
+    # depth vs in-flight comparison says WHICH resource ran out —
+    # scheduler starvation (queue persistently deeper than the worker
+    # pool: --fetch-concurrency is too small for this stream count) vs
+    # wire saturation (pool busy but the queue stays shallow: the link,
+    # not the admission layer, is the limit).
+    wait_s = track_delta(window, "fetch_sched_wait_s")
+    if wall > 0 and wait_s / wall >= FETCH_WAIT_SHARE:
+        queue_pts = track_points(window, "fetch_sched_queue")
+        inflight_pts = track_points(window, "fetch_sched_inflight")
+        mean_queue = (
+            sum(p[2] for p in queue_pts) / len(queue_pts)
+            if queue_pts else 0.0
+        )
+        mean_inflight = (
+            sum(p[2] for p in inflight_pts) / len(inflight_pts)
+            if inflight_pts else 0.0
+        )
+        starved = mean_queue > max(mean_inflight, 1.0)
+        attribution = (
+            "scheduler-starvation" if starved else "wire-saturation"
+        )
+        advice = (
+            "raise --fetch-concurrency"
+            if starved
+            else "the wire is the limit — more workers will not help"
+        )
+        findings.append({
+            "kind": "fetch-bound",
+            "summary": (
+                f"fetch-bound ({attribution}): requests spent "
+                f"{wait_s / wall:.0%} of the window queued in the fetch "
+                f"scheduler (mean queue {mean_queue:.1f} vs "
+                f"{mean_inflight:.1f} in flight) — {advice}"
+            ),
+            "evidence": {
+                "wait_seconds": round(wait_s, 3),
+                "wait_share": round(wait_s / wall, 4),
+                "mean_queue_depth": round(mean_queue, 2),
+                "mean_inflight": round(mean_inflight, 2),
+                "attribution": attribution,
             },
         })
     return findings

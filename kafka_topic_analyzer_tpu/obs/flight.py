@@ -126,6 +126,18 @@ def _default_tracks() -> "List[Tuple[str, str, Callable[[], float]]]":
          lambda: m.SEGSTORE_CACHE_VERIFY_SECONDS.value),
         ("cache_hit_bytes", "cum",
          lambda: m.SEGSTORE_CACHE_HIT_BYTES.value),
+        # Fetch-scheduler occupancy (io/fetchsched.py): queue depth vs
+        # in-flight workers vs cumulative queue wait.  The trio is what
+        # lets diagnose_trends attribute a fetch-bound stretch to
+        # scheduler starvation (queue persistently deeper than the pool
+        # — raise --fetch-concurrency) vs wire saturation (pool busy,
+        # queue shallow — the link is the limit).
+        ("fetch_sched_queue", "inst",
+         lambda: m.FETCH_SCHED_QUEUE_DEPTH.value),
+        ("fetch_sched_inflight", "inst",
+         lambda: m.FETCH_SCHED_INFLIGHT.value),
+        ("fetch_sched_wait_s", "cum",
+         lambda: m.FETCH_SCHED_WAIT_SECONDS.value),
     ]
     return tracks
 

@@ -143,10 +143,10 @@ SEGSTORE_RETRIES = _REG.counter(
 SEGSTORE_READAHEAD = _REG.gauge(
     "kta_segstore_readahead_occupancy",
     "Remote chunks currently prefetched (or fetching) ahead of the "
-    "consuming ingest streams, summed over this process's per-stream "
-    "read-ahead pools (0..workers x --segment-readahead)",
+    "consuming ingest streams through the process-wide fetch scheduler "
+    "(0..streams x (--segment-readahead + 1))",
     # Each process's streams prefetch disjoint chunks; fleet-wide
-    # occupancy is their sum, not the worst pool's.
+    # occupancy is their sum, not the worst stream's.
     merge="sum")
 SEGSTORE_CACHE_HITS = _REG.counter(
     "kta_segstore_cache_hits_total",
@@ -185,6 +185,54 @@ SEGSTORE_FALLBACK = _REG.counter(
     "byte-identical re-fetch — SSE-KMS/SSE-C-shaped ETag) — "
     "a cache bypass is never silent",
     labelnames=("reason",))
+SEGSTORE_CACHE_VERIFY_LATCHED = _REG.counter(
+    "kta_segstore_cache_verify_latched_total",
+    "Cache hits served under the process-lifetime verify latch: the "
+    "entry's sha256 was checked once this process and latched as "
+    "trusted, so this hit skipped re-hashing (the verify-seconds "
+    "counter stands still while this one advances).  Eviction, "
+    "re-population, and poison detection all drop the latch, so the "
+    "first touch of any on-disk bytes is ALWAYS verified — the PR-14 "
+    "never-serve-poison guarantee is unchanged")
+
+# -- process-wide fetch scheduler (io/fetchsched.py) --------------------------
+
+FETCH_SCHED_QUEUE_DEPTH = _REG.gauge(
+    "kta_fetch_sched_queue_depth",
+    "Fetch requests queued in the process-wide scheduler, not yet "
+    "picked up by a worker (demand + speculative).  Persistently "
+    "deeper than kta_fetch_sched_inflight = the pool is the "
+    "bottleneck — raise --fetch-concurrency",
+    # One scheduler per process; fleet-wide backlog is the sum of the
+    # per-process queues.
+    merge="sum")
+FETCH_SCHED_INFLIGHT = _REG.gauge(
+    "kta_fetch_sched_inflight",
+    "Fetch requests currently executing on scheduler workers "
+    "(0..--fetch-concurrency).  Pegged at the pool size with a shallow "
+    "queue = the wire, not the scheduler, is the limit",
+    # One scheduler per process; fleet-wide in-flight is the sum.
+    merge="sum")
+FETCH_SCHED_REORDERS = _REG.counter(
+    "kta_fetch_sched_reorders_total",
+    "Deadline-aware departures from submission order, by reason "
+    "(demand-over-speculative = a chunk a consumer is blocked on was "
+    "served before earlier-queued speculative read-ahead, "
+    "deadline-promotion = a consumer reached a chunk whose speculative "
+    "request was still queued and promoted it to demand class)",
+    labelnames=("reason",))
+FETCH_SCHED_WAIT_SECONDS = _REG.counter(
+    "kta_fetch_sched_wait_seconds_total",
+    "Cumulative seconds fetch requests spent queued before a scheduler "
+    "worker picked them up.  The starvation ledger: high wait with a "
+    "deep queue means the pool is undersized, high wait with the pool "
+    "pegged and a shallow queue means the wire is saturated "
+    "(obs/doctor.py attributes fetch-bound verdicts from exactly this)")
+FETCH_SCHED_CANCELLED = _REG.counter(
+    "kta_fetch_sched_cancelled_total",
+    "Queued fetch requests cancelled before a worker started them: "
+    "released chunks (degraded-partition skips), closed streams, and "
+    "scheduler shutdown — bytes nobody would have read, not fetched")
 
 # -- fused ingest (packing.FusedPackSink + io/kafka_wire + io/segfile) --------
 

@@ -129,6 +129,13 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="comma-separated --segment-readahead depths to "
                          "sweep for remote stores (e.g. '0,4'); 'auto' "
                          "uses the resolved default")
+    ap.add_argument("--fetch-concurrency", default="auto",
+                    help="comma-separated --fetch-concurrency sizes to "
+                         "sweep for remote stores (e.g. '2,4,8'), 'auto' "
+                         "for the resolved default only, or 'sweep' for "
+                         "the canonical 2,4,8,auto ladder — the BENCH "
+                         "round 16 referee for the shared-scheduler "
+                         "admission layer")
     ap.add_argument("--cache", metavar="DIR",
                     help="run remote cells through a --segment-cache at "
                          "DIR: the first pass per cell is recorded as "
@@ -163,6 +170,16 @@ def main(argv: "list[str] | None" = None) -> int:
         for r in args.readahead.split(",")
         if r.strip()
     ]
+    fc_text = args.fetch_concurrency.strip().lower()
+    if fc_text == "sweep":
+        fc_text = "2,4,8,auto"
+    fc_sweep: "list[int | str]" = [
+        ("auto" if c.strip().lower() == "auto" else int(c))
+        for c in fc_text.split(",")
+        if c.strip()
+    ]
+    if any(isinstance(c, int) and c < 1 for c in fc_sweep):
+        ap.error("--fetch-concurrency entries must be >= 1 or 'auto'")
 
     from kafka_topic_analyzer_tpu.io.segfile import SegmentFileSource
     from kafka_topic_analyzer_tpu.packing import pack_batch
@@ -193,16 +210,28 @@ def main(argv: "list[str] | None" = None) -> int:
     remote = store_spec is not None
     if not remote:
         ra_sweep = ["auto"]  # local: readahead resolves to 0; one cell
+        fc_sweep = ["auto"]  # local scans never touch the scheduler
 
-    def make_source(ra) -> SegmentFileSource:
+    def make_source(ra, fc="auto") -> SegmentFileSource:
         if not remote:
             return SegmentFileSource(seg_dir, args.topic)
         fetch = SegmentFetchConfig(
             readahead=ra,
             cache_dir=args.cache,
             timeout_s=args.timeout_s,
+            fetch_concurrency=fc,
         )
         return SegmentFileSource(store_spec, args.topic, fetch=fetch)
+
+    def reset_scheduler() -> None:
+        """Fresh scheduler per fetch-concurrency cell: the pool is a
+        process singleton and an explicit size latches, so sweeping
+        sizes inside one bench process needs a clean teardown between
+        cells (threads joined, configuration forgotten)."""
+        if remote:
+            from kafka_topic_analyzer_tpu.io import fetchsched
+
+            fetchsched._reset_for_tests()
 
     try:
         probe = make_source(0 if remote else "auto")
@@ -239,6 +268,7 @@ def main(argv: "list[str] | None" = None) -> int:
             "store": args.store,
             "inject_latency_ms": args.inject_latency_ms,
             "cache": bool(args.cache),
+            "fetch_concurrency": [str(c) for c in fc_sweep],
             "catalog": {
                 "files": probe.catalog.num_files,
                 "bytes": probe.catalog.total_bytes,
@@ -252,7 +282,16 @@ def main(argv: "list[str] | None" = None) -> int:
         cold_rates: "dict[str, int]" = {}
         for n in sweep:
             for ra in ra_sweep:
-                key = str(n) if not remote else f"w{n}.ra{ra}"
+              for fc in fc_sweep:
+                if not remote:
+                    key = str(n)
+                elif len(fc_sweep) > 1:
+                    key = f"w{n}.ra{ra}.fc{fc}"
+                else:
+                    # Round-14-compatible keys when concurrency isn't
+                    # being swept, so old/new ledgers diff cell-by-cell.
+                    key = f"w{n}.ra{ra}"
+                reset_scheduler()
                 if args.cache:
                     # Cold half of the warm-vs-cold referee: an empty
                     # cache, so pass 1 pays every fetch.
@@ -263,8 +302,12 @@ def main(argv: "list[str] | None" = None) -> int:
                     # A fresh source per pass: per-file constant caches and
                     # OS page cache persist (deliberately — cold *IO* is
                     # the disk's story; this measures the pipeline), but
-                    # reader state does not leak across cells.
-                    src = make_source(ra)
+                    # reader state does not leak across cells.  The warm
+                    # passes also restart the verify latch trust (new
+                    # scheduler/config process state persists within a
+                    # bench process — the latch is per-process, so pass 2+
+                    # measure the LATCHED warm path).
+                    src = make_source(ra, fc)
                     r = _measure(src, args.batch_size, n, stage)
                     rate = round(r["records"] / r["wall"])
                     n_runs.append(rate)

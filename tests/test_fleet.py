@@ -563,6 +563,20 @@ def test_fleet_follow_sigterm_checkpoint_resume(tmp_path, solo_referee):
         assert _metrics_doc(fr2.results[t]) == solo_referee[t]
 
 
+def test_cpu_backend_never_donates_state():
+    """Concurrent per-topic scan threads + donated-state dispatch race
+    XLA:CPU's donation bookkeeping: a live state buffer can be freed
+    while still referenced, and the fold reads recycled heap memory
+    (this surfaced as pointer-sized garbage in resumed fleet counts).
+    On the host-CPU platform the backend must therefore compile its
+    step WITHOUT donation; accelerators keep it."""
+    backend = TpuBackend(_cfg(), init_now_s=10**10)
+    if backend.device.platform == "cpu":
+        assert backend._donate == ()
+    else:
+        assert backend._donate == (0,)
+
+
 def test_fleet_follow_rediscovers_created_topic():
     follow = FollowConfig(**dict(FAST_FOLLOW))
     new_records = {0: _mk_records(42, 0, 0, 20)}

@@ -740,16 +740,27 @@ def test_trend_epoch_reset_not_a_droop():
 
 
 def test_segment_cache_books_verify_seconds_and_hit_bytes(tmp_path):
+    from kafka_topic_analyzer_tpu.io import objstore
     from kafka_topic_analyzer_tpu.io.objstore import SegmentCache
 
     cache = SegmentCache(str(tmp_path / "cache"), 1 << 20, "store-key")
     data = bytes(range(256)) * 512  # 128 KiB
+    # The trust latch is process-wide; drop any residue from earlier
+    # tests so first-touch verification is actually exercised here.
+    objstore._PROCESS_TRUSTED.discard(cache._digest("chunk-0", len(data)))
     cache.put("chunk-0", len(data), data)
     assert obs_metrics.SEGSTORE_CACHE_VERIFY_SECONDS.value == 0.0
     got = cache.get("chunk-0", len(data))
-    assert got == data
+    assert bytes(got) == data  # hits are zero-copy memmap views
     assert obs_metrics.SEGSTORE_CACHE_HIT_BYTES.value == len(data)
     assert obs_metrics.SEGSTORE_CACHE_VERIFY_SECONDS.value > 0.0
+    # Second hit of a verified digest is latched: the hash is skipped
+    # (verify-seconds stands still) and the latched counter books it.
+    spent = obs_metrics.SEGSTORE_CACHE_VERIFY_SECONDS.value
+    again = cache.get("chunk-0", len(data))
+    assert bytes(again) == data
+    assert obs_metrics.SEGSTORE_CACHE_VERIFY_SECONDS.value == spent
+    assert obs_metrics.SEGSTORE_CACHE_VERIFY_LATCHED.value == 1
 
 
 # ---------------------------------------------------------------------------

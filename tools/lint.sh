@@ -1417,3 +1417,110 @@ if failures:
     sys.exit(1)
 print("lint: OK (cursor jumps book their loss reason; none silent)")
 EOF
+
+# Fifteenth rule: one fetch scheduler per process (DESIGN.md §25).  The
+# process-wide scheduler in io/fetchsched.py is the single admission
+# point for every remote segment byte, so: (a) no privately-constructed
+# pools or bare threads in io/segstore.py, io/objstore.py or
+# io/segfile.py — ThreadPoolExecutor / threading.Thread constructions
+# (and concurrent.futures imports) are forbidden there; io/fetchsched.py
+# is the only module of the remote tier allowed to spawn workers.
+# (b) Cache-trust latching is confined to its choke points: the
+# SegmentCache._trusted set may be touched ONLY inside _latch_trusted /
+# _unlatch_trusted / _is_trusted (plus the __init__ assignment), and the
+# hit-side choke point must book kta_segstore_cache_verify_latched_total
+# — an unbooked trust decision is a lint failure.
+python - <<'EOF'
+import ast
+import pathlib
+import sys
+
+PKG = pathlib.Path("kafka_topic_analyzer_tpu")
+OBJSTORE = PKG / "io" / "objstore.py"
+NO_POOLS = [OBJSTORE, PKG / "io" / "segstore.py", PKG / "io" / "segfile.py"]
+
+failures = []
+
+# (a) no private pools/threads outside the scheduler.
+for path in NO_POOLS:
+    t = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    for node in ast.walk(t):
+        mods = []
+        if isinstance(node, ast.Import):
+            mods = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            mods = [node.module]
+        for mod in mods:
+            if mod.split(".")[0] == "concurrent":
+                failures.append(
+                    f"{path}:{node.lineno}: imports {mod!r} — remote "
+                    "fetch concurrency belongs to io/fetchsched.py's "
+                    "process-wide scheduler"
+                )
+        if isinstance(node, ast.Call):
+            name = None
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if name in ("ThreadPoolExecutor", "Thread"):
+                failures.append(
+                    f"{path}:{node.lineno}: constructs {name} — the "
+                    "process-wide fetch scheduler (io/fetchsched.py) is "
+                    "the only worker pool of the remote tier"
+                )
+
+# (b) trust latching confined to the booked choke points.
+tree = ast.parse(OBJSTORE.read_text(encoding="utf-8"), filename=str(OBJSTORE))
+CHOKE = {"_latch_trusted", "_unlatch_trusted", "_is_trusted", "__init__"}
+cache = None
+for node in ast.walk(tree):
+    if isinstance(node, ast.ClassDef) and node.name == "SegmentCache":
+        cache = node
+if cache is None:
+    failures.append(f"{OBJSTORE}: SegmentCache missing")
+else:
+    func_of = {}
+    for item in cache.body:
+        if isinstance(item, ast.FunctionDef):
+            for child in ast.walk(item):
+                func_of.setdefault(id(child), item.name)
+    for node in ast.walk(cache):
+        if isinstance(node, ast.Attribute) and node.attr == "_trusted":
+            fn = func_of.get(id(node))
+            if fn not in CHOKE:
+                failures.append(
+                    f"{OBJSTORE}:{node.lineno}: SegmentCache._trusted "
+                    f"touched in {fn!r} — trust transitions go through "
+                    "_latch_trusted/_unlatch_trusted/_is_trusted only"
+                )
+    hit_side = next(
+        (i for i in cache.body
+         if isinstance(i, ast.FunctionDef) and i.name == "_is_trusted"),
+        None,
+    )
+    if hit_side is None:
+        failures.append(
+            f"{OBJSTORE}: SegmentCache._is_trusted (the hit-side trust "
+            "choke point) missing"
+        )
+    elif not any(
+        isinstance(n, ast.Attribute)
+        and n.attr == "SEGSTORE_CACHE_VERIFY_LATCHED"
+        for n in ast.walk(hit_side)
+    ):
+        failures.append(
+            f"{OBJSTORE}:{hit_side.lineno}: _is_trusted serves latched "
+            "hits without booking "
+            "kta_segstore_cache_verify_latched_total"
+        )
+
+if failures:
+    print("lint: one fetch scheduler per process violated (no private")
+    print("lint: pools on the remote tier; cache-trust latching only via")
+    print("lint: its booked choke points — DESIGN.md §25):")
+    for f in failures:
+        print(f"  {f}")
+    sys.exit(1)
+print("lint: OK (one fetch scheduler; trust latching booked at its choke points)")
+EOF
