@@ -290,6 +290,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Serve Prometheus metrics on "
                         "http://127.0.0.1:PORT/metrics while the scan runs "
                         "(0 binds an ephemeral port)")
+    p.add_argument("--sse", action="store_true",
+                   help="Push a Server-Sent-Events stream of report "
+                        "publishes at /events on --metrics-port: each "
+                        "frame carries the new snapshot's seq and a "
+                        "compact delta summary, so dashboards re-fetch "
+                        "/report.json only when it actually changed "
+                        "(requires --metrics-port)")
+    p.add_argument("--no-serve-gzip", action="store_true",
+                   help="Disable publish-time gzip of /report.json "
+                        "bodies (the default compresses once per "
+                        "publish and serves the cached encoding to "
+                        "Accept-Encoding: gzip readers)")
     p.add_argument("--events-jsonl", metavar="FILE",
                    help="Append structured scan lifecycle + transport-fault "
                         "events to FILE as JSON lines")
@@ -1259,6 +1271,7 @@ def run_fleet(args, topics: "list[str] | None" = None) -> int:
         # /report.json assembly is pure waste when no HTTP server exists
         # to serve it (same rule as the solo follow service).
         publish_reports=args.metrics_port is not None,
+        serve_gzip=not args.no_serve_gzip,
         spinner=Spinner(enabled=not args.quiet),
         rediscover=rediscover,
         leases=lease_mgr,
@@ -1368,6 +1381,7 @@ def main(argv: "list[str] | None" = None) -> int:
             flight_record=args.flight_record,
             history_dir=history_dir,
             history_bytes=args.history_bytes,
+            sse=args.sse,
         ):
             return _run(args)
     except (OSError, KafkaProtocolError) as e:
@@ -1516,6 +1530,7 @@ def _run(args) -> int:
                     # /report.json assembly is pure waste when no HTTP
                     # server exists to serve it.
                     publish_reports=args.metrics_port is not None,
+                    serve_gzip=not args.no_serve_gzip,
                 )
             restore_signals = follow_service.install_signal_handlers()
             try:

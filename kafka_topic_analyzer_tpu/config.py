@@ -399,6 +399,52 @@ class HealthConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Read-path knobs (serve/state.py + serve/push.py; DESIGN.md §26).
+
+    Like `HealthConfig`, deliberately NOT part of `AnalyzerConfig`: how
+    reports are encoded and pushed changes neither state shapes nor fold
+    semantics — the serving plane only READS published snapshots — so
+    none of it may churn the checkpoint fingerprint, and a scan is
+    byte-identical with the serving stack on or off
+    (tests/test_serve_plane.py pins it).
+    """
+
+    #: Compress /report.json bodies once at publish time (``gzip_level``
+    #: below); ``--no-serve-gzip`` clears it.
+    gzip: bool = True
+    #: Gzip level for publish-time encoding: 6 is the classic wire
+    #: default — ~10× on report JSON, a low-single-digit-ms cost paid
+    #: once per poll boundary, never per request.
+    gzip_level: int = 6
+    #: Bodies smaller than this are not worth a gzip member's overhead;
+    #: the publish stores no gzip variant and every client gets identity.
+    gzip_min_bytes: int = 256
+    #: Per-subscriber SSE frame queue bound — a subscriber this far
+    #: behind the publish rate is evicted, never blocked on
+    #: (kta_serve_sse_dropped_total{reason="slow-client"}).
+    sse_queue_len: int = 64
+    #: SSE keepalive-comment cadence while no publish arrives, keeping
+    #: intermediaries from idling out the stream.
+    sse_keepalive_s: float = 15.0
+
+    def __post_init__(self) -> None:
+        if not (1 <= self.gzip_level <= 9):
+            raise ValueError("serve gzip level must be in 1..9")
+        if self.gzip_min_bytes < 0:
+            raise ValueError("serve gzip floor must be >= 0 bytes")
+        if self.sse_queue_len < 1:
+            raise ValueError("SSE queue length must be >= 1")
+        if self.sse_keepalive_s <= 0:
+            raise ValueError("SSE keepalive must be > 0 seconds")
+
+
+#: The one shared default — the serve modules read their constants from
+#: here so a knob has exactly one home.
+DEFAULT_SERVE = ServeConfig()
+
+
+@dataclasses.dataclass(frozen=True)
 class SegmentFetchConfig:
     """Remote-segment-tier knobs (``--segment-readahead``/``--segment-cache``;
     io/objstore.py + io/segstore.py, DESIGN.md §21).

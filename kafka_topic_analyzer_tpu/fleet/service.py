@@ -181,6 +181,7 @@ class FleetService:
         clock: Callable[[], float] = time.monotonic,
         leases: "Optional[LeaseManager]" = None,
         instance: str = "solo",
+        serve_gzip: bool = True,
     ):
         self.scans: "Dict[str, _TopicScan]" = {
             s.name: _TopicScan(s) for s in seeds
@@ -210,7 +211,8 @@ class FleetService:
         self.leases = leases
         self.instance = instance
         self.state = serve_state.ServiceState(
-            instance=instance if leases is not None else None
+            instance=instance if leases is not None else None,
+            gzip_enabled=serve_gzip,
         )
         self._stop = threading.Event()
         self._stop_reason: "Optional[str]" = None
@@ -446,7 +448,15 @@ class FleetService:
                 else None
             ),
         )
-        self.state.publish(doc, topic=scan.seed.name)
+        self.state.publish(
+            doc,
+            topic=scan.seed.name,
+            summary={
+                "status": scan.status.status,
+                "verdict": scan.status.verdict,
+                "passes": scan.status.passes,
+            },
+        )
 
     def _publish_rollup(self) -> dict:
         rollup = build_fleet_rollup(
@@ -467,7 +477,13 @@ class FleetService:
             ),
         )
         if self.publish_reports:
-            self.state.publish(rollup)
+            self.state.publish(
+                rollup,
+                summary={
+                    "discovered": self.discovered,
+                    "polls": self.polls,
+                },
+            )
         return rollup
 
     def _evaluate_health(self) -> None:

@@ -22,10 +22,18 @@ per process (DESIGN.md §25):
   when a demand request actually jumps queued speculative work, and
   ``{reason="deadline-promotion"}`` when a consumer reaches a chunk whose
   speculative request is still queued and promotes it.
-- **Per-stream fairness.**  Each consumer registers a `FetchStream`;
-  selection round-robins across streams within each priority class, so a
-  stream with a deep speculative backlog cannot starve a sibling's first
-  request (two fleet topics share the pool without cross-topic stalls).
+- **Per-stream fairness, weighted.**  Each consumer registers a
+  `FetchStream` with a WEIGHT (its lag / planned chunk count — the
+  ingest read-ahead registers its segment-plan size; default 1.0), and
+  selection within each priority class is smooth weighted round-robin
+  across the streams that have queued work: a stream with twice the
+  backlog weight is granted twice the admissions, interleaved (never
+  bursted), and equal weights degrade to the exact round-robin of PR
+  19 — so a stream with a deep speculative backlog cannot starve a
+  sibling's first request, and a fleet topic that is 10× further behind
+  drains ~10× the bytes instead of splitting the wire evenly with an
+  almost-caught-up sibling.  ``FetchStream.set_weight`` retargets a
+  live stream (lag moves; weights follow).
 - **Cancellation.**  A queued request can be cancelled before it starts
   (``kta_fetch_sched_cancelled_total``): degraded-partition skips and
   stream teardown must not pay for bytes nobody will read.  In-flight
@@ -122,7 +130,7 @@ class FetchTicket:
 
 
 class FetchStream:
-    """One consumer's handle on the scheduler: the unit of round-robin
+    """One consumer's handle on the scheduler: the unit of weighted
     fairness.  Each ingest stream (and each catalog open) registers its
     own; ``close`` cancels everything of this stream still queued."""
 
@@ -130,6 +138,14 @@ class FetchStream:
         self._sched = sched
         self.sid = sid
         self._closed = False
+
+    def set_weight(self, weight: float) -> "FetchStream":
+        """Retarget this stream's fairness weight (lag / partition or
+        chunk count).  Selection share within a priority class is
+        proportional among streams with queued work; takes effect on
+        the next admission."""
+        self._sched.set_weight(self.sid, weight)
+        return self
 
     def submit(
         self, fn: "Callable[[], object]", seq: int = 0,
@@ -169,9 +185,17 @@ class FetchScheduler:
         self._target = int(concurrency)
         #: stream id -> queued tickets (unordered; selection scans).
         self._queues: "Dict[int, List[FetchTicket]]" = {}
-        #: Stream ids in registration order — the round-robin rotation.
+        #: Stream ids in registration order — the deterministic
+        #: tie-break for weighted selection.
         self._order: "List[int]" = []
-        self._rr = 0
+        #: Smooth weighted round-robin state (nginx SWRR): each
+        #: selection credits every CANDIDATE stream (queued work in the
+        #: class being served) by its weight, picks the highest credit,
+        #: and debits the winner by the candidates' total — proportional
+        #: shares, interleaved, deterministic, and exactly round-robin
+        #: when all weights are equal.
+        self._weights: "Dict[int, float]" = {}
+        self._credits: "Dict[int, float]" = {}
         self._next_sid = 0
         self._ordinal = 0
         self._live = 0
@@ -186,7 +210,9 @@ class FetchScheduler:
 
     # -- streams --------------------------------------------------------------
 
-    def stream(self) -> FetchStream:
+    def stream(self, weight: float = 1.0) -> FetchStream:
+        if weight <= 0:
+            raise ValueError("fetch stream weight must be > 0")
         with self._cv:
             if self._stopped:
                 raise RuntimeError("fetch scheduler is shut down")
@@ -194,7 +220,16 @@ class FetchScheduler:
             self._next_sid += 1
             self._order.append(sid)
             self._queues[sid] = []
+            self._weights[sid] = float(weight)
+            self._credits[sid] = 0.0
         return FetchStream(self, sid)
+
+    def set_weight(self, sid: int, weight: float) -> None:
+        if weight <= 0:
+            raise ValueError("fetch stream weight must be > 0")
+        with self._cv:
+            if sid in self._weights:
+                self._weights[sid] = float(weight)
 
     def _close_stream(self, sid: int) -> None:
         with self._cv:
@@ -207,10 +242,9 @@ class FetchScheduler:
                 obs_metrics.FETCH_SCHED_QUEUE_DEPTH.inc(-1)
                 obs_metrics.FETCH_SCHED_CANCELLED.inc()
             if sid in self._order:
-                i = self._order.index(sid)
                 self._order.remove(sid)
-                if self._rr > i:
-                    self._rr -= 1
+            self._weights.pop(sid, None)
+            self._credits.pop(sid, None)
         for t in dropped:
             t._done.set()
 
@@ -289,50 +323,62 @@ class FetchScheduler:
 
     # -- selection (the admission policy) --------------------------------------
 
-    def _rotation(self) -> "List[int]":
-        n = len(self._order)
-        if n == 0:
-            return []
-        start = self._rr % n
-        return [self._order[(start + k) % n] for k in range(n)]
+    def _pick_stream(self, pclass: int) -> "Optional[int]":
+        """Smooth weighted round-robin over the streams with queued work
+        in ``pclass`` (callers hold the lock).  Idle streams accrue no
+        credit, so a stream that sat quiet cannot burst later; ties
+        break by registration order, keeping selection deterministic."""
+        candidates = [
+            sid
+            for sid in self._order
+            if any(t.pclass == pclass for t in self._queues.get(sid, ()))
+        ]
+        if not candidates:
+            return None
+        total = 0.0
+        best_sid: "Optional[int]" = None
+        for sid in candidates:
+            w = self._weights.get(sid, 1.0)
+            total += w
+            self._credits[sid] = self._credits.get(sid, 0.0) + w
+            if best_sid is None or self._credits[sid] > self._credits[best_sid]:
+                best_sid = sid
+        self._credits[best_sid] -= total
+        return best_sid
 
     def _select(self) -> "Optional[FetchTicket]":
         """Pick the next request (callers hold the lock): DEMAND before
-        SPECULATIVE, round-robin across streams within a class, lowest
-        (seq, ordinal) within a stream — deterministic given the queue."""
+        SPECULATIVE, weighted round-robin across streams within a class
+        (`_pick_stream`), lowest (seq, ordinal) within a stream —
+        deterministic given the queue and the weights."""
         for pclass in (DEMAND, SPECULATIVE):
-            for sid in self._rotation():
-                q = self._queues.get(sid)
-                if not q:
+            sid = self._pick_stream(pclass)
+            if sid is None:
+                continue
+            q = self._queues[sid]
+            best: "Optional[FetchTicket]" = None
+            for t in q:
+                if t.pclass != pclass:
                     continue
-                best: "Optional[FetchTicket]" = None
-                for t in q:
-                    if t.pclass != pclass:
-                        continue
-                    if best is None or (t.seq, t.ordinal) < (
-                        best.seq, best.ordinal
-                    ):
-                        best = t
-                if best is None:
-                    continue
-                q.remove(best)
-                if pclass == DEMAND and any(
-                    t.pclass == SPECULATIVE and t.ordinal < best.ordinal
-                    for queue in self._queues.values()
-                    for t in queue
+                if best is None or (t.seq, t.ordinal) < (
+                    best.seq, best.ordinal
                 ):
-                    # This demand request jumped speculative work that was
-                    # submitted before it — the deadline rule reordering
-                    # the wire, made visible.
-                    obs_metrics.FETCH_SCHED_REORDERS.labels(
-                        reason="demand-over-speculative"
-                    ).inc()
-                best.state = _RUNNING
-                obs_metrics.FETCH_SCHED_QUEUE_DEPTH.inc(-1)
-                self._rr = (self._order.index(sid) + 1) % max(
-                    1, len(self._order)
-                )
-                return best
+                    best = t
+            q.remove(best)
+            if pclass == DEMAND and any(
+                t.pclass == SPECULATIVE and t.ordinal < best.ordinal
+                for queue in self._queues.values()
+                for t in queue
+            ):
+                # This demand request jumped speculative work that was
+                # submitted before it — the deadline rule reordering
+                # the wire, made visible.
+                obs_metrics.FETCH_SCHED_REORDERS.labels(
+                    reason="demand-over-speculative"
+                ).inc()
+            best.state = _RUNNING
+            obs_metrics.FETCH_SCHED_QUEUE_DEPTH.inc(-1)
+            return best
         return None
 
     # -- the worker pool -------------------------------------------------------

@@ -477,6 +477,7 @@ class _ScheduledReadahead:
         self._tickets: "Dict[int, object]" = {}
         self._submitted: "set[int]" = set()
         self._consumed: "set[int]" = set()
+        self._weighted = False
 
     @staticmethod
     def _prefetch(seg: "RemoteSegmentFile") -> None:
@@ -490,6 +491,14 @@ class _ScheduledReadahead:
         chunks and partitions already degraded this scan).  Chunk i — the
         one the consumer is about to block on — submits at DEMAND class;
         the look-ahead tail is speculative."""
+        if not self._weighted:
+            # Weighted admission (DESIGN §25): this stream's fair share
+            # of the wire is proportional to how much it still has to
+            # fetch — the plan's chunk count (≈ partitions × segments).
+            # Registered once, at the first schedule, when the plan is
+            # first known.
+            self._weighted = True
+            self._stream.set_weight(max(1.0, float(len(plan))))
         for j in range(i, min(i + self.depth + 1, len(plan))):
             if j in self._submitted:
                 continue

@@ -44,6 +44,7 @@ def telemetry_session(
     flight_record: bool = False,
     history_dir: "Optional[str]" = None,
     history_bytes: int = 0,
+    sse: bool = False,
 ) -> "Iterator[Optional[SpanTracer]]":
     """Wire up the flag-selected telemetry outputs around a scan.
 
@@ -73,6 +74,11 @@ def telemetry_session(
     latest verdict.  Services may install their own engine instead
     (tests do); last ``set_active`` wins.
 
+    ``sse`` starts the Server-Sent-Events publisher (serve/push.py) as
+    the session's active one: every report publish is pushed to
+    ``/events`` subscribers from the publisher's own fan-out thread.
+    Requires ``metrics_port`` (the route needs a server to live on).
+
     Output paths are opened (and truncated, for the trace) at setup so a
     bad ``--trace-json``/``--events-jsonl`` path fails before the scan,
     not after it; and each teardown step is isolated, so a failing trace
@@ -92,6 +98,7 @@ def telemetry_session(
     recorder = None
     store = None
     engine = None
+    pusher = None
     try:
         if metrics_port is not None:
             from kafka_topic_analyzer_tpu.obs.exporters import (
@@ -135,8 +142,20 @@ def telemetry_session(
             # --stats health digest, the JSONL event bus) can read it.
             engine = _health.HealthEngine()
             _health.set_active(engine)
+        if sse and metrics_port is not None:
+            from kafka_topic_analyzer_tpu.serve import push as _push
+
+            pusher = _push.SsePublisher().start()
+            _push.set_active(pusher)
         yield tracer
     finally:
+        if pusher is not None:
+            from kafka_topic_analyzer_tpu.serve import push as _push
+
+            try:
+                pusher.stop()  # closes every stream; booked "shutdown"
+            finally:
+                _push.set_active(None)
         if engine is not None:
             # The session is the CLI's outermost scope: whatever engine
             # is active at teardown (ours, or a service's replacement)

@@ -44,6 +44,7 @@ through series shipping.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -169,6 +170,12 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._t: List[float] = []
         self._bufs: "List[List[float]]" = [[] for _ in self._tracks]
+        #: Samples ever taken — the ring mutates ONLY inside a sample
+        #: (appends and the 2:1 decimation both), so this count is the
+        #: strong cache validator ``/flight`` conditional GETs revalidate
+        #: against, and the key of the one-entry serialized cache below.
+        self._samples_total = 0
+        self._series_cache: "Optional[Tuple[int, bytes]]" = None
         self._stop = threading.Event()
         self._thread: "Optional[threading.Thread]" = None
         #: Optional disk-backed history sink (obs/history.HistoryStore):
@@ -195,6 +202,7 @@ class FlightRecorder:
         row = [reader() for _, _, reader in self._tracks]
         with self._lock:
             self._t.append(now)
+            self._samples_total += 1
             for buf, v in zip(self._bufs, row):
                 buf.append(v)
             if len(self._t) > self.max_samples:
@@ -279,6 +287,31 @@ class FlightRecorder:
                 for i, (name, _, _) in enumerate(self._tracks)
             },
         }
+
+    def series_etag(self) -> str:
+        """Strong validator for ``/flight``: the ring changes only when
+        a sample lands, so the sample count pins its contents.  O(1) —
+        the handler checks If-None-Match before any body exists."""
+        with self._lock:
+            return f'"f{self._samples_total}"'
+
+    def series_bytes(self) -> "Tuple[bytes, str]":
+        """(body, etag) for ``/flight`` — serialized on the RECORDER
+        side (rule 9: handlers serialize nothing) with a one-entry cache
+        keyed by the validator, so N dashboard polls between ticks pay
+        one encode, not N."""
+        with self._lock:
+            cached = self._series_cache
+            if cached is not None and cached[0] == self._samples_total:
+                return cached[1], f'"f{cached[0]}"'
+            stamp = self._samples_total
+        body = json.dumps(self.series()).encode()
+        with self._lock:
+            # A tick may have landed during the encode; cache under the
+            # stamp the body was built from so the ETag stays truthful
+            # (the next poll simply re-encodes).
+            self._series_cache = (stamp, body)
+        return body, f'"f{stamp}"'
 
 
 _active: "Optional[FlightRecorder]" = None

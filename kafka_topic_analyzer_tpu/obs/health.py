@@ -459,6 +459,22 @@ class HealthEngine:
                 return None
             return (200 if self._healthy else 503), self._doc_bytes
 
+    def healthz_entry(self) -> "Optional[Tuple[int, bytes, str]]":
+        """(status_code, body, etag) for conditional ``/healthz`` GETs
+        (DESIGN §26).  The evaluation count is the strong validator:
+        ``_doc_bytes`` is re-serialized exactly once per evaluation
+        (``evaluated_at``/``since_s`` move every pass, so each count
+        really is a distinct body), and both are assigned in the same
+        critical section of ``evaluate``."""
+        with self._lock:
+            if self._doc_bytes is None:
+                return None
+            return (
+                (200 if self._healthy else 503),
+                self._doc_bytes,
+                f'"e{self.evaluations}"',
+            )
+
     def alerts_block(self, topic: "Optional[str]" = None) -> "Optional[dict]":
         """The ``health`` block a report document embeds.  With
         ``topic``: only that topic's scoped alerts plus the global ones

@@ -54,6 +54,7 @@ import logging
 import os
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
@@ -113,6 +114,15 @@ class HistoryStore:
         self._pending: "List[Optional[Row]]" = [None] * self.tiers
         self.epoch = 1
         self._closed = False
+        #: Rows appended this process lifetime — with the epoch, the
+        #: store's strong cache validator: retention eviction and
+        #: downsample cascades only ever happen inside an append, so
+        #: (epoch, append_seq) pins the full retained row state.
+        self._append_seq = 0
+        #: Serialized-query cache keyed by ETag (which embeds the
+        #: validator state + query): repeated identical dashboard
+        #: queries between appends reuse one encode (DESIGN §26).
+        self._query_cache: "Dict[str, bytes]" = {}
         self._load()
 
     # -- layout ---------------------------------------------------------------
@@ -237,6 +247,7 @@ class HistoryStore:
                 return
             ts = float(self._clock() if t is None else t)
             self._append_tier(0, (ts, self.epoch, dict(values)))
+            self._append_seq += 1
         obs_metrics.HISTORY_SAMPLES.inc()
         self._book_bytes()
 
@@ -349,64 +360,165 @@ class HistoryStore:
         with self._lock:
             return list(self._rows[k])
 
+    def _compose(
+        self, lo: float, hi: float, min_tier: int
+    ) -> "Tuple[List[Row], List[int]]":
+        """In-window rows at the finest retained resolution per
+        sub-range, starting no finer than ``min_tier`` (callers hold the
+        lock)."""
+        out: "List[Row]" = []
+        covered_from: "Optional[float]" = None
+        tiers_used: "List[int]" = []
+        for k in range(min_tier, self.tiers):
+            # Sorted per query: the mirror keeps write order (the
+            # eviction invariant), which a wall-clock step across a
+            # restart can decouple from timestamp order.
+            rows = sorted(
+                (r for r in self._rows[k] if lo <= r[0] <= hi),
+                key=lambda r: r[0],
+            )
+            if not rows:
+                continue
+            if covered_from is None:
+                out = rows
+                covered_from = rows[0][0]
+                tiers_used.append(k)
+            else:
+                older = [r for r in rows if r[0] < covered_from]
+                if older:
+                    out = older + out
+                    covered_from = older[0][0]
+                    tiers_used.append(k)
+        out.sort(key=lambda r: (r[0], r[1]))
+        return out, tiers_used
+
+    def _window_locked(
+        self,
+        t0: "Optional[float]",
+        t1: "Optional[float]",
+        tracks: "Optional[List[str]]",
+        max_points: "Optional[int]",
+    ) -> dict:
+        lo = float("-inf") if t0 is None else float(t0)
+        hi = float("inf") if t1 is None else float(t1)
+        out, tiers_used = self._compose(lo, hi, 0)
+        decimated = False
+        if max_points is not None and 0 < max_points < len(out):
+            # Price the query from the existing RRD tiers: drop the
+            # finest tiers until the composed window fits — the answer
+            # a coarser tier gives is the same downsample policy the
+            # store already applies over time, just applied over the
+            # whole window.
+            for start in range(1, self.tiers):
+                coarser, used = self._compose(lo, hi, start)
+                if not coarser:
+                    break  # coarser tiers hold nothing here yet
+                out, tiers_used = coarser, used
+                if len(out) <= max_points:
+                    break
+            if len(out) > max_points:
+                # Even the coarsest retained tier exceeds the price:
+                # stride-decimate keeping each stride's LAST row (the
+                # cum-exact choice, same as the tier cascade).
+                stride = -(-len(out) // max_points)
+                out = out[stride - 1::stride]
+                decimated = True
+        names = (
+            list(tracks)
+            if tracks
+            else sorted({n for r in out for n in r[2]})
+        )
+        doc = {
+            "t": [round(r[0], 3) for r in out],
+            "epoch": [r[1] for r in out],
+            "tracks": {
+                name: [r[2].get(name) for r in out] for name in names
+            },
+            "kinds": {
+                n: self._kinds.get(n, "cum") for n in names
+            },
+            "tiers_used": tiers_used,
+            "epoch_now": self.epoch,
+            "now": round(self._clock(), 3),
+        }
+        if max_points is not None:
+            doc["max_points"] = int(max_points)
+            doc["points"] = len(out)
+            doc["decimated"] = decimated
+        return doc
+
     def window(
         self,
         t0: "Optional[float]" = None,
         t1: "Optional[float]" = None,
         tracks: "Optional[List[str]]" = None,
+        max_points: "Optional[int]" = None,
     ) -> dict:
         """Windowed query: rows with ``t0 <= t <= t1`` at the finest
         retained resolution per sub-range — tier 0 answers for whatever
         span it still holds, each coarser tier extends the answer
-        further back.  The JSON-able result is what ``/history`` serves:
-        one timestamp list, one epoch list (restart boundaries are
-        data), and one value list per track (None where a row predates
-        the track)."""
+        further back.  ``max_points`` prices the query: the coarsest
+        retained tier that satisfies the bound answers instead, so a
+        month-wide dashboard query returns kilobytes, not the raw ring.
+        The JSON-able result is what ``/history`` serves: one timestamp
+        list, one epoch list (restart boundaries are data), and one
+        value list per track (None where a row predates the track)."""
         with self._lock:
-            lo = float("-inf") if t0 is None else float(t0)
-            hi = float("inf") if t1 is None else float(t1)
-            out: "List[Row]" = []
-            covered_from: "Optional[float]" = None
-            tiers_used: "List[int]" = []
-            for k in range(self.tiers):
-                # Sorted per query: the mirror keeps write order (the
-                # eviction invariant), which a wall-clock step across a
-                # restart can decouple from timestamp order.
-                rows = sorted(
-                    (r for r in self._rows[k] if lo <= r[0] <= hi),
-                    key=lambda r: r[0],
-                )
-                if not rows:
-                    continue
-                if covered_from is None:
-                    out = rows
-                    covered_from = rows[0][0]
-                    tiers_used.append(k)
-                else:
-                    older = [r for r in rows if r[0] < covered_from]
-                    if older:
-                        out = older + out
-                        covered_from = older[0][0]
-                        tiers_used.append(k)
-            out.sort(key=lambda r: (r[0], r[1]))
-            names = (
-                list(tracks)
-                if tracks
-                else sorted({n for r in out for n in r[2]})
-            )
-            return {
-                "t": [round(r[0], 3) for r in out],
-                "epoch": [r[1] for r in out],
-                "tracks": {
-                    name: [r[2].get(name) for r in out] for name in names
-                },
-                "kinds": {
-                    n: self._kinds.get(n, "cum") for n in names
-                },
-                "tiers_used": tiers_used,
-                "epoch_now": self.epoch,
-                "now": round(self._clock(), 3),
-            }
+            return self._window_locked(t0, t1, tracks, max_points)
+
+    @staticmethod
+    def _query_key(
+        t0: "Optional[float]",
+        t1: "Optional[float]",
+        tracks: "Optional[List[str]]",
+        max_points: "Optional[int]",
+    ) -> int:
+        key = repr(
+            (t0, t1, tuple(tracks) if tracks else None, max_points)
+        )
+        return zlib.crc32(key.encode())
+
+    def window_etag(
+        self,
+        t0: "Optional[float]" = None,
+        t1: "Optional[float]" = None,
+        tracks: "Optional[List[str]]" = None,
+        max_points: "Optional[int]" = None,
+    ) -> str:
+        """Strong validator for one ``/history`` query: (epoch,
+        append-seq, query) — any append (which is also the only place
+        retention eviction or a downsample cascade can run) moves it.
+        O(1); the handler checks If-None-Match against this BEFORE any
+        body is built."""
+        qh = self._query_key(t0, t1, tracks, max_points)
+        with self._lock:
+            return f'"h{self.epoch}.{self._append_seq}.{qh:08x}"'
+
+    def window_bytes(
+        self,
+        t0: "Optional[float]" = None,
+        t1: "Optional[float]" = None,
+        tracks: "Optional[List[str]]" = None,
+        max_points: "Optional[int]" = None,
+    ) -> "Tuple[bytes, str]":
+        """(body, etag) for ``/history`` — serialized on the STORE side
+        (rule 9: handlers serialize nothing), under the store's own
+        lock, and cached per validator so identical queries between
+        appends reuse one encode.  The body is frozen at first encode
+        for its ETag: a 200 and a later 304 for the same validator
+        always describe the same bytes."""
+        qh = self._query_key(t0, t1, tracks, max_points)
+        with self._lock:
+            etag = f'"h{self.epoch}.{self._append_seq}.{qh:08x}"'
+            body = self._query_cache.get(etag)
+            if body is None:
+                body = json.dumps(
+                    self._window_locked(t0, t1, tracks, max_points)
+                ).encode()
+                self._query_cache[etag] = body
+                while len(self._query_cache) > 32:
+                    self._query_cache.pop(next(iter(self._query_cache)))
+            return body, etag
 
 
 # -- window algebra (shared by the trend doctor and the alert rules) ----------

@@ -452,6 +452,42 @@ REPORT_SNAPSHOTS = _REG.counter(
     "Point-in-time report documents published for /report.json (one per "
     "follow poll boundary; the HTTP handler only ever reads the latest)")
 
+# -- the serving plane (obs/exporters.py + serve/push.py, DESIGN §26) ---------
+
+SERVE_REQUESTS = _REG.counter(
+    "kta_serve_requests_total",
+    "HTTP requests served, by route and status code — 304s, JSON error "
+    "bodies, and SSE stream opens each book exactly one row, so the "
+    "read path's full traffic mix is reconstructible from the counter",
+    labelnames=("route", "status"))
+SERVE_NOT_MODIFIED = _REG.counter(
+    "kta_serve_not_modified_total",
+    "Conditional requests answered 304 Not Modified (If-None-Match "
+    "matched the published ETag): zero body bytes on the wire — the "
+    "read path's cache-hit count")
+SERVE_BYTES = _REG.counter(
+    "kta_serve_bytes_total",
+    "Response body bytes actually sent, by content encoding (gzip = the "
+    "publish-time-compressed variant; identity = raw JSON/text, which "
+    "is also where a gzip-requesting client lands when the snapshot "
+    "stored no gzip variant — the encoding fallback is visible here, "
+    "never silent; sse = streamed event frames)",
+    labelnames=("encoding",))
+SERVE_SSE_SUBSCRIBERS = _REG.gauge(
+    "kta_serve_sse_subscribers",
+    "Currently connected /events subscribers (serve/push.py)",
+    # Each process serves its own subscriber set; a federated scrape
+    # wants the fleet-wide audience.
+    merge="sum")
+SERVE_SSE_DROPPED = _REG.counter(
+    "kta_serve_sse_dropped_total",
+    "SSE subscriber streams closed by the publisher, by reason: "
+    "slow-client (bounded per-subscriber queue overflowed — eviction "
+    "over blocking, the backpressure contract) or shutdown (publisher "
+    "stopped with the session) — every eviction books exactly one "
+    "reason, never silent",
+    labelnames=("reason",))
+
 # -- fleet mode (fleet/discovery.py + fleet/scheduler.py + fleet/service.py) --
 
 FLEET_TOPICS_DISCOVERED = _REG.counter(

@@ -83,6 +83,7 @@ class FollowService:
         ingest_workers=1,
         heartbeat_every_s: float = 10.0,
         publish_reports: bool = True,
+        serve_gzip: bool = True,
         health: "Optional[obs_health.HealthEngine]" = None,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -145,8 +146,9 @@ class FollowService:
         #: installed, else none — alerting is opt-in observability and
         #: the loop must not pay for an engine nobody reads.
         self.health = health if health is not None else obs_health.active()
-        #: The lock-consistent /report.json snapshot (serve/state.py).
-        self.state = serve_state.ServiceState()
+        #: The lock-consistent /report.json snapshot (serve/state.py) —
+        #: publish-time gzip encoding rides the ``--serve-gzip`` knob.
+        self.state = serve_state.ServiceState(gzip_enabled=serve_gzip)
         self._stop = threading.Event()
         self._stop_reason: "Optional[str]" = None
         # Idle pacing: poll_interval floor, exponential backoff to the
@@ -486,7 +488,17 @@ class FollowService:
                 else None
             ),
         )
-        self.state.publish(doc)
+        # The compact delta block /events subscribers get instead of a
+        # body: enough to decide whether (and what) to fetch.
+        self.state.publish(
+            doc,
+            summary={
+                "records": int(self._seq_total),
+                "lag": int(obs_metrics.FOLLOW_LAG.value),
+                "polls": self.polls,
+                "passes": self.passes,
+            },
+        )
 
     def follow_block(self, result: "Optional[ScanResult]" = None) -> dict:
         """The ``follow`` block of the report document: service counters

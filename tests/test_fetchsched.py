@@ -183,6 +183,121 @@ def test_fairness_deep_backlog_cannot_starve_a_sibling_stream():
         sched.shutdown()
 
 
+def test_weighted_fairness_share_matches_weights():
+    """Smooth weighted round-robin: a stream carrying 3× the partitions
+    gets 3× the picks — deterministically interleaved (never 3 in a
+    burst then 1), so the light stream's latency stays bounded."""
+    sched = FetchScheduler(1)
+    order, lock = [], threading.Lock()
+    try:
+        g = sched.stream()
+        a = sched.stream(weight=3.0)
+        b = sched.stream(weight=1.0)
+        gate = _Gate(order=order)
+        g.submit(gate, speculative=False)
+        assert gate.started.wait(5)
+        tickets = [
+            a.submit(_recorder(f"a{i}", order, lock), seq=i)
+            for i in range(6)
+        ] + [
+            b.submit(_recorder(f"b{i}", order, lock), seq=i)
+            for i in range(2)
+        ]
+        gate.release.set()
+        for t in tickets:
+            assert t.wait(10)
+        served = order[1:]  # drop the gate
+        # First full weight cycle (4 picks): 3 of A, 1 of B — and the
+        # smooth property: B is served INSIDE the cycle, not appended.
+        assert sum(1 for x in served[:4] if x.startswith("a")) == 3
+        assert sum(1 for x in served[:4] if x.startswith("b")) == 1
+        # Whole run honours the 3:1 share and per-stream plan order.
+        for s in ("a", "b"):
+            got = [x for x in served if x.startswith(s)]
+            assert got == sorted(got)
+    finally:
+        sched.shutdown()
+
+
+def test_set_weight_rebalances_a_live_stream():
+    """set_weight() takes effect on the next pick: a stream that starts
+    equal and then declares a heavier plan immediately earns the larger
+    share (segfile registers its plan size on first schedule())."""
+    sched = FetchScheduler(1)
+    order, lock = [], threading.Lock()
+    try:
+        g, a, b = sched.stream(), sched.stream(), sched.stream()
+        a.set_weight(5.0)
+        gate = _Gate(order=order)
+        g.submit(gate, speculative=False)
+        assert gate.started.wait(5)
+        tickets = [
+            a.submit(_recorder(f"a{i}", order, lock), seq=i)
+            for i in range(5)
+        ] + [
+            b.submit(_recorder(f"b{i}", order, lock), seq=i)
+            for i in range(2)
+        ]
+        gate.release.set()
+        for t in tickets:
+            assert t.wait(10)
+        served = order[1:]
+        # One full cycle of 6 picks carries 5 of A and 1 of B.
+        assert sum(1 for x in served[:6] if x.startswith("a")) == 5
+        with pytest.raises(ValueError):
+            a.set_weight(0.0)
+    finally:
+        sched.shutdown()
+
+
+def test_equal_weights_are_exact_round_robin():
+    """The SWRR degenerate case: every weight 1.0 alternates strictly in
+    registration order — the pre-weight fairness contract, unchanged."""
+    sched = FetchScheduler(1)
+    order, lock = [], threading.Lock()
+    try:
+        g, a, b = sched.stream(), sched.stream(), sched.stream()
+        gate = _Gate(order=order)
+        g.submit(gate, speculative=False)
+        assert gate.started.wait(5)
+        tickets = [
+            a.submit(_recorder(f"a{i}", order, lock), seq=i)
+            for i in range(3)
+        ] + [
+            b.submit(_recorder(f"b{i}", order, lock), seq=i)
+            for i in range(3)
+        ]
+        gate.release.set()
+        for t in tickets:
+            assert t.wait(10)
+        assert order[1:] == ["a0", "b0", "a1", "b1", "a2", "b2"]
+    finally:
+        sched.shutdown()
+
+
+def test_weighted_remote_scan_stays_byte_identical(seg_dir):
+    """Weights change WHO is picked next, never WHAT is read: a remote
+    scan through auto-weighted streams (segfile registers plan sizes)
+    matches the local referee byte for byte."""
+    local = scan_doc(
+        run_scan(
+            "t", SegmentFileSource(seg_dir, "t"),
+            CpuExactBackend(cpu_cfg(), init_now_s=10**10), 700,
+        )
+    )
+    with FakeObjectStore(seg_dir) as store:
+        remote = scan_doc(
+            run_scan(
+                "t",
+                SegmentFileSource(
+                    store.url, "t", fetch=fetch_cfg(readahead=3, fc=2),
+                ),
+                CpuExactBackend(cpu_cfg(), init_now_s=10**10), 700,
+            )
+        )
+    assert remote == local
+
+
 def test_deadline_promotion_jumps_demand_past_speculation():
     """The deadline rule: promoting a queued speculative request to
     DEMAND books {deadline-promotion}, and serving it ahead of

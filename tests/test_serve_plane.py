@@ -1,0 +1,736 @@
+"""The production read path (ISSUE 20; DESIGN.md §26): conditional
+caching, publish-time compression, SSE push, priced /history, and the
+HTTP/1.1 header discipline that lets a fleet of dashboards poll the
+service without touching the scan.
+
+Coverage layers:
+
+- conditional GET: strong ETags on all four snapshot routes, 304 with
+  zero body bytes on a validator match, full 200 when the validator
+  goes stale (and again 304 after refreshing it);
+- publish-time encoding: the gzip variant decompresses to the exact
+  identity body, both validators name the same seq, a publish-vs-read
+  hammer proves the (raw, gzipped, etag) triple can never tear;
+- priced /history: max_points answers from the coarsest satisfying RRD
+  tier, stride decimation keeps the LAST row (cum-exact), tracks filter
+  before serialization, bad params are clean 400s;
+- SSE: subscribe/catch-up/receive over real HTTP, slow-client eviction
+  (booked, never blocking) and re-sync, publisher shutdown closes
+  streams;
+- header discipline: exact Content-Length on every route x status, a
+  body-less 304, JSON errors, keep-alive across mixed statuses on one
+  connection;
+- byte-identity: a follow scan with the WHOLE serving plane on and
+  pollers hammering it folds identically to the bare referee.
+"""
+
+from __future__ import annotations
+
+import gzip
+import http.client
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kafka_topic_analyzer_tpu.backends.tpu import TpuBackend
+from kafka_topic_analyzer_tpu.config import AnalyzerConfig, FollowConfig
+from kafka_topic_analyzer_tpu.engine import run_scan
+from kafka_topic_analyzer_tpu.io.kafka_wire import KafkaWireSource
+from kafka_topic_analyzer_tpu.obs import flight as obs_flight
+from kafka_topic_analyzer_tpu.obs import health as obs_health
+from kafka_topic_analyzer_tpu.obs import history as obs_history
+from kafka_topic_analyzer_tpu.obs import metrics as obs_metrics
+from kafka_topic_analyzer_tpu.obs.exporters import PrometheusExporter
+from kafka_topic_analyzer_tpu.obs.flight import FlightRecorder
+from kafka_topic_analyzer_tpu.obs.health import AlertRule, HealthEngine
+from kafka_topic_analyzer_tpu.obs.history import HistoryStore
+from kafka_topic_analyzer_tpu.obs.registry import default_registry
+from kafka_topic_analyzer_tpu.serve import push as serve_push
+from kafka_topic_analyzer_tpu.serve import state as serve_state
+from kafka_topic_analyzer_tpu.serve.follow import FollowService
+from kafka_topic_analyzer_tpu.serve.push import SsePublisher
+from kafka_topic_analyzer_tpu.serve.state import ServiceState
+
+from fake_broker import FakeBroker
+
+pytestmark = pytest.mark.serveplane
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    default_registry().reset()
+    yield
+    default_registry().reset()
+    serve_state.set_active(None)
+    serve_push.set_active(None)
+    obs_flight.set_active(None)
+    obs_history.set_active(None)
+    obs_health.set_active(None)
+
+
+def _fetch(port, path, headers=None):
+    """(status, headers, body) — errors return their response too."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def wait_metric(predicate, timeout_s=5.0):
+    """Handlers book metrics AFTER writing the response, so a client
+    that just read the body can race the inc() — poll, don't assert."""
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.01)
+    return True
+
+
+def metric_total(name, **labels):
+    m = default_registry().snapshot().get(name)
+    if not m:
+        return 0.0
+    return sum(
+        s["value"] for s in m["samples"]
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def _doc(seq_hint: int, pad: int = 600) -> dict:
+    """A report-ish doc big enough to clear the gzip floor."""
+    return {"topic": "t", "hint": seq_hint, "pad": "x" * pad}
+
+
+# ---------------------------------------------------------------------------
+# /report.json: conditional GET + publish-time gzip
+
+
+def test_report_conditional_get_and_gzip_roundtrip():
+    svc = ServiceState()
+    serve_state.set_active(svc)
+    svc.publish(_doc(1))
+    exporter = PrometheusExporter(0)
+    try:
+        code, hdr, body = _fetch(exporter.port, "/report.json")
+        assert code == 200
+        assert hdr["ETag"] == '"r1"'
+        assert hdr["Content-Type"] == "application/json"
+        assert hdr["Cache-Control"] == "no-cache"
+        assert int(hdr["Content-Length"]) == len(body)
+        assert "Content-Encoding" not in hdr
+        assert json.loads(body)["seq"] == 1
+
+        # Conditional: zero body bytes, validator echoed.
+        nm0 = metric_total("kta_serve_not_modified_total")
+        code, hdr, body = _fetch(
+            exporter.port, "/report.json",
+            {"If-None-Match": '"r1"'},
+        )
+        assert (code, body) == (304, b"")
+        assert hdr["Content-Length"] == "0"
+        assert wait_metric(
+            lambda: metric_total("kta_serve_not_modified_total") == nm0 + 1
+        )
+
+        # The gzip variant: its own validator, identical content.
+        code, hdr, gz = _fetch(
+            exporter.port, "/report.json",
+            {"Accept-Encoding": "gzip"},
+        )
+        assert code == 200
+        assert hdr["Content-Encoding"] == "gzip"
+        assert hdr["ETag"] == '"r1+gzip"'
+        assert hdr["Vary"] == "Accept-Encoding"
+        assert int(hdr["Content-Length"]) == len(gz)
+        assert gzip.decompress(gz) == body or json.loads(
+            gzip.decompress(gz)
+        )["seq"] == 1
+        assert len(gz) < len(gzip.decompress(gz))
+        assert wait_metric(
+            lambda: metric_total("kta_serve_bytes_total", encoding="gzip") > 0
+        )
+
+        # Cross-variant 304: same seq = same content, either validator
+        # satisfies a conditional for either encoding.
+        code, _, body = _fetch(
+            exporter.port, "/report.json",
+            {"If-None-Match": '"r1"', "Accept-Encoding": "gzip"},
+        )
+        assert (code, body) == (304, b"")
+        # q=0 explicitly refuses gzip.
+        code, hdr, _ = _fetch(
+            exporter.port, "/report.json",
+            {"Accept-Encoding": "gzip;q=0"},
+        )
+        assert code == 200 and "Content-Encoding" not in hdr
+    finally:
+        exporter.close()
+
+
+def test_report_304_across_seq_bumps():
+    svc = ServiceState()
+    serve_state.set_active(svc)
+    svc.publish(_doc(1))
+    exporter = PrometheusExporter(0)
+    try:
+        _, hdr, _ = _fetch(exporter.port, "/report.json")
+        etag1 = hdr["ETag"]
+        code, _, _ = _fetch(
+            exporter.port, "/report.json", {"If-None-Match": etag1}
+        )
+        assert code == 304
+        # A new publish stales the validator: the SAME conditional now
+        # pays the full body, and its refreshed validator 304s again.
+        svc.publish(_doc(2))
+        code, hdr, body = _fetch(
+            exporter.port, "/report.json", {"If-None-Match": etag1}
+        )
+        assert code == 200
+        assert hdr["ETag"] == '"r2"'
+        assert json.loads(body)["seq"] == 2
+        code, _, _ = _fetch(
+            exporter.port, "/report.json", {"If-None-Match": hdr["ETag"]}
+        )
+        assert code == 304
+    finally:
+        exporter.close()
+
+
+def test_small_and_disabled_bodies_fall_back_to_identity():
+    # Below the gzip floor: no gzip variant exists, gzip readers get
+    # identity (visible in the encoding label, never an error).
+    svc = ServiceState()
+    serve_state.set_active(svc)
+    svc.publish({"topic": "t"})
+    exporter = PrometheusExporter(0)
+    try:
+        code, hdr, _ = _fetch(
+            exporter.port, "/report.json", {"Accept-Encoding": "gzip"}
+        )
+        assert code == 200 and "Content-Encoding" not in hdr
+        assert svc.entry().gzipped is None
+    finally:
+        exporter.close()
+    # --no-serve-gzip: large bodies stay identity too.
+    svc2 = ServiceState(gzip_enabled=False)
+    svc2.publish(_doc(1))
+    assert svc2.entry().gzipped is None
+
+
+def test_torn_triple_hammer_under_concurrent_publishes():
+    """Readers racing a publisher can never see a body from one publish
+    with a validator (or gzip variant) from another."""
+    svc = ServiceState()
+    serve_state.set_active(svc)
+    svc.publish(_doc(0))
+    exporter = PrometheusExporter(0)
+    stop = threading.Event()
+    errors = []
+
+    def publisher():
+        i = 1
+        while not stop.is_set():
+            svc.publish(_doc(i, pad=600 + (i % 7) * 40))
+            i += 1
+
+    def reader(gzip_on: bool):
+        hdr_in = {"Accept-Encoding": "gzip"} if gzip_on else {}
+        try:
+            while not stop.is_set():
+                code, hdr, body = _fetch(
+                    exporter.port, "/report.json", dict(hdr_in)
+                )
+                assert code == 200
+                raw = (
+                    gzip.decompress(body)
+                    if hdr.get("Content-Encoding") == "gzip"
+                    else body
+                )
+                doc = json.loads(raw)
+                etag_seq = int(
+                    hdr["ETag"].strip('"').replace("+gzip", "")[1:]
+                )
+                assert doc["seq"] == etag_seq, (doc["seq"], hdr["ETag"])
+                assert int(hdr["Content-Length"]) == len(body)
+        except BaseException as e:  # surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=publisher)] + [
+        threading.Thread(target=reader, args=(g,)) for g in (False, True)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(1.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+        exporter.close()
+    assert not errors, errors[0]
+
+
+# ---------------------------------------------------------------------------
+# /healthz + /flight validators
+
+
+def test_healthz_conditional_get_follows_evaluations():
+    eng = HealthEngine(
+        [AlertRule("r", "s", lambda ctx: ctx.extras.get("on"))]
+    )
+    obs_health.set_active(eng)
+    eng.evaluate()
+    exporter = PrometheusExporter(0)
+    try:
+        code, hdr, body = _fetch(exporter.port, "/healthz")
+        assert code == 200
+        etag = hdr["ETag"]
+        assert etag.startswith('"e')
+        assert json.loads(body)["healthy"] is True
+        code, _, got = _fetch(
+            exporter.port, "/healthz", {"If-None-Match": etag}
+        )
+        assert (code, got) == (304, b"")
+        # Every evaluation moves the validator, changed verdict or not.
+        eng.evaluate()
+        code, hdr, _ = _fetch(
+            exporter.port, "/healthz", {"If-None-Match": etag}
+        )
+        assert code == 200 and hdr["ETag"] != etag
+    finally:
+        exporter.close()
+
+
+def test_flight_conditional_get_follows_samples():
+    rec = FlightRecorder()
+    obs_flight.set_active(rec)
+    rec.sample_once()
+    exporter = PrometheusExporter(0)
+    try:
+        code, hdr, body = _fetch(exporter.port, "/flight")
+        assert code == 200
+        etag = hdr["ETag"]
+        assert etag.startswith('"f')
+        json.loads(body)  # valid series doc
+        code, _, got = _fetch(
+            exporter.port, "/flight", {"If-None-Match": etag}
+        )
+        assert (code, got) == (304, b"")
+        rec.sample_once()
+        code, hdr, _ = _fetch(
+            exporter.port, "/flight", {"If-None-Match": etag}
+        )
+        assert code == 200 and hdr["ETag"] != etag
+    finally:
+        exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# /history: pricing + validators
+
+
+def _seeded_store(tmp_path, n=32):
+    store = HistoryStore(str(tmp_path / "hist"))
+    store.register_kinds({"cnt": "cum", "g": "inst"})
+    for i in range(n):
+        store.append({"cnt": float(i), "g": float(i % 4)}, t=float(i))
+    return store
+
+
+def test_history_max_points_prices_from_tiers(tmp_path):
+    store = _seeded_store(tmp_path)
+    obs_history.set_active(store)
+    exporter = PrometheusExporter(0)
+    try:
+        # Unpriced: every tier-0 row.
+        _, _, body = _fetch(exporter.port, "/history")
+        full = json.loads(body)
+        assert len(full["t"]) == 32
+        assert "max_points" not in full
+
+        # Priced: the coarsest satisfying RRD tier answers.
+        _, _, body = _fetch(exporter.port, "/history?max_points=8")
+        priced = json.loads(body)
+        assert priced["points"] == len(priced["t"]) <= 8
+        assert priced["max_points"] == 8
+        assert priced["decimated"] is False
+        # Cum tracks keep the LAST value at the surviving points —
+        # the window's final delta is exact.
+        assert priced["tracks"]["cnt"][-1] == full["tracks"]["cnt"][-1]
+
+        # Below every tier: stride decimation, still keep-last.
+        _, _, body = _fetch(exporter.port, "/history?max_points=3")
+        dec = json.loads(body)
+        assert dec["points"] <= 3 and dec["decimated"] is True
+        assert dec["tracks"]["cnt"][-1] == full["tracks"]["cnt"][-1]
+
+        # Track filtering happens before serialization.
+        _, _, body = _fetch(
+            exporter.port, "/history?tracks=cnt&max_points=8"
+        )
+        only = json.loads(body)
+        assert set(only["tracks"]) == {"cnt"}
+
+        # Bad params are clean JSON 400s.
+        for q in ("?max_points=0", "?max_points=zero", "?t0=notatime"):
+            code, hdr, body = _fetch(exporter.port, f"/history{q}")
+            assert code == 400
+            assert hdr["Content-Type"] == "application/json"
+            assert "error" in json.loads(body)
+    finally:
+        exporter.close()
+        store.close()
+
+
+def test_history_etag_covers_data_and_query(tmp_path):
+    store = _seeded_store(tmp_path, n=8)
+    obs_history.set_active(store)
+    exporter = PrometheusExporter(0)
+    try:
+        _, hdr, _ = _fetch(exporter.port, "/history?max_points=4")
+        etag = hdr["ETag"]
+        code, _, body = _fetch(
+            exporter.port, "/history?max_points=4",
+            {"If-None-Match": etag},
+        )
+        assert (code, body) == (304, b"")
+        # A different question never matches the old answer's validator.
+        code, hdr2, _ = _fetch(
+            exporter.port, "/history?max_points=2",
+            {"If-None-Match": etag},
+        )
+        assert code == 200 and hdr2["ETag"] != etag
+        # New data stales every query's validator.
+        store.append({"cnt": 99.0, "g": 1.0}, t=100.0)
+        code, hdr3, _ = _fetch(
+            exporter.port, "/history?max_points=4",
+            {"If-None-Match": etag},
+        )
+        assert code == 200 and hdr3["ETag"] != etag
+    finally:
+        exporter.close()
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# /events: SSE push
+
+
+def _read_sse_frame(resp, timeout_s=5.0):
+    """Read one frame (lines up to a blank line), skipping comments."""
+    deadline = time.monotonic() + timeout_s
+    lines = []
+    while time.monotonic() < deadline:
+        line = resp.readline()
+        if not line:
+            return None
+        line = line.rstrip(b"\r\n")
+        if line.startswith(b":"):
+            continue  # comment (stream-open / keepalive)
+        if line == b"":
+            if lines:
+                return lines
+            continue
+        lines.append(line)
+    raise AssertionError("no SSE frame within the timeout")
+
+
+def test_sse_stream_over_http_pushes_publishes():
+    svc = ServiceState()
+    serve_state.set_active(svc)
+    pub = SsePublisher().start()
+    serve_push.set_active(pub)
+    exporter = PrometheusExporter(0)
+    conn = http.client.HTTPConnection("127.0.0.1", exporter.port, timeout=5)
+    try:
+        conn.request("GET", "/events")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        assert resp.headers["Cache-Control"] == "no-store"
+        assert resp.headers.get("Connection") == "close"
+        # Let the subscribe land before publishing so the frame is live,
+        # not catch-up.
+        deadline = time.monotonic() + 5
+        while metric_total("kta_serve_sse_subscribers") < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        svc.publish(_doc(1), summary={"records": 7})
+        frame = _read_sse_frame(resp)
+        assert frame is not None
+        fields = dict(
+            line.split(b": ", 1) for line in frame if b": " in line
+        )
+        assert fields[b"event"] == b"publish"
+        assert int(fields[b"id"]) == 1
+        data = json.loads(fields[b"data"])
+        assert data["seq"] == 1 and data["records"] == 7
+        assert wait_metric(
+            lambda: metric_total("kta_serve_bytes_total", encoding="sse") > 0
+        )
+    finally:
+        conn.close()
+        pub.stop()
+        exporter.close()
+    assert wait_metric(
+        lambda: metric_total("kta_serve_sse_subscribers") == 0
+    )
+
+
+def test_sse_catchup_eviction_and_resync():
+    pub = SsePublisher(queue_len=2).start()
+    serve_push.set_active(pub)
+    svc = ServiceState()
+    try:
+        # Catch-up: a late subscriber gets the latest frame on connect.
+        svc.publish(_doc(1), summary={"records": 1})
+        deadline = time.monotonic() + 5
+        while pub._last_frame is None:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        sub = pub.subscribe()
+        frame = sub.next_frame(timeout=5)
+        assert b"id: 1" in frame
+
+        # Slow client: the queue bound evicts (booked), never blocks the
+        # publisher; the close sentinel ends the stream.
+        d0 = metric_total(
+            "kta_serve_sse_dropped_total", reason="slow-client"
+        )
+        for i in range(2, 12):
+            svc.publish(_doc(i), summary={"records": i})
+        deadline = time.monotonic() + 5
+        while metric_total(
+            "kta_serve_sse_dropped_total", reason="slow-client"
+        ) <= d0:
+            assert time.monotonic() < deadline, "eviction never booked"
+            time.sleep(0.01)
+        got = []
+        while True:
+            try:
+                f = sub.next_frame(timeout=0.5)
+            except queue.Empty:
+                pytest.fail("evicted stream not closed")
+            if f is None:
+                break
+            got.append(f)
+        assert len(got) <= 2  # bounded: never more than the queue held
+
+        # Re-sync: a fresh subscribe catches up at the LATEST seq (wait
+        # out the publisher thread draining its batch first).
+        deadline = time.monotonic() + 5
+        while b"id: 11" not in (pub._last_frame or b""):
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        sub2 = pub.subscribe()
+        frame = sub2.next_frame(timeout=5)
+        assert b"id: 11" in frame
+        pub.unsubscribe(sub2)
+    finally:
+        pub.stop()
+        serve_push.set_active(None)
+    assert metric_total("kta_serve_sse_subscribers") == 0
+    assert metric_total(
+        "kta_serve_sse_dropped_total", reason="shutdown"
+    ) >= 0
+
+
+def test_events_404_without_publisher():
+    exporter = PrometheusExporter(0)
+    try:
+        code, hdr, body = _fetch(exporter.port, "/events")
+        assert code == 404
+        assert "--sse" in json.loads(body)["error"]
+        assert int(hdr["Content-Length"]) == len(body)
+    finally:
+        exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# header discipline + keep-alive
+
+
+def test_header_discipline_on_every_status():
+    svc = ServiceState()
+    exporter = PrometheusExporter(0)
+    try:
+        cases = [
+            ("/report.json", 404),   # no service registered
+            ("/healthz", 404),       # no engine
+            ("/history", 404),       # no store
+            ("/flight", 404),        # no recorder
+            ("/nope", 404),          # unknown route
+        ]
+        for path, want in cases:
+            code, hdr, body = _fetch(exporter.port, path)
+            assert code == want, path
+            assert hdr["Content-Type"] == "application/json", path
+            assert int(hdr["Content-Length"]) == len(body), path
+            json.loads(body)
+        serve_state.set_active(svc)
+        code, hdr, body = _fetch(exporter.port, "/report.json")
+        assert code == 503  # registered but nothing published yet
+        assert int(hdr["Content-Length"]) == len(body)
+        code, hdr, body = _fetch(
+            exporter.port, "/report.json?topic=ghost"
+        )
+        assert code == 404 and b"ghost" in body
+    finally:
+        exporter.close()
+
+
+def test_keepalive_survives_mixed_statuses_on_one_connection():
+    """HTTP/1.1 framing is exact enough that 200/304/404/503 can share
+    one socket — a 1 Hz poller keeps a single connection."""
+    svc = ServiceState()
+    serve_state.set_active(svc)
+    svc.publish(_doc(1))
+    exporter = PrometheusExporter(0)
+    conn = http.client.HTTPConnection("127.0.0.1", exporter.port, timeout=5)
+    try:
+        seq = [
+            ("/report.json", {}, 200),
+            ("/report.json", {"If-None-Match": '"r1"'}, 304),
+            ("/healthz", {}, 404),
+            ("/report.json?topic=ghost", {}, 404),
+            ("/report.json", {"Accept-Encoding": "gzip"}, 200),
+            ("/metrics", {}, 200),
+        ]
+        for path, hdrs, want in seq:
+            conn.request("GET", path, headers=hdrs)
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == want, path
+            if want == 304:
+                assert body == b""
+        # The socket was reused throughout: requests_total book matches.
+        assert wait_metric(
+            lambda: metric_total(
+                "kta_serve_requests_total", route="/report.json"
+            ) == 4.0
+        )
+    finally:
+        conn.close()
+        exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: serving plane on + pollers hammering vs bare referee
+
+
+N_PARTS = 2
+
+
+def _mk_records(partition, n):
+    return [
+        (
+            i,
+            1_600_000_000_000 + i * 1000,
+            f"k{partition}-{i % 17}".encode() if i % 5 else None,
+            bytes(18 + (i % 11)) if i % 7 else None,
+        )
+        for i in range(n)
+    ]
+
+
+def _scan_cfg():
+    return AnalyzerConfig(
+        num_partitions=N_PARTS, batch_size=64,
+        count_alive_keys=True, alive_bitmap_bits=16,
+        enable_hll=True, hll_p=8,
+    )
+
+
+def _full_doc(result):
+    return {
+        "metrics": result.metrics.to_dict(
+            result.start_offsets, result.end_offsets
+        ),
+        "degraded": result.degraded_partitions,
+        "corrupt": result.corrupt_partitions,
+    }
+
+
+def test_scan_identity_with_serving_plane_under_load(tmp_path):
+    records = {p: _mk_records(p, 200) for p in range(N_PARTS)}
+
+    with FakeBroker("serve.topic", records, max_records_per_fetch=48) as b:
+        src = KafkaWireSource(
+            f"127.0.0.1:{b.port}", "serve.topic",
+            overrides={"retry.backoff.ms": "5"},
+        )
+        referee = _full_doc(run_scan(
+            "serve.topic", src,
+            TpuBackend(_scan_cfg(), init_now_s=10**10), 64,
+        ))
+        src.close()
+    default_registry().reset()
+
+    pub = SsePublisher().start()
+    serve_push.set_active(pub)
+    exporter = PrometheusExporter(0)
+    stop = threading.Event()
+    poll_errors = []
+
+    def poller(gz):
+        etag = None
+        while not stop.is_set():
+            try:
+                hdrs = {"Accept-Encoding": "gzip"} if gz else {}
+                if etag:
+                    hdrs["If-None-Match"] = etag
+                code, hdr, _ = _fetch(exporter.port, "/report.json", hdrs)
+                if code == 200:
+                    etag = hdr.get("ETag")
+                elif code not in (304, 404, 503):
+                    raise AssertionError(f"poller got {code}")
+            except (OSError, urllib.error.URLError):
+                pass  # teardown race
+            except BaseException as e:
+                poll_errors.append(e)
+                return
+
+    pollers = [
+        threading.Thread(target=poller, args=(g,))
+        for g in (False, True, False)
+    ]
+    try:
+        for t in pollers:
+            t.start()
+        follow = FollowConfig(
+            poll_interval_s=0.02, idle_backoff_max_s=0.05,
+            idle_exit_s=0.6,
+        )
+        with FakeBroker("serve.topic", records,
+                        max_records_per_fetch=48) as broker:
+            src = KafkaWireSource(
+                f"127.0.0.1:{broker.port}", "serve.topic",
+                overrides={"retry.backoff.ms": "5"},
+            )
+            svc = FollowService(
+                "serve.topic", src,
+                TpuBackend(_scan_cfg(), init_now_s=10**10), 64, follow,
+            )
+            result = svc.run()
+            src.close()
+    finally:
+        stop.set()
+        for t in pollers:
+            t.join(10)
+        pub.stop()
+        exporter.close()
+
+    assert not poll_errors, poll_errors[0]
+    assert _full_doc(result) == referee
+    # The plane actually served while the scan ran.
+    assert metric_total("kta_serve_requests_total") > 0

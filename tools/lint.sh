@@ -718,13 +718,23 @@ EOF
 # code (do_* methods / BaseHTTPRequestHandler subclasses) under serve/
 # and obs/exporters.py may not call into the drive loop or fold state
 # (run/update/finalize/get_state/..., the source read loop, the window
-# fold), and may not take locks of its own (.acquire / `with <lock>`):
-# everything a handler serves must come through a designated snapshot
-# accessor — serve.state.ServiceState.report_bytes/snapshot, the flight
-# recorder's series(), or render_prometheus over a registry snapshot —
-# whose single-reference-swap locking is owned by the publishing side.
-# A scrape is then O(1) reads, and a slow client can never hold a lock
-# the fold path wants (DESIGN.md §18 snapshot-consistency rule).
+# fold), may not take locks of its own (.acquire / `with <lock>`), and
+# may not SERIALIZE (json.dumps / gzip.compress / GzipFile): encoding
+# happens ONCE on the publishing side (serve/state.py's publish-time
+# triple, history/flight's *_bytes accessors) — a handler that
+# serializes per request turns N pollers into N encodes and re-creates
+# the very cost the conditional-GET plane removes.  Everything a handler
+# serves must come through a designated snapshot accessor —
+# ServiceState.entry, healthz_entry, window_etag/window_bytes,
+# series_etag/series_bytes, subscribe/next_frame, or render_prometheus
+# over a registry snapshot — whose single-reference-swap locking is
+# owned by the publishing side.  A scrape is then O(headers) work, and a
+# slow client can never hold a lock the fold path wants (DESIGN.md §18
+# snapshot-consistency rule, §26 read path).  The SSE publisher
+# (serve/push.py SsePublisher) is the one piece of serving-plane code
+# with its own thread + lock, so it gets the complementary no-fold-state
+# check: its methods may never reach a drive-loop entry point either —
+# it consumes published events, it never drives publishing.
 python - <<'EOF'
 import ast
 import pathlib
@@ -753,11 +763,18 @@ DRIVE_CALLS = {
     "evaluate", "maybe_evaluate", "append",
 }
 #: The sanctioned read-only snapshot accessors.  /healthz reads the
-#: engine's pre-serialized verdict; /history reads the store's windowed
-#: in-memory mirror under the store's own lock.
+#: engine's pre-serialized verdict; /history and /flight read their
+#: stores' pre-encoded (body, etag) pairs under the stores' own locks;
+#: /report.json reads the publish-time (raw, gzipped, etag) triple;
+#: /events reads pre-formatted frames off its subscriber queue.
 ACCESSORS = {"report_bytes", "snapshot", "series", "active",
              "render_prometheus", "healthz", "window", "doc",
-             "alerts_block"}
+             "alerts_block", "entry", "healthz_entry",
+             "window_etag", "window_bytes", "series_etag", "series_bytes",
+             "subscribe", "unsubscribe", "next_frame"}
+#: Per-request serialization is forbidden in handlers: encoding is paid
+#: once at publish time, never per scrape (DESIGN.md §26).
+SERIALIZERS = {"dumps", "dump", "compress", "GzipFile"}
 
 failures = []
 for path in SCOPE:
@@ -797,6 +814,13 @@ for path in SCOPE:
                         f"drive-loop/fold-state entry point {name!r} — serve "
                         "from the designated snapshot accessor instead"
                     )
+                if name in SERIALIZERS:
+                    failures.append(
+                        f"{path}:{node.lineno}: HTTP handler {qual!r} "
+                        f"serializes per request ({name!r}) — encoding is "
+                        "paid once at publish time (serve/state.py, the "
+                        "history/flight *_bytes accessors), never per scrape"
+                    )
                 if name == "acquire":
                     failures.append(
                         f"{path}:{node.lineno}: HTTP handler {qual!r} takes "
@@ -813,14 +837,45 @@ for path in SCOPE:
                             "pre-published snapshots instead"
                         )
 
+# The SSE publisher's no-fold-state check: SsePublisher consumes the
+# publish stream, it must never drive it.  Its own intake deque/subscriber
+# list mutations (.append) and its own lock are its sanctioned machinery,
+# so only the fold/drive entry points are forbidden — not container
+# mutators or locking.
+PUSH = PKG / "serve" / "push.py"
+FOLD_CALLS = DRIVE_CALLS - {"append", "request_stop"}
+push_tree = ast.parse(PUSH.read_text(encoding="utf-8"), filename=str(PUSH))
+for node in ast.walk(push_tree):
+    if not (isinstance(node, ast.ClassDef) and node.name == "SsePublisher"):
+        continue
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in ast.walk(item):
+            if isinstance(n, ast.Call):
+                name = None
+                if isinstance(n.func, ast.Attribute):
+                    name = n.func.attr
+                elif isinstance(n.func, ast.Name):
+                    name = n.func.id
+                if name in FOLD_CALLS and name not in ACCESSORS:
+                    failures.append(
+                        f"{PUSH}:{n.lineno}: SsePublisher.{item.name} calls "
+                        f"drive-loop/fold-state entry point {name!r} — the "
+                        "publisher consumes published events, it never "
+                        "drives publishing"
+                    )
+
 if failures:
     print("lint: service HTTP handlers must read only designated snapshot")
-    print("lint: accessors (no drive-loop calls, no fold-state locks —")
-    print("lint: a slow scrape can never stall ingest; DESIGN.md §18):")
+    print("lint: accessors (no drive-loop calls, no per-request")
+    print("lint: serialization, no fold-state locks — a slow scrape can")
+    print("lint: never stall ingest; DESIGN.md §18/§26):")
     for f in failures:
         print(f"  {f}")
     sys.exit(1)
-print("lint: OK (service HTTP handlers read only published snapshots)")
+print("lint: OK (service HTTP handlers read only published snapshots; "
+      "SSE publisher drives nothing)")
 EOF
 
 # Tenth rule: the fleet admission layer is PURE BOOKKEEPING.  (a) The
